@@ -1,0 +1,70 @@
+"""tensorflowonspark_tpu — a TPU-native cluster ML framework.
+
+A from-scratch, TPU-first rebuild of the capabilities of TensorFlowOnSpark
+(reference: tensorflowonspark/__init__.py): it turns a data-processing cluster
+(Spark, or a local multi-process pool) into a distributed JAX/XLA training and
+inference cluster.  Where the reference wires Spark executors into a
+TensorFlow gRPC cluster via TF_CONFIG, this framework bootstraps one JAX
+process per TPU host, builds a global `jax.sharding.Mesh`, runs pjit-sharded
+train steps with gradient allreduce over ICI/DCN, and streams RDD/DataFrame
+partitions into HBM through a chunked, prefetching DataFeed.
+
+Public surface (lazily imported to keep `import tensorflowonspark_tpu` cheap):
+
+- ``cluster``        — TPUCluster.run/train/inference/shutdown (maps TFCluster.py)
+- ``node``           — per-executor bootstrap closures        (maps TFSparkNode.py)
+- ``feed``           — DataFeed + path utilities              (maps TFNode.py)
+- ``reservation``    — rendezvous server/client               (maps reservation.py)
+- ``manager``        — queue/kv IPC manager                   (maps TFManager.py)
+- ``tpu_info``       — accelerator discovery                  (maps gpu_info.py)
+- ``dfutil``         — DataFrame/iterator ⇄ TFRecord          (maps dfutil.py)
+- ``pipeline``       — ML-pipeline Estimator/Model            (maps pipeline.py)
+- ``parallel_run``   — embarrassingly-parallel runner         (maps TFParallel.py)
+- ``parallel``       — mesh / sharding / train-step harness   (TPU-native, net-new)
+- ``models``, ``ops`` — model zoo and Pallas kernels          (TPU-native, net-new)
+"""
+import logging
+
+# Mirror the reference's package-level logging init (reference:
+# tensorflowonspark/__init__.py:3) — thread+process ids matter because the
+# runtime spans feeder processes, manager processes and the JAX process.
+logging.basicConfig(
+    level=logging.INFO,
+    format="%(asctime)s %(levelname)s (%(threadName)s-%(process)d) %(message)s",
+)
+
+__version__ = "0.1.0"
+
+_LAZY_SUBMODULES = {
+    "cluster", "node", "feed", "reservation", "manager", "tpu_info", "util",
+    "compat", "marker", "dfutil", "tfrecord", "pipeline", "parallel_run",
+    "backend", "parallel", "models", "ops", "utils",
+}
+
+_LAZY_ATTRS = {
+    # attr -> (module, name)
+    "TPUCluster": ("tensorflowonspark_tpu.cluster", "TPUCluster"),
+    "InputMode": ("tensorflowonspark_tpu.cluster", "InputMode"),
+    "run": ("tensorflowonspark_tpu.cluster", "run"),
+    "DataFeed": ("tensorflowonspark_tpu.feed", "DataFeed"),
+    "NodeContext": ("tensorflowonspark_tpu.node", "NodeContext"),
+}
+
+
+def __getattr__(name):
+    import importlib
+    try:
+        if name in _LAZY_SUBMODULES:
+            return importlib.import_module(f"tensorflowonspark_tpu.{name}")
+        if name in _LAZY_ATTRS:
+            mod, attr = _LAZY_ATTRS[name]
+            return getattr(importlib.import_module(mod), attr)
+    except ModuleNotFoundError as e:
+        # hasattr()/feature-detection must see AttributeError, not an import
+        # error escaping through the lazy loader.
+        raise AttributeError(f"lazy import of {name!r} failed: {e}") from e
+    raise AttributeError(f"module 'tensorflowonspark_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | _LAZY_SUBMODULES | set(_LAZY_ATTRS))

@@ -1,0 +1,44 @@
+"""Version shims for the JAX stack (maps reference compat.py:1-31).
+
+The reference shimmed TF1/TF2 API drift; here we pin down the couple of JAX
+API locations that have moved across releases so the rest of the codebase
+imports from one place.
+"""
+
+
+def tree_map(f, *trees):
+    import jax
+    if hasattr(jax, "tree"):
+        return jax.tree.map(f, *trees)
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def shard_map():
+    """Return the shard_map callable across jax versions."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def make_mesh(axis_shapes, axis_names, devices=None):
+    """Build a Mesh; prefers jax.make_mesh (better device ordering for ICI)."""
+    import jax
+    import numpy as np
+    if devices is None and hasattr(jax, "make_mesh"):
+        return jax.make_mesh(tuple(axis_shapes), tuple(axis_names))
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return jax.sharding.Mesh(devs.reshape(tuple(axis_shapes)), tuple(axis_names))
+
+
+def export_chief_only(save_fn, is_chief, *args, **kwargs):
+    """Run a model-export function on the chief only (reference: compat.py:10-17).
+
+    The reference had non-chief workers save to a throwaway local dir because
+    MultiWorkerMirroredStrategy required symmetric saves; JAX has no such
+    requirement, so non-chief is a no-op.
+    """
+    if is_chief:
+        return save_fn(*args, **kwargs)
+    return None

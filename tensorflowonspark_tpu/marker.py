@@ -1,0 +1,40 @@
+"""Sentinel markers placed in data queues (maps reference marker.py:1-18).
+
+The feeder side pushes these into the ``input`` queue to signal structural
+events to the consumer (`feed.DataFeed`):
+
+- ``None`` (not a class here, by convention) — end of the entire feed.
+- ``EndPartition`` — end of one upstream partition; used during inference so
+  the consumer can flush exactly one result per input record before results
+  for the next partition begin (reference: TFSparkNode.py:541-546).
+- ``Chunk`` — a TPU-native addition: a batched list of records pushed as ONE
+  queue item.  Per-item pickled queue puts are the reference design's
+  throughput ceiling (SURVEY.md §7); chunked transfer amortizes IPC cost.
+"""
+
+
+class Marker:
+    """Base class for data-queue sentinels."""
+
+
+class EndPartition(Marker):
+    """Marks the end of one input partition within the feed."""
+
+
+class Chunk:
+    """A list of records transported as a single queue item.
+
+    Not a Marker: it carries payload.  ``items`` is a plain list so it pickles
+    cheaply through the multiprocessing proxy.
+    """
+
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = items
+
+    def __len__(self):
+        return len(self.items)
+
+    def __repr__(self):
+        return f"Chunk(n={len(self.items)})"

@@ -1,0 +1,298 @@
+"""Cluster rendezvous: reservation server + client.
+
+Maps the reference's cleanest component (reference: reservation.py:31-301) with
+two deliberate re-designs for the TPU build:
+
+1. **msgpack framing, not pickle.**  The reference exchanges pickled dicts
+   (reference: reservation.py:68-97); pickle over TCP executes arbitrary code
+   from untrusted peers.  We keep the 4-byte big-endian length prefix but the
+   payload is msgpack (bytes-safe, no code execution).
+
+2. **The server hands out JAX-distributed bootstrap info.**  The reference's
+   clients scout free ports and the server aggregates them into a TF
+   ClusterSpec.  On TPU, the XLA runtime owns interconnect setup, so nodes
+   register host metadata and the aggregate reservation list yields
+   ``(coordinator_addr, num_processes, process_id)`` for
+   ``jax.distributed.initialize`` (SURVEY.md §2.4).
+
+Message types (reference: reservation.py:130-146 had REG/QUERY/QINFO/STOP):
+
+- ``REG``   {node: {...meta}}          -> ``OK``
+- ``QUERY`` {}                         -> ``QUERY`` {done: bool, count: int}
+- ``QINFO`` {}                         -> ``QINFO`` {nodes: [...]}
+- ``ERROR`` {node, error: str}         -> ``OK``       (net-new: failure detection)
+- ``STOP``  {}                         -> ``OK``, server shuts down
+"""
+import logging
+import os
+import select
+import socket
+import struct
+import threading
+import time
+
+import msgpack
+
+from . import util
+
+logger = logging.getLogger(__name__)
+
+# Env overrides for the server bind address (reference: reservation.py:25-26).
+SERVER_HOST_ENV = "TFOS_TPU_SERVER_HOST"
+SERVER_PORT_ENV = "TFOS_TPU_SERVER_PORT"
+
+CONNECT_RETRIES = 3
+CONNECT_RETRY_DELAY_SECS = 2
+
+
+class Reservations:
+    """Thread-safe registry of node reservations (reference: reservation.py:31-65)."""
+
+    def __init__(self, required):
+        self.required = required
+        self._lock = threading.RLock()
+        self._nodes = []
+        self._errors = []
+
+    def add(self, meta):
+        with self._lock:
+            self._nodes.append(meta)
+
+    def done(self):
+        with self._lock:
+            return len(self._nodes) >= self.required
+
+    def get(self):
+        with self._lock:
+            return list(self._nodes)
+
+    def remaining(self):
+        with self._lock:
+            return self.required - len(self._nodes)
+
+    def add_error(self, err):
+        with self._lock:
+            self._errors.append(err)
+
+    def get_errors(self):
+        with self._lock:
+            return list(self._errors)
+
+
+class MessageSocket:
+    """Length-prefixed msgpack messages over a socket (reference: reservation.py:68-97)."""
+
+    MAX_FRAME_BYTES = 64 * 1024 * 1024  # rendezvous messages are small
+
+    def receive(self, sock):
+        header = self._recv_exact(sock, 4)
+        (length,) = struct.unpack(">I", header)
+        if length > self.MAX_FRAME_BYTES:
+            raise ValueError(f"frame of {length} bytes exceeds protocol limit")
+        payload = self._recv_exact(sock, length)
+        return msgpack.unpackb(payload, raw=False)
+
+    def send(self, sock, msg):
+        payload = msgpack.packb(msg, use_bin_type=True)
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+    @staticmethod
+    def _recv_exact(sock, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("socket closed mid-message")
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+class Server(MessageSocket):
+    """Driver-side rendezvous server (reference: reservation.py:100-231).
+
+    Runs a selector loop on a daemon thread; the driver blocks in
+    `await_reservations` until all `count` nodes registered (or error/timeout).
+    """
+
+    def __init__(self, count):
+        assert count > 0
+        self.reservations = Reservations(count)
+        self.done = threading.Event()
+        self._sock = None
+
+    def start(self):
+        """Bind per env overrides and start the listener thread; return (host, port)."""
+        host = os.environ.get(SERVER_HOST_ENV, util.get_ip_address())
+        port_spec = os.environ.get(SERVER_PORT_ENV)
+        ports = util.parse_port_spec(port_spec) if port_spec else None
+        self._sock = util.bind_socket(host, ports)
+        addr = (host, self._sock.getsockname()[1])
+        logger.info("reservation server listening on %s", addr)
+        t = threading.Thread(target=self._serve, name="reservation-server", daemon=True)
+        t.start()
+        return addr
+
+    @property
+    def address(self):
+        host, port = self._sock.getsockname()
+        return (host, port)
+
+    def _serve(self):
+        conns = [self._sock]
+        while not self.done.is_set():
+            try:
+                readable, _, _ = select.select(conns, [], [], 1.0)
+            except OSError:
+                break  # listener closed during shutdown
+            for s in readable:
+                if s is self._sock:
+                    try:
+                        client, _ = self._sock.accept()
+                        # A peer that stalls mid-frame must not wedge the
+                        # single serve thread: bound each read so the peer is
+                        # dropped instead (select readiness only guarantees
+                        # >=1 byte, not a whole frame).
+                        client.settimeout(10.0)
+                        conns.append(client)
+                    except OSError:
+                        pass
+                else:
+                    try:
+                        msg = self.receive(s)
+                        self._dispatch(s, msg)
+                    except Exception as e:
+                        # A malformed frame from one peer must never kill the
+                        # rendezvous loop for everyone else: drop that peer.
+                        if not isinstance(e, (ConnectionError, OSError)):
+                            logger.warning("dropping connection after bad message: %s", e)
+                        conns.remove(s)
+                        s.close()
+        for s in conns:
+            s.close()
+
+    def _dispatch(self, sock, msg):
+        mtype = msg.get("type")
+        if mtype == "REG":
+            self.reservations.add(msg["node"])
+            logger.info("registered node: %s", msg["node"])
+            self.send(sock, {"type": "OK"})
+        elif mtype == "QUERY":
+            self.send(sock, {
+                "type": "QUERY",
+                "done": self.reservations.done(),
+                "count": len(self.reservations.get()),
+                "required": self.reservations.required,
+            })
+        elif mtype == "QINFO":
+            self.send(sock, {"type": "QINFO", "nodes": self.reservations.get()})
+        elif mtype == "ERROR":
+            logger.error("node reported error: %s", msg.get("error"))
+            self.reservations.add_error(
+                {"node": msg.get("node"), "error": msg.get("error", "")})
+            self.send(sock, {"type": "OK"})
+        elif mtype == "STOP":
+            logger.info("received STOP, shutting down reservation server")
+            self.send(sock, {"type": "OK"})
+            self.stop()
+        else:
+            self.send(sock, {"type": "ERR", "error": f"unknown message {mtype!r}"})
+
+    def await_reservations(self, timeout=600, status=None):
+        """Block until all nodes registered (reference: reservation.py:113-128).
+
+        `status` is an optional mutable mapping with an 'error' key set by the
+        launch thread (reference TFCluster's tf_status) — aborts early if set.
+        Node-reported ERROR messages abort as well (net-new failure detection).
+        """
+        deadline = time.time() + timeout
+        while not self.reservations.done():
+            if status is not None and status.get("error"):
+                raise RuntimeError(f"cluster launch failed: {status['error']}")
+            errs = self.reservations.get_errors()
+            if errs:
+                raise RuntimeError(f"node(s) failed during startup: {errs}")
+            logger.info("waiting for %d reservations", self.reservations.remaining())
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {self.reservations.remaining()} "
+                    f"of {self.reservations.required} reservations")
+            time.sleep(1)
+        logger.info("all %d reservations completed", self.reservations.required)
+        return self.reservations.get()
+
+    def stop(self):
+        self.done.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class Client(MessageSocket):
+    """Executor-side rendezvous client (reference: reservation.py:234-301)."""
+
+    def __init__(self, server_addr):
+        self.server_addr = (server_addr[0], int(server_addr[1]))
+        self._sock = self._connect()
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        last = None
+        for attempt in range(CONNECT_RETRIES):
+            try:
+                s = socket.create_connection(self.server_addr, timeout=30)
+                # Keep a bounded per-RPC timeout: if the server host dies
+                # without RST, a blocked receive() must not hang the executor
+                # forever (await_reservations' deadline only runs between
+                # RPCs).  Rendezvous RPCs complete in milliseconds.
+                s.settimeout(60.0)
+                return s
+            except OSError as e:
+                last = e
+                logger.warning("connect to %s failed (%s); retry %d/%d",
+                               self.server_addr, e, attempt + 1, CONNECT_RETRIES)
+                time.sleep(CONNECT_RETRY_DELAY_SECS * (attempt + 1))
+        raise ConnectionError(f"could not reach reservation server at {self.server_addr}: {last}")
+
+    def _request(self, msg):
+        with self._lock:
+            self.send(self._sock, msg)
+            return self.receive(self._sock)
+
+    def register(self, node_meta):
+        return self._request({"type": "REG", "node": node_meta})
+
+    def query(self):
+        return self._request({"type": "QUERY"})
+
+    def get_reservations(self):
+        return self._request({"type": "QINFO"})["nodes"]
+
+    def await_reservations(self, timeout=600):
+        """Poll until the cluster is fully registered; return the node list."""
+        deadline = time.time() + timeout
+        while True:
+            resp = self.query()
+            if resp.get("done"):
+                return self.get_reservations()
+            if time.time() > deadline:
+                raise TimeoutError("timed out awaiting cluster reservations")
+            time.sleep(1)
+
+    def report_error(self, node_meta, error):
+        try:
+            return self._request({"type": "ERROR", "node": node_meta, "error": str(error)})
+        except OSError:
+            logger.warning("could not report error to reservation server")
+
+    def request_stop(self):
+        try:
+            return self._request({"type": "STOP"})
+        except (ConnectionError, OSError):
+            return {"type": "OK"}  # server already gone
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

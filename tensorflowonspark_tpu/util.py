@@ -1,0 +1,129 @@
+"""Host/environment utilities (maps reference util.py:1-94).
+
+Pure-Python helpers with no JAX dependency so the coordination layer can be
+imported and unit-tested without paying accelerator-runtime startup.
+"""
+import errno
+import logging
+import os
+import socket
+
+logger = logging.getLogger(__name__)
+
+EXECUTOR_ID_FILE = "executor_id"
+
+
+def get_ip_address():
+    """Best-effort routable IP of this host.
+
+    Uses the UDP-connect trick (reference: util.py:52-65): no packets are
+    sent; the kernel just picks the interface that would route to the target.
+    Falls back to loopback when the host is offline.
+    """
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def get_free_port(host=""):
+    """Reserve an ephemeral TCP port and return it (racy but adequate)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def parse_port_spec(spec):
+    """Parse a port env var: '8080' -> [8080]; '8000-8010' -> [8000..8010].
+
+    Mirrors the reference's TFOS_SERVER_PORT range support
+    (reference: reservation.py:190-206).
+    """
+    spec = str(spec).strip()
+    if "-" in spec:
+        lo, hi = spec.split("-", 1)
+        lo, hi = int(lo), int(hi)
+        if hi < lo:
+            raise ValueError(f"invalid port range: {spec}")
+        return list(range(lo, hi + 1))
+    return [int(spec)]
+
+
+def bind_socket(host, ports=None):
+    """Bind a listening TCP socket on `host`.
+
+    `ports` is None (ephemeral) or a list of candidate ports tried in order
+    (reference: reservation.py:190-206).  Returns the bound, listening socket.
+    """
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if not ports:
+        sock.bind((host, 0))
+    else:
+        last_err = None
+        for port in ports:
+            try:
+                sock.bind((host, port))
+                last_err = None
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE:
+                    raise
+                last_err = e
+        if last_err is not None:
+            sock.close()
+            raise last_err
+    sock.listen(64)
+    return sock
+
+
+def find_in_path(path, file_name):
+    """Find `file_name` in a ':'-separated search path (reference: util.py:68-76)."""
+    for p in path.split(os.pathsep):
+        candidate = os.path.join(p, file_name)
+        if os.path.exists(candidate) and os.path.isfile(candidate):
+            return candidate
+    return False
+
+
+def write_executor_id(num, cwd=None):
+    """Persist this executor's id in a CWD file.
+
+    Later feeder tasks scheduled on the same executor read it to locate the
+    node's queue manager (reference: util.py:77-82).
+    """
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_ID_FILE)
+    with open(path, "w") as f:
+        f.write(str(num))
+
+
+def read_executor_id(cwd=None):
+    """Read the executor id written by `write_executor_id` (reference: util.py:85-94)."""
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_ID_FILE)
+    with open(path) as f:
+        return int(f.read())
+
+
+def single_node_env(num_cpu_devices=None):
+    """Configure the environment for a single-node JAX run.
+
+    Maps reference util.py:21-49 (which expanded the Hadoop CLASSPATH and set
+    CUDA_VISIBLE_DEVICES).  On the TPU build the analog is: make sure child
+    processes inherit a sane JAX platform selection, and optionally force a
+    virtual multi-device CPU platform for testing.
+    """
+    if num_cpu_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        token = f"--xla_force_host_platform_device_count={num_cpu_devices}"
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + token).strip()
+    # Keep TF (used only for TFRecord interop tests) off the accelerator.
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")

@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported
+anywhere, so multi-chip sharding (dp/tp/sp meshes, collectives) is exercised
+without TPU hardware — the TPU analog of the reference's trick of testing on
+a local 2-worker Spark standalone cluster (reference: tests/README.md:10,
+tox.ini:29-34).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import multiprocessing as mp
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mp_ctx():
+    # 'fork' keeps worker startup cheap on the 1-core CI box; the runtime
+    # itself supports spawn (each executor re-execs its bootstrap closure).
+    return mp.get_context("fork")

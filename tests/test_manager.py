@@ -1,0 +1,51 @@
+"""Queue-manager IPC tests (the reference exercised TFManager inside
+tests/test_TFNode.py; here it gets its own unit tier)."""
+import uuid
+
+import pytest
+
+from tensorflowonspark_tpu import manager
+
+
+def test_local_queues_and_kv():
+    authkey = uuid.uuid4().bytes
+    mgr = manager.start(authkey, ["input", "output", "error"], mode="local")
+    try:
+        q = mgr.get_queue("input")
+        q.put(1)
+        q.put("two")
+        assert q.get() == 1
+        q.task_done()
+        assert q.get() == "two"
+        q.task_done()
+        with pytest.raises(Exception):
+            mgr.get_queue("missing")
+        assert not mgr.has_queue("missing")._getvalue()
+
+        mgr.set("state", "running")
+        assert mgr.get("state")._getvalue() == "running"
+    finally:
+        mgr.shutdown()
+
+
+def test_connect_from_other_process(mp_ctx):
+    authkey = uuid.uuid4().bytes
+    mgr = manager.start(authkey, ["input"], mode="remote")
+    addr = mgr._tfos_addr
+
+    def child(addr, authkey, q):
+        from tensorflowonspark_tpu import manager as m
+        remote = m.connect(addr, authkey)
+        remote.get_queue("input").put("from-child")
+        q.put("ok")
+
+    q = mp_ctx.Queue()
+    p = mp_ctx.Process(target=child, args=(addr, authkey, q))
+    p.start()
+    assert q.get(timeout=30) == "ok"
+    p.join(timeout=30)
+    try:
+        item = mgr.get_queue("input").get(timeout=10)
+        assert item == "from-child"
+    finally:
+        mgr.shutdown()

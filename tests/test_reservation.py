@@ -1,0 +1,124 @@
+"""Rendezvous protocol tests (models reference tests/test_reservation.py:1-132)."""
+import os
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import reservation, util
+
+
+def test_reservations_counting():
+    r = reservation.Reservations(3)
+    assert not r.done()
+    assert r.remaining() == 3
+    r.add({"host": "a"})
+    r.add({"host": "b"})
+    assert r.remaining() == 1
+    assert not r.done()
+    r.add({"host": "c"})
+    assert r.done()
+    assert len(r.get()) == 3
+
+
+def test_register_query_stop():
+    server = reservation.Server(1)
+    addr = server.start()
+    client = reservation.Client(addr)
+    meta = {"executor_id": 0, "host": "127.0.0.1", "job_name": "chief",
+            "task_index": 0, "authkey": b"\x00\x01"}
+    client.register(meta)
+    nodes = client.await_reservations(timeout=10)
+    assert len(nodes) == 1
+    assert nodes[0]["job_name"] == "chief"
+    assert nodes[0]["authkey"] == b"\x00\x01"  # bytes survive msgpack framing
+    client.request_stop()
+    client.close()
+    time.sleep(0.2)
+    assert server.done.is_set()
+
+
+def test_server_env_port_binding(monkeypatch):
+    port = util.get_free_port()
+    monkeypatch.setenv(reservation.SERVER_HOST_ENV, "127.0.0.1")
+    monkeypatch.setenv(reservation.SERVER_PORT_ENV, f"{port}-{port + 20}")
+    server = reservation.Server(1)
+    host, bound = server.start()
+    assert host == "127.0.0.1"
+    assert port <= bound <= port + 20
+    server.stop()
+
+
+def test_concurrent_clients():
+    n = 4
+    server = reservation.Server(n)
+    addr = server.start()
+    results = []
+
+    def node(i):
+        c = reservation.Client(addr)
+        c.register({"executor_id": i, "host": "127.0.0.1", "task_index": i})
+        nodes = c.await_reservations(timeout=30)
+        results.append(len(nodes))
+        c.close()
+
+    threads = [threading.Thread(target=node, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    got = server.await_reservations(timeout=30)
+    for t in threads:
+        t.join()
+    assert len(got) == n
+    assert results == [n] * n
+    server.stop()
+
+
+def test_await_timeout():
+    server = reservation.Server(2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0})
+    with pytest.raises(TimeoutError):
+        server.await_reservations(timeout=2)
+    server.stop()
+
+
+def test_error_aborts_await():
+    server = reservation.Server(2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0})
+    client.report_error({"executor_id": 0}, "boom")
+    with pytest.raises(RuntimeError, match="boom"):
+        server.await_reservations(timeout=10)
+    server.stop()
+
+
+def test_malformed_frame_does_not_kill_server():
+    """Regression: a bad msgpack frame from one peer must not kill the
+    rendezvous loop for everyone else (found via runtime probing)."""
+    import socket
+    import struct
+
+    server = reservation.Server(1)
+    addr = server.start()
+    s = socket.create_connection(addr)
+    s.sendall(struct.pack(">I", 5) + b"\xc1garb")  # 0xc1 is never valid msgpack
+    s.close()
+    s2 = socket.create_connection(addr)
+    s2.sendall(struct.pack(">I", 2**31 - 1))  # absurd frame length
+    time.sleep(0.3)
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0})
+    assert server.await_reservations(timeout=10)
+    s2.close()
+    client.close()
+    server.stop()
+
+
+def test_status_flag_aborts_await():
+    server = reservation.Server(1)
+    server.start()
+    with pytest.raises(RuntimeError, match="launch failed"):
+        server.await_reservations(timeout=10, status={"error": "driver thread died"})
+    server.stop()

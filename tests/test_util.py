@@ -1,0 +1,43 @@
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import util
+
+
+def test_ip_address_shape():
+    ip = util.get_ip_address()
+    parts = ip.split(".")
+    assert len(parts) == 4
+
+
+def test_parse_port_spec():
+    assert util.parse_port_spec("8080") == [8080]
+    assert util.parse_port_spec("8000-8002") == [8000, 8001, 8002]
+    with pytest.raises(ValueError):
+        util.parse_port_spec("9-5")
+
+
+def test_executor_id_roundtrip(tmp_path):
+    util.write_executor_id(7, cwd=str(tmp_path))
+    assert util.read_executor_id(cwd=str(tmp_path)) == 7
+
+
+def test_find_in_path(tmp_path):
+    f = tmp_path / "needle.txt"
+    f.write_text("x")
+    path = os.pathsep.join(["/nonexistent", str(tmp_path)])
+    assert util.find_in_path(path, "needle.txt") == str(f)
+    assert util.find_in_path(path, "missing.txt") is False
+
+
+def test_bind_socket_port_list():
+    port = util.get_free_port()
+    s1 = util.bind_socket("127.0.0.1", [port])
+    try:
+        # first port busy -> falls through to the next in range
+        s2 = util.bind_socket("127.0.0.1", [port, port + 1, port + 2])
+        assert s2.getsockname()[1] in (port + 1, port + 2)
+        s2.close()
+    finally:
+        s1.close()
