@@ -1,0 +1,281 @@
+"""Executor substrate abstraction.
+
+The reference is hard-wired to Spark: `TFCluster.run` does
+`sc.parallelize(range(N), N).foreachPartition(...)` and feeders ride
+`dataRDD.foreachPartition` (reference: TFCluster.py:297-334, :94).  This
+framework factors that contract into a `Backend` interface with two
+implementations:
+
+- `SparkBackend` — thin wrappers over a live SparkContext (import-gated, since
+  pyspark is optional).
+- `LocalBackend`  — N real OS processes, one per "executor", each pinned to
+  its own working directory.  This is both the test substrate (the TPU analog
+  of the reference's 2-worker Spark standalone test cluster,
+  tests/README.md:10) and a usable single-host runtime.
+
+The contract every backend provides:
+- `run_on_executors(fn, n)`  — launch the node-bootstrap closure once per
+  executor, asynchronously; `fn` receives an iterator yielding the executor id.
+- `foreach_partition(partitions, fn)` — run `fn(iter(partition))` for each
+  partition, routed so partition i lands on executor i % n (feeders must land
+  where a node's queue manager lives — the executor-id-file discovery trick,
+  reference: util.py:77-94).
+- `map_partitions(partitions, fn)` — same, collecting each call's result list.
+"""
+import logging
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import tempfile
+import threading
+import time
+import traceback
+
+logger = logging.getLogger(__name__)
+
+
+class Backend:
+    """Interface; see module docstring."""
+
+    @property
+    def num_executors(self):
+        raise NotImplementedError
+
+    def run_on_executors(self, fn, n):
+        raise NotImplementedError
+
+    def foreach_partition(self, partitions, fn):
+        raise NotImplementedError
+
+    def map_partitions(self, partitions, fn):
+        raise NotImplementedError
+
+
+def _task_trampoline(fn, part, result_q, index, workdir, collect):
+    """Child-process shim: chdir to the executor dir, run, ship result/error."""
+    try:
+        if workdir:
+            os.chdir(workdir)
+        out = fn(iter(part))
+        if collect:
+            result_q.put((index, "ok", list(out) if out is not None else []))
+        else:
+            # foreach: drain any generator for its side effects
+            if out is not None:
+                for _ in out:
+                    pass
+            result_q.put((index, "ok", None))
+    except BaseException:
+        result_q.put((index, "error", traceback.format_exc()))
+        raise SystemExit(1)
+
+
+def _bootstrap_trampoline(fn, executor_id, workdir, status_q, manager_linger=600):
+    """Run a node bootstrap in its own process, then keep the executor alive
+    while its node process and queue manager are needed — a stand-in for
+    Spark's long-lived reused python-worker (reference precondition
+    SPARK_REUSE_WORKER, TFSparkNode.py:393-395).
+
+    Lifecycle: join the node process(es) first; then hold the queue manager
+    open until the cluster-shutdown closure marks state 'stopped' (feeders
+    and the shutdown path still need the queues after the node exits), with
+    a linger timeout as a leak guard; then stop the manager and exit.
+    """
+    from tensorflowonspark_tpu import manager as manager_mod
+    try:
+        os.chdir(workdir)
+        fn(iter([executor_id]))
+        status_q.put((executor_id, "ok", None))
+        node_failed = False
+        for child in mp.active_children():
+            if child.name.startswith("QueueManager"):
+                continue
+            child.join()
+            if child.exitcode not in (0, None):
+                node_failed = True
+                status_q.put((executor_id, "error",
+                              f"node process {child.name} exited with "
+                              f"code {child.exitcode}"))
+        deadline = time.time() + manager_linger
+        for mgr in manager_mod._started_managers:
+            while time.time() < deadline:
+                try:
+                    state = manager_mod.get_value(mgr, "state")
+                except Exception:
+                    break  # server already gone
+                if state == "stopped":
+                    break
+                time.sleep(0.5)
+            try:
+                mgr.shutdown()
+            except Exception:
+                pass
+        if node_failed:
+            raise SystemExit(1)
+    except SystemExit:
+        raise
+    except BaseException:
+        status_q.put((executor_id, "error", traceback.format_exc()))
+        raise SystemExit(1)
+
+
+class LocalBackend(Backend):
+    """N-process local executor pool with per-executor working directories."""
+
+    def __init__(self, num_executors, workdir=None, start_method="fork"):
+        self._n = num_executors
+        self._ctx = mp.get_context(start_method)
+        self._root = workdir or tempfile.mkdtemp(prefix="tfos-tpu-local-")
+        self._dirs = []
+        for i in range(num_executors):
+            d = os.path.join(self._root, f"executor-{i}")
+            os.makedirs(d, exist_ok=True)
+            self._dirs.append(d)
+        self._bootstrap_procs = []
+        self._status_q = self._ctx.Queue()
+
+    @property
+    def num_executors(self):
+        return self._n
+
+    @property
+    def executor_dirs(self):
+        return list(self._dirs)
+
+    def run_on_executors(self, fn, n):
+        assert n == self._n, f"backend has {self._n} executors, asked for {n}"
+        for i in range(n):
+            p = self._ctx.Process(
+                target=_bootstrap_trampoline,
+                args=(fn, i, self._dirs[i], self._status_q),
+                name=f"executor-{i}",
+            )
+            p.start()
+            self._bootstrap_procs.append(p)
+
+    def check_bootstrap_errors(self):
+        """Non-blocking: return the first bootstrap error traceback, if any."""
+        try:
+            while True:
+                _, kind, payload = self._status_q.get_nowait()
+                if kind == "error":
+                    return payload
+        except queue_mod.Empty:
+            return None
+
+    def _run_tasks(self, partitions, fn, collect):
+        """Run one task per partition: partitions for different executors run
+        concurrently; multiple partitions routed to the SAME executor run
+        sequentially.  Serialization per executor matters for correctness —
+        Spark schedules one task per executor core (the reference's test
+        cluster pins 1 core/executor, tox.ini:33-34), and concurrent feeders
+        would interleave records on one queue, breaking the EndPartition
+        1:1-result accounting."""
+        parts = list(partitions)
+        result_q = self._ctx.Queue()
+        by_exec = {}
+        for i, part in enumerate(parts):
+            by_exec.setdefault(i % self._n, []).append((i, list(part)))
+
+        def _run_serial(eid, tasks):
+            for index, part in tasks:
+                p = self._ctx.Process(
+                    target=_task_trampoline,
+                    args=(fn, part, result_q, index, self._dirs[eid], collect),
+                    name=f"task-{index}",
+                )
+                p.start()
+                p.join()
+
+        threads = [threading.Thread(target=_run_serial, args=(eid, tasks))
+                   for eid, tasks in by_exec.items()]
+        for t in threads:
+            t.start()
+        results = [None] * len(parts)
+        errors = []
+        seen = 0
+        while seen < len(parts):
+            try:
+                index, kind, payload = result_q.get(timeout=1)
+            except queue_mod.Empty:
+                if not any(t.is_alive() for t in threads):
+                    errors.append((-1, "task process died without reporting "
+                                       "(killed or crashed hard)"))
+                    break
+                continue
+            seen += 1
+            if kind == "error":
+                errors.append((index, payload))
+            else:
+                results[index] = payload
+        for t in threads:
+            t.join()
+        if errors:
+            errors.sort()
+            index, tb = errors[0]
+            raise RuntimeError(f"task {index} failed:\n{tb}")
+        return results
+
+    def foreach_partition(self, partitions, fn):
+        self._run_tasks(partitions, fn, collect=False)
+
+    def map_partitions(self, partitions, fn):
+        nested = self._run_tasks(partitions, fn, collect=True)
+        return [item for part in nested for item in part]
+
+    def join(self, timeout=None):
+        """Wait for all bootstrap (executor) processes to exit."""
+        for p in self._bootstrap_procs:
+            p.join(timeout)
+
+    def terminate(self):
+        for p in self._bootstrap_procs:
+            if p.is_alive():
+                p.terminate()
+
+
+class SparkBackend(Backend):
+    """Backend over a live SparkContext (requires pyspark at call time).
+
+    Maps the reference's direct Spark calls: node bootstrap via
+    `sc.parallelize(range(n), n).foreachPartition` on a daemon thread
+    (reference: TFCluster.py:297-334), feeding via RDD.foreachPartition, and
+    inference via RDD.mapPartitions (reference: TFCluster.py:94,:115).
+    """
+
+    def __init__(self, sc):
+        self._sc = sc
+
+    @property
+    def num_executors(self):
+        return int(self._sc.defaultParallelism)
+
+    @property
+    def spark_context(self):
+        return self._sc
+
+    def run_on_executors(self, fn, n):
+        import threading
+
+        node_rdd = self._sc.parallelize(range(n), n)
+        t = threading.Thread(target=node_rdd.foreachPartition, args=(fn,), daemon=True)
+        t.start()
+
+    def foreach_partition(self, partitions, fn):
+        rdd = partitions if hasattr(partitions, "foreachPartition") else \
+            self._sc.parallelize(partitions, len(list(partitions)))
+        rdd.foreachPartition(fn)
+
+    def map_partitions(self, partitions, fn):
+        rdd = partitions if hasattr(partitions, "mapPartitions") else \
+            self._sc.parallelize(partitions, len(list(partitions)))
+        return rdd.mapPartitions(fn)  # lazy RDD, like the reference
+
+
+def resolve(backend_or_sc):
+    """Accept a Backend, or duck-typed SparkContext, and return a Backend."""
+    if isinstance(backend_or_sc, Backend):
+        return backend_or_sc
+    if hasattr(backend_or_sc, "parallelize"):
+        return SparkBackend(backend_or_sc)
+    raise TypeError(f"cannot build an executor backend from {type(backend_or_sc)!r}")

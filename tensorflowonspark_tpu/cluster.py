@@ -1,0 +1,222 @@
+"""Cluster lifecycle API (maps reference TFCluster.py:40-383).
+
+`run()` turns N executors (Spark or local processes) into a distributed JAX
+cluster; the returned `TPUCluster` feeds it (`train`), queries it
+(`inference`), and tears it down (`shutdown`) with the reference's
+semantics: epochs-via-repetition, feed timeouts, grace periods, error
+propagation that aborts the whole job, and a duplicate-registration sanity
+check.
+"""
+import logging
+import random
+import threading
+import time
+
+from . import backend as backend_mod
+from . import node, reservation
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode:
+    """How the training fn receives data (maps TFCluster.py:43-46).
+
+    NATIVE: the fn reads its own data (tf.data/grain/files) — the
+    reference called this InputMode.TENSORFLOW; the alias is kept for
+    migration.
+    SPARK: partitions are pushed from the data layer through DataFeed.
+    """
+    NATIVE = 0
+    TENSORFLOW = 0  # migration alias
+    SPARK = 1
+
+
+class TPUCluster:
+    """Handle to a running cluster (maps the TFCluster object, TFCluster.py:48-212)."""
+
+    sc = None
+    meta = None
+    server = None
+    cluster_info = None
+    cluster_meta = None
+    input_mode = None
+    queues = None
+    _backend = None
+    _status = None
+
+    def train(self, data_partitions, num_epochs=1, feed_timeout=600, qname="input"):
+        """Feed partitions to the cluster (maps TFCluster.train, TFCluster.py:63-94).
+
+        `data_partitions` is an RDD (Spark backend) or a list of record lists.
+        Epochs repeat the data, like the reference's RDD union.
+        """
+        assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
+        logger.info("feeding training data (epochs=%d)", max(num_epochs, 1))
+        parts = data_partitions
+        if num_epochs > 1:
+            if hasattr(parts, "union"):  # RDD path, like sc.union([rdd]*epochs)
+                repeated = parts
+                for _ in range(num_epochs - 1):
+                    repeated = repeated.union(parts)
+                parts = repeated
+            else:
+                parts = [p for _ in range(num_epochs) for p in parts]
+        self._check_driver_error()
+        self._backend.foreach_partition(
+            parts, node.train(self.cluster_info, self.cluster_meta,
+                              feed_timeout=feed_timeout, qname=qname))
+
+    def inference(self, data_partitions, qname="input"):
+        """Run distributed inference over partitions, returning results
+        (maps TFCluster.inference, TFCluster.py:96-115)."""
+        assert self.input_mode == InputMode.SPARK, "inference() requires InputMode.SPARK"
+        self._check_driver_error()
+        return self._backend.map_partitions(
+            data_partitions, node.inference(self.cluster_info, self.cluster_meta,
+                                            qname=qname))
+
+    def shutdown(self, grace_secs=0, timeout=259200):
+        """Stop the cluster (maps TFCluster.shutdown, TFCluster.py:117-205).
+
+        Pushes end-of-feed sentinels to every worker, waits out grace_secs
+        (the chief may still be exporting a model), surfaces any node errors
+        as an exception on the driver, then stops the reservation server.
+        `timeout` bounds the whole teardown (reference used SIGALRM; we use a
+        watchdog thread so it also works off the main thread).
+        """
+        logger.info("shutting down cluster")
+        watchdog = threading.Timer(timeout, lambda: (
+            logger.error("cluster shutdown timed out after %ds", timeout),
+            self._backend.terminate() if hasattr(self._backend, "terminate") else None))
+        watchdog.daemon = True
+        watchdog.start()
+        try:
+            workers = [eid for j in ("chief", "worker")
+                       for eid in self.cluster_meta["cluster_template"].get(j, [])]
+            shutdown_parts = [[eid] for eid in sorted(workers)]
+            self._backend.foreach_partition(
+                shutdown_parts,
+                node.shutdown(self.cluster_info, queues=self.queues_to_close,
+                              grace_secs=grace_secs))
+            self._check_driver_error()
+            # Evaluator nodes run remote-mode managers so the driver can push
+            # their stop sentinel directly (maps TFCluster.py:186-194); then
+            # mark them 'stopped' so their bootstrap releases the manager.
+            from . import manager as manager_mod
+            for n in self.cluster_info:
+                if n["job_name"] == "evaluator":
+                    mgr = manager_mod.connect(tuple(n["addr"]), n["authkey"])
+                    mgr.get_queue("control").put(None)
+                    mgr.get_queue("input").put(None)
+                    mgr.set("state", "stopped")
+        finally:
+            watchdog.cancel()
+            self.server.stop()
+        if isinstance(self._backend, backend_mod.LocalBackend):
+            self._backend.join(timeout=60)
+            err = self._backend.check_bootstrap_errors()
+            if err:
+                raise RuntimeError(f"node failed during run:\n{err}")
+
+    def tensorboard_url(self):
+        """URL of the chief's profiler/TensorBoard endpoint, if enabled
+        (maps TFCluster.tensorboard_url, TFCluster.py:207-212)."""
+        for n in self.cluster_info:
+            if n.get("tb_port"):
+                return f"http://{n['host']}:{n['tb_port']}"
+        return None
+
+    def _check_driver_error(self):
+        if self._status.get("error"):
+            raise RuntimeError(f"cluster failed: {self._status['error']}")
+        if isinstance(self._backend, backend_mod.LocalBackend):
+            err = self._backend.check_bootstrap_errors()
+            if err:
+                self._status["error"] = err
+                raise RuntimeError(f"node bootstrap failed:\n{err}")
+
+
+def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
+        tensorboard=False, input_mode=InputMode.NATIVE, log_dir=None,
+        master_node="chief", reservation_timeout=600,
+        queues=("input", "output", "error", "control"), eval_node=False,
+        num_chips=0, default_fs="file://"):
+    """Start a cluster (maps TFCluster.run, TFCluster.py:215-383).
+
+    Returns a `TPUCluster` once every node has registered.
+    """
+    backend = backend_mod.resolve(backend_or_sc)
+    num_executors = num_executors or backend.num_executors
+
+    # Role template {job_name: [executor ids]} (maps TFCluster.py:255-270).
+    # PS-style async has no TPU analog: schedule would-be PS nodes as extra
+    # synchronous workers (intentional divergence, SURVEY.md §2.3).
+    if num_ps:
+        logger.warning(
+            "num_ps=%d requested, but parameter-server async training has no "
+            "TPU analog; scheduling them as synchronous data-parallel workers "
+            "(gradient exchange rides ICI allreduce)", num_ps)
+    executors = list(range(num_executors))
+    cluster_template = {"chief": [executors[0]]}  # master_node accepted for
+    # reference-API compatibility; the role is always named 'chief' here.
+    if eval_node:
+        assert num_executors >= 2, "eval_node requires at least 2 executors"
+        cluster_template["evaluator"] = [executors[-1]]
+        workers = executors[1:-1]
+    else:
+        workers = executors[1:]
+    if workers:
+        cluster_template["worker"] = workers
+    logger.info("cluster template: %s", cluster_template)
+
+    server = reservation.Server(num_executors)
+    server_addr = server.start()
+
+    cluster_meta = {
+        "cluster_id": f"{int(time.time())}-{random.randint(0, 1 << 30)}",
+        "cluster_template": cluster_template,
+        "num_executors": num_executors,
+        "server_addr": list(server_addr),
+        "default_fs": default_fs,
+        "num_chips": num_chips,
+        "reservation_timeout": reservation_timeout,
+    }
+
+    status = {"error": None}
+    background = input_mode == InputMode.SPARK
+
+    def _launch():
+        try:
+            backend.run_on_executors(
+                node.run(map_fun, tf_args, cluster_meta, tensorboard=tensorboard,
+                         log_dir=log_dir, queues=queues, background=background),
+                num_executors)
+        except Exception as e:  # surfaced to await_reservations via status
+            logger.exception("cluster launch failed")
+            status["error"] = str(e)
+
+    t = threading.Thread(target=_launch, name="cluster-launch", daemon=True)
+    t.start()
+
+    cluster_info = server.await_reservations(
+        timeout=reservation_timeout, status=status)
+
+    # Duplicate (host, executor_id) detection (maps TFCluster.py:355-370):
+    # a task retry that re-bootstrapped would corrupt feed routing.
+    seen = set()
+    for n in cluster_info:
+        key = (n["host"], n["executor_id"])
+        if key in seen:
+            raise RuntimeError(f"duplicate node registered for {key}")
+        seen.add(key)
+
+    cluster = TPUCluster()
+    cluster.server = server
+    cluster.cluster_info = cluster_info
+    cluster.cluster_meta = cluster_meta
+    cluster.input_mode = input_mode
+    cluster.queues_to_close = [q for q in queues if q in ("input",)]
+    cluster._backend = backend
+    cluster._status = status
+    logger.info("cluster is running: %d nodes", len(cluster_info))
+    return cluster

@@ -1,0 +1,162 @@
+"""User-side data feed & path utilities (maps reference TFNode.py:29-329).
+
+`DataFeed` is the consumer half of InputMode.SPARK: the training process
+pulls batches that feeder tasks pushed into the node's queue manager.  The
+marker protocol is preserved from the reference (None = end of feed,
+EndPartition = partition boundary), with one TPU-era change: records travel
+in `marker.Chunk` batches, one queue item per chunk, because per-record
+pickled queue puts are the reference's throughput ceiling (SURVEY.md §7).
+
+`next_batch` returns records; `next_numpy_batch` stacks them into numpy
+arrays ready for `jax.device_put`; `iter_batches` wraps the loop.
+"""
+import logging
+
+from . import marker
+
+logger = logging.getLogger(__name__)
+
+
+def hdfs_path(ctx, path):
+    """Normalize a path per the filesystem schemes the cluster uses.
+
+    Maps reference TFNode.hdfs_path (TFNode.py:29-64): absolute and
+    scheme-qualified paths pass through; relative paths are resolved against
+    the cluster's default FS (for remote schemes) or the node's working dir.
+    """
+    schemes = ("hdfs://", "viewfs://", "file://", "gs://", "s3://", "s3a://",
+               "s3n://", "wasb://", "abfs://", "maprfs://", "oss://", "swift://")
+    if path.startswith(schemes):
+        return path
+    local_fs = ctx.default_fs.startswith("file://") or not ctx.default_fs.startswith(schemes)
+    if path.startswith("/"):
+        return path if local_fs else ctx.default_fs + path
+    if not local_fs:
+        return f"{ctx.default_fs.rstrip('/')}/user/{ctx.user_name}/{path}"
+    import os
+    return os.path.join(ctx.working_dir, path)
+
+
+class DataFeed:
+    """Pulls feeder-pushed records from the node's input queue.
+
+    Maps reference TFNode.DataFeed (TFNode.py:221-329); the public contract
+    (`next_batch`, `should_stop`, `batch_results`, `terminate`) is identical.
+    """
+
+    def __init__(self, mgr, train_mode=True, qname_in="input", qname_out="output",
+                 input_mapping=None):
+        self.mgr = mgr
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.input_mapping = input_mapping
+        self.done_feeding = False
+        self._buffer = []          # records drained from chunks, not yet returned
+        self._partition_break = False
+
+    def next_batch(self, batch_size):
+        """Return up to `batch_size` records.
+
+        Returns fewer records at a partition boundary (so inference result
+        accounting stays 1:1 per partition, reference: TFNode.py:243-288) and
+        an empty/short batch at end-of-feed.  With `input_mapping` (a dict
+        column_index_or_key -> name), returns {name: [values...]} instead.
+        """
+        q = self.mgr.get_queue(self.qname_in)
+        batch = []
+        while len(batch) < batch_size:
+            if self._buffer:
+                batch.append(self._buffer.pop(0))
+                continue
+            if self.done_feeding or self._partition_break:
+                break
+            item = q.get()
+            if item is None:
+                self.done_feeding = True
+                q.task_done()
+            elif isinstance(item, marker.EndPartition):
+                q.task_done()
+                if batch:
+                    self._partition_break = True  # flush current batch first
+                    break
+                # empty batch so far: partition boundary is invisible, continue
+            elif isinstance(item, marker.Chunk):
+                self._buffer.extend(item.items)
+                q.task_done()
+            else:
+                batch.append(item)
+                q.task_done()
+        if self._partition_break and not self._buffer:
+            self._partition_break = False
+        if self.input_mapping:
+            return self._apply_mapping(batch)
+        return batch
+
+    def _apply_mapping(self, batch):
+        cols = {name: [] for name in self.input_mapping.values()}
+        for rec in batch:
+            for key, name in self.input_mapping.items():
+                cols[name].append(rec[key])
+        return cols
+
+    def next_numpy_batch(self, batch_size, dtype=None):
+        """Like next_batch but stacks records into numpy arrays.
+
+        Records that are tuples/lists of fields become a tuple of arrays
+        (one per field); scalar/array records become one array.  This is the
+        shape `jax.device_put` wants.
+        """
+        import numpy as np
+        batch = self.next_batch(batch_size)
+        if self.input_mapping:
+            return {k: np.asarray(v, dtype=dtype) for k, v in batch.items()}
+        if not batch:
+            return None
+        first = batch[0]
+        if isinstance(first, (tuple, list)) and not np.isscalar(first):
+            ncols = len(first)
+            return tuple(np.asarray([r[i] for r in batch], dtype=dtype)
+                         for i in range(ncols))
+        return np.asarray(batch, dtype=dtype)
+
+    def iter_batches(self, batch_size, numpy=False):
+        """Generator over batches until end-of-feed."""
+        while not self.should_stop():
+            batch = (self.next_numpy_batch(batch_size) if numpy
+                     else self.next_batch(batch_size))
+            if batch is None or (hasattr(batch, "__len__") and len(batch) == 0):
+                if self.should_stop():
+                    break
+                continue
+            yield batch
+
+    def should_stop(self):
+        """True once the end-of-feed sentinel was consumed (reference: TFNode.py:290)."""
+        return self.done_feeding and not self._buffer
+
+    def batch_results(self, results):
+        """Push inference results to the output queue (reference: TFNode.py:294-305)."""
+        q = self.mgr.get_queue(self.qname_out)
+        for item in results:
+            q.put(item)
+
+    def terminate(self):
+        """Signal feeders to stop and drain the input queue (reference: TFNode.py:307-329)."""
+        logger.info("terminate() requested; marking state terminating")
+        self.mgr.set("state", "terminating")
+        # Drain whatever is in flight so feeder queue.join() can complete.
+        q = self.mgr.get_queue(self.qname_in)
+        import queue as queue_mod
+        count = 0
+        done = False
+        while not done:
+            try:
+                item = q.get(timeout=3)
+                q.task_done()
+                count += 1
+                if item is None:
+                    self.done_feeding = True
+            except queue_mod.Empty:
+                done = True
+        logger.info("terminate() drained %d in-flight items", count)

@@ -83,8 +83,46 @@ def start(authkey, queues, mode="local"):
     # mgr.address gives ('', port) in remote mode; substitute a routable host.
     port = mgr.address[1]
     mgr._tfos_addr = (host, port)
+    # CRITICAL: keep a module-global reference.  BaseManager registers a
+    # weakref-triggered finalizer that sends the server a shutdown message as
+    # soon as the manager OBJECT is garbage-collected — so a manager held
+    # only in a local variable dies with the enclosing frame.  The reference
+    # relied on the same trick (module global `mgr`, TFManager.py:20-22).
+    _started_managers.append(mgr)
     logger.info("started %s queue manager on %s (queues=%s)", mode, mgr._tfos_addr, queues)
     return mgr
+
+
+_started_managers = []
+
+
+def shutdown_remote(addr, authkey):
+    """Ask a manager server (possibly in another process tree) to exit.
+
+    BaseManager.shutdown() only works on the instance that called start();
+    this sends the same protocol message over a fresh connection, letting the
+    cluster-shutdown closure stop managers it didn't create.
+    """
+    from multiprocessing.connection import Client as ConnClient
+    from multiprocessing.managers import dispatch
+
+    if not isinstance(authkey, bytes):
+        authkey = bytes(authkey)
+    mp.current_process().authkey = authkey
+    try:
+        conn = ConnClient((addr[0], int(addr[1])), authkey=authkey)
+        try:
+            dispatch(conn, None, "shutdown")
+        finally:
+            conn.close()
+    except (EOFError, OSError, ConnectionError):
+        pass  # already gone
+
+
+def get_value(mgr, key):
+    """Unwrap a kv value from its AutoProxy (proxies str-ify with quotes)."""
+    proxy = mgr.get(key)
+    return proxy._getvalue() if proxy is not None else None
 
 
 def connect(addr, authkey):

@@ -1,0 +1,418 @@
+"""Per-executor node runtime (maps reference TFSparkNode.py:43-636).
+
+`run/train/inference/shutdown` build closures that the cluster layer ships to
+executors through a `Backend`.  Differences from the reference, by design
+(SURVEY.md §7):
+
+- No TF_CONFIG / port scouting.  Registration metadata feeds a
+  **JAX-distributed bootstrap**: the sorted reservation list yields
+  `(coordinator_addr, num_processes, process_id)`; `NodeContext.
+  init_distributed()` hands these to `jax.distributed.initialize` on real
+  multi-host TPU slices.  Chief (process 0) offers a coordinator port at
+  registration time.
+- Roles are `chief` / `worker` / `evaluator`.  Parameter servers have no TPU
+  analog — async PS gradients are replaced by synchronous allreduce over
+  ICI; `num_ps > 0` is accepted and scheduled as extra workers with a
+  loud divergence warning (SURVEY.md §2.3).
+- Data feeding is chunked (`marker.Chunk`) rather than per-record.
+"""
+import logging
+import multiprocessing as mp
+import os
+import time
+import traceback
+import uuid
+
+from . import feed as feed_mod
+from . import manager, marker, reservation, tpu_info, util
+
+logger = logging.getLogger(__name__)
+
+CHUNK_SIZE = 512  # records per queue item when feeding
+
+
+class NodeContext:
+    """Runtime context handed to the user's map_fun (maps TFSparkNode.py:59-99)."""
+
+    def __init__(self, executor_id=0, job_name="chief", task_index=0, num_workers=1,
+                 cluster_info=None, default_fs="file://", working_dir=None, mgr=None):
+        self.executor_id = executor_id
+        self.job_name = job_name
+        self.task_index = task_index
+        self.num_workers = num_workers
+        self.cluster_info = cluster_info or []
+        self.default_fs = default_fs
+        self.working_dir = working_dir or os.getcwd()
+        self.mgr = mgr
+        self.user_name = os.environ.get("USER", "user")
+        # process_id = rank in the sorted node list (chief first); the
+        # jax.distributed bootstrap identity for this node.
+        ordered = sorted(self.cluster_info,
+                         key=lambda n: (n.get("job_name") != "chief", n.get("executor_id", 0)))
+        self.process_id = next(
+            (i for i, n in enumerate(ordered)
+             if n.get("executor_id") == executor_id), 0)
+        self.num_processes = max(len(ordered), 1)
+        chief = next((n for n in ordered if n.get("job_name") == "chief"), None)
+        self.coordinator_address = None
+        if chief is not None and chief.get("coordinator_port"):
+            self.coordinator_address = f"{chief['host']}:{chief['coordinator_port']}"
+
+    @property
+    def is_chief(self):
+        return self.job_name == "chief"
+
+    def get_data_feed(self, train_mode=True, qname_in="input", qname_out="output",
+                      input_mapping=None):
+        """Build the DataFeed for InputMode.SPARK (maps TFNode.py:221-241)."""
+        return feed_mod.DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    def absolute_path(self, path):
+        """Normalize against the cluster default FS (maps TFNode.hdfs_path)."""
+        return feed_mod.hdfs_path(self, path)
+
+    def init_distributed(self):
+        """Initialize jax.distributed from the reservation-derived identity.
+
+        Call once per node process on real multi-host clusters BEFORE any
+        other jax API.  No-op for single-process clusters (local testing) —
+        where the full mesh is already visible to the one process.
+        """
+        if self.num_processes <= 1 or self.coordinator_address is None:
+            logger.info("single-process cluster; skipping jax.distributed init")
+            return False
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+        return True
+
+
+def _get_manager(cluster_info, host, executor_id):
+    """Locate the queue manager for (host, executor_id) from the reservation
+    list (maps TFSparkNode._get_manager, TFSparkNode.py:119-146)."""
+    for node in cluster_info:
+        if node["executor_id"] == executor_id and node["host"] == host:
+            addr = tuple(node["addr"])
+            mgr = manager.connect(addr, node["authkey"])
+            logger.debug("connected to manager for executor %d, state=%s",
+                         executor_id, manager.get_value(mgr, "state"))
+            return mgr
+    raise RuntimeError(
+        f"no node registered for host={host} executor_id={executor_id}; "
+        f"known: {[(n['host'], n['executor_id']) for n in cluster_info]}")
+
+
+def _wrapper_fn(map_fun, tf_args, ctx):
+    """Invoke the user function, re-injecting argv-style args
+    (maps TFSparkNode.py:397-401)."""
+    if isinstance(tf_args, list):
+        import sys
+        sys.argv = [sys.argv[0] if sys.argv else "map_fun"] + list(tf_args)
+    return map_fun(tf_args, ctx)
+
+
+def _wrapper_fn_background(map_fun, tf_args, ctx, error_q_addr, authkey):
+    """Background-process trampoline: exceptions land on the node's error
+    queue instead of vanishing (maps TFSparkNode.py:403-409)."""
+    try:
+        mgr = manager.connect(error_q_addr, authkey)
+        ctx.mgr = mgr
+        _wrapper_fn(map_fun, tf_args, ctx)
+    except BaseException:
+        tb = traceback.format_exc()
+        logger.error("background node fn failed:\n%s", tb)
+        try:
+            mgr.get_queue("error").put(tb)
+        except Exception:
+            pass
+        raise SystemExit(1)
+
+
+def run(map_fun, tf_args, cluster_meta, tensorboard=False, log_dir=None,
+        queues=("input", "output", "error", "control"), background=False):
+    """Build the per-executor bootstrap closure (maps TFSparkNode.run,
+    TFSparkNode.py:149-446).
+
+    `cluster_meta` carries: cluster_id, cluster_template {job_name: [ids]},
+    num_executors, default_fs, server_addr, num_chips (per worker),
+    reservation_timeout.
+    """
+
+    def _mapfn(iterator):
+        executor_id = None
+        for item in iterator:
+            executor_id = item
+        assert executor_id is not None, "bootstrap task received no executor id"
+
+        # 1. role assignment from the template (maps TFSparkNode.py:231-241)
+        job_name, task_index = None, -1
+        for jname, ids in cluster_meta["cluster_template"].items():
+            if executor_id in ids:
+                job_name = jname
+                task_index = ids.index(executor_id)
+                break
+        assert job_name is not None, f"executor {executor_id} not in cluster template"
+        logger.info("executor %d assigned %s:%d", executor_id, job_name, task_index)
+
+        # 2. stale-manager detection: a Spark task retry on the same executor
+        #    must not double-start a node (maps TFSparkNode.py:249-255).
+        state_file = os.path.join(os.getcwd(), ".tfos_cluster_id")
+        if os.path.exists(state_file):
+            with open(state_file) as f:
+                prior = f.read().strip()
+            if prior == str(cluster_meta["cluster_id"]):
+                raise RuntimeError(
+                    f"executor {executor_id} already hosts a node for cluster "
+                    f"{prior}; refusing duplicate bootstrap (task retry?)")
+        with open(state_file, "w") as f:
+            f.write(str(cluster_meta["cluster_id"]))
+
+        # 4. queue manager: 'remote' for evaluator so the driver can reach its
+        #    control queue (maps TFSparkNode.py:259-268).
+        authkey = uuid.uuid4().bytes
+        mode = "remote" if job_name == "evaluator" else "local"
+        mgr = manager.start(authkey, list(queues), mode=mode)
+        mgr.set("state", f"running/{job_name}")
+        util.write_executor_id(executor_id)
+
+        # 5. chief offers a jax.distributed coordinator port; every node
+        #    learns it from the reservation list (replaces TF_CONFIG assembly,
+        #    TFSparkNode.py:366-374).
+        host = util.get_ip_address()
+        coordinator_port = util.get_free_port(host) if job_name == "chief" else None
+
+        # 6. optional profiler server (the TensorBoard-subprocess analog,
+        #    TFSparkNode.py:282-319) — started lazily inside the user fn via
+        #    utils.profiling; here we only reserve the port on the chief.
+        tb_port = None
+        if tensorboard and job_name == "chief":
+            tb_port = int(os.environ.get("TFOS_TPU_PROFILER_PORT", 0)) or \
+                util.get_free_port(host)
+
+        # 7. register & rendezvous (maps TFSparkNode.py:321-360)
+        client = reservation.Client(cluster_meta["server_addr"])
+        node_meta = {
+            "executor_id": executor_id,
+            "host": host,
+            "job_name": job_name,
+            "task_index": task_index,
+            "addr": list(mgr._tfos_addr),
+            "authkey": authkey,
+            "coordinator_port": coordinator_port,
+            "tb_port": tb_port,
+            "pid": os.getpid(),
+        }
+        client.register(node_meta)
+        cluster_info = client.await_reservations(
+            timeout=cluster_meta.get("reservation_timeout", 600))
+
+        # TPU chip assignment (maps the cluster-aware second GPU pass,
+        # TFSparkNode.py:376-378): only meaningful when several executors
+        # share one TPU host; the worker index must be HOST-LOCAL (my rank
+        # among same-host peers), which is only knowable post-rendezvous.
+        # num_chips=0 means "whole host" (the common one-executor-per-host
+        # layout) — no restriction applied.
+        num_chips = cluster_meta.get("num_chips", 0)
+        if num_chips:
+            peers_here = sorted(n["executor_id"] for n in cluster_info
+                                if n["host"] == host)
+            local_index = peers_here.index(executor_id)
+            tpu_info.assign_chips(num_chips, worker_index=local_index)
+
+        num_workers = sum(len(v) for k, v in cluster_meta["cluster_template"].items()
+                          if k in ("chief", "worker"))
+        ctx = NodeContext(
+            executor_id=executor_id,
+            job_name=job_name,
+            task_index=task_index,
+            num_workers=num_workers,
+            cluster_info=cluster_info,
+            default_fs=cluster_meta.get("default_fs", "file://"),
+            working_dir=os.getcwd(),
+            mgr=mgr,
+        )
+
+        # 8. dispatch (maps TFSparkNode.py:397-443)
+        try:
+            if background:
+                # SPARK input mode: node runs in a background process so this
+                # task can return and free the executor slot for feeder tasks.
+                ctx_bg = NodeContext(
+                    executor_id=executor_id, job_name=job_name,
+                    task_index=task_index, num_workers=num_workers,
+                    cluster_info=cluster_info,
+                    default_fs=cluster_meta.get("default_fs", "file://"),
+                    working_dir=os.getcwd(), mgr=None)
+                p = mp.Process(
+                    target=_wrapper_fn_background,
+                    args=(map_fun, tf_args, ctx_bg, mgr._tfos_addr, authkey),
+                    name=f"node-{job_name}-{task_index}")
+                p.start()
+                logger.info("started background node process pid=%d", p.pid)
+            else:
+                _wrapper_fn(map_fun, tf_args, ctx)
+        except BaseException as e:
+            tb = traceback.format_exc()
+            logger.error("node fn failed on executor %d:\n%s", executor_id, tb)
+            try:
+                mgr.get_queue("error").put(tb)
+            except Exception:
+                pass
+            client.report_error(
+                {"executor_id": executor_id, "job_name": job_name}, str(e))
+            raise
+        finally:
+            client.close()
+
+    return _mapfn
+
+
+def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+    """Build the feeder closure for training data (maps TFSparkNode.train,
+    TFSparkNode.py:448-515)."""
+
+    def _train(iterator):
+        mgr = _get_manager(cluster_info, util.get_ip_address(), util.read_executor_id())
+        state = manager.get_value(mgr, "state") or ""
+        if "terminating" in state:
+            # Late partitions are skipped fast once training asked to stop
+            # (maps TFSparkNode.py:470-476).
+            logger.info("node is terminating; skipping partition")
+            count = sum(1 for _ in iterator)
+            logger.info("skipped %d records", count)
+            # Signal the driver that remaining feeding is pointless
+            # (maps TFSparkNode.py:499-511).
+            try:
+                client = reservation.Client(cluster_meta["server_addr"])
+                client.request_stop()
+                client.close()
+            except Exception:
+                pass
+            return
+
+        q = mgr.get_queue(qname)
+        equeue = mgr.get_queue("error")
+        count = 0
+        chunk = []
+        for item in iterator:
+            chunk.append(item)
+            if len(chunk) >= CHUNK_SIZE:
+                q.put(marker.Chunk(chunk))
+                count += len(chunk)
+                chunk = []
+        if chunk:
+            q.put(marker.Chunk(chunk))
+            count += len(chunk)
+        logger.info("pushed %d records into %s queue", count, qname)
+
+        _join_with_watchdog(q, equeue, feed_timeout)
+
+    return _train
+
+
+def inference(cluster_info, cluster_meta, qname="input"):
+    """Build the feeder/collector closure for inference (maps
+    TFSparkNode.inference, TFSparkNode.py:518-579).  Returns exactly one
+    result per input record, per partition."""
+
+    def _inference(iterator):
+        mgr = _get_manager(cluster_info, util.get_ip_address(), util.read_executor_id())
+        q = mgr.get_queue(qname)
+        equeue = mgr.get_queue("error")
+        count = 0
+        chunk = []
+        for item in iterator:
+            chunk.append(item)
+            if len(chunk) >= CHUNK_SIZE:
+                q.put(marker.Chunk(chunk))
+                count += len(chunk)
+                chunk = []
+        if chunk:
+            q.put(marker.Chunk(chunk))
+            count += len(chunk)
+        q.put(marker.EndPartition())
+        logger.info("pushed %d records (+EndPartition) into %s queue", count, qname)
+        if count == 0:
+            return iter([])
+
+        _join_with_watchdog(q, equeue, timeout=600)
+
+        # Drain exactly `count` results (maps TFSparkNode.py:567-577).
+        out = mgr.get_queue("output")
+        results = []
+        while len(results) < count:
+            results.append(out.get())
+            out.task_done()
+        logger.info("collected %d inference results", len(results))
+        return iter(results)
+
+    return _inference
+
+
+def _join_with_watchdog(q, equeue, timeout):
+    """queue.join() with error propagation + feed timeout (maps
+    TFSparkNode.py:485-495)."""
+    import threading
+
+    joined = threading.Event()
+
+    def _join():
+        q.join()
+        joined.set()
+
+    t = threading.Thread(target=_join, daemon=True)
+    t.start()
+    deadline = time.time() + timeout
+    while not joined.is_set():
+        if not equeue.empty():
+            tb = equeue.get()
+            equeue.task_done()
+            # Re-put so the error stays visible to the shutdown path too
+            # (the reference's peek/re-put trick, TFSparkNode.py:624-630).
+            equeue.put(tb)
+            raise RuntimeError(f"training function failed:\n{tb}")
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"data feed not consumed within {timeout}s — the training "
+                f"process is likely dead or stuck")
+        joined.wait(0.5)
+
+
+def shutdown(cluster_info, queues=("input",), grace_secs=0):
+    """Build the per-executor shutdown closure (maps TFSparkNode.shutdown,
+    TFSparkNode.py:582-636): push end-of-feed sentinels, wait out the grace
+    period (chief may still be exporting), surface late errors, mark stopped."""
+
+    def _shutdown(iterator):
+        for _ in iterator:
+            pass
+        mgr = _get_manager(cluster_info, util.get_ip_address(), util.read_executor_id())
+        for qname in queues:
+            try:
+                mgr.get_queue(qname).put(None)
+            except Exception:
+                logger.warning("could not push sentinel into %s", qname)
+        if grace_secs:
+            time.sleep(grace_secs)
+        # Late-error surfacing with the peek/re-put trick
+        # (maps TFSparkNode.py:624-630): leave the error visible for other
+        # shutdown paths while still raising here.
+        equeue = mgr.get_queue("error")
+        late_error = None
+        if not equeue.empty():
+            tb = equeue.get()
+            equeue.task_done()
+            equeue.put(tb)
+            late_error = tb
+        # Marking 'stopped' is the manager's death warrant: the executor's
+        # bootstrap process waits for this state, then stops the manager and
+        # exits (backend._bootstrap_trampoline) — the node process gets its
+        # full grace window first.
+        mgr.set("state", "stopped")
+        if late_error is not None:
+            raise RuntimeError(f"node failed after feeding completed:\n{late_error}")
+
+    return _shutdown

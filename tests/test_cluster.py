@@ -1,0 +1,105 @@
+"""Distributed integration tests on the local multi-process backend
+(models reference tests/test_TFCluster.py:1-95 — including the
+sum-of-squares round trip and both fault-injection cases)."""
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import backend, cluster
+
+NUM_EXECUTORS = 2
+
+
+def _local_backend(tmp_path):
+    return backend.LocalBackend(NUM_EXECUTORS, workdir=str(tmp_path))
+
+
+# --- map functions (must be module-level: they cross process boundaries) ---
+
+def fn_independent(args, ctx):
+    # independent single-node fns with args (reference: test_TFCluster.py:29-38)
+    assert args["expected"] == "something"
+    assert ctx.num_workers == NUM_EXECUTORS
+
+
+def fn_square(args, ctx):
+    df = ctx.get_data_feed(train_mode=False)
+    while not df.should_stop():
+        batch = df.next_batch(10)
+        if batch:
+            df.batch_results([x * x for x in batch])
+
+
+def fn_fail_during_feed(args, ctx):
+    df = ctx.get_data_feed()
+    df.next_batch(1)
+    raise RuntimeError("injected failure mid-feed")
+
+
+def fn_fail_after_feed(args, ctx):
+    df = ctx.get_data_feed()
+    while not df.should_stop():
+        df.next_batch(10)
+    raise RuntimeError("injected failure after feeding")
+
+
+def fn_train_consume(args, ctx):
+    df = ctx.get_data_feed()
+    total = 0
+    while not df.should_stop():
+        total += sum(df.next_batch(10))
+
+
+# --- tests ---
+
+def test_independent_fns(tmp_path):
+    c = cluster.run(_local_backend(tmp_path), fn_independent,
+                    tf_args={"expected": "something"},
+                    num_executors=NUM_EXECUTORS,
+                    input_mode=cluster.InputMode.SPARK)
+    c.shutdown()
+
+
+def test_inference_roundtrip_sum_of_squares(tmp_path):
+    """The canonical first integration test (SURVEY.md §7): squares of 0..99
+    computed in the cluster, summed on the driver against analytic truth."""
+    c = cluster.run(_local_backend(tmp_path), fn_square, tf_args={},
+                    num_executors=NUM_EXECUTORS,
+                    input_mode=cluster.InputMode.SPARK)
+    data = list(range(100))
+    parts = [data[i::4] for i in range(4)]  # 4 partitions over 2 executors
+    results = c.inference(parts)
+    assert sum(results) == sum(x * x for x in data)
+    c.shutdown()
+
+
+def test_train_then_shutdown(tmp_path):
+    c = cluster.run(_local_backend(tmp_path), fn_train_consume, tf_args={},
+                    num_executors=NUM_EXECUTORS,
+                    input_mode=cluster.InputMode.SPARK)
+    parts = [list(range(50)), list(range(50, 100))]
+    c.train(parts, num_epochs=2, feed_timeout=60)
+    c.shutdown(grace_secs=1)
+
+
+def test_error_during_feeding_raises(tmp_path):
+    # maps reference test_TFCluster.py:50-68 (feed_timeout path)
+    c = cluster.run(_local_backend(tmp_path), fn_fail_during_feed, tf_args={},
+                    num_executors=NUM_EXECUTORS,
+                    input_mode=cluster.InputMode.SPARK)
+    parts = [list(range(1000)), list(range(1000))]
+    with pytest.raises(Exception, match="injected failure mid-feed|task .* failed"):
+        c.train(parts, feed_timeout=15)
+    with pytest.raises(Exception):
+        c.shutdown(grace_secs=1)
+
+
+def test_error_after_feeding_raises(tmp_path):
+    # maps reference test_TFCluster.py:70-91 (grace_secs path)
+    c = cluster.run(_local_backend(tmp_path), fn_fail_after_feed, tf_args={},
+                    num_executors=NUM_EXECUTORS,
+                    input_mode=cluster.InputMode.SPARK)
+    parts = [list(range(10)), list(range(10, 20))]
+    c.train(parts, feed_timeout=60)
+    with pytest.raises(Exception, match="injected failure after feeding|failed"):
+        c.shutdown(grace_secs=3)

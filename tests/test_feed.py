@@ -1,0 +1,131 @@
+"""DataFeed batch-semantics tests (models reference tests/test_TFNode.py:27-58)."""
+import uuid
+
+import numpy as np
+
+from tensorflowonspark_tpu import feed, manager, marker
+
+
+def _mgr(queues=("input", "output", "error")):
+    return manager.start(uuid.uuid4().bytes, list(queues), mode="local")
+
+
+def test_next_batch_plain_and_end_of_feed():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        for i in range(5):
+            q.put(i)
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        assert df.next_batch(3) == [0, 1, 2]
+        assert not df.should_stop()
+        assert df.next_batch(3) == [3, 4]
+        assert df.should_stop()
+        assert df.next_batch(3) == []
+    finally:
+        mgr.shutdown()
+
+
+def test_next_batch_chunked():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        q.put(marker.Chunk(list(range(7))))
+        q.put(marker.Chunk(list(range(7, 10))))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        assert df.next_batch(4) == [0, 1, 2, 3]
+        assert df.next_batch(4) == [4, 5, 6, 7]
+        assert df.next_batch(4) == [8, 9]
+        assert df.should_stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_end_partition_flushes_early():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        q.put(marker.Chunk([1, 2, 3]))
+        q.put(marker.EndPartition())
+        q.put(marker.Chunk([4, 5]))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        # partition boundary ends the batch early so results stay 1:1
+        assert df.next_batch(10) == [1, 2, 3]
+        assert df.next_batch(10) == [4, 5]
+        assert df.should_stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_input_mapping():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        q.put(marker.Chunk([(1, "a"), (2, "b")]))
+        q.put(None)
+        df = feed.DataFeed(mgr, input_mapping={0: "x", 1: "label"})
+        batch = df.next_batch(2)
+        assert batch == {"x": [1, 2], "label": ["a", "b"]}
+    finally:
+        mgr.shutdown()
+
+
+def test_numpy_batch_tuple_records():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        q.put(marker.Chunk([([1.0, 2.0], 0), ([3.0, 4.0], 1)]))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        x, y = df.next_numpy_batch(2)
+        np.testing.assert_array_equal(x, [[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_array_equal(y, [0, 1])
+    finally:
+        mgr.shutdown()
+
+
+def test_batch_results_roundtrip():
+    mgr = _mgr()
+    try:
+        df = feed.DataFeed(mgr)
+        df.batch_results([10, 20, 30])
+        out = mgr.get_queue("output")
+        got = [out.get() for _ in range(3)]
+        assert got == [10, 20, 30]
+    finally:
+        mgr.shutdown()
+
+
+def test_terminate_drains():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        for i in range(4):
+            q.put(i)
+        df = feed.DataFeed(mgr)
+        df.terminate()
+        assert manager.get_value(mgr, "state") == "terminating"
+        q.join()  # all items were task_done'd by the drain
+    finally:
+        mgr.shutdown()
+
+
+class _Ctx:
+    default_fs = "hdfs://nn:8020"
+    user_name = "alice"
+    working_dir = "/tmp/wd"
+
+
+def test_hdfs_path_matrix():
+    ctx = _Ctx()
+    assert feed.hdfs_path(ctx, "hdfs://other/x") == "hdfs://other/x"
+    assert feed.hdfs_path(ctx, "gs://bucket/x") == "gs://bucket/x"
+    assert feed.hdfs_path(ctx, "/abs/path") == "hdfs://nn:8020/abs/path"
+    assert feed.hdfs_path(ctx, "rel/path") == "hdfs://nn:8020/user/alice/rel/path"
+    ctx2 = _Ctx()
+    ctx2.default_fs = "file://"
+    assert feed.hdfs_path(ctx2, "/abs/path") == "/abs/path"
+    assert feed.hdfs_path(ctx2, "rel/path") == "/tmp/wd/rel/path"
