@@ -1,0 +1,72 @@
+"""Benchmark entry point (run by the driver on real TPU hardware).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Metric: MNIST CNN training throughput (images/sec) including the host->HBM
+transfer per step — the TPU-native analog of the reference's canonical
+InputMode.SPARK MNIST example (examples/mnist/keras/mnist_spark.py).  The
+reference publishes no numbers (BASELINE.md: "published: {}"), so
+vs_baseline is reported against our own recorded north-star target placeholder
+(1.0 = the value itself is the baseline being established this round).
+"""
+import json
+import time
+
+
+def bench_mnist_cnn(batch_size=1024, steps=30, warmup=5):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models.cnn import MnistCNN
+    from tensorflowonspark_tpu.models.mlp import cross_entropy_loss
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    model = MnistCNN()
+    rng = jax.random.key(0)
+    X_host = np.random.RandomState(0).rand(batch_size, 28, 28, 1).astype("float32")
+    y_host = np.random.RandomState(1).randint(0, 10, batch_size).astype("int32")
+    params = model.init(rng, jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def loss_fn(params, batch, rng):
+        X, y = batch
+        logits = model.apply({"params": params}, X)
+        return cross_entropy_loss(logits, y)
+
+    opt = optax.adam(1e-3)
+    state = train_mod.TrainState(jnp.zeros((), jnp.int32), params,
+                                 opt.init(params))
+    step = train_mod.make_train_step(loss_fn, opt, donate=False)
+
+    def one_step(state):
+        # include host->device transfer: the DataFeed path lands numpy
+        # batches that must cross PCIe/ICI into HBM each step
+        batch = (jax.device_put(X_host), jax.device_put(y_host))
+        state, metrics = step(state, batch, rng)
+        return state, metrics
+
+    for _ in range(warmup):
+        state, metrics = one_step(state)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = one_step(state)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return batch_size * steps / dt
+
+
+def main():
+    value = bench_mnist_cnn()
+    print(json.dumps({
+        "metric": "mnist_cnn_train_throughput",
+        "value": round(value, 1),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

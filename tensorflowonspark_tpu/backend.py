@@ -163,7 +163,7 @@ class LocalBackend(Backend):
         except queue_mod.Empty:
             return None
 
-    def _run_tasks(self, partitions, fn, collect):
+    def _run_tasks(self, partitions, fn, collect, timeout=None):
         """Run one task per partition: partitions for different executors run
         concurrently; multiple partitions routed to the SAME executor run
         sequentially.  Serialization per executor matters for correctness —
@@ -177,14 +177,20 @@ class LocalBackend(Backend):
         for i, part in enumerate(parts):
             by_exec.setdefault(i % self._n, []).append((i, list(part)))
 
+        live_procs = []
+        cancelled = threading.Event()
+
         def _run_serial(eid, tasks):
             for index, part in tasks:
+                if cancelled.is_set():
+                    return
                 p = self._ctx.Process(
                     target=_task_trampoline,
                     args=(fn, part, result_q, index, self._dirs[eid], collect),
                     name=f"task-{index}",
                 )
                 p.start()
+                live_procs.append(p)
                 p.join()
 
         threads = [threading.Thread(target=_run_serial, args=(eid, tasks))
@@ -194,10 +200,21 @@ class LocalBackend(Backend):
         results = [None] * len(parts)
         errors = []
         seen = 0
+        deadline = None if timeout is None else time.time() + timeout
         while seen < len(parts):
             try:
                 index, kind, payload = result_q.get(timeout=1)
             except queue_mod.Empty:
+                if deadline is not None and time.time() > deadline:
+                    # Bound the teardown: kill wedged task processes so the
+                    # caller's timeout contract holds (the reference used
+                    # SIGALRM on the driver, TFCluster.py:136-144).
+                    cancelled.set()
+                    for p in live_procs:
+                        if p.is_alive():
+                            p.terminate()
+                    errors.append((-1, f"tasks exceeded {timeout}s timeout"))
+                    break
                 if not any(t.is_alive() for t in threads):
                     errors.append((-1, "task process died without reporting "
                                        "(killed or crashed hard)"))
@@ -216,12 +233,12 @@ class LocalBackend(Backend):
             raise RuntimeError(f"task {index} failed:\n{tb}")
         return results
 
-    def foreach_partition(self, partitions, fn):
-        self._run_tasks(partitions, fn, collect=False)
+    def foreach_partition(self, partitions, fn, timeout=None):
+        self._run_tasks(partitions, fn, collect=False, timeout=timeout)
 
     def map_partitions(self, partitions, fn):
         nested = self._run_tasks(partitions, fn, collect=True)
-        return [item for part in nested for item in part]
+        return [item for part in nested if part for item in part]
 
     def join(self, timeout=None):
         """Wait for all bootstrap (executor) processes to exit."""
@@ -261,15 +278,34 @@ class SparkBackend(Backend):
         t = threading.Thread(target=node_rdd.foreachPartition, args=(fn,), daemon=True)
         t.start()
 
-    def foreach_partition(self, partitions, fn):
-        rdd = partitions if hasattr(partitions, "foreachPartition") else \
-            self._sc.parallelize(partitions, len(list(partitions)))
-        rdd.foreachPartition(fn)
+    @staticmethod
+    def _adapt(fn):
+        """Wrap a record-iterator closure for an RDD whose ELEMENTS are
+        partition-lists (the shape `parallelize(list_of_partitions)`
+        produces): unwrap one level so fn still sees records."""
+        def run(element_iter):
+            for part in element_iter:
+                out = fn(iter(part))
+                if out is not None:
+                    yield from out
+        return run
+
+    def _as_rdd(self, partitions):
+        """(rdd, fn_adapter) for either a real RDD or a list of partition
+        lists.  Materializes generators exactly once so nothing is silently
+        consumed before parallelize."""
+        if hasattr(partitions, "foreachPartition"):
+            return partitions, lambda fn: fn
+        parts = [list(p) for p in partitions]
+        return self._sc.parallelize(parts, max(len(parts), 1)), self._adapt
+
+    def foreach_partition(self, partitions, fn, timeout=None):
+        rdd, adapt = self._as_rdd(partitions)
+        rdd.foreachPartition(adapt(fn))
 
     def map_partitions(self, partitions, fn):
-        rdd = partitions if hasattr(partitions, "mapPartitions") else \
-            self._sc.parallelize(partitions, len(list(partitions)))
-        return rdd.mapPartitions(fn)  # lazy RDD, like the reference
+        rdd, adapt = self._as_rdd(partitions)
+        return rdd.mapPartitions(adapt(fn))  # lazy RDD, like the reference
 
 
 def resolve(backend_or_sc):

@@ -31,6 +31,26 @@ class InputMode:
     SPARK = 1
 
 
+class _StatusView(dict):
+    """Driver-side error status that also surfaces executor bootstrap
+    failures (reported through the backend's status channel) into
+    `await_reservations`'s polling loop, so a node that dies before it can
+    reach the rendezvous server aborts the launch immediately instead of
+    burning the whole reservation timeout."""
+
+    def __init__(self, backend):
+        super().__init__(error=None)
+        self._backend = backend
+
+    def get(self, key, default=None):
+        if key == "error" and not super().get("error") and \
+                hasattr(self._backend, "check_bootstrap_errors"):
+            err = self._backend.check_bootstrap_errors()
+            if err:
+                self["error"] = err
+        return super().get(key, default)
+
+
 class TPUCluster:
     """Handle to a running cluster (maps the TFCluster object, TFCluster.py:48-212)."""
 
@@ -94,10 +114,13 @@ class TPUCluster:
             workers = [eid for j in ("chief", "worker")
                        for eid in self.cluster_meta["cluster_template"].get(j, [])]
             shutdown_parts = [[eid] for eid in sorted(workers)]
+            kwargs = {}
+            if isinstance(self._backend, backend_mod.LocalBackend):
+                kwargs["timeout"] = timeout  # hard bound on wedged teardown
             self._backend.foreach_partition(
                 shutdown_parts,
                 node.shutdown(self.cluster_info, queues=self.queues_to_close,
-                              grace_secs=grace_secs))
+                              grace_secs=grace_secs), **kwargs)
             self._check_driver_error()
             # Evaluator nodes run remote-mode managers so the driver can push
             # their stop sentinel directly (maps TFCluster.py:186-194); then
@@ -106,8 +129,11 @@ class TPUCluster:
             for n in self.cluster_info:
                 if n["job_name"] == "evaluator":
                     mgr = manager_mod.connect(tuple(n["addr"]), n["authkey"])
-                    mgr.get_queue("control").put(None)
-                    mgr.get_queue("input").put(None)
+                    for qname in ("control", "input"):
+                        try:
+                            mgr.get_queue(qname).put(None)
+                        except Exception:
+                            pass  # user configured a custom queue set
                     mgr.set("state", "stopped")
         finally:
             watchdog.cancel()
@@ -127,13 +153,9 @@ class TPUCluster:
         return None
 
     def _check_driver_error(self):
-        if self._status.get("error"):
-            raise RuntimeError(f"cluster failed: {self._status['error']}")
-        if isinstance(self._backend, backend_mod.LocalBackend):
-            err = self._backend.check_bootstrap_errors()
-            if err:
-                self._status["error"] = err
-                raise RuntimeError(f"node bootstrap failed:\n{err}")
+        err = self._status.get("error")  # _StatusView folds in backend errors
+        if err:
+            raise RuntimeError(f"cluster failed:\n{err}")
 
 
 def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
@@ -182,7 +204,7 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
         "reservation_timeout": reservation_timeout,
     }
 
-    status = {"error": None}
+    status = _StatusView(backend)
     background = input_mode == InputMode.SPARK
 
     def _launch():

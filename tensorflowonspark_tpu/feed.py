@@ -120,12 +120,25 @@ class DataFeed:
                          for i in range(ncols))
         return np.asarray(batch, dtype=dtype)
 
+    @staticmethod
+    def _is_empty(batch):
+        """Recognize an empty batch in every shape next_batch can return:
+        None, [], {}, a mapping of empty columns, a tuple of empty arrays,
+        or a zero-length array."""
+        if batch is None:
+            return True
+        if isinstance(batch, dict):
+            return all(len(v) == 0 for v in batch.values()) or not batch
+        if isinstance(batch, tuple):
+            return all(len(v) == 0 for v in batch) or not batch
+        return hasattr(batch, "__len__") and len(batch) == 0
+
     def iter_batches(self, batch_size, numpy=False):
         """Generator over batches until end-of-feed."""
         while not self.should_stop():
             batch = (self.next_numpy_batch(batch_size) if numpy
                      else self.next_batch(batch_size))
-            if batch is None or (hasattr(batch, "__len__") and len(batch) == 0):
+            if self._is_empty(batch):
                 if self.should_stop():
                     break
                 continue

@@ -96,29 +96,6 @@ def start(authkey, queues, mode="local"):
 _started_managers = []
 
 
-def shutdown_remote(addr, authkey):
-    """Ask a manager server (possibly in another process tree) to exit.
-
-    BaseManager.shutdown() only works on the instance that called start();
-    this sends the same protocol message over a fresh connection, letting the
-    cluster-shutdown closure stop managers it didn't create.
-    """
-    from multiprocessing.connection import Client as ConnClient
-    from multiprocessing.managers import dispatch
-
-    if not isinstance(authkey, bytes):
-        authkey = bytes(authkey)
-    mp.current_process().authkey = authkey
-    try:
-        conn = ConnClient((addr[0], int(addr[1])), authkey=authkey)
-        try:
-            dispatch(conn, None, "shutdown")
-        finally:
-            conn.close()
-    except (EOFError, OSError, ConnectionError):
-        pass  # already gone
-
-
 def get_value(mgr, key):
     """Unwrap a kv value from its AutoProxy (proxies str-ify with quotes)."""
     proxy = mgr.get(key)

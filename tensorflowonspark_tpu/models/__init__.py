@@ -1,0 +1,20 @@
+"""Model zoo: TPU-idiomatic flax implementations of the model families the
+reference's examples exercise (MNIST MLP/CNN, ResNet/CIFAR, UNet
+segmentation — SURVEY.md §2.5) plus the net-new transformer/BERT family used
+for the distributed-parallelism benchmarks.
+"""
+
+_REGISTRY = {
+    "mnist_mlp": ("tensorflowonspark_tpu.models.mlp", "MnistMLP"),
+    "mnist_cnn": ("tensorflowonspark_tpu.models.cnn", "MnistCNN"),
+    "resnet": ("tensorflowonspark_tpu.models.resnet", "ResNet"),
+    "unet": ("tensorflowonspark_tpu.models.unet", "UNet"),
+    "transformer": ("tensorflowonspark_tpu.models.transformer", "Transformer"),
+}
+
+
+def get_model(name, **kwargs):
+    import importlib
+    mod_name, cls_name = _REGISTRY[name]
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    return cls(**kwargs)

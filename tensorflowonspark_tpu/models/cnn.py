@@ -1,0 +1,28 @@
+"""MNIST CNN (parity with the reference's conv model in
+examples/mnist/keras/mnist_spark.py:34-44: two conv blocks + dropout head).
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU) and channel counts
+kept in MXU-friendly multiples.
+"""
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        if x.ndim == 2:  # flat 784 input from an RDD feed
+            x = x.reshape((-1, 28, 28, 1))
+        x = x.astype(jnp.float32)
+        x = nn.Conv(32, (3, 3), name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, name="logits")(x)
+        return x

@@ -1,0 +1,23 @@
+"""MNIST MLP — the minimal end-to-end model (parity with the reference's
+Keras Sequential MLP used in examples/mnist/keras/mnist_spark.py's model)."""
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistMLP(nn.Module):
+    hidden: int = 512
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.Dense(self.hidden, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, name="logits")(x)
+        return x
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean softmax cross entropy; labels are int class ids."""
+    import optax
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()

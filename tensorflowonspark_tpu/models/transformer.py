@@ -1,0 +1,202 @@
+"""Transformer LM — the flagship distributed model family.
+
+Net-new relative to the reference (which is DP-only, SURVEY.md §2.3): this
+model is built so that the framework's sharding rules
+(parallel/sharding.DEFAULT_RULES) give Megatron-style tensor parallelism by
+name — column-parallel query/key/value and mlp.wi, row-parallel attn.out and
+mlp.wo, vocab-sharded embedding/lm_head — and XLA inserts the tp collectives
+from the shardings alone.  Long-context support comes from ring attention
+(parallel/ring_attention.py) engaged when sequence shards are placed on the
+tp axis; MoE layers shard experts over the ep (=dp) axis.
+
+TPU notes: bfloat16 activations, f32 layernorm/softmax accumulators, static
+shapes everywhere, einsum formulations that map onto the MXU.
+"""
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    causal: bool = True
+    dtype: str = "bfloat16"
+    num_experts: int = 0          # 0 = dense MLP; >0 = MoE with EP sharding
+    moe_every: int = 2            # every k-th layer is MoE (when enabled)
+    remat: bool = False
+    ring_attention_axis: Optional[str] = None  # e.g. "tp" to enable CP
+    sp_axis: Optional[str] = None  # Megatron-SP: shard residual stream's
+    # sequence dim over this axis between blocks (usually "tp")
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        head_dim = cfg.d_model // cfg.n_heads
+        q = nn.Dense(cfg.d_model, use_bias=False, name="query", dtype=dtype)(x)
+        k = nn.Dense(cfg.d_model, use_bias=False, name="key", dtype=dtype)(x)
+        v = nn.Dense(cfg.d_model, use_bias=False, name="value", dtype=dtype)(x)
+        B, S = x.shape[0], x.shape[1]
+        q = q.reshape(B, S, cfg.n_heads, head_dim)
+        k = k.reshape(B, S, cfg.n_heads, head_dim)
+        v = v.reshape(B, S, cfg.n_heads, head_dim)
+
+        if cfg.ring_attention_axis:
+            from tensorflowonspark_tpu.parallel.ring_attention import (
+                ring_attention)
+            out = ring_attention(q, k, v, axis_name=cfg.ring_attention_axis,
+                                 causal=cfg.causal)
+        else:
+            out = dot_product_attention(q, k, v, causal=cfg.causal)
+        out = out.reshape(B, S, cfg.d_model)
+        return nn.Dense(cfg.d_model, use_bias=False, name="out", dtype=dtype)(out)
+
+
+def dot_product_attention(q, k, v, causal=True):
+    """Standard attention with f32 softmax accumulation.
+
+    [B, S, H, D] inputs; einsum layouts chosen so the two matmuls land on
+    the MXU as [S, D] x [D, S] and [S, S] x [S, D] per (batch, head).
+    """
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S_q, S_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((S_q, S_k), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class DenseMLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = jnp.dtype(self.cfg.dtype)
+        h = nn.Dense(self.cfg.d_ff, use_bias=False, name="wi", dtype=dtype)(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.cfg.d_model, use_bias=False, name="wo", dtype=dtype)(h)
+
+
+class MoEMLP(nn.Module):
+    """Mixture-of-experts MLP with top-1 routing (Switch-style).
+
+    Expert weights carry a leading [num_experts] dim that the sharding rules
+    place on the ep axis; routing uses dense einsum dispatch (one-hot
+    combine) — static shapes, MXU-friendly, no sorting, at the cost of
+    capacity = full batch per expert.  Fine at test scale; a capacity-based
+    dispatch is a later optimization.
+    """
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, D = x.shape
+        E = cfg.num_experts
+        gate_logits = nn.Dense(E, use_bias=False, name="router")(
+            x.astype(jnp.float32))
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        top_idx = jnp.argmax(probs, axis=-1)                 # [B, S]
+        top_p = jnp.take_along_axis(probs, top_idx[..., None], axis=-1)
+        dispatch = jax.nn.one_hot(top_idx, E, dtype=dtype)   # [B, S, E]
+
+        wi = self.param("experts_wi/kernel", nn.initializers.lecun_normal(),
+                        (E, D, cfg.d_ff)).astype(dtype)
+        wo = self.param("experts_wo/kernel", nn.initializers.lecun_normal(),
+                        (E, cfg.d_ff, D)).astype(dtype)
+        # dispatch every token to every expert slot densely, mask by routing
+        xe = jnp.einsum("bsd,bse->ebsd", x, dispatch)
+        h = jnp.einsum("ebsd,edf->ebsf", xe, wi)
+        h = nn.gelu(h)
+        ye = jnp.einsum("ebsf,efd->ebsd", h, wo)
+        y = jnp.einsum("ebsd->bsd", ye)
+        # aux load-balancing loss (Switch): E * sum_e (frac_tokens * frac_prob)
+        frac_tokens = jnp.mean(dispatch.astype(jnp.float32), axis=(0, 1))
+        frac_probs = jnp.mean(probs, axis=(0, 1))
+        aux = E * jnp.sum(frac_tokens * frac_probs)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return y * top_p.astype(dtype)
+
+
+def _sp_constrain(x, cfg):
+    """Megatron sequence parallelism: between blocks the residual stream is
+    sharded over sequence on the sp axis, so the layernorms and elementwise
+    work are divided N_tp-ways and XLA turns the tp allreduces into
+    reduce-scatter + all-gather pairs at block entry/exit."""
+    if not cfg.sp_axis:
+        return x
+    from jax.sharding import PartitionSpec as P
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, P("dp", cfg.sp_axis, None))
+    except Exception:
+        return x  # no mesh context active (single-device runs)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = _sp_constrain(x, self.cfg)
+        h = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x)
+        x = x + Attention(self.cfg, name="attn")(h)
+        x = _sp_constrain(x, self.cfg)
+        h = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x)
+        mlp = (MoEMLP(self.cfg, name="moe") if self.use_moe
+               else DenseMLP(self.cfg, name="mlp"))
+        return x + mlp(h)
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
+                     dtype=dtype)(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.d_model, name="pos_embed",
+                       dtype=dtype)(jnp.arange(tokens.shape[1])[None])
+        x = x + pos
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block)
+        for i in range(cfg.n_layers):
+            use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
+            x = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                          dtype=dtype)(x)
+        return logits
+
+
+def lm_loss(logits, targets, ignore_id=-1):
+    """Causal-LM cross entropy written gather-free (one-hot einsum) so a
+    vocab-sharded lm_head works under jit sharding propagation."""
+    vocab = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    onehot = jax.nn.one_hot(jnp.maximum(targets, 0), vocab, dtype=jnp.float32)
+    gold = jnp.einsum("bsv,bsv->bs", logits, onehot)
+    mask = (targets != ignore_id).astype(jnp.float32)
+    return jnp.sum((logz - gold) * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -157,6 +157,26 @@ def run(map_fun, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         assert job_name is not None, f"executor {executor_id} not in cluster template"
         logger.info("executor %d assigned %s:%d", executor_id, job_name, task_index)
 
+        # Connect to the rendezvous server FIRST so that any bootstrap
+        # failure below (duplicate-bootstrap, manager start, chip probe) is
+        # reported to the driver instead of silently burning the full
+        # reservation timeout.
+        client = reservation.Client(cluster_meta["server_addr"])
+        try:
+            _bootstrap(executor_id, job_name, task_index, client, map_fun,
+                       tf_args, cluster_meta, tensorboard, queues, background)
+        except BaseException as e:
+            client.report_error(
+                {"executor_id": executor_id, "job_name": job_name}, repr(e))
+            raise
+        finally:
+            client.close()
+
+    return _mapfn
+
+
+def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
+               cluster_meta, tensorboard, queues, background):
         # 2. stale-manager detection: a Spark task retry on the same executor
         #    must not double-start a node (maps TFSparkNode.py:249-255).
         state_file = os.path.join(os.getcwd(), ".tfos_cluster_id")
@@ -193,7 +213,6 @@ def run(map_fun, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 util.get_free_port(host)
 
         # 7. register & rendezvous (maps TFSparkNode.py:321-360)
-        client = reservation.Client(cluster_meta["server_addr"])
         node_meta = {
             "executor_id": executor_id,
             "host": host,
@@ -254,20 +273,32 @@ def run(map_fun, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 logger.info("started background node process pid=%d", p.pid)
             else:
                 _wrapper_fn(map_fun, tf_args, ctx)
-        except BaseException as e:
+        except BaseException:
             tb = traceback.format_exc()
             logger.error("node fn failed on executor %d:\n%s", executor_id, tb)
             try:
                 mgr.get_queue("error").put(tb)
             except Exception:
                 pass
-            client.report_error(
-                {"executor_id": executor_id, "job_name": job_name}, str(e))
-            raise
-        finally:
-            client.close()
+            raise  # _mapfn's outer handler reports to the rendezvous server
 
-    return _mapfn
+
+def _push_chunks(q, iterator):
+    """Push records as Chunk batches (one queue item per CHUNK_SIZE records);
+    returns the record count.  Shared by the train and inference feeders —
+    inference's 1:1 result accounting depends on this count being exact."""
+    count = 0
+    chunk = []
+    for item in iterator:
+        chunk.append(item)
+        if len(chunk) >= CHUNK_SIZE:
+            q.put(marker.Chunk(chunk))
+            count += len(chunk)
+            chunk = []
+    if chunk:
+        q.put(marker.Chunk(chunk))
+        count += len(chunk)
+    return count
 
 
 def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
@@ -295,17 +326,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
         q = mgr.get_queue(qname)
         equeue = mgr.get_queue("error")
-        count = 0
-        chunk = []
-        for item in iterator:
-            chunk.append(item)
-            if len(chunk) >= CHUNK_SIZE:
-                q.put(marker.Chunk(chunk))
-                count += len(chunk)
-                chunk = []
-        if chunk:
-            q.put(marker.Chunk(chunk))
-            count += len(chunk)
+        count = _push_chunks(q, iterator)
         logger.info("pushed %d records into %s queue", count, qname)
 
         _join_with_watchdog(q, equeue, feed_timeout)
@@ -322,17 +343,7 @@ def inference(cluster_info, cluster_meta, qname="input"):
         mgr = _get_manager(cluster_info, util.get_ip_address(), util.read_executor_id())
         q = mgr.get_queue(qname)
         equeue = mgr.get_queue("error")
-        count = 0
-        chunk = []
-        for item in iterator:
-            chunk.append(item)
-            if len(chunk) >= CHUNK_SIZE:
-                q.put(marker.Chunk(chunk))
-                count += len(chunk)
-                chunk = []
-        if chunk:
-            q.put(marker.Chunk(chunk))
-            count += len(chunk)
+        count = _push_chunks(q, iterator)
         q.put(marker.EndPartition())
         logger.info("pushed %d records (+EndPartition) into %s queue", count, qname)
         if count == 0:

@@ -1,0 +1,115 @@
+"""Device-mesh construction and axis conventions.
+
+Axis names (fixed vocabulary used by every sharding rule in the framework):
+
+- ``dp``   — data parallel: batch is split, gradients allreduced (the
+             TPU-native replacement for the reference's
+             MultiWorkerMirroredStrategy path, SURVEY.md §2.3).
+- ``fsdp`` — data parallel with parameter sharding (ZeRO-3 style): batch
+             split like dp, parameters/optimizer state sharded and
+             all-gathered per layer.
+- ``pp``   — pipeline parallel: layers are partitioned into stages.
+- ``tp``   — tensor parallel (Megatron-style): weight matrices split.
+             Sequence parallelism (``sp``) reuses this axis: activations
+             outside attention/mlp blocks are sharded over sequence on the
+             same devices that shard weights.
+- ``ep``   — expert parallel for MoE layers; experts are distributed over
+             this axis (aliases a slice of the dp axis when not explicit).
+
+Mesh-axis ORDER is (dp, fsdp, pp, tp): the innermost axis (tp) maps to the
+most tightly-coupled devices (same host / shortest ICI hops), which is what
+`jax.make_mesh` optimizes for; dp/fsdp collectives tolerate longer paths and
+DCN when multi-slice.
+"""
+import dataclasses
+import logging
+import math
+
+logger = logging.getLogger(__name__)
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_PP = "pp"
+AXIS_TP = "tp"
+ALL_AXES = (AXIS_DP, AXIS_FSDP, AXIS_PP, AXIS_TP)
+
+# Axes over which a data batch is split (used for per-host feed sharding and
+# for gradient psum).
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout.  -1 for dp means "whatever is left"."""
+    dp: int = -1
+    fsdp: int = 1
+    pp: int = 1
+    tp: int = 1
+
+    def resolve(self, num_devices):
+        fixed = self.fsdp * self.pp * self.tp
+        if self.dp == -1:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fsdp*pp*tp={fixed}")
+            dp = num_devices // fixed
+        else:
+            dp = self.dp
+        total = dp * fixed
+        if total != num_devices:
+            raise ValueError(
+                f"mesh {dp}x{self.fsdp}x{self.pp}x{self.tp}={total} does not "
+                f"match {num_devices} devices")
+        return MeshSpec(dp=dp, fsdp=self.fsdp, pp=self.pp, tp=self.tp)
+
+    @property
+    def shape(self):
+        return (self.dp, self.fsdp, self.pp, self.tp)
+
+    @property
+    def batch_size_divisor(self):
+        return self.dp * self.fsdp
+
+
+def build_mesh(spec=None, devices=None):
+    """Build a `jax.sharding.Mesh` with the framework's canonical axes."""
+    import jax
+    import numpy as np
+
+    devs = list(devices) if devices is not None else jax.devices()
+    spec = (spec or MeshSpec()).resolve(len(devs))
+    # Auto axis types = classic GSPMD propagation: the compiler may insert
+    # collectives (partial-sum allreduce for row-parallel matmuls,
+    # reduce-scatter/all-gather at SP boundaries) instead of treating
+    # shardings as assertions, which is what Megatron-style TP+SP needs.
+    axis_types = (jax.sharding.AxisType.Auto,) * len(ALL_AXES)
+    if devices is None and hasattr(jax, "make_mesh"):
+        # make_mesh picks a device order that keeps inner axes on short ICI
+        # paths — use it whenever we're not given an explicit device list.
+        mesh = jax.make_mesh(spec.shape, ALL_AXES, axis_types=axis_types)
+    else:
+        mesh = jax.sharding.Mesh(
+            np.asarray(devs).reshape(spec.shape), ALL_AXES,
+            axis_types=axis_types)
+    logger.info("built mesh %s over %d devices", dict(zip(ALL_AXES, spec.shape)),
+                len(devs))
+    return mesh
+
+
+def local_mesh_spec(num_devices=None, tp=1, pp=1, fsdp=1):
+    """Convenience: all remaining devices to dp."""
+    import jax
+    n = num_devices or len(jax.devices())
+    return MeshSpec(dp=-1, fsdp=fsdp, pp=pp, tp=tp).resolve(n)
+
+
+def batch_sharding(mesh):
+    """NamedSharding for a [batch, ...] input: batch split over dp+fsdp."""
+    import jax
+    P = jax.sharding.PartitionSpec
+    return jax.sharding.NamedSharding(mesh, P(BATCH_AXES))
+
+
+def replicated_sharding(mesh):
+    import jax
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())

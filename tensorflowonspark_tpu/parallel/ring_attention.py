@@ -1,0 +1,103 @@
+"""Ring attention: exact attention over sequence shards with O(S/N) memory
+per device and K/V blocks rotated around the mesh axis via `lax.ppermute`.
+
+Long-context machinery is absent from the reference (SURVEY.md §5
+"Long-context / sequence parallelism: absent"); here it is first-class: the
+sequence axis of q/k/v is sharded over a mesh axis (context parallelism) and
+each device computes its queries against every K/V block as the blocks flow
+around the ring, maintaining a numerically-stable online softmax
+(flash-attention style running max/denominator), so the result is EXACTLY
+dense attention.
+
+Collectives ride ICI: each step's ppermute is a neighbor exchange, which is
+the optimal pattern on a TPU torus.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _online_update(o, m, l, logits, v_blk):
+    """One block's contribution via streaming softmax.
+
+    o: [B, Sq, H, D] accumulated (unnormalized) output
+    m: [B, H, Sq]    running max
+    l: [B, H, Sq]    running denominator
+    logits: [B, H, Sq, Sk] this block's scores (f32, already masked)
+    """
+    m_blk = jnp.max(logits, axis=-1)                       # [B,H,Sq]
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows: exp(-inf - -inf) -> use safe max
+    alpha = jnp.exp(m - m_new)                              # rescale old
+    p = jnp.exp(logits - m_new[..., None])                  # [B,H,Sq,Sk]
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk).astype(jnp.float32)
+    return o_new, m_new, l_new
+
+
+def _ring_attention_local(q, k, v, axis_name, causal):
+    """Body running under shard_map: q/k/v are the LOCAL sequence blocks."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+
+    q32 = q
+    o = jnp.zeros((B, Sq, H, D), jnp.float32)
+    m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Sq), jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step_fn(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        # which global block is currently resident: blocks rotate forward,
+        # so at `step` we hold block (my_idx - step) mod N
+        blk_idx = (my_idx - step) % axis_size
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk).astype(jnp.float32)
+        logits = logits * scale
+        if causal:
+            Sk = k_blk.shape[1]
+            q_pos = my_idx * Sq + jnp.arange(Sq)            # global q positions
+            k_pos = blk_idx * Sk + jnp.arange(Sk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        o, m, l = _online_update(o, m, l, logits, v_blk)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = lax.scan(step_fn, (o, m, l, k, v),
+                                  jnp.arange(axis_size))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name="tp", causal=True, mesh=None):
+    """Exact attention with q/k/v sequence-sharded over `axis_name`.
+
+    Call either (a) inside an existing shard_map/jit context where
+    `axis_name` is bound — then this runs the local body directly — or
+    (b) at top level with `mesh` provided, in which case it wraps itself in
+    shard_map with the sequence dim of [B, S, H, D] sharded over the axis.
+    """
+    if mesh is None:
+        return _ring_attention_local(q, k, v, axis_name, causal)
+
+    from jax.sharding import PartitionSpec as P
+    shard_map = _get_shard_map()
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _get_shard_map():
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+    return shard_map

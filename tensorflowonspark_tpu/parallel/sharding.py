@@ -1,0 +1,117 @@
+"""Parameter-sharding rules: map parameter tree paths to PartitionSpecs.
+
+The reference had no model parallelism (SURVEY.md §2.3 — "Model parallelism:
+not implemented"); here it is first-class: a small rule engine assigns every
+parameter a PartitionSpec by regex over its tree path, with Megatron-style
+defaults for transformer blocks (column-parallel in-projections, row-parallel
+out-projections) and optional fsdp sharding of whatever is left.
+"""
+import logging
+import re
+
+logger = logging.getLogger(__name__)
+
+
+def P(*axes):
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*axes)
+
+
+# Megatron-style defaults for transformer parameters.  Paths are
+# '/'-joined flax param paths, matched with re.search.
+# `(^|/)` anchors each pattern at a path-segment start so e.g. "router"
+# cannot match an "out*" rule by substring.
+DEFAULT_RULES = (
+    # MoE expert weights first (most specific): leading expert dim over ep
+    ((r"(^|/)experts_(wi|up)[^/]*/kernel"), ("ep", "embed", "tp")),
+    ((r"(^|/)experts_(wo|down)[^/]*/kernel"), ("ep", "tp", "embed")),
+    ((r"(^|/)router[^/]*/kernel"), ()),
+    # attention in-projections: split heads over tp (column parallel)
+    (r"(^|/)(query|key|value|qkv)[^/]*/kernel", ("embed", "tp")),
+    # attention out-projection: row parallel (tp partial-sums -> psum)
+    (r"(^|/)(out|o_proj|attn_out)[^/]*/kernel", ("tp", "embed")),
+    # MLP up/gate: column parallel
+    (r"(^|/)(mlp|ffn)[^/]*/(up|gate|wi|fc1|in_proj)[^/]*/kernel", ("embed", "tp")),
+    # MLP down: row parallel
+    (r"(^|/)(mlp|ffn)[^/]*/(down|wo|fc2|out_proj)[^/]*/kernel", ("tp", "embed")),
+    # embedding tables: split the model dim over tp (vocab-dim sharding
+    # would make the row gather a cross-shard collective)
+    (r"(^|/)(embed|embedding|token_embed|pos_embed)[^/]*/(embedding|kernel)",
+     ("embed", "tp")),
+    # lm head: split vocab over tp; the loss reduces over vocab with a psum
+    (r"(^|/)(lm_head|logits)[^/]*/kernel", ("embed", "tp")),
+    # norms / biases / scales: replicated
+    (r"(scale|bias|norm)", ()),
+)
+
+# Logical-axis name -> mesh axis (or None = replicate).  'ep' rides the dp
+# axis: experts are distributed across data-parallel shards.
+DEFAULT_AXIS_MAP = {
+    "tp": "tp",
+    "embed": None,
+    "ep": "dp",
+}
+
+
+def spec_for_path(path, rules=DEFAULT_RULES, axis_map=None):
+    """Return the PartitionSpec for one parameter path."""
+    axis_map = axis_map or DEFAULT_AXIS_MAP
+    for pattern, logical in rules:
+        if re.search(pattern, path):
+            return P(*(axis_map.get(ax) for ax in logical))
+    return P()
+
+
+def infer_param_shardings(params, mesh, rules=DEFAULT_RULES, axis_map=None,
+                          fsdp=False):
+    """Build a pytree of NamedShardings matching `params`.
+
+    With fsdp=True, parameters that ended up replicated get their largest
+    divisible dimension sharded over the fsdp axis (ZeRO-3 flavor).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    fsdp_size = mesh.shape.get("fsdp", 1)
+
+    def one(path_parts, leaf):
+        path = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path_parts)
+        spec = spec_for_path(path, rules, axis_map)
+        # Axes of extent 1 on this mesh carry no sharding but still trigger
+        # sharding-in-types checks downstream — drop them.
+        spec = P(*(ax if ax is not None and mesh.shape.get(ax, 1) > 1 else None
+                   for ax in spec))
+        if fsdp and fsdp_size > 1:
+            spec = _add_fsdp(spec, leaf, fsdp_size)
+        # Drop specs that exceed the leaf's rank (scalar params etc.)
+        if len(spec) > getattr(leaf, "ndim", 0):
+            spec = P()
+        while len(spec) and spec[-1] is None:
+            spec = P(*spec[:-1])
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _add_fsdp(spec, leaf, fsdp_size):
+    """Shard the largest still-unsharded, divisible dim over fsdp."""
+    ndim = getattr(leaf, "ndim", 0)
+    shape = getattr(leaf, "shape", ())
+    axes = list(spec) + [None] * (ndim - len(spec))
+    candidates = [(shape[i], i) for i in range(ndim)
+                  if axes[i] is None and shape[i] % fsdp_size == 0]
+    if not candidates:
+        return spec
+    _, dim = max(candidates)
+    axes[dim] = "fsdp"
+    while axes and axes[-1] is None:
+        axes.pop()
+    return P(*axes)
+
+
+def shard_params(params, shardings):
+    """Place a parameter pytree onto the mesh per `shardings`."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), params, shardings)

@@ -1,0 +1,148 @@
+"""pjit train-step harness: the compute engine the examples plug into.
+
+Replaces the reference's delegation to `tf.distribute` strategies inside the
+user map_fun (SURVEY.md §2.3): here the framework owns the step — a jitted
+function with explicit in/out shardings over the cluster mesh, so XLA
+inserts gradient allreduce over ICI from the sharding layout alone (no
+NCCL/gRPC plumbing).  Supports gradient accumulation (lax.scan over
+microbatches), bfloat16 compute with float32 params, and rematerialization.
+"""
+import functools
+import logging
+from typing import Any, NamedTuple
+
+from . import mesh as mesh_mod
+from . import sharding as sharding_mod
+
+logger = logging.getLogger(__name__)
+
+
+class TrainState(NamedTuple):
+    step: Any
+    params: Any
+    opt_state: Any
+
+
+def create_train_state(params, optimizer, mesh=None, param_shardings=None):
+    """Initialize TrainState, placing params/opt state on the mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is not None:
+        if param_shardings is None:
+            param_shardings = sharding_mod.infer_param_shardings(params, mesh)
+        params = sharding_mod.shard_params(params, param_shardings)
+    opt_state = optimizer.init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=opt_state)
+
+
+def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
+                    grad_accum=1, compute_dtype=None, donate=True):
+    """Build the jitted train step.
+
+    `loss_fn(params, batch, rng) -> scalar loss` — the mean over the LOCAL
+    shard; with the batch sharded over dp/fsdp and params replicated (or
+    sharded), jit's sharding propagation makes XLA emit the gradient
+    allreduce automatically.
+
+    Returns `train_step(state, batch, rng) -> (state, metrics)`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _loss(params, batch, rng):
+        if compute_dtype is not None:
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(compute_dtype)
+                if hasattr(x, "astype") and jnp.issubdtype(x.dtype, jnp.floating)
+                else x, params)
+        return loss_fn(params, batch, rng)
+
+    def _step(state, batch, rng):
+        if grad_accum > 1:
+            micro = jax.tree_util.tree_map(
+                lambda x: x.reshape((grad_accum, x.shape[0] // grad_accum)
+                                    + x.shape[1:]), batch)
+
+            def body(carry, mb):
+                loss, g = jax.value_and_grad(_loss)(state.params, mb, rng)
+                acc_loss, acc_g = carry
+                return (acc_loss + loss,
+                        jax.tree_util.tree_map(jnp.add, acc_g, g)), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            (loss, grads), _ = jax.lax.scan(body, (0.0, zeros), micro)
+            loss = loss / grad_accum
+            grads = jax.tree_util.tree_map(lambda g: g / grad_accum, grads)
+        else:
+            loss, grads = jax.value_and_grad(_loss)(state.params, batch, rng)
+
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        import optax
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state)
+        metrics = {"loss": loss,
+                   "grad_norm": optax.global_norm(grads)}
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(_step, donate_argnums=(0,) if donate else ())
+
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(mesh, PartitionSpec())
+    batch_shard = mesh_mod.batch_sharding(mesh)
+    if param_shardings is None:
+        state_shardings = None  # let jit infer from input placement
+        in_shardings = (None, batch_shard, repl)
+        out_shardings = (None, repl)
+    else:
+        state_shardings = TrainState(
+            step=repl, params=param_shardings,
+            opt_state=_opt_state_shardings(optimizer, param_shardings, repl))
+        in_shardings = (state_shardings, batch_shard, repl)
+        out_shardings = (state_shardings, repl)
+
+    return jax.jit(_step, in_shardings=in_shardings,
+                   out_shardings=out_shardings,
+                   donate_argnums=(0,) if donate else ())
+
+
+def _opt_state_shardings(optimizer, param_shardings, repl):
+    """Mirror param shardings onto optimizer slots (mu/nu mirror the param
+    tree and inherit its shardings; scalar slots like counts replicate)."""
+    import jax
+    import jax.numpy as jnp
+
+    dummy = jax.tree_util.tree_map(lambda s: jnp.zeros(()), param_shardings)
+    state = optimizer.init(dummy)
+    return _map_state(state, param_shardings, repl)
+
+
+def _map_state(state, param_shardings, repl):
+    import jax
+
+    params_struct = jax.tree_util.tree_structure(param_shardings)
+    if jax.tree_util.tree_structure(state) == params_struct:
+        return param_shardings
+    if hasattr(state, "_fields"):  # NamedTuple (ScaleByAdamState etc.)
+        return type(state)(*(_map_state(getattr(state, f), param_shardings, repl)
+                             for f in state._fields))
+    if isinstance(state, (tuple, list)):
+        return type(state)(_map_state(s, param_shardings, repl) for s in state)
+    return jax.tree_util.tree_map(lambda _: repl, state)
+
+
+def make_eval_step(forward_fn, mesh=None):
+    """Jitted forward/eval step with batch sharded over dp."""
+    import jax
+
+    if mesh is None:
+        return jax.jit(forward_fn)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(
+        forward_fn,
+        in_shardings=(NamedSharding(mesh, PartitionSpec()),
+                      mesh_mod.batch_sharding(mesh)),
+        out_shardings=mesh_mod.batch_sharding(mesh))

@@ -8,7 +8,9 @@ tox.ini:29-34).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the surrounding environment may pin JAX_PLATFORMS
+# to the real accelerator; tests must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -17,6 +19,13 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 import multiprocessing as mp
 
 import pytest
+
+# The env var alone is not enough under the axon TPU plugin (it re-pins the
+# platform); the config API wins.  Import jax here so every test module sees
+# the 8-device CPU platform.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")
