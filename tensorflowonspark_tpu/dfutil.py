@@ -1,0 +1,231 @@
+"""DataFrame / iterator ⇄ TFRecord interchange (maps reference dfutil.py:1-212).
+
+The reference converts Spark DataFrames to `tf.train.Example` TFRecords via
+the tensorflow-hadoop jar (dfutil.py:29-81) with schema inference and a
+`binary_features` hint to disambiguate bytes vs string (dfutil.py:134-168).
+This build owns the format natively (tfrecord.py + native/tfrecord_io.cc)
+and works at two levels:
+
+- iterator level (no Spark needed): `write_tfrecords` / `read_tfrecords` /
+  `infer_schema` over dicts of values — this is also what feeds DataFeed.
+- Spark level (gated on pyspark): `saveAsTFRecords` / `loadTFRecords` with
+  the reference's semantics — each executor writes its partition as a
+  `part-rXXXXX` shard, schema is inferred from the first record, and
+  `loadedDF` tracks provenance for `isLoadedDF` (reference: dfutil.py:15-26).
+"""
+import glob
+import logging
+import os
+
+from . import tfrecord
+
+logger = logging.getLogger(__name__)
+
+# DataFrames produced by loadTFRecords, keyed by id (reference: dfutil.py:15-26)
+loadedDF = {}
+
+
+def isLoadedDF(df):
+    """True if `df` came from loadTFRecords (reference: dfutil.py:20-26)."""
+    return id(df) in loadedDF
+
+
+# --------------------------------------------------------------------------
+# Schema: {column: type} with types 'int64' | 'float32' | 'binary' |
+# 'string' | 'array<int64>' | 'array<float32>' | 'array<binary>' | 'array<string>'
+# --------------------------------------------------------------------------
+
+_SCALAR_KINDS = {"int64", "float32", "binary", "string"}
+
+
+def infer_schema(row, binary_features=()):
+    """Infer {column: type} from one example row (dict of python values).
+
+    Maps reference infer_schema (dfutil.py:134-168): bytes default to
+    'string' unless named in `binary_features` (TFRecords don't distinguish).
+    """
+    schema = {}
+    for name, value in row.items():
+        is_array = isinstance(value, (list, tuple))
+        probe = value[0] if is_array and len(value) else value
+        if isinstance(probe, bool):
+            kind = "int64"
+        elif isinstance(probe, int):
+            kind = "int64"
+        elif isinstance(probe, float):
+            kind = "float32"
+        elif isinstance(probe, (bytes, bytearray)):
+            kind = "binary" if name in binary_features else "string"
+        elif isinstance(probe, str):
+            kind = "string"
+        elif is_array and not len(value):
+            kind = "float32"  # empty array: assume float (reference default)
+        else:
+            raise TypeError(f"cannot infer TFRecord type for column {name!r} "
+                            f"value {value!r}")
+        schema[name] = f"array<{kind}>" if is_array else kind
+    return schema
+
+
+def schema_from_example(example, binary_features=()):
+    """Infer schema from a decoded example {name: (kind, values)}.
+
+    Single-valued features map to scalars, multi-valued to arrays — the same
+    first-record heuristic as the reference (dfutil.py:44-81).
+    """
+    schema = {}
+    for name, (kind, values) in example.items():
+        if kind == "bytes":
+            col = "binary" if name in binary_features else "string"
+        elif kind == "float":
+            col = "float32"
+        else:
+            col = "int64"
+        schema[name] = col if len(values) <= 1 else f"array<{col}>"
+    return schema
+
+
+def to_feature_dict(row, schema=None):
+    """Convert a python row dict into encode_example-ready values."""
+    out = {}
+    for name, value in row.items():
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        elif isinstance(value, (list, tuple)):
+            value = [v.encode("utf-8") if isinstance(v, str) else v
+                     for v in value]
+        elif isinstance(value, bool):
+            value = int(value)
+        out[name] = value
+    return out
+
+
+def from_example(example, schema):
+    """Decode {name: (kind, values)} into a python row dict per `schema`
+    (maps reference fromTFExample, dfutil.py:171-212)."""
+    row = {}
+    for name, coltype in schema.items():
+        kind, values = example.get(name, ("bytes", []))
+        is_array = coltype.startswith("array<")
+        base = coltype[6:-1] if is_array else coltype
+        if base == "string":
+            values = [v.decode("utf-8", "replace") if isinstance(v, bytes)
+                      else v for v in values]
+        elif base == "binary":
+            values = [bytes(v) for v in values]
+        elif base == "float32":
+            values = [float(v) for v in values]
+        elif base == "int64":
+            values = [int(v) for v in values]
+        if is_array:
+            row[name] = values
+        else:
+            row[name] = values[0] if values else None
+    return row
+
+
+# --------------------------------------------------------------------------
+# Iterator-level API (no Spark required)
+# --------------------------------------------------------------------------
+
+def write_tfrecords(rows, path):
+    """Write an iterable of row dicts to one TFRecord file; returns count."""
+    return tfrecord.write_examples(
+        path, (to_feature_dict(r) for r in rows))
+
+
+def read_tfrecords(path_or_dir, binary_features=(), schema=None):
+    """Read rows back from a file or a directory of part files.
+
+    Returns (rows, schema); schema is inferred from the first record unless
+    given (the reference's loadTFRecords contract, dfutil.py:44-81).
+    """
+    if os.path.isdir(path_or_dir):
+        paths = sorted(glob.glob(os.path.join(path_or_dir, "part-*")))
+        if not paths:
+            paths = sorted(p for p in glob.glob(os.path.join(path_or_dir, "*"))
+                           if os.path.isfile(p) and not
+                           os.path.basename(p).startswith(("_", ".")))
+    else:
+        paths = [path_or_dir]
+    rows = []
+    for p in paths:
+        for example in tfrecord.read_examples(p):
+            if schema is None:
+                schema = schema_from_example(example, binary_features)
+            rows.append(from_example(example, schema))
+    return rows, (schema or {})
+
+
+# --------------------------------------------------------------------------
+# Spark-level API (gated)
+# --------------------------------------------------------------------------
+
+def saveAsTFRecords(df, output_dir):
+    """Save a Spark DataFrame as sharded TFRecord files (maps reference
+    saveAsTFRecords, dfutil.py:29-41 — but writes natively per executor
+    instead of through the Hadoop output format)."""
+    columns = df.columns
+
+    def write_partition(index, iterator):
+        # makedirs must run on the EXECUTOR, not the driver: on a multi-node
+        # cluster the driver's filesystem is a different machine.  Note the
+        # shards land on a shared filesystem iff output_dir is one (NFS/
+        # GCS-fuse); unlike the reference's Hadoop output format there is no
+        # HDFS client underneath.
+        os.makedirs(output_dir, exist_ok=True)
+        part = os.path.join(output_dir, f"part-r-{index:05d}")
+        count = write_tfrecords(
+            (dict(zip(columns, row)) for row in iterator), part)
+        yield (index, count)
+
+    counts = df.rdd.mapPartitionsWithIndex(write_partition).collect()
+    total = sum(c for _, c in counts)
+    logger.info("wrote %d records to %s in %d shards", total, output_dir,
+                len(counts))
+    return total
+
+
+def loadTFRecords(sc, input_dir, binary_features=(), schema_hint=None):
+    """Load TFRecord shards into a Spark DataFrame (maps reference
+    loadTFRecords, dfutil.py:44-81).  `schema_hint` is {column: type} using
+    this module's type strings."""
+    from pyspark.sql import SparkSession
+
+    spark = SparkSession.builder.getOrCreate()
+    paths = sorted(glob.glob(os.path.join(input_dir, "part-*"))) or [input_dir]
+
+    # infer schema from the first record of the first shard
+    schema = dict(schema_hint or {})
+    if not schema:
+        first = next(iter(tfrecord.read_examples(paths[0])), None)
+        if first is None:
+            raise ValueError(f"no records found under {input_dir}")
+        schema = schema_from_example(first, binary_features)
+    columns = sorted(schema)
+
+    def read_shard(path):
+        for example in tfrecord.read_examples(path):
+            row = from_example(example, schema)
+            yield tuple(row[c] for c in columns)
+
+    rdd = sc.parallelize(paths, len(paths)).flatMap(read_shard)
+    df = spark.createDataFrame(rdd, _spark_schema(schema, columns))
+    loadedDF[id(df)] = input_dir
+    return df
+
+
+def _spark_schema(schema, columns):
+    from pyspark.sql import types as T
+
+    base = {"int64": T.LongType(), "float32": T.FloatType(),
+            "binary": T.BinaryType(), "string": T.StringType()}
+
+    fields = []
+    for c in columns:
+        t = schema[c]
+        if t.startswith("array<"):
+            fields.append(T.StructField(c, T.ArrayType(base[t[6:-1]])))
+        else:
+            fields.append(T.StructField(c, base[t]))
+    return T.StructType(fields)

@@ -1,0 +1,1 @@
+"""Auxiliary subsystems: checkpointing, profiling (SURVEY.md §5)."""

@@ -1,0 +1,75 @@
+"""Checkpoint/resume via Orbax, with the reference's chief-export semantics.
+
+The reference delegated checkpointing to TF callbacks inside user code and
+contributed path normalization + chief-only export + a grace period so the
+chief can finish writing after feeding stops (SURVEY.md §5 "Checkpoint /
+resume"; compat.py:10-17, TFCluster.py:125).  Here the framework provides
+the equivalents natively: multi-host-safe Orbax saves, chief-only gating,
+and step-numbered checkpoint directories with latest-step discovery.
+"""
+import logging
+import os
+import re
+
+logger = logging.getLogger(__name__)
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(ckpt_dir, state, step, is_chief=True, keep=None):
+    """Save `state` (a pytree) under ckpt_dir/step_N.
+
+    Non-chief processes no-op (single-controller semantics; under real
+    multi-host jax.distributed, orbax coordinates internally and every
+    process must call — pass is_chief=True on all hosts in that case).
+    """
+    if not is_chief:
+        return None
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{int(step)}")
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    logger.info("saved checkpoint %s", path)
+    if keep:
+        _prune(ckpt_dir, keep)
+    return path
+
+
+def restore_checkpoint(ckpt_dir, target, step=None):
+    """Restore the pytree saved at `step` (default: latest).
+
+    `target` is an example pytree (same structure/shapes) — with sharded
+    arrays, pass abstract shapes carrying shardings for direct-to-device
+    restore.
+    """
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            return None, None
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{int(step)}")
+    restored = _checkpointer().restore(path, target)
+    logger.info("restored checkpoint %s", path)
+    return restored, step
+
+
+def latest_step(ckpt_dir):
+    """Largest step number with a checkpoint under ckpt_dir, or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [int(m.group(1)) for d in os.listdir(ckpt_dir)
+             if (m := _STEP_DIR.match(d))]
+    return max(steps) if steps else None
+
+
+def _prune(ckpt_dir, keep):
+    import shutil
+    steps = sorted(int(m.group(1)) for d in os.listdir(ckpt_dir)
+                   if (m := _STEP_DIR.match(d)))
+    for s in steps[:-keep]:
+        shutil.rmtree(os.path.join(ckpt_dir, f"step_{s}"), ignore_errors=True)
+        logger.info("pruned checkpoint step_%d", s)

@@ -1,0 +1,52 @@
+"""Checkpoint save/restore/prune semantics (the reference's checkpoint
+parity lives in user callbacks; ours is framework-owned — SURVEY.md §5)."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+
+def _state(v):
+    return {"params": {"w": jnp.full((4,), float(v)), "b": jnp.zeros(())},
+            "step": jnp.asarray(v)}
+
+
+def test_save_restore_latest(tmp_path):
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, _state(1), step=1)
+    ckpt.save_checkpoint(d, _state(5), step=5)
+    assert ckpt.latest_step(d) == 5
+    restored, step = ckpt.restore_checkpoint(d, _state(0))
+    assert step == 5
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 5.0)
+
+
+def test_restore_specific_step(tmp_path):
+    d = str(tmp_path / "ckpts")
+    ckpt.save_checkpoint(d, _state(1), step=1)
+    ckpt.save_checkpoint(d, _state(2), step=2)
+    restored, step = ckpt.restore_checkpoint(d, _state(0), step=1)
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 1.0)
+
+
+def test_non_chief_noop_and_empty_restore(tmp_path):
+    d = str(tmp_path / "ckpts")
+    assert ckpt.save_checkpoint(d, _state(1), step=1, is_chief=False) is None
+    assert ckpt.latest_step(d) is None
+    restored, step = ckpt.restore_checkpoint(d, _state(0))
+    assert restored is None and step is None
+
+
+def test_prune_keeps_newest(tmp_path):
+    d = str(tmp_path / "ckpts")
+    for s in (1, 2, 3, 4):
+        ckpt.save_checkpoint(d, _state(s), step=s, keep=2)
+    assert ckpt.latest_step(d) == 4
+    restored, step = ckpt.restore_checkpoint(d, _state(0), step=3)
+    assert step == 3  # still present
+    with pytest.raises(Exception):
+        ckpt.restore_checkpoint(d, _state(0), step=1)  # pruned
