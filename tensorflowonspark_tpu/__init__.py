@@ -19,7 +19,8 @@ Public surface (lazily imported to keep `import tensorflowonspark_tpu` cheap):
 - ``tpu_info``       — accelerator discovery                  (maps gpu_info.py)
 - ``dfutil``         — DataFrame/iterator ⇄ TFRecord          (maps dfutil.py)
 - ``pipeline``       — ML-pipeline Estimator/Model            (maps pipeline.py)
-- ``parallel_run``   — embarrassingly-parallel runner         (maps TFParallel.py)
+- ``export``         — saved-model export/load                (maps TFNode.export_saved_model)
+- ``parallel_runner`` — embarrassingly-parallel runner        (maps TFParallel.py)
 - ``parallel``       — mesh / sharding / train-step harness   (TPU-native, net-new)
 - ``models``, ``ops`` — model zoo and Pallas kernels          (TPU-native, net-new)
 """
@@ -37,8 +38,8 @@ __version__ = "0.1.0"
 
 _LAZY_SUBMODULES = {
     "cluster", "node", "feed", "reservation", "manager", "tpu_info", "util",
-    "compat", "marker", "dfutil", "tfrecord", "pipeline", "parallel_run",
-    "backend", "parallel", "models", "ops", "utils",
+    "compat", "marker", "dfutil", "tfrecord", "pipeline", "parallel_runner",
+    "backend", "parallel", "models", "ops", "utils", "export",
 }
 
 _LAZY_ATTRS = {
@@ -48,6 +49,9 @@ _LAZY_ATTRS = {
     "run": ("tensorflowonspark_tpu.cluster", "run"),
     "DataFeed": ("tensorflowonspark_tpu.feed", "DataFeed"),
     "NodeContext": ("tensorflowonspark_tpu.node", "NodeContext"),
+    "TFEstimator": ("tensorflowonspark_tpu.pipeline", "TFEstimator"),
+    "TFModel": ("tensorflowonspark_tpu.pipeline", "TFModel"),
+    "Namespace": ("tensorflowonspark_tpu.pipeline", "Namespace"),
 }
 
 

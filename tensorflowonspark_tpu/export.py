@@ -1,0 +1,134 @@
+"""Saved-model export/load — the TPU-native SavedModel analog.
+
+The reference exports TF SavedModels (TFNode.export_saved_model,
+reference: TFNode.py:159-208; chief-only gating in compat.py:10-17) and its
+pipeline/JVM layers reload them by signature (pipeline.py:585-644,
+TFModel.scala:245-292).  Here the export artifact is a directory holding:
+
+- ``tfos_model.json`` — a *builder spec* (``"module:callable"`` import path
+  + JSON kwargs) that reconstructs the model, plus named **signatures**
+  describing input tensor names/shapes/dtypes and output names.  Shapes are
+  recorded because tabular sources (Spark Rows) carry flat arrays that must
+  be coerced back to tensor shapes at serving time (the reference does the
+  same dance at pipeline.py:615-644).
+- ``params.msgpack`` — the parameter pytree (flax serialization).
+
+``load_saved_model`` rebuilds ``(apply_fn, params, signature)`` — the serving
+triple that pipeline.TFModel and the native batch-inference runner consume.
+"""
+import importlib
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+MODEL_SPEC = "tfos_model.json"
+PARAMS_FILE = "params.msgpack"
+DEFAULT_SIGNATURE = "serving_default"  # reference: pipeline.py:276 default
+
+
+def _resolve_builder(spec):
+    """Import ``"module:callable"`` → the callable."""
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise ValueError(f"builder spec {spec!r} must look like 'module:callable'")
+    obj = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def export_saved_model(export_dir, params, builder, builder_kwargs=None,
+                       signatures=None, is_chief=True):
+    """Write the serving artifact (maps TFNode.export_saved_model).
+
+    - ``builder``: ``"module:callable"`` import path.  Called with
+      ``**builder_kwargs`` it must return either a flax ``nn.Module`` (its
+      ``.apply`` is used) or a plain ``apply(params, *inputs)`` callable.
+    - ``signatures``: {name: {"inputs": {in_name: {"shape": [...],
+      "dtype": "float32"}}, "outputs": [out_names]}}; defaults to a single
+      ``serving_default`` with one unconstrained input.
+    - Non-chief processes no-op, like the reference's chief-only export.
+    """
+    if not is_chief:
+        logger.info("non-chief process skipping export to %s", export_dir)
+        return None
+    _resolve_builder(builder)  # fail fast on a bad spec
+    import flax.serialization
+
+    os.makedirs(export_dir, exist_ok=True)
+    spec = {
+        "format": "tfos-tpu-saved-model",
+        "version": 1,
+        "builder": builder,
+        "builder_kwargs": builder_kwargs or {},
+        "signatures": signatures or {
+            DEFAULT_SIGNATURE: {"inputs": {"input": {}}, "outputs": ["output"]}},
+    }
+    with open(os.path.join(export_dir, MODEL_SPEC), "w") as f:
+        json.dump(spec, f, indent=2)
+    with open(os.path.join(export_dir, PARAMS_FILE), "wb") as f:
+        f.write(flax.serialization.to_bytes(params))
+    logger.info("exported saved model to %s", export_dir)
+    return export_dir
+
+
+def load_saved_model(export_dir, signature_def_key=None):
+    """Load ``(apply_fn, params, signature)`` from an export dir.
+
+    ``apply_fn(params, *inputs)`` is the raw forward; callers jit it.  Maps
+    the reference's ``tf.saved_model.load`` + signature lookup
+    (pipeline.py:596-613).
+    """
+    with open(os.path.join(export_dir, MODEL_SPEC)) as f:
+        spec = json.load(f)
+    if spec.get("format") != "tfos-tpu-saved-model":
+        raise ValueError(f"{export_dir} is not a tfos-tpu saved model")
+    sig_key = signature_def_key or DEFAULT_SIGNATURE
+    try:
+        signature = spec["signatures"][sig_key]
+    except KeyError:
+        raise ValueError(
+            f"signature {sig_key!r} not found; available: "
+            f"{sorted(spec['signatures'])}") from None
+
+    built = _resolve_builder(spec["builder"])(**spec["builder_kwargs"])
+    if hasattr(built, "apply") and hasattr(built, "init"):  # flax Module
+        model = built
+
+        def apply_fn(params, *inputs):
+            return model.apply({"params": params}, *inputs)
+    else:
+        apply_fn = built
+
+    import flax.serialization
+    with open(os.path.join(export_dir, PARAMS_FILE), "rb") as f:
+        raw = f.read()
+    # msgpack restore needs no target template for plain dict pytrees
+    params = flax.serialization.msgpack_restore(raw)
+    if isinstance(params, dict) and set(params) == {"params"}:
+        params = params["params"]
+    return apply_fn, params, signature
+
+
+def coerce_inputs(signature, columns):
+    """Reshape flat tabular columns into the signature's tensor shapes.
+
+    ``columns`` is {input_name: list_of_row_values}; each row value may be a
+    flat list that the recorded shape (leading batch dim excluded, -1 ok)
+    restores to its tensor form — the reference's shape-coercion for Spark's
+    flat arrays (pipeline.py:615-630).
+    """
+    import numpy as np
+
+    arrays = []
+    for name, meta in signature["inputs"].items():
+        if name not in columns:
+            raise KeyError(f"input column {name!r} missing; have {sorted(columns)}")
+        arr = np.asarray(columns[name], dtype=meta.get("dtype") or None)
+        shape = meta.get("shape")
+        if shape:
+            arr = arr.reshape((arr.shape[0],) + tuple(int(d) for d in shape))
+        arrays.append(arr)
+    return arrays

@@ -1,0 +1,14 @@
+"""Linear regression — the smallest pipeline-API model (parity with the
+reference's pipeline integration test, which fits a MultiWorkerMirrored
+linear model on synthetic data: reference tests/test_pipeline.py:89-158)."""
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Linear(nn.Module):
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.features, name="dense")(x.astype(jnp.float32))
+        return y[..., 0] if self.features == 1 else y

@@ -1,0 +1,74 @@
+"""Embarrassingly-parallel runner (maps reference TFParallel.py:17-64).
+
+Runs N *independent* single-node instances of a user function — no
+rendezvous, no collectives, no data feed — the shape the reference used for
+parallel inference under Spark barrier mode.  Each instance gets a minimal
+`NodeContext` (executor_id == task_index, every node is a "worker") and,
+when several executors share a TPU host, a deterministic chip slice
+(maps the BarrierTaskContext peer-placement GPU math, TFParallel.py:42-49).
+"""
+import logging
+
+from . import backend as backend_mod
+from . import node as node_mod
+from . import tpu_info, util
+
+logger = logging.getLogger(__name__)
+
+
+def run(backend_or_sc, map_fn, tf_args=None, num_executors=None, num_chips=0):
+    """Run `map_fn(tf_args, ctx)` once per executor, independently.
+
+    Returns the collected per-node return values (a list; nodes returning
+    None contribute nothing), where the reference returned nothing — the
+    results channel is free on TPU because inference output need not ride a
+    queue manager here.
+    """
+    backend = backend_mod.resolve(backend_or_sc)
+    n = num_executors or backend.num_executors
+
+    def _mapfn(iterator):
+        executor_id = None
+        for item in iterator:
+            executor_id = item
+        assert executor_id is not None, "parallel task received no executor id"
+        if num_chips:
+            tpu_info.assign_chips(num_chips,
+                                  worker_index=_local_index(executor_id, num_chips))
+        util.write_executor_id(executor_id)
+        ctx = node_mod.NodeContext(
+            executor_id=executor_id, job_name="worker",
+            task_index=executor_id, num_workers=n)
+        logger.info("parallel node %d/%d starting", executor_id, n)
+        out = node_mod._wrapper_fn(map_fn, tf_args, ctx)
+        return [] if out is None else [out]
+
+    results = backend.map_partitions([[i] for i in range(n)], _mapfn)
+    if hasattr(results, "collect"):
+        # SparkBackend.map_partitions returns a lazy RDD; the reference's
+        # barrier-mode run executed eagerly (TFParallel.py:63-64), and
+        # callers ported from it discard the return value — force the jobs.
+        results = results.collect()
+    return results
+
+
+def _local_index(executor_id, num_chips):
+    """Host-local worker index for chip slicing.
+
+    Under Spark barrier mode the task infos give exact same-host peer ranks
+    (what the reference used, TFParallel.py:42-49); otherwise fall back to
+    executor_id modulo the host's worker-slot count (local chips / chips per
+    worker) — exact for LocalBackend (single host) and for contiguous-block
+    executor placement.
+    """
+    try:
+        from pyspark import BarrierTaskContext
+        tc = BarrierTaskContext.get()
+        infos = tc.getTaskInfos()
+        host = util.get_ip_address()
+        peers = [i for i, ti in enumerate(infos) if ti.address.split(":")[0]
+                 in (host, "localhost", "127.0.0.1")]
+        return peers.index(tc.partitionId())
+    except Exception:
+        slots = max(tpu_info._count_local_chips() // max(num_chips, 1), 1)
+        return executor_id % slots

@@ -1,0 +1,58 @@
+"""Parallel runner tests (maps the reference's TFParallel usage in
+examples/mnist/keras/mnist_inference.py:79 — N independent nodes, no
+cluster)."""
+import pytest
+
+from tensorflowonspark_tpu import backend, parallel_runner
+
+NUM_EXECUTORS = 2
+
+
+def fn_identity(args, ctx):
+    assert ctx.job_name == "worker"
+    assert ctx.num_workers == NUM_EXECUTORS
+    return {"executor": ctx.executor_id, "tag": args["tag"]}
+
+
+def fn_shard(args, ctx):
+    # each node processes its own shard, like ds.shard(num_workers, worker_num)
+    data = args["data"]
+    shard = data[ctx.task_index::ctx.num_workers]
+    return sum(x * x for x in shard)
+
+
+def fn_none(args, ctx):
+    return None
+
+
+def fn_boom(args, ctx):
+    raise ValueError("boom")
+
+
+def _bk(tmp_path):
+    return backend.LocalBackend(NUM_EXECUTORS, workdir=str(tmp_path))
+
+
+def test_runs_one_instance_per_executor(tmp_path):
+    out = parallel_runner.run(_bk(tmp_path), fn_identity, {"tag": "t"},
+                              num_executors=NUM_EXECUTORS)
+    assert sorted(r["executor"] for r in out) == [0, 1]
+    assert all(r["tag"] == "t" for r in out)
+
+
+def test_sharded_work_covers_all_data(tmp_path):
+    data = list(range(100))
+    out = parallel_runner.run(_bk(tmp_path), fn_shard, {"data": data},
+                              num_executors=NUM_EXECUTORS)
+    assert sum(out) == sum(x * x for x in data)
+
+
+def test_none_results_dropped(tmp_path):
+    assert parallel_runner.run(_bk(tmp_path), fn_none, {},
+                               num_executors=NUM_EXECUTORS) == []
+
+
+def test_errors_propagate(tmp_path):
+    with pytest.raises(RuntimeError, match="boom"):
+        parallel_runner.run(_bk(tmp_path), fn_boom, {},
+                            num_executors=NUM_EXECUTORS)

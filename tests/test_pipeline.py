@@ -1,0 +1,153 @@
+"""Pipeline (Estimator/Model) tests — mirrors reference tests/test_pipeline.py:
+Namespace/TFParams merging unit tests (:48-87) and the full
+fit-then-transform integration on synthetic linear data with an
+analytically-known solution (:89-172)."""
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import backend, pipeline
+
+NUM_EXECUTORS = 2
+
+# Exact linear ground truth (reference seeds np.random with 1234 and checks
+# learned weights; exact data lets us assert predictions, not just shape).
+W_TRUE = np.array([2.0, -3.0], "float32")
+B_TRUE = 1.5
+
+
+def _make_data(n=256):
+    rng = np.random.RandomState(1234)
+    X = rng.rand(n, 2).astype("float32")
+    y = X @ W_TRUE + B_TRUE
+    return X, y
+
+
+# --- map/builder functions (module-level: they cross process boundaries) ---
+
+def train_fn_linear(args, ctx):
+    """Consume the feed, solve least squares, chief exports the artifact."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import export
+
+    df = ctx.get_data_feed()
+    X, Y = [], []
+    while not df.should_stop():
+        for rec in df.next_batch(args.batch_size):
+            X.append(rec[0])
+            Y.append(rec[1])
+    assert X, "feed delivered no records"
+    if ctx.is_chief:
+        X, Y = np.asarray(X, "float32"), np.asarray(Y, "float32")
+        sol, *_ = np.linalg.lstsq(np.c_[X, np.ones(len(X))], Y, rcond=None)
+        params = {"dense": {
+            "kernel": sol[:-1].reshape(2, 1).astype("float32"),
+            "bias": sol[-1:].astype("float32")}}
+        export.export_saved_model(
+            args.export_dir, params,
+            builder="tensorflowonspark_tpu.models.linear:Linear",
+            builder_kwargs={"features": 1},
+            signatures={"serving_default": {
+                "inputs": {"x": {"shape": [2], "dtype": "float32"}},
+                "outputs": ["y"]}})
+
+
+# --- unit tests: Namespace / params (reference test_pipeline.py:48-87) ---
+
+def test_namespace_from_dict():
+    ns = pipeline.Namespace({"foo": 1, "bar": "x"})
+    assert ns.foo == 1 and ns.bar == "x"
+    assert "foo" in ns and "baz" not in ns
+
+
+def test_namespace_from_argv():
+    ns = pipeline.Namespace(["--steps", "10"])
+    assert ns.argv == ["--steps", "10"]
+
+
+def test_namespace_copy():
+    ns = pipeline.Namespace({"foo": 1})
+    ns2 = pipeline.Namespace(ns)
+    ns2.foo = 2
+    assert ns.foo == 1 and ns2.foo == 2
+
+
+def test_namespace_rejects_garbage():
+    with pytest.raises(TypeError):
+        pipeline.Namespace(42)
+
+
+def test_merge_args_params_param_wins():
+    est = pipeline.TFEstimator(train_fn_linear, {"batch_size": 7, "custom": "v"})
+    est.setBatchSize(64).setEpochs(3)
+    merged = est.merge_args_params()
+    assert merged.batch_size == 64      # explicit param beats args
+    assert merged.epochs == 3
+    assert merged.custom == "v"         # user args preserved
+    assert merged.steps == 1000         # untouched default fills in
+
+
+def test_param_type_conversion_and_chaining():
+    est = pipeline.TFEstimator(train_fn_linear, {})
+    assert est.setBatchSize("32") is est
+    assert est.getBatchSize() == 32
+    assert est.getMasterNode() == "chief"
+
+
+def test_model_requires_export_dir():
+    with pytest.raises(ValueError, match="export_dir"):
+        pipeline.TFModel({}).transform([[(1,)]])
+
+
+def test_model_rejects_raw_checkpoint_dir(tmp_path):
+    (tmp_path / "step_5").mkdir()
+    with pytest.raises(ValueError, match="export"):
+        pipeline.TFModel({"model_dir": str(tmp_path)}).transform([[(1,)]])
+
+
+def test_bad_output_mapping_raises(tmp_path):
+    X, y = _make_data(32)
+    parts = [list(zip(X.tolist(), y.tolist()))]
+    est = (pipeline.TFEstimator(train_fn_linear,
+                                {"export_dir": str(tmp_path / "export")})
+           .setClusterSize(1).setGraceSecs(0))
+    bk = backend.LocalBackend(1, workdir=str(tmp_path / "bk"))
+    model = est.fit(parts, backend=bk)
+    model.setOutputMapping({"wrong_name": "pred"})
+    with pytest.raises((ValueError, RuntimeError), match="output_mapping"):
+        model.transform([[(row,) for row in X[:4].tolist()]])
+
+
+# --- integration: fit -> transform (reference test_pipeline.py:89-172) ---
+
+def test_fit_then_transform(tmp_path):
+    X, y = _make_data()
+    records = list(zip(X.tolist(), y.tolist()))
+    parts = [records[i::4] for i in range(4)]
+
+    est = (pipeline.TFEstimator(train_fn_linear,
+                                {"export_dir": str(tmp_path / "export")})
+           .setClusterSize(NUM_EXECUTORS)
+           .setBatchSize(32)
+           .setGraceSecs(0))
+    bk = backend.LocalBackend(NUM_EXECUTORS, workdir=str(tmp_path / "bk"))
+    model = est.fit(parts, backend=bk)
+    assert isinstance(model, pipeline.TFModel)
+    assert (tmp_path / "export" / "tfos_model.json").exists()
+
+    Xt, yt = _make_data(50)
+    preds = model.transform([[(row,) for row in Xt.tolist()]])
+    np.testing.assert_allclose(np.asarray(preds), yt, rtol=1e-4, atol=1e-4)
+
+
+def test_transform_with_output_mapping(tmp_path):
+    X, y = _make_data()
+    parts = [list(zip(X.tolist(), y.tolist()))]
+    est = (pipeline.TFEstimator(train_fn_linear,
+                                {"export_dir": str(tmp_path / "export")})
+           .setClusterSize(1).setGraceSecs(0))
+    bk = backend.LocalBackend(1, workdir=str(tmp_path / "bk"))
+    model = est.fit(parts, backend=bk)
+    model.setInputMapping({"features": "x"}).setOutputMapping({"y": "pred"})
+    preds = model.transform([[(row,) for row in X[:8].tolist()]])
+    np.testing.assert_allclose(np.asarray(preds), y[:8], rtol=1e-4, atol=1e-4)
