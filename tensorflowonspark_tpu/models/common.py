@@ -1,0 +1,28 @@
+"""Shared model-zoo building blocks."""
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ChannelGroupNorm(nn.Module):
+    """GroupNorm that adapts its grouping to the channel count.
+
+    Prefers groups of ``preferred_group_size`` channels; when the channel
+    count is not divisible, falls back to gcd(channels, preferred) groups so
+    any width normalizes (flax's GroupNorm hard-errors on indivisible
+    configurations).  Always computes in float32.
+    """
+    preferred_group_size: int = 16
+    epsilon: float = 1e-5
+    scale_init: nn.initializers.Initializer = nn.initializers.ones_init()
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        if c % self.preferred_group_size == 0:
+            kw = {"num_groups": None, "group_size": self.preferred_group_size}
+        else:
+            kw = {"num_groups": math.gcd(c, self.preferred_group_size)}
+        return nn.GroupNorm(epsilon=self.epsilon, dtype=jnp.float32,
+                            scale_init=self.scale_init, name="gn", **kw)(x)
