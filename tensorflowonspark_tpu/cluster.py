@@ -86,6 +86,39 @@ class TPUCluster:
             parts, node.train(self.cluster_info, self.cluster_meta,
                               feed_timeout=feed_timeout, qname=qname))
 
+    def train_stream(self, stream, feed_timeout=600, qname="input"):
+        """Feed an unbounded stream of data (maps the reference's DStream
+        support, TFCluster.py:83-85 + the streaming example
+        examples/mnist/estimator/mnist_spark_streaming.py).
+
+        `stream` is either a pyspark DStream (fed via foreachRDD) or any
+        iterable yielding *batches* — each batch a list of partitions (or an
+        RDD).  Feeding stops when the stream ends or when a STOP message
+        reaches the reservation server (`stop_requested()`), which is what
+        the stop-streaming CLI sends (reference:
+        examples/utils/stop_streaming.py).
+        """
+        assert self.input_mode == InputMode.SPARK, "train_stream() requires InputMode.SPARK"
+        feeder = node.train(self.cluster_info, self.cluster_meta,
+                            feed_timeout=feed_timeout, qname=qname)
+        if hasattr(stream, "foreachRDD"):  # pyspark DStream
+            def _feed(rdd):
+                if not self.stop_requested():
+                    self._backend.foreach_partition(rdd, feeder)
+            stream.foreachRDD(lambda _time, rdd: _feed(rdd))
+            return
+        for batch in stream:
+            if self.stop_requested():
+                logger.info("stop requested; ending stream feed")
+                break
+            self._check_driver_error()
+            self._backend.foreach_partition(batch, feeder)
+
+    def stop_requested(self):
+        """True once a STOP message reached the reservation server (the
+        streaming-job termination signal, reference: reservation.py:141-144)."""
+        return self.server.done.is_set()
+
     def inference(self, data_partitions, qname="input"):
         """Run distributed inference over partitions, returning results
         (maps TFCluster.inference, TFCluster.py:96-115)."""
@@ -95,16 +128,20 @@ class TPUCluster:
             data_partitions, node.inference(self.cluster_info, self.cluster_meta,
                                             qname=qname))
 
-    def shutdown(self, grace_secs=0, timeout=259200):
+    def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
         """Stop the cluster (maps TFCluster.shutdown, TFCluster.py:117-205).
 
         Pushes end-of-feed sentinels to every worker, waits out grace_secs
         (the chief may still be exporting a model), surfaces any node errors
         as an exception on the driver, then stops the reservation server.
         `timeout` bounds the whole teardown (reference used SIGALRM; we use a
-        watchdog thread so it also works off the main thread).
+        watchdog thread so it also works off the main thread).  `ssc` is an
+        optional streaming context, stopped gracefully first (maps
+        TFCluster.py:147-153).
         """
         logger.info("shutting down cluster")
+        if ssc is not None:
+            ssc.stop(stopSparkContext=False, stopGraceFully=True)
         watchdog = threading.Timer(timeout, lambda: (
             logger.error("cluster shutdown timed out after %ds", timeout),
             self._backend.terminate() if hasattr(self._backend, "terminate") else None))

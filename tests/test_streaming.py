@@ -1,0 +1,81 @@
+"""Streaming-feed tests (maps the reference's DStream path: TFCluster.py:83-85
+and examples/mnist/estimator/mnist_spark_streaming.py + the stop_streaming
+CLI, examples/utils/stop_streaming.py)."""
+import os
+import threading
+import time
+
+from tensorflowonspark_tpu import backend, cluster, reservation
+
+NUM_EXECUTORS = 2
+
+
+def fn_stream_consume(args, ctx):
+    """Consume the feed until end, persisting the running sum so the driver
+    can assert delivery (executor cwd survives the run)."""
+    df = ctx.get_data_feed()
+    total = 0
+    while not df.should_stop():
+        total += sum(df.next_batch(16))
+    with open(os.path.join(ctx.working_dir, "consumed.txt"), "w") as f:
+        f.write(str(total))
+
+
+def _run_cluster(tmp_path):
+    bk = backend.LocalBackend(NUM_EXECUTORS, workdir=str(tmp_path))
+    c = cluster.run(bk, fn_stream_consume, tf_args={},
+                    num_executors=NUM_EXECUTORS,
+                    input_mode=cluster.InputMode.SPARK)
+    return bk, c
+
+
+def _consumed_total(bk):
+    total = 0
+    for d in bk.executor_dirs:
+        p = os.path.join(d, "consumed.txt")
+        if os.path.exists(p):
+            total += int(open(p).read())
+    return total
+
+
+def test_bounded_stream_feeds_all_batches(tmp_path):
+    bk, c = _run_cluster(tmp_path)
+
+    def stream():
+        for start in (0, 100, 200):
+            yield [[start + i for i in range(10)],
+                   [start + 50 + i for i in range(10)]]
+
+    c.train_stream(stream())
+    c.shutdown()
+    expected = sum(sum(p) for start in (0, 100, 200)
+                   for p in ([start + i for i in range(10)],
+                             [start + 50 + i for i in range(10)]))
+    assert _consumed_total(bk) == expected
+
+
+def test_stop_message_ends_stream(tmp_path):
+    bk, c = _run_cluster(tmp_path)
+    fed_batches = [0]
+
+    def endless():
+        n = 0
+        while True:
+            fed_batches[0] += 1
+            yield [[n + i for i in range(5)], [n + 10 + i for i in range(5)]]
+            n += 100
+
+    def send_stop():
+        time.sleep(1.0)
+        client = reservation.Client(c.cluster_meta["server_addr"])
+        client.request_stop()
+        client.close()
+
+    t = threading.Thread(target=send_stop)
+    t.start()
+    c.train_stream(endless())  # returns once STOP lands
+    t.join()
+    assert c.stop_requested()
+    assert fed_batches[0] < 1000  # actually stopped, not exhausted
+    c.shutdown()
+    assert _consumed_total(bk) > 0
