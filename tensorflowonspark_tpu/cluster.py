@@ -104,6 +104,7 @@ class TPUCluster:
         if hasattr(stream, "foreachRDD"):  # pyspark DStream
             def _feed(rdd):
                 if not self.stop_requested():
+                    self._check_driver_error()
                     self._backend.foreach_partition(rdd, feeder)
             stream.foreachRDD(lambda _time, rdd: _feed(rdd))
             return
@@ -140,14 +141,14 @@ class TPUCluster:
         TFCluster.py:147-153).
         """
         logger.info("shutting down cluster")
-        if ssc is not None:
-            ssc.stop(stopSparkContext=False, stopGraceFully=True)
         watchdog = threading.Timer(timeout, lambda: (
             logger.error("cluster shutdown timed out after %ds", timeout),
             self._backend.terminate() if hasattr(self._backend, "terminate") else None))
         watchdog.daemon = True
         watchdog.start()
         try:
+            if ssc is not None:
+                ssc.stop(stopSparkContext=False, stopGraceFully=True)
             workers = [eid for j in ("chief", "worker")
                        for eid in self.cluster_meta["cluster_template"].get(j, [])]
             shutdown_parts = [[eid] for eid in sorted(workers)]
