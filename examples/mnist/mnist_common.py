@@ -1,0 +1,146 @@
+"""Shared pieces for the MNIST examples: the training map_fun and data
+loading helpers. The same map_fun serves the local multi-process backend and
+a Spark-backed cluster — mirroring the reference's "same map_fun under
+spark-submit" contract (reference: examples/mnist/keras/mnist_spark.py:17-76).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import os
+
+
+def load_csv_partitions(data_dir, num_partitions):
+    """Read csv/images.csv + labels.csv into `num_partitions` lists of
+    (flat_image[784], label) records — the RDD-partitions stand-in."""
+    import numpy as np
+
+    images = np.loadtxt(os.path.join(data_dir, "csv", "images.csv"),
+                        delimiter=",", dtype="float32")
+    labels = np.loadtxt(os.path.join(data_dir, "csv", "labels.csv"),
+                        dtype="int64")
+    records = list(zip(images.tolist(), labels.tolist()))
+    return [records[i::num_partitions] for i in range(num_partitions)]
+
+
+def mnist_map_fun(args, ctx):
+    """Train MnistCNN from the cluster data feed (InputMode.SPARK).
+
+    TPU-first shape: one jitted train step over the node-local device mesh,
+    batch sharded on the data axis; on a multi-host pod ctx.init_distributed()
+    first forms the global runtime so the same code scales out
+    (reference analog: examples/mnist/keras/mnist_spark.py:17-76).
+    """
+    import jax
+    if getattr(args, "platform", "cpu") == "cpu":
+        # Keep local multi-process demos off the real accelerator even when
+        # the parent process preloaded an accelerator-pinned jax (fork
+        # inherits it); the config API wins over inherited env/state.
+        jax.config.update("jax_platforms", "cpu")
+    ctx.init_distributed()
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import export
+    from tensorflowonspark_tpu.models.cnn import MnistCNN
+    from tensorflowonspark_tpu.models.mlp import cross_entropy_loss
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import train as train_mod
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt_mod
+
+    batch_size = getattr(args, "batch_size", 64)
+    # the fixed per-process batch must tile over this process's devices
+    batch_size = max(batch_size - batch_size % jax.local_device_count(),
+                     jax.local_device_count())
+    model_dir = getattr(args, "model_dir", None)
+    export_dir = getattr(args, "export_dir", None)
+
+    model = MnistCNN()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def loss_fn(params, batch, rng):
+        X, y = batch
+        logits = model.apply({"params": params}, X)
+        return cross_entropy_loss(logits, y)
+
+    mesh = mesh_mod.build_mesh()          # node-local devices (dp only)
+    opt = optax.adam(1e-3)
+    state = train_mod.create_train_state(params, opt, mesh)
+    step = train_mod.make_train_step(loss_fn, opt, mesh)
+    bsharding = mesh_mod.batch_sharding(mesh)
+
+    df = ctx.get_data_feed(train_mode=True)
+    rng = jax.random.key(ctx.process_id)
+    steps = losses = 0
+    while True:
+        # bounded probe, not a blocking get: a worker stuck in q.get() while
+        # its peers sit in the gradient collective would deadlock the
+        # cluster; timing out lets it vote "dry" in the consensus below
+        recs = [] if df.should_stop() else df.next_batch(batch_size, timeout=30)
+        # stop-consensus: ALL workers stop on the same step the first time
+        # any feed runs dry, so the sharded step's collectives never go
+        # ragged (the deadlock the reference dodges with its 90%-of-steps
+        # heuristic, examples/mnist/keras/mnist_spark.py:58-64)
+        if not train_mod.feed_consensus(bool(recs)):
+            if recs or not df.should_stop():
+                df.terminate()  # drain the dropped tail so feeders unblock
+            break
+        # repeat-pad the ragged final batch up to the fixed batch_size: the
+        # jitted step keeps ONE static shape (no tail recompiles) and every
+        # process contributes an identical local shard shape, which the
+        # multi-process put_batch requires (the reference instead *skips*
+        # 10% of steps to dodge ragged feeds — mnist_spark.py:58-64)
+        while len(recs) < batch_size:
+            recs.append(recs[-1])
+        X = np.asarray([r[0] for r in recs], "float32").reshape(-1, 28, 28, 1) / 255.0
+        y = np.asarray([r[1] for r in recs], "int64")
+        batch = mesh_mod.put_batch((jnp.asarray(X), jnp.asarray(y)), bsharding)
+        rng, sub = jax.random.split(rng)
+        state, metrics = step(state, batch, sub)
+        losses += float(metrics["loss"])
+        steps += 1
+        if model_dir and ctx.is_chief and steps % 100 == 0:
+            ckpt_mod.save_checkpoint(model_dir, state.params, steps)
+
+    if steps:
+        print(f"[{ctx.job_name}:{ctx.task_index}] trained {steps} steps, "
+              f"mean loss {losses / steps:.4f}")
+    if ctx.is_chief:
+        if model_dir:
+            ckpt_mod.save_checkpoint(model_dir, state.params, max(steps, 1))
+        if export_dir:
+            export.export_saved_model(
+                export_dir, jax.device_get(state.params),
+                builder="tensorflowonspark_tpu.models.cnn:MnistCNN",
+                signatures={"serving_default": {
+                    "inputs": {"image": {"shape": [28, 28, 1],
+                                         "dtype": "float32"}},
+                    "outputs": ["logits"]}})
+
+
+def add_common_args(parser):
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--data_dir", default="data/mnist")
+    parser.add_argument("--model_dir", default=None)
+    parser.add_argument("--export_dir", default=None)
+    parser.add_argument("--platform", choices=["cpu", "tpu"], default="cpu",
+                        help="cpu keeps local multi-process demos off the "
+                             "(single) real TPU; use tpu on a real pod")
+    return parser
+
+
+def absolutize_args(args):
+    from tensorflowonspark_tpu import util
+
+    return util.absolutize_args(args)
+
+
+def pin_platform(platform):
+    if platform == "cpu":
+        from tensorflowonspark_tpu import util
+
+        util.pin_platform("cpu")

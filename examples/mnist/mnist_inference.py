@@ -1,0 +1,76 @@
+"""Embarrassingly-parallel MNIST inference via the parallel runner.
+
+Independent single-node instances, no inter-node communication; each instance
+takes a deterministic shard of the input files by rank — the analog of the
+reference's TFParallel path (reference: examples/mnist/keras/mnist_inference.py:1-79,
+shard selection at :42; TFParallel.py:36-64).
+
+Local run:
+    python examples/mnist/mnist_data_setup.py --output data/mnist
+    python examples/mnist/mnist_spark.py --cluster_size 2 --export_dir /tmp/me
+    python examples/mnist/mnist_inference.py --cluster_size 2 \
+        --export_dir /tmp/me --output /tmp/mnist_preds
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+from mnist_common import absolutize_args, add_common_args, pin_platform
+
+from tensorflowonspark_tpu import backend, parallel_runner, pipeline
+
+
+def map_fun(args, ctx):
+    import glob
+    import os
+
+    import jax
+    if getattr(args, "platform", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from tensorflowonspark_tpu import export, tfrecord
+
+    paths = sorted(glob.glob(
+        os.path.join(args.data_dir, "tfrecords", "*.tfrecord")))
+    shard = paths[ctx.executor_id::max(ctx.num_workers, 1)]
+    apply_fn, params, signature = export.load_saved_model(args.export_dir)
+    jit_apply = jax.jit(apply_fn)
+
+    os.makedirs(args.output, exist_ok=True)
+    out_path = os.path.join(args.output, f"part-{ctx.executor_id:05d}.csv")
+    n = 0
+    with open(out_path, "w") as out:
+        for path in shard:
+            examples = list(tfrecord.read_examples(path))
+            if not examples:
+                continue
+            X = np.asarray([ex["image"][1] for ex in examples],
+                           "float32").reshape(-1, 28, 28, 1) / 255.0
+            labels = [int(ex["label"][1][0]) for ex in examples]
+            logits = np.asarray(jit_apply(params, X))
+            for lab, pred in zip(labels, logits.argmax(axis=1)):
+                out.write(f"{lab},{int(pred)}\n")
+            n += len(labels)
+    print(f"[executor {ctx.executor_id}] wrote {n} predictions to {out_path}")
+
+
+def main(argv=None):
+    p = add_common_args(argparse.ArgumentParser())
+    p.add_argument("--output", default="/tmp/mnist_predictions")
+    args = absolutize_args(p.parse_args(argv))
+    pin_platform(args.platform)
+    if not args.export_dir:
+        p.error("--export_dir is required")
+
+    bk = backend.LocalBackend(args.cluster_size)
+    parallel_runner.run(bk, map_fun, pipeline.Namespace(vars(args)),
+                        num_executors=args.cluster_size)
+    print("parallel inference complete:", args.output)
+
+
+if __name__ == "__main__":
+    main()

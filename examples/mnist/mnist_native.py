@@ -1,0 +1,99 @@
+"""MNIST training, file-fed (InputMode.NATIVE).
+
+Each worker reads its own shard of the TFRecord files directly — the analog
+of the reference's InputMode.TENSORFLOW path where workers stream TFRecords
+from HDFS themselves (reference: examples/mnist/keras/mnist_tf_ds.py:1-120,
+shard selection at :41-50) instead of being queue-fed by the cluster.
+
+Local run:
+    python examples/mnist/mnist_data_setup.py --output data/mnist
+    python examples/mnist/mnist_native.py --cluster_size 2 --steps 60
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+from mnist_common import absolutize_args, add_common_args, pin_platform
+
+from tensorflowonspark_tpu import backend, cluster, pipeline
+
+
+def map_fun(args, ctx):
+    import glob
+    import os
+
+    import jax
+    if getattr(args, "platform", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    ctx.init_distributed()
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu.models.cnn import MnistCNN
+    from tensorflowonspark_tpu.models.mlp import cross_entropy_loss
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    # deterministic shard: every worker takes files round-robin by rank
+    # (maps ds.shard(num_workers, worker_index), mnist_tf_ds.py:41-50)
+    paths = sorted(glob.glob(
+        os.path.join(ctx.absolute_path(args.data_dir), "tfrecords", "*.tfrecord")))
+    shard = paths[ctx.process_id::max(ctx.num_processes, 1)]
+    records = []
+    for path in shard:
+        for ex in tfrecord.read_examples(path):
+            records.append((np.asarray(ex["image"][1], "float32"),
+                            int(ex["label"][1][0])))
+    print(f"[{ctx.job_name}:{ctx.task_index}] {len(records)} records "
+          f"from {len(shard)} shards")
+
+    model = MnistCNN()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def loss_fn(params, batch, rng):
+        X, y = batch
+        return cross_entropy_loss(model.apply({"params": params}, X), y)
+
+    mesh = mesh_mod.build_mesh()
+    opt = optax.adam(1e-3)
+    state = train_mod.create_train_state(params, opt, mesh)
+    step = train_mod.make_train_step(loss_fn, opt, mesh)
+    bsharding = mesh_mod.batch_sharding(mesh)
+
+    rng = np.random.RandomState(ctx.process_id)
+    jrng = jax.random.key(ctx.process_id)
+    bs = max(args.batch_size - args.batch_size % mesh.devices.size,
+             mesh.devices.size)
+    for i in range(args.steps):
+        idx = rng.randint(0, len(records), bs)
+        X = np.stack([records[j][0] for j in idx]).reshape(-1, 28, 28, 1) / 255.0
+        y = np.asarray([records[j][1] for j in idx], "int64")
+        batch = mesh_mod.put_batch((jnp.asarray(X), jnp.asarray(y)),
+                                   bsharding)
+        jrng, sub = jax.random.split(jrng)
+        state, metrics = step(state, batch, sub)
+        if i % 20 == 0:
+            print(f"[{ctx.job_name}:{ctx.task_index}] step {i} "
+                  f"loss {float(metrics['loss']):.4f}")
+
+
+def main(argv=None):
+    p = add_common_args(argparse.ArgumentParser())
+    p.add_argument("--steps", type=int, default=60)
+    args = absolutize_args(p.parse_args(argv))
+    pin_platform(args.platform)
+
+    bk = backend.LocalBackend(args.cluster_size)
+    c = cluster.run(bk, map_fun, pipeline.Namespace(vars(args)), num_executors=args.cluster_size,
+                    input_mode=cluster.InputMode.NATIVE)
+    c.shutdown(grace_secs=0)
+    print("native-mode training complete")
+
+
+if __name__ == "__main__":
+    main()

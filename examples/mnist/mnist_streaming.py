@@ -1,0 +1,63 @@
+"""MNIST training from an unbounded stream.
+
+Feeds batches of partitions for as long as the stream produces them, until a
+STOP message reaches the reservation server — which is what
+examples/utils/stop_streaming.py sends. Mirrors the reference's DStream
+example (reference: examples/mnist/estimator/mnist_spark_streaming.py:1-142;
+termination CLI examples/utils/stop_streaming.py:14-17). PS-style async has
+no TPU analog, so the stream feeds synchronous data-parallel workers
+(intentional divergence, SURVEY.md §2.3).
+
+Local run (ctrl-c or stop_streaming.py to end):
+    python examples/mnist/mnist_data_setup.py --output data/mnist
+    python examples/mnist/mnist_streaming.py --cluster_size 2 --max_batches 5
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import itertools
+import time
+
+from mnist_common import (absolutize_args, add_common_args,
+                          load_csv_partitions, mnist_map_fun, pin_platform)
+
+from tensorflowonspark_tpu import backend, cluster, pipeline
+
+
+def micro_batches(parts, max_batches, interval_secs):
+    """Re-deal the partitions forever (or max_batches times), one micro-epoch
+    per tick — the DStream stand-in for local runs."""
+    for i in itertools.count():
+        if max_batches and i >= max_batches:
+            return
+        yield parts
+        time.sleep(interval_secs)
+
+
+def main(argv=None):
+    p = add_common_args(argparse.ArgumentParser())
+    p.add_argument("--max_batches", type=int, default=0,
+                   help="0 = run until STOP (stop_streaming.py)")
+    p.add_argument("--interval_secs", type=float, default=1.0)
+    args = absolutize_args(p.parse_args(argv))
+    pin_platform(args.platform)
+
+    parts = load_csv_partitions(args.data_dir, 2 * args.cluster_size)
+    bk = backend.LocalBackend(args.cluster_size)
+    c = cluster.run(bk, mnist_map_fun, pipeline.Namespace(vars(args)),
+                    num_executors=args.cluster_size,
+                    input_mode=cluster.InputMode.SPARK)
+    host, port = c.cluster_meta["server_addr"]
+    print(f"streaming; stop with: python examples/utils/stop_streaming.py "
+          f"--host {host} --port {port}")
+    c.train_stream(micro_batches(parts, args.max_batches, args.interval_secs),
+                   feed_timeout=600)
+    c.shutdown(grace_secs=2)
+    print("streaming training stopped")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Thin cluster driver for the ResNet56/CIFAR training fn — the "<10 lines
+of change" migration pattern (reference: examples/resnet/resnet_cifar_spark.py:1-22,
+absl-flag passthrough at :19-21): all real logic lives in
+resnet_cifar_dist.main_fun; this driver only forms the cluster and passes
+argv through.
+
+    python examples/resnet/resnet_cifar_spark.py --cluster_size 2 --steps 10
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import sys
+
+from resnet_cifar_dist import build_argparser, main_fun
+
+from tensorflowonspark_tpu import backend, cluster, util
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    args = build_argparser().parse_args(argv)  # validate eagerly on driver
+    util.absolutize_args(args)
+    if args.platform == "cpu":
+        util.pin_platform("cpu")
+    bk = backend.LocalBackend(args.cluster_size)
+    c = cluster.run(bk, main_fun, argv, num_executors=args.cluster_size,
+                    input_mode=cluster.InputMode.NATIVE)
+    c.shutdown(grace_secs=0)
+    print("resnet cifar training complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Single-node UNet image segmentation.
+
+First rung of the reference's 3-stage conversion ladder (single-node →
+raw-distributed → cluster-managed; reference: examples/segmentation/README.md:5,
+segmentation.py:1-155 — Oxford-IIIT pets via pix2pix-style UNet). No egress
+here, so the dataset is a synthetic shapes corpus: random rectangles/disks
+composited on noise with exact masks — learnable and self-checking.
+
+    python examples/segmentation/segmentation.py --steps 20
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--image_size", type=int, default=64)
+    p.add_argument("--num_examples", type=int, default=512)
+    p.add_argument("--model_dir", default=None)
+    p.add_argument("--platform", choices=["cpu", "tpu"], default="cpu")
+    p.add_argument("--cluster_size", type=int, default=1)
+    return p
+
+
+def synthetic_shapes(n, size, seed=0):
+    """Images with one random bright rectangle (class 1) and one disk
+    (class 2) over noise (class 0); returns (images, masks)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    imgs = rng.rand(n, size, size, 3).astype("float32") * 0.3
+    masks = np.zeros((n, size, size), dtype="int64")
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        x0, y0 = rng.randint(0, size // 2, 2)
+        w, h = rng.randint(size // 8, size // 3, 2)
+        imgs[i, y0:y0 + h, x0:x0 + w] += 0.6
+        masks[i, y0:y0 + h, x0:x0 + w] = 1
+        cx, cy, r = rng.randint(size // 4, 3 * size // 4, 2).tolist() + [size // 8]
+        disk = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+        imgs[i, disk] = imgs[i, disk] * 0.4 + 0.5
+        masks[i][disk] = 2
+    return np.clip(imgs, 0, 1), masks
+
+
+def train(args, ctx=None):
+    import jax
+    if getattr(args, "platform", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    if ctx is not None:
+        ctx.init_distributed()
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models.unet import UNet, pixel_cross_entropy
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import train as train_mod
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt_mod
+
+    task = ctx.process_id if ctx is not None else 0
+    images, masks = synthetic_shapes(args.num_examples, args.image_size,
+                                     seed=task)
+
+    model = UNet(num_classes=3)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, args.image_size, args.image_size, 3)))["params"]
+
+    def loss_fn(params, batch, rng):
+        X, y = batch
+        return pixel_cross_entropy(model.apply({"params": params}, X), y)
+
+    mesh = mesh_mod.build_mesh()
+    opt = optax.adam(1e-3)
+    state = train_mod.create_train_state(params, opt, mesh)
+    step = train_mod.make_train_step(loss_fn, opt, mesh)
+    bsharding = mesh_mod.batch_sharding(mesh)
+
+    bs = max(args.batch_size - args.batch_size % mesh.devices.size,
+             mesh.devices.size)
+    rng = np.random.RandomState(task)
+    jrng = jax.random.key(task)
+    for i in range(args.steps):
+        idx = rng.randint(0, len(images), bs)
+        batch = mesh_mod.put_batch((jnp.asarray(images[idx]),
+                                    jnp.asarray(masks[idx])), bsharding)
+        jrng, sub = jax.random.split(jrng)
+        state, metrics = step(state, batch, sub)
+        if i % 10 == 0:
+            who = f"worker:{task}" if ctx else "local"
+            print(f"[{who}] step {i} loss {float(metrics['loss']):.4f}")
+    if args.model_dir and (ctx is None or ctx.is_chief):
+        ckpt_mod.save_checkpoint(args.model_dir, state.params, args.steps)
+    return state
+
+
+if __name__ == "__main__":
+    train(build_argparser().parse_args())

@@ -1,0 +1,37 @@
+"""Cluster-managed UNet segmentation — the same training fn as
+segmentation.py, formed into a cluster by the framework (reference:
+examples/segmentation/segmentation_spark.py:1-193, third rung of the
+conversion ladder in examples/segmentation/README.md:5).
+
+    python examples/segmentation/segmentation_spark.py --cluster_size 2 --steps 10
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from segmentation import build_argparser, train
+
+from tensorflowonspark_tpu import backend, cluster, pipeline, util
+
+
+def map_fun(args, ctx):
+    if isinstance(args, list):
+        args = build_argparser().parse_args(args)
+    train(args, ctx)
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    util.absolutize_args(args)
+    if args.platform == "cpu":
+        util.pin_platform("cpu")
+    bk = backend.LocalBackend(args.cluster_size)
+    c = cluster.run(bk, map_fun, pipeline.Namespace(vars(args)), num_executors=args.cluster_size,
+                    input_mode=cluster.InputMode.NATIVE)
+    c.shutdown(grace_secs=0)
+    print("segmentation training complete")
+
+
+if __name__ == "__main__":
+    main()

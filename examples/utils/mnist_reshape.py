@@ -1,0 +1,7 @@
+"""Reshape a flat-784 MNIST vector to 28x28x1 (reference:
+examples/utils/mnist_reshape.py:1-9)."""
+import numpy as np
+
+
+def reshape_mnist(flat):
+    return np.asarray(flat, dtype="float32").reshape(28, 28, 1)

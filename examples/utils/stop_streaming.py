@@ -1,0 +1,28 @@
+"""Terminate a running streaming job by sending STOP to its reservation
+server (reference: examples/utils/stop_streaming.py:1-18).
+
+    python examples/utils/stop_streaming.py --host <driver_host> --port <port>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+from tensorflowonspark_tpu import reservation
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", required=True)
+    p.add_argument("--port", type=int, required=True)
+    args = p.parse_args(argv)
+    client = reservation.Client((args.host, args.port))
+    client.request_stop()
+    client.close()
+    print(f"sent STOP to {args.host}:{args.port}")
+
+
+if __name__ == "__main__":
+    main()

@@ -55,14 +55,25 @@ class DataFeed:
         self._buffer = []          # records drained from chunks, not yet returned
         self._partition_break = False
 
-    def next_batch(self, batch_size):
+    def next_batch(self, batch_size, timeout=None):
         """Return up to `batch_size` records.
 
         Returns fewer records at a partition boundary (so inference result
         accounting stays 1:1 per partition, reference: TFNode.py:243-288) and
         an empty/short batch at end-of-feed.  With `input_mapping` (a dict
         column_index_or_key -> name), returns {name: [values...]} instead.
+
+        `timeout` (seconds) bounds each blocking wait: when no record
+        arrives within `timeout`, returns whatever was collected so far
+        (possibly []).
+        Synchronous multi-worker consumers need this probe semantics — a
+        worker blocked forever in q.get() while its peers sit in a gradient
+        collective would deadlock the cluster (see
+        parallel.train.feed_consensus); a bounded probe instead lets the
+        worker vote "dry" and the cluster stop in lockstep.
         """
+        import queue as queue_mod
+
         q = self.mgr.get_queue(self.qname_in)
         batch = []
         while len(batch) < batch_size:
@@ -71,7 +82,10 @@ class DataFeed:
                 continue
             if self.done_feeding or self._partition_break:
                 break
-            item = q.get()
+            try:
+                item = q.get(timeout=timeout) if timeout else q.get()
+            except queue_mod.Empty:
+                break
             if item is None:
                 self.done_feeding = True
                 q.task_done()

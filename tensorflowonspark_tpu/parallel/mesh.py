@@ -113,3 +113,22 @@ def batch_sharding(mesh):
 def replicated_sharding(mesh):
     import jax
     return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+def put_batch(tree, sharding):
+    """Place a process-local batch (pytree of host arrays) onto the mesh.
+
+    Single-process: a plain ``jax.device_put``. Multi-process SPMD: each
+    process passes ITS shard and the result is the global array spanning all
+    processes (``jax.make_array_from_process_local_data``) — the device_put
+    analog of the reference's per-worker feed shards flowing into a
+    collective-synchronized step. Every process must contribute the same
+    local batch shape; pad the ragged tail (see examples/mnist) to keep the
+    jitted step's shapes static.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return jax.device_put(tree, sharding)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), tree)

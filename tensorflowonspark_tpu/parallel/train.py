@@ -146,3 +146,37 @@ def make_eval_step(forward_fn, mesh=None):
         in_shardings=(NamedSharding(mesh, PartitionSpec()),
                       mesh_mod.batch_sharding(mesh)),
         out_shardings=mesh_mod.batch_sharding(mesh))
+
+
+def feed_consensus(has_data):
+    """Global stop-consensus for synchronous training over an uneven feed.
+
+    Every process calls this once per step with whether ITS feed produced a
+    batch; returns True only while every process has data. The first dry
+    process flips the whole cluster to stop on the same step, so sharded
+    collectives never go ragged. This replaces the reference's heuristic of
+    training only 90% of the per-worker steps to dodge uneven RDD partitions
+    (reference: examples/mnist/keras/mnist_spark.py:58-64) with an exact
+    consensus; the dropped remainder is bounded by the feed imbalance, and
+    callers should df.terminate() to drain it.
+
+    Callers MUST pair this with a bounded feed probe
+    (``DataFeed.next_batch(bs, timeout=...)``), never a blocking read: a
+    worker blocked in q.get() waiting for records that only arrive after its
+    peers advance would never reach this collective, deadlocking the cluster
+    until feed_timeout.
+
+    Single-process clusters short-circuit (no collective). Cross-process it
+    is one tiny allgather over the cluster fabric (Gloo on CPU hosts, ICI/DCN
+    on TPU) per step.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return bool(has_data)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if has_data else 0], np.int32))
+    return bool(np.asarray(flags).min())

@@ -127,3 +127,31 @@ def single_node_env(num_cpu_devices=None):
             os.environ["XLA_FLAGS"] = (flags + " " + token).strip()
     # Keep TF (used only for TFRecord interop tests) off the accelerator.
     os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+
+def pin_platform(platform):
+    """Pin THIS process (and everything forked from it) to a JAX platform.
+
+    Env alone is not enough: the surrounding environment may both preload
+    jax and pin JAX_PLATFORMS to the real accelerator, so the config API
+    must win; the env var is still set so spawn-started children (which do
+    not inherit config state) agree. Local multi-process demos must pin
+    "cpu" — several processes sharing one real TPU deadlock on the device.
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
+def absolutize_args(args, keys=("data_dir", "model_dir", "export_dir",
+                                "output", "tfrecord_dir")):
+    """Resolve path-valued args on the DRIVER: executor processes run in
+    their own per-executor workdirs, so relative paths would land there
+    (the reference routes paths through ctx.absolute_path/hdfs_path for the
+    same reason, TFNode.py:29-64)."""
+    for k in keys:
+        v = getattr(args, k, None)
+        if v and "://" not in v:
+            setattr(args, k, os.path.abspath(v))
+    return args

@@ -1,0 +1,96 @@
+"""Smoke tests for the L6 examples tree — each driver runs end-to-end as a
+real subprocess on tiny shapes (the reference exercises its examples only in
+docs/CI scripts; we pin them in the suite so they cannot rot)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+EX = os.path.join(REPO, "examples")
+
+
+def _run(script, *args, cwd, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EX, script), *args],
+        cwd=str(cwd), env=env, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}")
+    return proc.stdout
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("exdata")
+    _run("mnist/mnist_data_setup.py", "--output", "data/mnist",
+         "--num_examples", "120", "--num_partitions", "4", cwd=d)
+    return d
+
+
+def test_mnist_data_setup(mnist_data):
+    images = np.loadtxt(mnist_data / "data/mnist/csv/images.csv",
+                        delimiter=",", dtype="float32")
+    assert images.shape == (120, 784)
+    shards = list((mnist_data / "data/mnist/tfrecords").glob("*.tfrecord"))
+    assert len(shards) == 4
+
+
+def test_mnist_spark_trains_and_exports(mnist_data):
+    out = _run("mnist/mnist_spark.py", "--cluster_size", "2",
+               "--batch_size", "16", "--export_dir", "mnist_export",
+               cwd=mnist_data)
+    assert "training complete" in out
+    assert (mnist_data / "mnist_export").exists()
+
+
+def test_mnist_native(mnist_data):
+    out = _run("mnist/mnist_native.py", "--cluster_size", "2",
+               "--steps", "3", "--batch_size", "8", cwd=mnist_data)
+    assert "native-mode training complete" in out
+
+
+def test_mnist_pipeline_fit_transform(mnist_data):
+    out = _run("mnist/mnist_pipeline.py", "--cluster_size", "1",
+               "--batch_size", "16", "--export_dir", "pipe_export",
+               cwd=mnist_data)
+    assert "transform produced 100 predictions" in out
+
+
+def test_mnist_parallel_inference(mnist_data):
+    _run("mnist/mnist_spark.py", "--cluster_size", "1", "--batch_size", "16",
+         "--export_dir", "inf_export", cwd=mnist_data)
+    out = _run("mnist/mnist_inference.py", "--cluster_size", "2",
+               "--export_dir", "inf_export", "--output", "preds",
+               cwd=mnist_data)
+    assert "parallel inference complete" in out
+    rows = [line for p in (mnist_data / "preds").glob("part-*.csv")
+            for line in p.read_text().splitlines()]
+    assert len(rows) == 120  # every example predicted exactly once
+
+
+def test_mnist_streaming_bounded(mnist_data):
+    out = _run("mnist/mnist_streaming.py", "--cluster_size", "1",
+               "--batch_size", "16", "--max_batches", "2",
+               "--interval_secs", "0.1", cwd=mnist_data)
+    assert "streaming training stopped" in out
+
+
+def test_resnet_cifar_cluster(tmp_path):
+    out = _run("resnet/resnet_cifar_spark.py", "--cluster_size", "1",
+               "--steps", "2", "--batch_size", "8", "--num_examples", "64",
+               cwd=tmp_path)
+    assert "resnet cifar training complete" in out
+
+
+def test_segmentation_single_and_cluster(tmp_path):
+    _run("segmentation/segmentation.py", "--steps", "2", "--batch_size", "4",
+         "--image_size", "32", "--num_examples", "16", cwd=tmp_path)
+    out = _run("segmentation/segmentation_spark.py", "--cluster_size", "1",
+               "--steps", "2", "--batch_size", "4", "--image_size", "32",
+               "--num_examples", "16", cwd=tmp_path)
+    assert "segmentation training complete" in out
