@@ -32,12 +32,12 @@ def mnist_map_fun(args, ctx):
     first forms the global runtime so the same code scales out
     (reference analog: examples/mnist/keras/mnist_spark.py:17-76).
     """
-    import jax
+    from tensorflowonspark_tpu import util as fw_util
+
     if getattr(args, "platform", "cpu") == "cpu":
-        # Keep local multi-process demos off the real accelerator even when
-        # the parent process preloaded an accelerator-pinned jax (fork
-        # inherits it); the config API wins over inherited env/state.
-        jax.config.update("jax_platforms", "cpu")
+        # keep local multi-process demos off the (single) real accelerator
+        fw_util.pin_platform("cpu")
+    import jax
     ctx.init_distributed()
     import jax.numpy as jnp
     import numpy as np
@@ -71,6 +71,10 @@ def mnist_map_fun(args, ctx):
     step = train_mod.make_train_step(loss_fn, opt, mesh)
     bsharding = mesh_mod.batch_sharding(mesh)
 
+    # how long a worker waits for feed data before voting "dry" in the
+    # stop-consensus; streaming jobs use a large value (gaps between
+    # micro-batches are normal), bounded batch jobs a small one
+    probe = getattr(args, "feed_probe_secs", 30)
     df = ctx.get_data_feed(train_mode=True)
     rng = jax.random.key(ctx.process_id)
     steps = losses = 0
@@ -78,7 +82,7 @@ def mnist_map_fun(args, ctx):
         # bounded probe, not a blocking get: a worker stuck in q.get() while
         # its peers sit in the gradient collective would deadlock the
         # cluster; timing out lets it vote "dry" in the consensus below
-        recs = [] if df.should_stop() else df.next_batch(batch_size, timeout=30)
+        recs = [] if df.should_stop() else df.next_batch(batch_size, timeout=probe)
         # stop-consensus: ALL workers stop on the same step the first time
         # any feed runs dry, so the sharded step's collectives never go
         # ragged (the deadlock the reference dodges with its 90%-of-steps
@@ -127,6 +131,9 @@ def add_common_args(parser):
     parser.add_argument("--data_dir", default="data/mnist")
     parser.add_argument("--model_dir", default=None)
     parser.add_argument("--export_dir", default=None)
+    parser.add_argument("--feed_probe_secs", type=float, default=30,
+                        help="worker feed-probe timeout before voting dry "
+                             "in the stop-consensus")
     parser.add_argument("--platform", choices=["cpu", "tpu"], default="cpu",
                         help="cpu keeps local multi-process demos off the "
                              "(single) real TPU; use tpu on a real pod")

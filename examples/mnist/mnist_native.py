@@ -25,9 +25,11 @@ def map_fun(args, ctx):
     import glob
     import os
 
-    import jax
+    from tensorflowonspark_tpu import util as fw_util
+
     if getattr(args, "platform", "cpu") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+        fw_util.pin_platform("cpu")
+    import jax
     ctx.init_distributed()
     import jax.numpy as jnp
     import numpy as np

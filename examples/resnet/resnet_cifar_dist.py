@@ -47,9 +47,11 @@ def main_fun(args, ctx):
     """The distributed training program (argv-style args, framework ctx)."""
     if isinstance(args, list):
         args = build_argparser().parse_args(args)
-    import jax
+    from tensorflowonspark_tpu import util as fw_util
+
     if getattr(args, "platform", "cpu") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+        fw_util.pin_platform("cpu")
+    import jax
     if ctx is not None:
         ctx.init_distributed()
     import jax.numpy as jnp

@@ -50,9 +50,11 @@ def synthetic_shapes(n, size, seed=0):
 
 
 def train(args, ctx=None):
-    import jax
+    from tensorflowonspark_tpu import util as fw_util
+
     if getattr(args, "platform", "cpu") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+        fw_util.pin_platform("cpu")
+    import jax
     if ctx is not None:
         ctx.init_distributed()
     import jax.numpy as jnp

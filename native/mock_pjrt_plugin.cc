@@ -1,0 +1,215 @@
+// Minimal mock PJRT plugin for testing the C++ runner without accelerator
+// hardware — the native analog of the reference's mock seam at the
+// device-discovery layer (reference: tests/test_TFSparkNode.py patches
+// gpu_info.get_gpus). Implements exactly the PJRT C API subset
+// pjrt_runner.cc exercises; "compile" records nothing and "execute" copies
+// input 0 to the single output (identity function), so tests can check the
+// full host->device->execute->host marshalling path byte-for-byte.
+#include <cstdint>
+#include <cstring>
+#include <new>
+#include <string>
+#include <vector>
+
+#include "tensorflow/compiler/xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct MockError {
+  std::string message;
+};
+
+struct MockBuffer {
+  std::vector<char> data;
+  std::vector<int64_t> dims;
+  PJRT_Buffer_Type type;
+};
+
+PJRT_Error* make_error(const std::string& msg) {
+  auto* e = new MockError{msg};
+  return reinterpret_cast<PJRT_Error*>(e);
+}
+
+int mock_device_marker;  // address doubles as the one fake PJRT_Device*
+PJRT_Device* kDevice = reinterpret_cast<PJRT_Device*>(&mock_device_marker);
+PJRT_Device* kDeviceList[1] = {kDevice};
+int mock_client_marker;
+int mock_exec_marker;
+
+void Error_Destroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<MockError*>(args->error);
+}
+
+void Error_Message(PJRT_Error_Message_Args* args) {
+  auto* e = reinterpret_cast<MockError*>(const_cast<PJRT_Error*>(args->error));
+  args->message = e->message.c_str();
+  args->message_size = e->message.size();
+}
+
+PJRT_Error* Plugin_Initialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* Client_Create(PJRT_Client_Create_Args* args) {
+  args->client = reinterpret_cast<PJRT_Client*>(&mock_client_marker);
+  return nullptr;
+}
+
+PJRT_Error* Client_Destroy(PJRT_Client_Destroy_Args*) { return nullptr; }
+
+PJRT_Error* Client_PlatformName(PJRT_Client_PlatformName_Args* args) {
+  static const char kName[] = "mock";
+  args->platform_name = kName;
+  args->platform_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* Client_AddressableDevices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  args->addressable_devices = kDeviceList;
+  args->num_addressable_devices = 1;
+  return nullptr;
+}
+
+PJRT_Error* Client_Compile(PJRT_Client_Compile_Args* args) {
+  if (args->program == nullptr || args->program->code_size == 0) {
+    return make_error("mock: empty program");
+  }
+  args->executable =
+      reinterpret_cast<PJRT_LoadedExecutable*>(&mock_exec_marker);
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutable_GetExecutable(
+    PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable = reinterpret_cast<PJRT_Executable*>(&mock_exec_marker);
+  return nullptr;
+}
+
+PJRT_Error* Executable_NumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = 1;
+  return nullptr;
+}
+
+PJRT_Error* Executable_Destroy(PJRT_Executable_Destroy_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutable_Destroy(PJRT_LoadedExecutable_Destroy_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* Client_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  auto* b = new MockBuffer();
+  int64_t elems = 1;
+  for (size_t i = 0; i < args->num_dims; ++i) elems *= args->dims[i];
+  int64_t esize;
+  switch (args->type) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      esize = 1;
+      break;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      esize = 2;
+      break;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+      esize = 8;
+      break;
+    default:
+      esize = 4;
+  }
+  b->data.assign(static_cast<const char*>(args->data),
+                 static_cast<const char*>(args->data) + elems * esize);
+  b->dims.assign(args->dims, args->dims + args->num_dims);
+  b->type = args->type;
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  args->done_with_host_buffer = nullptr;  // copy completed synchronously
+  return nullptr;
+}
+
+PJRT_Error* Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
+  delete reinterpret_cast<MockBuffer*>(args->buffer);
+  return nullptr;
+}
+
+PJRT_Error* Buffer_Dimensions(PJRT_Buffer_Dimensions_Args* args) {
+  auto* b = reinterpret_cast<MockBuffer*>(args->buffer);
+  args->dims = b->dims.data();
+  args->num_dims = b->dims.size();
+  return nullptr;
+}
+
+PJRT_Error* Buffer_ElementType(PJRT_Buffer_ElementType_Args* args) {
+  args->type = reinterpret_cast<MockBuffer*>(args->buffer)->type;
+  return nullptr;
+}
+
+PJRT_Error* Buffer_ToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  auto* b = reinterpret_cast<MockBuffer*>(args->src);
+  if (args->dst == nullptr) {
+    args->dst_size = b->data.size();
+    return nullptr;
+  }
+  if (args->dst_size < b->data.size()) {
+    return make_error("mock: dst too small");
+  }
+  std::memcpy(args->dst, b->data.data(), b->data.size());
+  args->event = nullptr;  // synchronous copy
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutable_Execute(
+    PJRT_LoadedExecutable_Execute_Args* args) {
+  if (args->num_devices != 1 || args->num_args < 1) {
+    return make_error("mock: expected 1 device and >=1 args");
+  }
+  auto* in0 = reinterpret_cast<MockBuffer*>(args->argument_lists[0][0]);
+  auto* out = new MockBuffer(*in0);  // identity
+  args->output_lists[0][0] = reinterpret_cast<PJRT_Buffer*>(out);
+  if (args->device_complete_events) {
+    args->device_complete_events[0] = nullptr;
+  }
+  return nullptr;
+}
+
+PJRT_Error* Event_Await(PJRT_Event_Await_Args*) { return nullptr; }
+PJRT_Error* Event_Destroy(PJRT_Event_Destroy_Args*) { return nullptr; }
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static PJRT_Api api = [] {
+    PJRT_Api a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Api_STRUCT_SIZE;
+    a.pjrt_api_version.major_version = PJRT_API_MAJOR;
+    a.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    a.PJRT_Error_Destroy = Error_Destroy;
+    a.PJRT_Error_Message = Error_Message;
+    a.PJRT_Plugin_Initialize = Plugin_Initialize;
+    a.PJRT_Client_Create = Client_Create;
+    a.PJRT_Client_Destroy = Client_Destroy;
+    a.PJRT_Client_PlatformName = Client_PlatformName;
+    a.PJRT_Client_AddressableDevices = Client_AddressableDevices;
+    a.PJRT_Client_Compile = Client_Compile;
+    a.PJRT_Client_BufferFromHostBuffer = Client_BufferFromHostBuffer;
+    a.PJRT_LoadedExecutable_GetExecutable = LoadedExecutable_GetExecutable;
+    a.PJRT_Executable_NumOutputs = Executable_NumOutputs;
+    a.PJRT_Executable_Destroy = Executable_Destroy;
+    a.PJRT_LoadedExecutable_Destroy = LoadedExecutable_Destroy;
+    a.PJRT_LoadedExecutable_Execute = LoadedExecutable_Execute;
+    a.PJRT_Buffer_Destroy = Buffer_Destroy;
+    a.PJRT_Buffer_Dimensions = Buffer_Dimensions;
+    a.PJRT_Buffer_ElementType = Buffer_ElementType;
+    a.PJRT_Buffer_ToHostBuffer = Buffer_ToHostBuffer;
+    a.PJRT_Event_Await = Event_Await;
+    a.PJRT_Event_Destroy = Event_Destroy;
+    return a;
+  }();
+  return &api;
+}

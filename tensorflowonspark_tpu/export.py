@@ -40,7 +40,8 @@ def _resolve_builder(spec):
 
 
 def export_saved_model(export_dir, params, builder, builder_kwargs=None,
-                       signatures=None, is_chief=True):
+                       signatures=None, is_chief=True, aot_batch_sizes=None,
+                       aot_platforms=None):
     """Write the serving artifact (maps TFNode.export_saved_model).
 
     - ``builder``: ``"module:callable"`` import path.  Called with
@@ -50,6 +51,9 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
       "dtype": "float32"}}, "outputs": [out_names]}}; defaults to a single
       ``serving_default`` with one unconstrained input.
     - Non-chief processes no-op, like the reference's chief-only export.
+    - ``aot_batch_sizes``: additionally AOT-compile the default signature to
+      StableHLO at these serving batch sizes (aot.export_aot) so the C++
+      PJRT runner / CLI can serve the model with no Python model code.
     """
     if not is_chief:
         logger.info("non-chief process skipping export to %s", export_dir)
@@ -71,6 +75,20 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
     with open(os.path.join(export_dir, PARAMS_FILE), "wb") as f:
         f.write(flax.serialization.to_bytes(params))
     logger.info("exported saved model to %s", export_dir)
+
+    if aot_batch_sizes:
+        from . import aot as aot_mod
+
+        # AOT-compile the default signature when present, else the sole /
+        # first declared one (callers may use custom signature names)
+        sig_names = list(spec["signatures"])
+        sig_key = (DEFAULT_SIGNATURE if DEFAULT_SIGNATURE in sig_names
+                   else sig_names[0])
+        apply_fn, loaded_params, signature = load_saved_model(
+            export_dir, signature_def_key=sig_key)
+        aot_mod.export_aot(export_dir, apply_fn, loaded_params, signature,
+                           batch_sizes=aot_batch_sizes,
+                           platforms=aot_platforms)
     return export_dir
 
 

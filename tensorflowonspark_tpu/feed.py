@@ -83,7 +83,7 @@ class DataFeed:
             if self.done_feeding or self._partition_break:
                 break
             try:
-                item = q.get(timeout=timeout) if timeout else q.get()
+                item = q.get(timeout=timeout) if timeout is not None else q.get()
             except queue_mod.Empty:
                 break
             if item is None:

@@ -1,0 +1,214 @@
+"""Batch-inference CLI over TFRecord shards and an exported model.
+
+Maps the reference's JVM inference driver
+(reference: src/main/scala/com/yahoo/tensorflowonspark/Inference.scala:30-43
+args, :52-79 load TFRecords -> TFModel.transform -> write JSON): reads
+TFRecord files, runs the exported model — preferring the AOT/native PJRT
+engine when the artifact carries one — and writes JSON-lines output, one
+file per input shard.
+
+    python -m tensorflowonspark_tpu.inference \
+        --export_dir /models/mnist --input data/mnist/tfrecords \
+        --schema_hint 'struct<image:array<float>,label:long>' \
+        --input_mapping '{"image": "image"}' \
+        --output_mapping '{"logits": "prediction"}' \
+        --output /tmp/predictions [--engine auto|native|jax]
+"""
+import argparse
+import glob
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def build_argparser():
+    p = argparse.ArgumentParser(
+        prog="tensorflowonspark_tpu.inference",
+        description="batch inference over TFRecords (Inference.scala analog)")
+    p.add_argument("--export_dir", required=True,
+                   help="saved-model dir (export.export_saved_model)")
+    p.add_argument("--input", required=True,
+                   help="TFRecord file, dir, or glob")
+    p.add_argument("--output", required=True, help="output dir (JSON lines)")
+    p.add_argument("--schema_hint", default=None,
+                   help="struct<name:type,...> to type the decoded features")
+    p.add_argument("--input_mapping", default=None,
+                   help='JSON {feature_name: model_input_name}')
+    p.add_argument("--output_mapping", default=None,
+                   help='JSON {model_output_name: result_column}')
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--signature_def_key", default=None)
+    p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
+                   default="auto",
+                   help="auto: AOT artifact if present (native PJRT runner "
+                        "when available), else rebuild from the model spec")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def _input_files(pattern):
+    if os.path.isdir(pattern):
+        files = sorted(glob.glob(os.path.join(pattern, "*.tfrecord"))) or \
+            sorted(glob.glob(os.path.join(pattern, "part-*")))
+    else:
+        files = sorted(glob.glob(pattern))
+    if not files:
+        raise FileNotFoundError(f"no input files match {pattern!r}")
+    return files
+
+
+def _decode_shard(path, fields):
+    """TFRecord shard -> {feature: list_of_values} honoring the schema hint
+    (reference DFUtil.loadTFRecords + schemaHint, DFUtil.scala:35-110)."""
+    import numpy as np
+
+    from . import tfrecord
+
+    columns = {}
+    count = 0
+    for ex in tfrecord.read_examples(path):
+        missing = [n for n in columns if n not in ex]
+        if missing:
+            # tf.train.Example allows sparse features, but a tabular batch
+            # cannot: silently skipping would misalign rows across columns
+            raise ValueError(
+                f"{path}: example {count} is missing feature(s) {missing}; "
+                "all examples in a shard must carry the same features")
+        for name, (kind, values) in ex.items():
+            if count and name not in columns:
+                raise ValueError(
+                    f"{path}: example {count} introduces new feature "
+                    f"{name!r} absent from earlier examples")
+            f = fields.get(name) if fields else None
+            if f is None:
+                value = values if kind != "bytes" or len(values) != 1 else values[0]
+            elif f.dtype == "string":
+                value = (values[0].decode("utf-8", "replace")
+                         if values and isinstance(values[0], bytes) else values)
+            elif f.dtype == "binary":
+                value = values[0] if len(values) == 1 else values
+            elif f.is_array:
+                value = np.asarray(values, f.dtype)
+            else:
+                value = np.asarray(values, f.dtype).reshape(-1)[0] if values else None
+            columns.setdefault(name, []).append(value)
+        count += 1
+    return columns, count
+
+
+def _load_predictor(args):
+    """Return (predict_rows(columns) -> {out_col: list}, description)."""
+    from . import aot, export
+
+    signature = None
+    spec_inputs = None
+    in_map = json.loads(args.input_mapping) if args.input_mapping else None
+    out_map = json.loads(args.output_mapping) if args.output_mapping else None
+
+    use_aot = args.engine in ("auto", "native", "jax") and aot.has_aot(args.export_dir)
+    if args.engine in ("native", "jax") and not use_aot:
+        raise ValueError(
+            f"--engine {args.engine} requires an AOT artifact "
+            f"({args.export_dir}/aot); re-export with aot_batch_sizes")
+
+    if use_aot:
+        engine = args.engine if args.engine != "auto" else "auto"
+        predict, spec, bs = aot.load_aot(args.export_dir,
+                                         batch_size=args.batch_size,
+                                         engine=engine)
+        spec_inputs = [(i["name"], i) for i in spec["inputs"]]
+        out_names = spec["outputs"]
+        desc = f"aot(batch={bs})"
+
+        def predict_rows(columns, n):
+            import numpy as np
+
+            arrays = []
+            inv = {v: k for k, v in (in_map or {}).items()}
+            for name, meta in spec_inputs:
+                feat = inv.get(name, name)
+                col = columns.get(feat)
+                if col is None:
+                    raise KeyError(
+                        f"model input {name!r} not fed: no feature {feat!r} "
+                        f"(have {sorted(columns)})")
+                arr = np.asarray(col, dtype=meta["dtype"])
+                arr = arr.reshape((n,) + tuple(int(d) for d in meta["shape"]))
+                arrays.append(arr)
+            outs = aot.predict_batched(predict, arrays, bs)
+            return _name_outputs(outs, out_names, out_map)
+    else:
+        import jax
+
+        apply_fn, params, signature = export.load_saved_model(
+            args.export_dir, args.signature_def_key)
+        jit_apply = jax.jit(apply_fn)
+        out_names = signature.get("outputs", ["output"])
+        desc = "builder"
+
+        def predict_rows(columns, n):
+            cols = {}
+            inv = {v: k for k, v in (in_map or {}).items()}
+            for name in signature["inputs"]:
+                feat = inv.get(name, name)
+                if feat not in columns:
+                    raise KeyError(
+                        f"model input {name!r} not fed: no feature {feat!r} "
+                        f"(have {sorted(columns)})")
+                cols[name] = columns[feat]
+            arrays = export.coerce_inputs(signature, cols)
+            outs = jit_apply(params, *arrays)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            return _name_outputs(outs, out_names, out_map)
+
+    return predict_rows, desc
+
+
+def _name_outputs(outs, out_names, out_map):
+    import numpy as np
+
+    named = {}
+    for name, arr in zip(out_names, outs):
+        if out_map and name not in out_map:
+            continue
+        named[(out_map or {}).get(name, name)] = np.asarray(arr)
+    return named
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from . import schema as schema_mod
+
+    fields = None
+    if args.schema_hint:
+        fields = {f.name: f for f in schema_mod.parse_struct(args.schema_hint)}
+
+    files = _input_files(args.input)
+    predict_rows, desc = _load_predictor(args)
+    logger.info("inference over %d shards with engine %s", len(files), desc)
+
+    os.makedirs(args.output, exist_ok=True)
+    total = 0
+    for i, path in enumerate(files):
+        columns, n = _decode_shard(path, fields)
+        out_path = os.path.join(args.output, f"part-{i:05d}.json")
+        if n == 0:
+            open(out_path, "w").close()
+            continue
+        named = predict_rows(columns, n)
+        with open(out_path, "w") as out:
+            for r in range(n):
+                row = {k: v[r].tolist() for k, v in named.items()}
+                out.write(json.dumps(row) + "\n")
+        total += n
+    print(f"wrote {total} predictions to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,185 @@
+"""AOT export + native PJRT runner + inference CLI tests.
+
+Engine matrix mirrors the reference's JVM inference tests
+(TFModelTest.scala batch2tensors/tensors2batch dtype coverage;
+Inference.scala end-to-end): the jax engine checks numerical round trips,
+the native C++ runner is exercised against the mock PJRT plugin
+(identity executable) to pin the full ctypes -> C ABI -> PJRT C API
+marshalling path, and the CLI runs end-to-end over real TFRecord shards.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+NATIVE = os.path.join(REPO, "native")
+MOCK_PLUGIN = os.path.join(NATIVE, "libmock_pjrt.so")
+RUNNER_LIB = os.path.join(NATIVE, "libtos_pjrt.so")
+
+from tensorflowonspark_tpu import aot, export, schema, tfrecord
+
+
+def _native_built():
+    return os.path.exists(MOCK_PLUGIN) and os.path.exists(RUNNER_LIB)
+
+
+@pytest.fixture(scope="module")
+def linear_export(tmp_path_factory):
+    """Export the Linear model with a known analytic solution + AOT."""
+    d = str(tmp_path_factory.mktemp("aotmodel") / "export")
+    params = {"dense": {"kernel": np.array([[2.0], [-3.0]], "float32"),
+                        "bias": np.array([1.5], "float32")}}
+    export.export_saved_model(
+        d, params, builder="tensorflowonspark_tpu.models.linear:Linear",
+        builder_kwargs={"features": 1},
+        signatures={"serving_default": {
+            "inputs": {"x": {"shape": [2], "dtype": "float32"}},
+            "outputs": ["y"]}},
+        aot_batch_sizes=(4, 16))
+    return d
+
+
+def test_aot_artifact_layout(linear_export):
+    spec = aot.read_spec(linear_export)
+    assert spec["batch_sizes"] == [4, 16]
+    for bs in (4, 16):
+        for platform in ("cpu", "tpu"):
+            assert os.path.exists(os.path.join(
+                linear_export, "aot", f"model_b{bs}.{platform}.jexport"))
+            mlir = open(os.path.join(
+                linear_export, "aot",
+                f"model_b{bs}.{platform}.stablehlo.mlir")).read()
+            assert "stablehlo" in mlir or "mhlo" in mlir
+    assert os.path.getsize(os.path.join(
+        linear_export, "aot", "compile_options.pb")) > 0
+
+
+def test_aot_jax_engine_numerics(linear_export):
+    predict, spec, bs = aot.load_aot(linear_export, batch_size=4, engine="jax")
+    assert bs == 4
+    X = np.array([[1, 1], [2, 0], [0, 0], [3, -1]], "float32")
+    (y,) = predict([X])
+    np.testing.assert_allclose(
+        np.asarray(y).ravel(), X @ np.array([2.0, -3.0]) + 1.5, rtol=1e-5)
+
+
+def test_aot_predict_batched_pads_and_trims(linear_export):
+    predict, spec, bs = aot.load_aot(linear_export, batch_size=4, engine="jax")
+    X = np.random.RandomState(0).rand(10, 2).astype("float32")  # 10 % 4 != 0
+    (y,) = aot.predict_batched(predict, [X], bs)
+    assert y.shape == (10,)  # Linear squeezes the feature dim
+    np.testing.assert_allclose(
+        y, X @ np.array([2.0, -3.0]) + 1.5, rtol=1e-5)
+
+
+@pytest.mark.skipif(not _native_built(), reason="native libs not built")
+def test_native_runner_mock_plugin_roundtrip(linear_export):
+    """Full C ABI path against the mock plugin (identity executable):
+    bytes in == bytes out, dims/dtype preserved."""
+    with open(os.path.join(linear_export, "aot",
+                           "model_b4.cpu.stablehlo.mlir")) as f:
+        mlir = f.read()
+    with open(os.path.join(linear_export, "aot", "compile_options.pb"),
+              "rb") as f:
+        copts = f.read()
+    runner = aot.NativeRunner(mlir, copts, plugin_path=MOCK_PLUGIN)
+    try:
+        assert runner.platform == "mock"
+        assert runner.num_outputs == 1
+        X = np.arange(8, dtype=np.float32).reshape(4, 2)
+        (out,) = runner.run([X])
+        np.testing.assert_array_equal(out, X)  # identity executable
+        # int dtype path
+        I = np.arange(12, dtype=np.int64).reshape(4, 3)
+        (out2,) = runner.run([I])
+        assert out2.dtype == np.int64
+        np.testing.assert_array_equal(out2, I)
+    finally:
+        runner.close()
+
+
+@pytest.mark.skipif(not _native_built(), reason="native libs not built")
+def test_native_runner_reports_compile_errors():
+    with open(os.path.join(NATIVE, "libmock_pjrt.so"), "rb"):
+        pass
+    with pytest.raises(RuntimeError, match="empty program"):
+        aot.NativeRunner("", b"", plugin_path=MOCK_PLUGIN)
+
+
+def test_native_runner_bad_plugin_path():
+    if not os.path.exists(RUNNER_LIB):
+        pytest.skip("native libs not built")
+    with pytest.raises(RuntimeError, match="dlopen"):
+        aot.NativeRunner("module {}", b"", plugin_path="/nonexistent/lib.so")
+
+
+# --- schema parser (SimpleTypeParserTest.scala analog) ---
+
+def test_parse_struct_all_types():
+    fields = schema.parse_struct(
+        "struct<b:binary,f:boolean,i:int,l:long,big:bigint,"
+        "fl:float,d:double,s:string,af:array<float>,al:array<long>>")
+    assert [f.dtype for f in fields] == [
+        "binary", "bool", "int32", "int64", "int64",
+        "float32", "float64", "string", "float32", "int64"]
+    assert [f.is_array for f in fields] == [False] * 8 + [True, True]
+    # round trip (bigint normalizes to long)
+    s = schema.to_simple_string(fields)
+    assert schema.parse_struct(s) == fields
+
+
+@pytest.mark.parametrize("bad", [
+    "notastruct", "struct<missingtype>", "struct<x:complex>",
+    "struct<x:array<struct<y:int>>>"])
+def test_parse_struct_rejects(bad):
+    with pytest.raises(ValueError):
+        schema.parse_struct(bad)
+
+
+# --- inference CLI end-to-end (Inference.scala analog) ---
+
+@pytest.fixture(scope="module")
+def tfr_input(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tfr")
+    rng = np.random.RandomState(7)
+    X = rng.rand(25, 2).astype("float32")
+    for shard in range(2):
+        idx = range(shard, 25, 2)
+        tfrecord.write_examples(
+            str(d / f"part-{shard:05d}.tfrecord"),
+            ({"x": X[i].tolist(), "tag": [f"row{i}".encode()]} for i in idx))
+    return d, X
+
+
+@pytest.mark.parametrize("engine", ["auto", "jax"])
+def test_inference_cli(linear_export, tfr_input, tmp_path, engine):
+    d, X = tfr_input
+    out_dir = tmp_path / f"out_{engine}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TFOS_TPU_PJRT_PLUGIN=MOCK_PLUGIN,  # native engine would no-op math
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    # 'auto' with the mock plugin exercises plugin selection; assert math
+    # only for the jax engine (the mock executable is identity, not linear)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tensorflowonspark_tpu.inference",
+         "--export_dir", linear_export, "--input", str(d),
+         "--schema_hint", "struct<x:array<float>,tag:string>",
+         "--output_mapping", '{"y": "pred"}',
+         "--output", str(out_dir), "--batch_size", "4",
+         "--engine", "jax" if engine == "jax" else "auto"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = []
+    for p in sorted(out_dir.glob("part-*.json")):
+        rows += [json.loads(line) for line in p.read_text().splitlines()]
+    assert len(rows) == 25
+    if engine == "jax":
+        got = np.array([r["pred"] for r in rows], "float32").ravel()
+        # shard 0 holds even rows, shard 1 odd rows
+        order = list(range(0, 25, 2)) + list(range(1, 25, 2))
+        expect = (X @ np.array([2.0, -3.0]) + 1.5)[order]
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
