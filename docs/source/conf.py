@@ -1,0 +1,22 @@
+# Sphinx configuration (maps the reference's docs/source/conf.py Sphinx API
+# docs built by the tox docs env, reference: tox.ini:87-101).
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "tensorflowonspark-tpu"
+author = "tensorflowonspark-tpu developers"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+autodoc_member_order = "bysource"
+autodoc_mock_imports = ["jax", "jaxlib", "flax", "optax", "numpy", "pyspark",
+                        "libtpu", "orbax"]
+
+html_theme = "alabaster"
+exclude_patterns = []

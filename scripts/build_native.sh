@@ -1,0 +1,8 @@
+#!/bin/bash
+# Build the C++ layer: TFRecord codec, PJRT batch-inference runner, and the
+# mock PJRT plugin used by tests (maps the reference's Maven build of its
+# Scala/JNI layer, reference: pom.xml).
+set -euo pipefail
+cd "$(dirname "$0")/../native"
+make "$@"
+ls -la ./*.so

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Build the native libs and run the full suite (maps the reference's
+# tests/run_tests.sh, which started a 2-worker Spark standalone cluster
+# first — the LocalBackend inside the suite plays that role here).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+make -C native
+exec python -m pytest tests/ -q "$@"
