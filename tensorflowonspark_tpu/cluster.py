@@ -41,13 +41,19 @@ class _StatusView(dict):
     def __init__(self, backend):
         super().__init__(error=None)
         self._backend = backend
+        self.server = None  # set once the rendezvous server exists
 
     def get(self, key, default=None):
-        if key == "error" and not super().get("error") and \
-                hasattr(self._backend, "check_bootstrap_errors"):
-            err = self._backend.check_bootstrap_errors()
-            if err:
-                self["error"] = err
+        if key == "error" and not super().get("error"):
+            if hasattr(self._backend, "check_bootstrap_errors"):
+                err = self._backend.check_bootstrap_errors()
+                if err:
+                    self["error"] = err
+            if not super().get("error") and self.server is not None:
+                errs = self.server.reservations.get_errors()
+                if errs:
+                    self["error"] = "; ".join(
+                        e.get("error", str(e)) for e in errs)
         return super().get(key, default)
 
 
@@ -200,7 +206,7 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
         tensorboard=False, input_mode=InputMode.NATIVE, log_dir=None,
         master_node="chief", reservation_timeout=600,
         queues=("input", "output", "error", "control"), eval_node=False,
-        num_chips=0, default_fs="file://"):
+        num_chips=0, default_fs="file://", heartbeat_timeout=60):
     """Start a cluster (maps TFCluster.run, TFCluster.py:215-383).
 
     Returns a `TPUCluster` once every node has registered.
@@ -269,6 +275,13 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
         if key in seen:
             raise RuntimeError(f"duplicate node registered for {key}")
         seen.add(key)
+
+    # Failure detection (net-new, SURVEY.md §5): nodes heartbeat to the
+    # rendezvous server; the monitor turns silence into a cluster error the
+    # driver surfaces on its next train/inference/shutdown call.
+    status.server = server
+    if heartbeat_timeout:
+        server.start_monitor(heartbeat_timeout)
 
     cluster = TPUCluster()
     cluster.server = server

@@ -114,16 +114,35 @@ def _wrapper_fn(map_fun, tf_args, ctx):
     return map_fun(tf_args, ctx)
 
 
-def _wrapper_fn_background(map_fun, tf_args, ctx, error_q_addr, authkey):
+def _wrapper_fn_background(map_fun, tf_args, ctx, error_q_addr, authkey,
+                           server_addr=None):
     """Background-process trampoline: exceptions land on the node's error
-    queue instead of vanishing (maps TFSparkNode.py:403-409)."""
+    queue instead of vanishing (maps TFSparkNode.py:403-409). This process
+    is the liveness principal for the node, so it also owns the heartbeat:
+    a silent death here (OOM, SIGKILL) is what the coordinator's monitor
+    exists to catch."""
+    hb_client = None
+    if server_addr is not None:
+        try:
+            hb_client = reservation.Client(tuple(server_addr))
+            hb_client.start_heartbeat(
+                ctx.executor_id,
+                interval=float(os.environ.get("TFOS_TPU_HEARTBEAT_INTERVAL", 5)))
+        except (ConnectionError, OSError) as e:
+            logger.warning("could not start heartbeat: %s", e)
+            hb_client = None
     try:
         mgr = manager.connect(error_q_addr, authkey)
         ctx.mgr = mgr
         _wrapper_fn(map_fun, tf_args, ctx)
+        if hb_client is not None:
+            hb_client.bye(ctx.executor_id)
+            hb_client.close()
     except BaseException:
         tb = traceback.format_exc()
         logger.error("background node fn failed:\n%s", tb)
+        if hb_client is not None:
+            hb_client.close()  # stops beating; ERROR flows via the queue
         try:
             mgr.get_queue("error").put(tb)
         except Exception:
@@ -267,12 +286,18 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
                     working_dir=os.getcwd(), mgr=None)
                 p = mp.Process(
                     target=_wrapper_fn_background,
-                    args=(map_fun, tf_args, ctx_bg, mgr._tfos_addr, authkey),
+                    args=(map_fun, tf_args, ctx_bg, mgr._tfos_addr, authkey,
+                          cluster_meta.get("server_addr")),
                     name=f"node-{job_name}-{task_index}")
                 p.start()
                 logger.info("started background node process pid=%d", p.pid)
             else:
+                # foreground node: this process is the liveness principal
+                client.start_heartbeat(
+                    executor_id,
+                    interval=float(os.environ.get("TFOS_TPU_HEARTBEAT_INTERVAL", 5)))
                 _wrapper_fn(map_fun, tf_args, ctx)
+                client.bye(executor_id)
         except BaseException:
             tb = traceback.format_exc()
             logger.error("node fn failed on executor %d:\n%s", executor_id, tb)

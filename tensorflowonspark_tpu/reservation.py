@@ -119,6 +119,13 @@ class Server(MessageSocket):
         self.reservations = Reservations(count)
         self.done = threading.Event()
         self._sock = None
+        # Heartbeat state (net-new failure detection, SURVEY.md §5: the
+        # reference has none and jax.distributed historically hangs on
+        # silent peer loss; the coordinator must notice instead).
+        self._beats = {}        # executor_id -> last beat monotonic time
+        self._finished = set()  # executor_ids that sent BYE (normal exit)
+        self._flagged = set()   # executor_ids already reported dead
+        self._beat_lock = threading.Lock()
 
     def start(self):
         """Bind per env overrides and start the listener thread; return (host, port)."""
@@ -185,6 +192,15 @@ class Server(MessageSocket):
             })
         elif mtype == "QINFO":
             self.send(sock, {"type": "QINFO", "nodes": self.reservations.get()})
+        elif mtype == "BEAT":
+            with self._beat_lock:
+                self._beats[msg.get("executor_id")] = time.monotonic()
+            self.send(sock, {"type": "OK"})
+        elif mtype == "BYE":
+            with self._beat_lock:
+                self._finished.add(msg.get("executor_id"))
+            logger.info("node %s finished (BYE)", msg.get("executor_id"))
+            self.send(sock, {"type": "OK"})
         elif mtype == "ERROR":
             logger.error("node reported error: %s", msg.get("error"))
             self.reservations.add_error(
@@ -219,6 +235,42 @@ class Server(MessageSocket):
             time.sleep(1)
         logger.info("all %d reservations completed", self.reservations.required)
         return self.reservations.get()
+
+    def dead_nodes(self, timeout):
+        """Executor ids that heartbeated once but have been silent for
+        > `timeout` seconds and did not announce a normal exit (BYE)."""
+        now = time.monotonic()
+        with self._beat_lock:
+            return [eid for eid, t in self._beats.items()
+                    if eid not in self._finished and now - t > timeout]
+
+    def start_monitor(self, heartbeat_timeout, interval=None):
+        """Flag silently-dead nodes as cluster errors (net-new vs the
+        reference, which only noticed errors nodes *reported*; a SIGKILLed
+        or OOMed training process reports nothing). Each dead node is
+        reported once, through the same error channel `ERROR` messages use,
+        so the driver's existing error surfacing aborts the job."""
+
+        def _watch():
+            poll = interval or max(heartbeat_timeout / 4.0, 1.0)
+            while not self.done.is_set():
+                for eid in self.dead_nodes(heartbeat_timeout):
+                    with self._beat_lock:
+                        if eid in self._flagged:
+                            continue
+                        self._flagged.add(eid)
+                    logger.error("node %s heartbeat lost (> %ss silent)",
+                                 eid, heartbeat_timeout)
+                    self.reservations.add_error(
+                        {"node": {"executor_id": eid},
+                         "error": f"heartbeat lost for executor {eid} "
+                                  f"(silent > {heartbeat_timeout}s)"})
+                self.done.wait(poll)
+
+        t = threading.Thread(target=_watch, name="heartbeat-monitor",
+                             daemon=True)
+        t.start()
+        return t
 
     def stop(self):
         self.done.set()
@@ -291,7 +343,56 @@ class Client(MessageSocket):
         except (ConnectionError, OSError):
             return {"type": "OK"}  # server already gone
 
+    def start_heartbeat(self, executor_id, interval=5.0):
+        """Beat on a daemon thread until `stop_heartbeat`/`close`/`bye`.
+
+        Uses a DEDICATED connection: the beat thread must not interleave
+        frames with request/response traffic on the main socket. A gone
+        server (normal at teardown) ends the thread quietly after a few
+        failed attempts.
+        """
+        self._hb_stop = getattr(self, "_hb_stop", None) or threading.Event()
+        self._hb_stop.clear()
+
+        def _beat():
+            hb = None
+            failures = 0
+            while not self._hb_stop.is_set() and failures < 3:
+                try:
+                    if hb is None:
+                        hb = Client(self.server_addr)
+                    hb._request({"type": "BEAT", "executor_id": executor_id})
+                    failures = 0
+                except (ConnectionError, OSError):
+                    failures += 1
+                    if hb is not None:
+                        hb.close()
+                        hb = None
+                self._hb_stop.wait(interval)
+            if hb is not None:
+                hb.close()
+
+        t = threading.Thread(target=_beat, name=f"heartbeat-{executor_id}",
+                             daemon=True)
+        t.start()
+        self._hb_thread = t
+        return t
+
+    def stop_heartbeat(self):
+        ev = getattr(self, "_hb_stop", None)
+        if ev is not None:
+            ev.set()
+
+    def bye(self, executor_id):
+        """Announce a normal exit so the monitor won't flag this node."""
+        self.stop_heartbeat()
+        try:
+            return self._request({"type": "BYE", "executor_id": executor_id})
+        except (ConnectionError, OSError):
+            return {"type": "OK"}  # server already gone
+
     def close(self):
+        self.stop_heartbeat()
         try:
             self._sock.close()
         except OSError:

@@ -1,0 +1,105 @@
+"""Heartbeat failure-detection tests (net-new vs the reference, SURVEY.md §5:
+the reference only notices errors nodes REPORT; a SIGKILLed process reports
+nothing, and jax.distributed historically hangs on silent peer loss)."""
+import os
+import signal
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import backend, cluster, reservation
+
+pytestmark = pytest.mark.usefixtures()
+
+
+def _wait_until(pred, timeout, step=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+# --- protocol-level (no cluster) ---
+
+def test_heartbeat_and_monitor_flow():
+    server = reservation.Server(1)
+    addr = server.start()
+    try:
+        client = reservation.Client(addr)
+        client.register({"executor_id": 0})
+        client.start_heartbeat(0, interval=0.1)
+        server.start_monitor(heartbeat_timeout=0.8, interval=0.1)
+
+        assert _wait_until(lambda: 0 in server._beats, 5)
+        time.sleep(1.2)  # beating: monitor must stay quiet
+        assert server.reservations.get_errors() == []
+        assert server.dead_nodes(0.8) == []
+
+        client.stop_heartbeat()  # silent death
+        assert _wait_until(lambda: server.reservations.get_errors(), 10)
+        errs = server.reservations.get_errors()
+        assert "heartbeat lost" in errs[0]["error"]
+        # flagged once, not repeatedly
+        time.sleep(0.5)
+        assert len(server.reservations.get_errors()) == 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_bye_prevents_false_positive():
+    server = reservation.Server(1)
+    addr = server.start()
+    try:
+        client = reservation.Client(addr)
+        client.register({"executor_id": 3})
+        client.start_heartbeat(3, interval=0.1)
+        server.start_monitor(heartbeat_timeout=0.5, interval=0.1)
+        assert _wait_until(lambda: 3 in server._beats, 5)
+        client.bye(3)  # normal exit: stops beating AND deregisters
+        time.sleep(1.2)
+        assert server.reservations.get_errors() == []
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_heartbeat_survives_server_restart_quietly():
+    """A gone server must end the beat thread, not crash the node."""
+    server = reservation.Server(1)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 5})
+    t = client.start_heartbeat(5, interval=0.1)
+    assert _wait_until(lambda: 5 in server._beats, 5)
+    server.stop()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    client.close()
+
+
+# --- cluster-level: silent node death surfaces on the driver ---
+
+def fn_suicide_worker(args, ctx):
+    df = ctx.get_data_feed()
+    df.next_batch(1)
+    if ctx.job_name == "worker":
+        os.kill(os.getpid(), signal.SIGKILL)  # silent: no ERROR, no queue
+    while not df.should_stop():
+        df.next_batch(10)
+
+
+def test_silent_node_death_surfaces(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFOS_TPU_HEARTBEAT_INTERVAL", "0.2")
+    c = cluster.run(backend.LocalBackend(2, workdir=str(tmp_path)),
+                    fn_suicide_worker, tf_args={}, num_executors=2,
+                    input_mode=cluster.InputMode.SPARK,
+                    heartbeat_timeout=2)
+    parts = [list(range(20)), list(range(20, 40))]
+    c.train(parts, feed_timeout=30)
+    assert _wait_until(lambda: c._status.get("error"), 30), \
+        "monitor never flagged the SIGKILLed node"
+    with pytest.raises(RuntimeError, match="heartbeat lost"):
+        c.shutdown(grace_secs=0, timeout=60)
