@@ -41,20 +41,43 @@ class _StatusView(dict):
     def __init__(self, backend):
         super().__init__(error=None)
         self._backend = backend
+        self._parts = []  # every error message seen, in arrival order
+        self._lock = threading.Lock()  # launch thread + driver thread write
         self.server = None  # set once the rendezvous server exists
 
+    def _add_part(self, value):
+        with self._lock:
+            if value and value not in self._parts:
+                self._parts.append(value)
+            super().__setitem__("error", "; ".join(self._parts) or None)
+
+    def __setitem__(self, key, value):
+        if key == "error":
+            self._add_part(value)
+        else:
+            super().__setitem__(key, value)
+
+    def _refresh(self):
+        # Accumulate, don't cache-first-wins: a node that is SIGKILLed
+        # produces BOTH a backend exit-code error and (later) a heartbeat-
+        # lost error from the monitor; the driver should see both.  The
+        # backend status queue is consumed on read, so messages are folded
+        # into _parts rather than re-polled.
+        if hasattr(self._backend, "check_bootstrap_errors"):
+            self._add_part(self._backend.check_bootstrap_errors())
+        if self.server is not None:
+            for e in self.server.reservations.get_errors():
+                self._add_part(e.get("error", str(e)))
+
     def get(self, key, default=None):
-        if key == "error" and not super().get("error"):
-            if hasattr(self._backend, "check_bootstrap_errors"):
-                err = self._backend.check_bootstrap_errors()
-                if err:
-                    self["error"] = err
-            if not super().get("error") and self.server is not None:
-                errs = self.server.reservations.get_errors()
-                if errs:
-                    self["error"] = "; ".join(
-                        e.get("error", str(e)) for e in errs)
+        if key == "error":
+            self._refresh()
         return super().get(key, default)
+
+    def __getitem__(self, key):
+        if key == "error":
+            self._refresh()
+        return super().__getitem__(key)
 
 
 class TPUCluster:
@@ -246,6 +269,9 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
         "default_fs": default_fs,
         "num_chips": num_chips,
         "reservation_timeout": reservation_timeout,
+        # Beat 4x per monitor window so one dropped beat can't trip the
+        # monitor; 0 disables beating entirely when the monitor is off.
+        "heartbeat_interval": heartbeat_timeout / 4.0 if heartbeat_timeout else 0,
     }
 
     status = _StatusView(backend)
@@ -281,7 +307,9 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
     # driver surfaces on its next train/inference/shutdown call.
     status.server = server
     if heartbeat_timeout:
-        server.start_monitor(heartbeat_timeout)
+        server.start_monitor(
+            heartbeat_timeout,
+            expected=[n["executor_id"] for n in cluster_info])
 
     cluster = TPUCluster()
     cluster.server = server

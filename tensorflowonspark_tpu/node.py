@@ -114,23 +114,38 @@ def _wrapper_fn(map_fun, tf_args, ctx):
     return map_fun(tf_args, ctx)
 
 
+def _heartbeat_interval(cluster_meta):
+    """Beat-interval resolution.  The DRIVER decides whether heartbeats
+    exist (heartbeat_timeout -> cluster_meta['heartbeat_interval'], 0 when
+    the monitor is off): the monitor seeds every registered node into its
+    beat table, so a node-side switch that disarmed beating while the
+    monitor is armed would get every healthy node flagged dead.  The env
+    var can therefore only retune the cadence, never disable it."""
+    base = float(cluster_meta.get("heartbeat_interval", 5.0))
+    if base <= 0:
+        return 0.0
+    env = os.environ.get("TFOS_TPU_HEARTBEAT_INTERVAL")
+    if env is not None and float(env) > 0:
+        return float(env)
+    return base
+
+
 def _wrapper_fn_background(map_fun, tf_args, ctx, error_q_addr, authkey,
-                           server_addr=None):
+                           server_addr=None, hb_interval=5.0):
     """Background-process trampoline: exceptions land on the node's error
     queue instead of vanishing (maps TFSparkNode.py:403-409). This process
     is the liveness principal for the node, so it also owns the heartbeat:
     a silent death here (OOM, SIGKILL) is what the coordinator's monitor
     exists to catch."""
     hb_client = None
-    if server_addr is not None:
-        try:
-            hb_client = reservation.Client(tuple(server_addr))
-            hb_client.start_heartbeat(
-                ctx.executor_id,
-                interval=float(os.environ.get("TFOS_TPU_HEARTBEAT_INTERVAL", 5)))
-        except (ConnectionError, OSError) as e:
-            logger.warning("could not start heartbeat: %s", e)
-            hb_client = None
+    if server_addr is not None and hb_interval > 0:
+        # connect=False: the beat thread makes its own connections and
+        # retries forever, so a briefly-unreachable server at node start
+        # must not leave the node permanently unmonitored (the seeded
+        # monitor would flag it dead).
+        hb_client = reservation.Client(tuple(server_addr), connect=False)
+        hb_client.start_heartbeat(ctx.executor_id, interval=hb_interval)
+    mgr = None
     try:
         mgr = manager.connect(error_q_addr, authkey)
         ctx.mgr = mgr
@@ -141,12 +156,26 @@ def _wrapper_fn_background(map_fun, tf_args, ctx, error_q_addr, authkey,
     except BaseException:
         tb = traceback.format_exc()
         logger.error("background node fn failed:\n%s", tb)
+        reported = False
+        if mgr is not None:
+            try:
+                mgr.get_queue("error").put(tb)
+                reported = True
+            except Exception:
+                pass
         if hb_client is not None:
-            hb_client.close()  # stops beating; ERROR flows via the queue
-        try:
-            mgr.get_queue("error").put(tb)
-        except Exception:
-            pass
+            if reported:
+                # BYE only once the death is durably REPORTED: the monitor
+                # must not pile a spurious "heartbeat lost" on a reported
+                # traceback — but if reporting failed, heartbeat loss is
+                # the ONLY signal the driver will ever get; keep it.
+                hb_client.bye(ctx.executor_id)
+            else:
+                resp = hb_client.report_error(
+                    {"executor_id": ctx.executor_id}, tb)
+                if resp is not None:  # None = report lost too; let the
+                    hb_client.bye(ctx.executor_id)  # monitor flag the death
+            hb_client.close()
         raise SystemExit(1)
 
 
@@ -185,8 +214,14 @@ def run(map_fun, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             _bootstrap(executor_id, job_name, task_index, client, map_fun,
                        tf_args, cluster_meta, tensorboard, queues, background)
         except BaseException as e:
-            client.report_error(
+            resp = client.report_error(
                 {"executor_id": executor_id, "job_name": job_name}, repr(e))
+            if resp is not None:
+                # Death is durably reported — suppress the monitor's
+                # redundant "heartbeat lost" for this node.  If the report
+                # was lost (resp None), heartbeat loss stays the only
+                # signal the driver gets; keep it.
+                client.bye(executor_id)
             raise
         finally:
             client.close()
@@ -287,15 +322,16 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
                 p = mp.Process(
                     target=_wrapper_fn_background,
                     args=(map_fun, tf_args, ctx_bg, mgr._tfos_addr, authkey,
-                          cluster_meta.get("server_addr")),
+                          cluster_meta.get("server_addr"),
+                          _heartbeat_interval(cluster_meta)),
                     name=f"node-{job_name}-{task_index}")
                 p.start()
                 logger.info("started background node process pid=%d", p.pid)
             else:
                 # foreground node: this process is the liveness principal
-                client.start_heartbeat(
-                    executor_id,
-                    interval=float(os.environ.get("TFOS_TPU_HEARTBEAT_INTERVAL", 5)))
+                hb_interval = _heartbeat_interval(cluster_meta)
+                if hb_interval > 0:
+                    client.start_heartbeat(executor_id, interval=hb_interval)
                 _wrapper_fn(map_fun, tf_args, ctx)
                 client.bye(executor_id)
         except BaseException:
@@ -305,7 +341,7 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
                 mgr.get_queue("error").put(tb)
             except Exception:
                 pass
-            raise  # _mapfn's outer handler reports to the rendezvous server
+            raise  # _mapfn's outer handler reports to the server, then BYEs
 
 
 def _push_chunks(q, iterator):

@@ -21,6 +21,9 @@ Message types (reference: reservation.py:130-146 had REG/QUERY/QINFO/STOP):
 - ``QUERY`` {}                         -> ``QUERY`` {done: bool, count: int}
 - ``QINFO`` {}                         -> ``QINFO`` {nodes: [...]}
 - ``ERROR`` {node, error: str}         -> ``OK``       (net-new: failure detection)
+- ``BEAT``  {executor_id}              -> ``OK``       (net-new: liveness heartbeat)
+- ``BYE``   {executor_id}              -> ``OK``       (net-new: announced exit, so
+                                          the monitor won't flag this node)
 - ``STOP``  {}                         -> ``OK``, server shuts down
 """
 import logging
@@ -244,12 +247,24 @@ class Server(MessageSocket):
             return [eid for eid, t in self._beats.items()
                     if eid not in self._finished and now - t > timeout]
 
-    def start_monitor(self, heartbeat_timeout, interval=None):
+    def start_monitor(self, heartbeat_timeout, interval=None, expected=None):
         """Flag silently-dead nodes as cluster errors (net-new vs the
         reference, which only noticed errors nodes *reported*; a SIGKILLed
         or OOMed training process reports nothing). Each dead node is
         reported once, through the same error channel `ERROR` messages use,
-        so the driver's existing error surfacing aborts the job."""
+        so the driver's existing error surfacing aborts the job.
+
+        `expected` seeds the beat table with every registered executor id
+        (as if each had just beaten): a node whose heartbeat client never
+        managed to connect is otherwise invisible to `dead_nodes` — exactly
+        the unmonitored-node hole this monitor exists to close.  Seeding
+        grants each node one full timeout window to start beating.
+        """
+        if expected:
+            now = time.monotonic()
+            with self._beat_lock:
+                for eid in expected:
+                    self._beats.setdefault(eid, now)
 
         def _watch():
             poll = interval or max(heartbeat_timeout / 4.0, 1.0)
@@ -283,9 +298,13 @@ class Server(MessageSocket):
 class Client(MessageSocket):
     """Executor-side rendezvous client (reference: reservation.py:234-301)."""
 
-    def __init__(self, server_addr):
+    def __init__(self, server_addr, connect=True):
+        """`connect=False` defers the main-socket connect to the first
+        RPC — used by heartbeat-only clients, whose beat thread makes its
+        own connections and must start (and keep retrying) even while the
+        server is briefly unreachable."""
         self.server_addr = (server_addr[0], int(server_addr[1]))
-        self._sock = self._connect()
+        self._sock = self._connect() if connect else None
         self._lock = threading.Lock()
 
     def _connect(self):
@@ -308,6 +327,8 @@ class Client(MessageSocket):
 
     def _request(self, msg):
         with self._lock:
+            if self._sock is None:
+                self._sock = self._connect()
             self.send(self._sock, msg)
             return self.receive(self._sock)
 
@@ -347,30 +368,47 @@ class Client(MessageSocket):
         """Beat on a daemon thread until `stop_heartbeat`/`close`/`bye`.
 
         Uses a DEDICATED connection: the beat thread must not interleave
-        frames with request/response traffic on the main socket. A gone
-        server (normal at teardown) ends the thread quietly after a few
-        failed attempts.
+        frames with request/response traffic on the main socket.  An
+        unreachable server never ends the thread — it retries with capped
+        backoff until explicitly stopped.  Giving up would be worse than
+        useless: the node may be training fine through a transient blip,
+        and a (possibly restarted) monitor would then flag a healthy node
+        as dead and abort the whole job.
         """
         self._hb_stop = getattr(self, "_hb_stop", None) or threading.Event()
         self._hb_stop.clear()
 
         def _beat():
+            # Single-attempt reconnects (NOT the Client() constructor, whose
+            # retry/backoff sleeps ignore the stop event): stop_heartbeat
+            # must end this thread within ~one beat interval.
             hb = None
-            failures = 0
-            while not self._hb_stop.is_set() and failures < 3:
+            while not self._hb_stop.is_set():
                 try:
                     if hb is None:
-                        hb = Client(self.server_addr)
-                    hb._request({"type": "BEAT", "executor_id": executor_id})
-                    failures = 0
+                        hb = socket.create_connection(self.server_addr,
+                                                      timeout=5)
+                        hb.settimeout(10.0)
+                    self.send(hb, {"type": "BEAT",
+                                   "executor_id": executor_id})
+                    self.receive(hb)
                 except (ConnectionError, OSError):
-                    failures += 1
                     if hb is not None:
-                        hb.close()
+                        try:
+                            hb.close()
+                        except OSError:
+                            pass
                         hb = None
+                # Constant cadence, no backoff: a BEAT is one tiny frame,
+                # and widening the gap during an outage is exactly when
+                # liveness proof is most urgent — backoff would let a
+                # ~heartbeat_timeout/2 blip trip the monitor.
                 self._hb_stop.wait(interval)
             if hb is not None:
-                hb.close()
+                try:
+                    hb.close()
+                except OSError:
+                    pass
 
         t = threading.Thread(target=_beat, name=f"heartbeat-{executor_id}",
                              daemon=True)
@@ -384,12 +422,40 @@ class Client(MessageSocket):
             ev.set()
 
     def bye(self, executor_id):
-        """Announce a normal exit so the monitor won't flag this node."""
+        """Announce a normal exit so the monitor won't flag this node.
+
+        A lost BYE would convert a successful node into a false
+        "heartbeat lost" job failure (beats stop regardless), so it must
+        not depend on the main socket — which sat idle for the whole
+        training run and may have been dropped by NAT/conntrack.  Try the
+        main socket once, then fresh connections.
+        """
         self.stop_heartbeat()
+        msg = {"type": "BYE", "executor_id": executor_id}
         try:
-            return self._request({"type": "BYE", "executor_id": executor_id})
+            return self._request(msg)
         except (ConnectionError, OSError):
-            return {"type": "OK"}  # server already gone
+            pass
+        for attempt in range(CONNECT_RETRIES):
+            try:
+                s = socket.create_connection(self.server_addr, timeout=5)
+                s.settimeout(10.0)
+                try:
+                    self.send(s, msg)
+                    return self.receive(s)
+                finally:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            except ConnectionRefusedError:
+                # Fast refusal = the server was stopped on purpose (normal
+                # at teardown) — its monitor died with it, so BYE is moot.
+                break
+            except (ConnectionError, OSError):
+                if attempt < CONNECT_RETRIES - 1:
+                    time.sleep(0.5)
+        return {"type": "OK"}  # server really gone (normal at teardown)
 
     def close(self):
         self.stop_heartbeat()
