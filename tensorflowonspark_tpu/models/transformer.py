@@ -36,6 +36,7 @@ class TransformerConfig:
     ring_attention_axis: Optional[str] = None  # e.g. "tp" to enable CP
     sp_axis: Optional[str] = None  # Megatron-SP: shard residual stream's
     # sequence dim over this axis between blocks (usually "tp")
+    attention_impl: str = "auto"  # auto | flash (pallas) | dense
 
 
 class Attention(nn.Module):
@@ -54,15 +55,57 @@ class Attention(nn.Module):
         k = k.reshape(B, S, cfg.n_heads, head_dim)
         v = v.reshape(B, S, cfg.n_heads, head_dim)
 
+        if cfg.attention_impl not in ("auto", "flash", "dense"):
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} not in "
+                "('auto', 'flash', 'dense')")
         if cfg.ring_attention_axis:
             from tensorflowonspark_tpu.parallel.ring_attention import (
                 ring_attention)
             out = ring_attention(q, k, v, axis_name=cfg.ring_attention_axis,
                                  causal=cfg.causal)
+        elif cfg.attention_impl == "flash" or (
+                cfg.attention_impl == "auto"
+                and jax.default_backend() == "tpu"):
+            out = _flash_dispatch(q, k, v, cfg)
         else:
             out = dot_product_attention(q, k, v, causal=cfg.causal)
         out = out.reshape(B, S, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, name="out", dtype=dtype)(out)
+
+
+def _flash_dispatch(q, k, v, cfg):
+    """Route to the pallas flash kernel.
+
+    `pallas_call` is a custom call GSPMD cannot partition, so under an
+    active mesh the kernel must be wrapped in shard_map — batch over dp,
+    heads over tp (the same layout the column-parallel qkv sharding rules
+    produce).  Falls back to dense attention when the shard axes don't
+    divide the batch/head dims.
+    """
+    from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return flash_attention(q, k, v, causal=cfg.causal)
+    axes = mesh.axis_names
+
+    def _divides(axis, dim):
+        return axis in axes and dim % mesh.shape[axis] == 0
+
+    dp = "dp" if _divides("dp", q.shape[0]) else None
+    tp = "tp" if _divides("tp", q.shape[2]) else None
+    # dense fallback when a >1-sized mesh axis can't shard its dim: a
+    # replicated in_spec there would all-gather the sharded activations and
+    # recompute attention redundantly on every member of that axis
+    for name, got in (("dp", dp), ("tp", tp)):
+        if got is None and name in axes and mesh.shape[name] > 1:
+            return dot_product_attention(q, k, v, causal=cfg.causal)
+    import functools
+    from jax.sharding import PartitionSpec as P
+    spec = P(dp, None, tp, None)
+    local = functools.partial(flash_attention, causal=cfg.causal)
+    return jax.shard_map(local, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
 
 
 def dot_product_attention(q, k, v, causal=True):

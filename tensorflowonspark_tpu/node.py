@@ -31,6 +31,13 @@ logger = logging.getLogger(__name__)
 CHUNK_SIZE = 512  # records per queue item when feeding
 
 
+class DuplicateBootstrapError(RuntimeError):
+    """A task retry tried to bootstrap an executor that already hosts a live
+    node for this cluster_id (maps TFSparkNode.py:249-255).  Distinguished
+    from other bootstrap failures because the ORIGINAL node is still alive:
+    its heartbeat monitoring must not be cancelled on its behalf."""
+
+
 class NodeContext:
     """Runtime context handed to the user's map_fun (maps TFSparkNode.py:59-99)."""
 
@@ -120,13 +127,26 @@ def _heartbeat_interval(cluster_meta):
     the monitor is off): the monitor seeds every registered node into its
     beat table, so a node-side switch that disarmed beating while the
     monitor is armed would get every healthy node flagged dead.  The env
-    var can therefore only retune the cadence, never disable it."""
+    var can therefore only retune the cadence, never disable it — and only
+    downward: an override above the driver-computed base would beat wider
+    than the monitor's window, which is functionally disabling."""
     base = float(cluster_meta.get("heartbeat_interval", 5.0))
     if base <= 0:
         return 0.0
     env = os.environ.get("TFOS_TPU_HEARTBEAT_INTERVAL")
-    if env is not None and float(env) > 0:
-        return float(env)
+    if env is not None:
+        try:
+            override = float(env)
+        except ValueError:
+            logger.warning("ignoring malformed TFOS_TPU_HEARTBEAT_INTERVAL=%r",
+                           env)
+            return base
+        if override > 0:
+            if override > base:
+                logger.warning(
+                    "TFOS_TPU_HEARTBEAT_INTERVAL=%s exceeds the monitor "
+                    "window's cadence %.1fs; clamping", env, base)
+            return min(override, base)
     return base
 
 
@@ -216,11 +236,13 @@ def run(map_fun, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         except BaseException as e:
             resp = client.report_error(
                 {"executor_id": executor_id, "job_name": job_name}, repr(e))
-            if resp is not None:
+            if resp is not None and not isinstance(e, DuplicateBootstrapError):
                 # Death is durably reported — suppress the monitor's
                 # redundant "heartbeat lost" for this node.  If the report
                 # was lost (resp None), heartbeat loss stays the only
-                # signal the driver gets; keep it.
+                # signal the driver gets; keep it.  A duplicate-bootstrap
+                # rejection must NOT send BYE: the ORIGINAL node on this
+                # executor_id is alive and its heartbeats still matter.
                 client.bye(executor_id)
             raise
         finally:
@@ -238,7 +260,7 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
             with open(state_file) as f:
                 prior = f.read().strip()
             if prior == str(cluster_meta["cluster_id"]):
-                raise RuntimeError(
+                raise DuplicateBootstrapError(
                     f"executor {executor_id} already hosts a node for cluster "
                     f"{prior}; refusing duplicate bootstrap (task retry?)")
         with open(state_file, "w") as f:

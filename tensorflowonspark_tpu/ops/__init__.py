@@ -1,0 +1,22 @@
+"""TPU kernel ops (Pallas).
+
+The reference delegates all tensor math to TensorFlow and ships no kernels
+of its own (SURVEY.md §1 "delegates all actual tensor math ... to TensorFlow
+itself"); in a TPU-native framework the hot ops are first-class: hand-tiled
+Pallas kernels that stream blocks HBM→VMEM and keep the MXU busy, with an
+interpret-mode path so the same kernels are testable on the CPU mesh.
+
+- flash_attention : blocked online-softmax attention, O(S) memory per core
+- fused_layernorm : single-pass layernorm, f32 accumulation in VMEM
+"""
+from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+from tensorflowonspark_tpu.ops.layernorm import fused_layernorm
+
+__all__ = ["flash_attention", "fused_layernorm"]
+
+
+def default_interpret():
+    """Pallas kernels run natively on TPU, in interpret mode elsewhere
+    (the CPU test mesh), so one code path covers both."""
+    import jax
+    return jax.default_backend() != "tpu"

@@ -1,0 +1,199 @@
+"""Pallas TPU flash attention.
+
+Blocked attention with a numerically-stable online softmax: the [S, S]
+score matrix never materializes in HBM.  The grid streams K/V blocks
+through VMEM (innermost grid dim) while per-q-block running max /
+denominator / accumulator live in VMEM scratch that persists across the
+sequential k-steps of the TPU grid; both matmuls run on the MXU in f32
+accumulation.  Causal q/k block pairs with no overlap are skipped entirely
+(`pl.when`), halving the work for causal LMs.
+
+Composes with ring attention (parallel/ring_attention.py): ring handles the
+cross-device sequence axis, this kernel the on-device blocks.
+
+Backward is a custom VJP that recomputes attention from the saved q/k/v
+(flash-style recompute: residuals are O(B·S·H·D), not O(S²)) through the
+JAX reference implementation, letting XLA fuse the backward matmuls.
+
+The reference framework has no kernels at all — math is delegated to TF
+(SURVEY.md §1); this file is net-new TPU machinery.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled jaxlibs; interpret mode needs it not
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = -1e30  # large-finite: exp(NEG_INF - m) == 0 without inf-inf NaNs
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                sm_scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, m_scr.dtype)
+        l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        # [bq, bk] scores on the MXU, f32 accumulation
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_len                        # padded keys
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                         # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip blocks strictly above the diagonal
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _pad_seq(x, block):
+    s = x.shape[2]
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    # [B, S, H, D] (framework layout) -> [B, H, S, D]
+    B, S, H, D = q.shape
+    qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)
+    kt = _pad_seq(k.transpose(0, 2, 1, 3), block_k)
+    vt = _pad_seq(v.transpose(0, 2, 1, 3), block_k)
+    Sq, Sk = qt.shape[2], kt.shape[2]
+    nq, nk = Sq // block_q, Sk // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=S)
+    kw = {}
+    if _VMEM is not None:
+        kw["scratch_shapes"] = [
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ]
+    else:  # pragma: no cover - CPU-only jaxlib
+        kw["scratch_shapes"] = [
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, D), jnp.float32),
+        ]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+        **kw,
+    )(qt, kt, vt)
+    return out[:, :, :S].transpose(0, 2, 1, 3)
+
+
+def attention_reference(q, k, v, causal=True, sm_scale=None):
+    """Dense reference with semantics identical to the kernel (f32 softmax,
+    large-finite mask).  Used for tests and as the recompute path in the
+    custom VJP."""
+    D = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                           interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(q, k, v, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None,
+                    block_q=512, block_k=512, interpret=None):
+    """Flash attention over [B, S, H, D] q/k/v.
+
+    Sequence lengths need not be multiples of the block sizes (padded keys
+    are masked out).  `interpret=None` auto-selects: native Mosaic on TPU,
+    interpreter elsewhere (the CPU test mesh).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        from tensorflowonspark_tpu.ops import default_interpret
+        interpret = default_interpret()
+    S = q.shape[1]
+    block_q = min(block_q, max(S, 16))
+    block_k = min(block_k, max(k.shape[1], 16))
+    return _flash(q, k, v, causal, float(sm_scale), int(block_q),
+                  int(block_k), bool(interpret))

@@ -307,17 +307,22 @@ class Client(MessageSocket):
         self._sock = self._connect() if connect else None
         self._lock = threading.Lock()
 
+    def _dial(self, connect_timeout, rpc_timeout):
+        """One fresh connection to the server.  The per-RPC timeout bounds
+        receive(): if the server host dies without RST, a blocked read must
+        not hang the executor forever."""
+        s = socket.create_connection(self.server_addr,
+                                     timeout=connect_timeout)
+        s.settimeout(rpc_timeout)
+        return s
+
     def _connect(self):
         last = None
         for attempt in range(CONNECT_RETRIES):
             try:
-                s = socket.create_connection(self.server_addr, timeout=30)
-                # Keep a bounded per-RPC timeout: if the server host dies
-                # without RST, a blocked receive() must not hang the executor
-                # forever (await_reservations' deadline only runs between
-                # RPCs).  Rendezvous RPCs complete in milliseconds.
-                s.settimeout(60.0)
-                return s
+                # Rendezvous RPCs complete in milliseconds; 60s covers a
+                # driver briefly stalled by GC/oversubscription.
+                return self._dial(connect_timeout=30.0, rpc_timeout=60.0)
             except OSError as e:
                 last = e
                 logger.warning("connect to %s failed (%s); retry %d/%d",
@@ -386,9 +391,8 @@ class Client(MessageSocket):
             while not self._hb_stop.is_set():
                 try:
                     if hb is None:
-                        hb = socket.create_connection(self.server_addr,
-                                                      timeout=5)
-                        hb.settimeout(10.0)
+                        hb = self._dial(connect_timeout=5.0,
+                                        rpc_timeout=10.0)
                     self.send(hb, {"type": "BEAT",
                                    "executor_id": executor_id})
                     self.receive(hb)
@@ -432,14 +436,14 @@ class Client(MessageSocket):
         """
         self.stop_heartbeat()
         msg = {"type": "BYE", "executor_id": executor_id}
-        try:
-            return self._request(msg)
-        except (ConnectionError, OSError):
-            pass
+        # Never use the main socket: it sat idle for the whole run and a
+        # NAT/conntrack-dropped connection swallows the send and stalls
+        # receive() for the full 60s RPC timeout — longer than typical
+        # monitor windows, so the "lost heartbeat" this method exists to
+        # prevent would fire while BYE is stuck.  Fresh 5s dials only.
         for attempt in range(CONNECT_RETRIES):
             try:
-                s = socket.create_connection(self.server_addr, timeout=5)
-                s.settimeout(10.0)
+                s = self._dial(connect_timeout=5.0, rpc_timeout=10.0)
                 try:
                     self.send(s, msg)
                     return self.receive(s)
@@ -459,7 +463,8 @@ class Client(MessageSocket):
 
     def close(self):
         self.stop_heartbeat()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass

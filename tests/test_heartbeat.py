@@ -109,3 +109,39 @@ def test_silent_node_death_surfaces(tmp_path, monkeypatch):
         "monitor never flagged the SIGKILLed node"
     with pytest.raises(RuntimeError, match="heartbeat lost"):
         c.shutdown(grace_secs=0, timeout=60)
+
+
+def test_close_and_bye_on_never_connected_client():
+    # deferred-connect client whose server is gone: close() must not raise,
+    # bye() must return fast (no constructor retry ladder)
+    client = reservation.Client(("127.0.0.1", 1), connect=False)
+    t0 = time.time()
+    resp = client.bye(7)
+    assert resp == {"type": "OK"}
+    assert time.time() - t0 < 5
+    client.close()  # _sock is None: must be a no-op, not AttributeError
+
+
+def test_duplicate_bootstrap_does_not_send_bye(tmp_path, monkeypatch):
+    # a task retry rejected as duplicate must leave the ORIGINAL node's
+    # heartbeat monitoring intact (no BYE on its executor_id)
+    from tensorflowonspark_tpu import node as node_mod
+
+    monkeypatch.chdir(tmp_path)
+    server = reservation.Server(1)
+    addr = server.start()
+    try:
+        meta = {"cluster_id": "c1", "server_addr": addr,
+                "cluster_template": {"chief": [0]}, "default_fs": "file://",
+                "num_workers": 1, "queues": ["input", "output", "error"]}
+        (tmp_path / ".tfos_cluster_id").write_text("c1")  # live original
+        mapfn = node_mod.run(lambda args, ctx: None, (), meta,
+                             background=False)
+        with pytest.raises(node_mod.DuplicateBootstrapError):
+            mapfn(iter([0]))
+        # error reported to the driver...
+        assert server.reservations.get_errors()
+        # ...but executor 0 NOT marked finished: its heartbeats still count
+        assert 0 not in server._finished
+    finally:
+        server.stop()
