@@ -10,6 +10,7 @@ _REGISTRY = {
     "resnet": ("tensorflowonspark_tpu.models.resnet", "ResNet"),
     "unet": ("tensorflowonspark_tpu.models.unet", "UNet"),
     "transformer": ("tensorflowonspark_tpu.models.transformer", "Transformer"),
+    "bert": ("tensorflowonspark_tpu.models.bert", "BertForPreTraining"),
 }
 
 

@@ -60,16 +60,24 @@ class Attention(nn.Module):
                 f"attention_impl={cfg.attention_impl!r} not in "
                 "('auto', 'flash', 'dense')")
         if cfg.ring_attention_axis:
+            if mask is not None:
+                raise NotImplementedError(
+                    "key-padding masks are not supported with ring "
+                    "attention; pad/pack sequences to full length or use "
+                    "attention_impl='dense'")
             from tensorflowonspark_tpu.parallel.ring_attention import (
                 ring_attention)
             out = ring_attention(q, k, v, axis_name=cfg.ring_attention_axis,
                                  causal=cfg.causal)
-        elif cfg.attention_impl == "flash" or (
+        elif mask is None and (cfg.attention_impl == "flash" or (
                 cfg.attention_impl == "auto"
-                and jax.default_backend() == "tpu"):
+                and jax.default_backend() == "tpu")):
+            # arbitrary key-padding masks aren't implemented in the pallas
+            # kernel; masked (BERT-style) batches take the dense path
             out = _flash_dispatch(q, k, v, cfg)
         else:
-            out = dot_product_attention(q, k, v, causal=cfg.causal)
+            out = dot_product_attention(q, k, v, causal=cfg.causal,
+                                        mask=mask)
         out = out.reshape(B, S, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, name="out", dtype=dtype)(out)
 
@@ -108,19 +116,23 @@ def _flash_dispatch(q, k, v, cfg):
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
-def dot_product_attention(q, k, v, causal=True):
+def dot_product_attention(q, k, v, causal=True, mask=None):
     """Standard attention with f32 softmax accumulation.
 
     [B, S, H, D] inputs; einsum layouts chosen so the two matmuls land on
     the MXU as [S, D] x [D, S] and [S, S] x [S, D] per (batch, head).
+    `mask` is an optional [B, S_k] key-validity mask (True = attend),
+    BERT-style padding.
     """
     head_dim = q.shape[-1]
     scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         S_q, S_k = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((S_q, S_k), dtype=bool))
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        cmask = jnp.tril(jnp.ones((S_q, S_k), dtype=bool))
+        logits = jnp.where(cmask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -198,10 +210,10 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mask=None):
         x = _sp_constrain(x, self.cfg)
         h = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x)
-        x = x + Attention(self.cfg, name="attn")(h)
+        x = x + Attention(self.cfg, name="attn")(h, mask=mask)
         x = _sp_constrain(x, self.cfg)
         h = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x)
         mlp = (MoEMLP(self.cfg, name="moe") if self.use_moe

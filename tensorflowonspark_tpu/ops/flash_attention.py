@@ -12,8 +12,10 @@ Composes with ring attention (parallel/ring_attention.py): ring handles the
 cross-device sequence axis, this kernel the on-device blocks.
 
 Backward is a custom VJP that recomputes attention from the saved q/k/v
-(flash-style recompute: residuals are O(B·S·H·D), not O(S²)) through the
-JAX reference implementation, letting XLA fuse the backward matmuls.
+(residuals are O(B·S·H·D)) through the JAX reference implementation — note
+the backward pass itself still materializes the [S, S] scores, so the
+O(S)-memory claim holds for forward/serving; a blocked pallas backward is
+the upgrade path for long-context training.
 
 The reference framework has no kernels at all — math is delegated to TF
 (SURVEY.md §1); this file is net-new TPU machinery.

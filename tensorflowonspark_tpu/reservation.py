@@ -429,10 +429,10 @@ class Client(MessageSocket):
         """Announce a normal exit so the monitor won't flag this node.
 
         A lost BYE would convert a successful node into a false
-        "heartbeat lost" job failure (beats stop regardless), so it must
-        not depend on the main socket — which sat idle for the whole
-        training run and may have been dropped by NAT/conntrack.  Try the
-        main socket once, then fresh connections.
+        "heartbeat lost" job failure (beats stop regardless), so it never
+        touches the main socket — which sat idle for the whole training
+        run and may have been dropped by NAT/conntrack — and uses only
+        fresh short-timeout connections.
         """
         self.stop_heartbeat()
         msg = {"type": "BYE", "executor_id": executor_id}

@@ -1,0 +1,101 @@
+"""BERT model family: shapes, masking semantics, and a learnability check
+on the analytic ramp corpus (ground truth by construction, not goldens)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflowonspark_tpu.models import bert as bert_mod
+
+TINY = dict(vocab_size=32, d_model=32, n_heads=2, n_layers=1, d_ff=48,
+            max_seq_len=16, dtype="float32", mask_token_id=0)
+
+
+def _model_and_params(**over):
+    cfg = bert_mod.BertConfig(**{**TINY, **over})
+    model = bert_mod.BertForPreTraining(cfg)
+    tokens = jnp.zeros((2, cfg.max_seq_len), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    return cfg, model, params
+
+
+def test_forward_shapes():
+    cfg, model, params = _model_and_params()
+    tokens = jax.random.randint(jax.random.key(1), (3, 16), 0, 32)
+    mlm, nsp = model.apply({"params": params}, tokens)
+    assert mlm.shape == (3, 16, 32)
+    assert nsp.shape == (3, 2)
+
+
+def test_attention_mask_blocks_padded_keys():
+    # changing a masked-out (padding) token must not change other positions
+    cfg, model, params = _model_and_params()
+    tokens = jax.random.randint(jax.random.key(1), (1, 16), 1, 32)
+    mask = jnp.array([[True] * 12 + [False] * 4])
+    out1, _ = model.apply({"params": params}, tokens, attention_mask=mask)
+    tokens2 = tokens.at[0, 14].set((tokens[0, 14] + 7) % 32)
+    out2, _ = model.apply({"params": params}, tokens2, attention_mask=mask)
+    np.testing.assert_allclose(out1[0, :12], out2[0, :12], atol=1e-5)
+
+
+def test_apply_mlm_masking_contract():
+    tokens = np.arange(4 * 64).reshape(4, 64) % 50 + 1
+    corrupted, targets = bert_mod.apply_mlm_masking(0, tokens, 0, 50,
+                                                    mask_prob=0.3)
+    sel = targets != -1
+    assert 0 < sel.sum() < tokens.size            # some but not all selected
+    assert (targets[sel] == tokens[sel]).all()    # targets = original ids
+    assert (corrupted[~sel] == tokens[~sel]).all()  # unselected untouched
+    frac_masked = (corrupted[sel] == 0).mean()
+    assert 0.6 < frac_masked < 0.95               # ~80% become [MASK]
+
+
+def test_mlm_loss_ignores_unselected():
+    logits = jax.random.normal(jax.random.key(0), (2, 8, 32))
+    all_ignored = jnp.full((2, 8), -1)
+    assert float(bert_mod.mlm_loss(logits, all_ignored)) == 0.0
+    some = all_ignored.at[0, 3].set(5)
+    assert float(bert_mod.mlm_loss(logits, some)) > 0.0
+
+
+def test_bert_learns_ramp_corpus():
+    # MLM on the arithmetic ramp: loss must fall far below chance ln(V)
+    import optax
+
+    cfg, model, params = _model_and_params()
+    V, S = cfg.vocab_size, cfg.max_seq_len
+    rng = np.random.default_rng(0)
+
+    def batch(step):
+        starts = rng.integers(0, V, 16)
+        toks = (starts[:, None] + np.arange(S)[None]) % V
+        corrupted, targets = bert_mod.apply_mlm_masking(
+            step, toks, cfg.mask_token_id, V, mask_prob=0.25)
+        return jnp.asarray(corrupted), jnp.asarray(targets)
+
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step_fn(params, opt_state, tokens, targets):
+        def loss_fn(p):
+            mlm, _ = model.apply({"params": p}, tokens)
+            return bert_mod.mlm_loss(mlm, targets)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    first = None
+    for i in range(250):
+        toks, tgts = batch(i)
+        params, opt_state, loss = step_fn(params, opt_state, toks, tgts)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < 0.5 * first, (first, float(loss))
+    assert float(loss) < 0.6 * np.log(V)  # well below uniform chance
+
+
+def test_nsp_loss_basic():
+    logits = jnp.array([[10.0, -10.0], [-10.0, 10.0]])
+    labels = jnp.array([0, 1])
+    assert float(bert_mod.nsp_loss(logits, labels)) < 1e-3
+    assert float(bert_mod.nsp_loss(logits, 1 - labels)) > 5.0

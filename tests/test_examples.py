@@ -94,3 +94,13 @@ def test_segmentation_single_and_cluster(tmp_path):
                "--steps", "2", "--batch_size", "4", "--image_size", "32",
                "--num_examples", "16", cwd=tmp_path)
     assert "segmentation training complete" in out
+
+
+def test_bert_pretrain_pipeline(tmp_path):
+    out = _run("bert/bert_pretrain.py", "--cluster_size", "1",
+               "--epochs", "1", "--num_records", "64", "--batch_size", "16",
+               "--n_layers", "1", "--d_model", "32", "--d_ff", "64",
+               "--export_dir", "bert_export", cwd=tmp_path)
+    assert "bert pretraining complete" in out
+    assert "transform produced 16 rows" in out
+    assert (tmp_path / "bert_export").exists()
