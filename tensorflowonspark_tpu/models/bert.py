@@ -117,12 +117,10 @@ def mlm_loss(logits, targets):
 
 def nsp_loss(logits, labels):
     """Next-sentence-prediction cross entropy over [B, 2] logits."""
-    import jax
+    import optax
 
-    logits = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-    return jnp.mean(logz - gold)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels).mean()
 
 
 def apply_mlm_masking(rng, tokens, mask_token_id, vocab_size,

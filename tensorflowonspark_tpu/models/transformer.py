@@ -13,6 +13,7 @@ TPU notes: bfloat16 activations, f32 layernorm/softmax accumulators, static
 shapes everywhere, einsum formulations that map onto the MXU.
 """
 import dataclasses
+import logging
 from typing import Optional
 
 import flax.linen as nn
@@ -72,10 +73,15 @@ class Attention(nn.Module):
         elif mask is None and (cfg.attention_impl == "flash" or (
                 cfg.attention_impl == "auto"
                 and jax.default_backend() == "tpu")):
-            # arbitrary key-padding masks aren't implemented in the pallas
-            # kernel; masked (BERT-style) batches take the dense path
             out = _flash_dispatch(q, k, v, cfg)
         else:
+            if mask is not None and cfg.attention_impl == "flash":
+                # arbitrary key-padding masks aren't implemented in the
+                # pallas kernel; an explicit 'flash' request must not
+                # silently lose its O(S) memory promise
+                logging.getLogger(__name__).warning(
+                    "attention_impl='flash' with a key-padding mask falls "
+                    "back to dense O(S^2) attention")
             out = dot_product_attention(q, k, v, causal=cfg.causal,
                                         mask=mask)
         out = out.reshape(B, S, cfg.d_model)

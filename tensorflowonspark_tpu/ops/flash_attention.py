@@ -1,21 +1,19 @@
-"""Pallas TPU flash attention.
+"""Pallas TPU flash attention (forward + blocked backward).
 
 Blocked attention with a numerically-stable online softmax: the [S, S]
-score matrix never materializes in HBM.  The grid streams K/V blocks
-through VMEM (innermost grid dim) while per-q-block running max /
-denominator / accumulator live in VMEM scratch that persists across the
-sequential k-steps of the TPU grid; both matmuls run on the MXU in f32
-accumulation.  Causal q/k block pairs with no overlap are skipped entirely
-(`pl.when`), halving the work for causal LMs.
+score matrix never materializes in HBM — in either direction.  The forward
+grid streams K/V blocks through VMEM (innermost grid dim) while per-q-block
+running max / denominator / accumulator live in VMEM scratch that persists
+across the sequential k-steps of the TPU grid, and emits the per-row
+logsumexp.  The backward recomputes probabilities blockwise from (q, k,
+lse) — flash-style recompute, residuals O(B·S·H·D) — in two kernels: one
+accumulating dq over streamed K/V blocks, one accumulating dk/dv over
+streamed Q/dO blocks.  All matmuls run on the MXU with f32 accumulation.
+Causal q/k block pairs with no overlap are skipped entirely (`pl.when`),
+halving the work for causal LMs.
 
 Composes with ring attention (parallel/ring_attention.py): ring handles the
 cross-device sequence axis, this kernel the on-device blocks.
-
-Backward is a custom VJP that recomputes attention from the saved q/k/v
-(residuals are O(B·S·H·D)) through the JAX reference implementation — note
-the backward pass itself still materializes the [S, S] scores, so the
-O(S)-memory claim holds for forward/serving; a blocked pallas backward is
-the upgrade path for long-context training.
 
 The reference framework has no kernels at all — math is delegated to TF
 (SURVEY.md §1); this file is net-new TPU machinery.
@@ -36,8 +34,31 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30  # large-finite: exp(NEG_INF - m) == 0 without inf-inf NaNs
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                sm_scale, causal, block_q, block_k, seq_len):
+def _scratch(shape, dtype=jnp.float32):
+    if _VMEM is not None:
+        return pltpu.VMEM(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype)  # pragma: no cover
+
+
+def _block_mask(qi, ki, block_q, block_k, seq_len, causal):
+    """[bq, bk] validity mask for one (q-block, k-block) tile: real rows,
+    real keys, and the causal triangle."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    return mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                sm_scale, causal, block_q, block_k, seq_len, need_lse):
+    if need_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -56,14 +77,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = k_pos < seq_len                        # padded keys
-        if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
-        s = jnp.where(mask, s, NEG_INF)
+        s = jnp.where(_block_mask(qi, ki, block_q, block_k, seq_len, causal),
+                      s, NEG_INF)
 
         m_prev = m_scr[:, :1]                         # [bq, 1]
         l_prev = l_scr[:, :1]
@@ -90,6 +105,102 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finish():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if need_lse:
+            # lse rows that saw no valid key (padding) get a finite sentinel
+            # so the backward's exp(NEG_INF - lse) underflows to exactly 0
+            m = m_scr[:, :1]
+            lse = jnp.where(m <= NEG_INF / 2, 0.0, m + jnp.log(l))
+            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
+                   dq_scr, *, sm_scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, dq_scr.dtype)
+
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, D]
+        lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
+        dlt = dlt_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(_block_mask(qi, ki, block_q, block_k, seq_len, causal),
+                      s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt)                           # [bq, bk]
+        dq_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    sm_scale, causal, block_q, block_k, seq_len):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)                             # q innermost here
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
+
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        dlt = dlt_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(_block_mask(qi, ki, block_q, block_k, seq_len, causal),
+                      s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        # dv += p^T @ dO
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt)                           # [bq, bk]
+        # dk += ds^T @ q
+        dk_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _pad_seq(x, block):
@@ -100,8 +211,16 @@ def _pad_seq(x, block):
     return x
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    # [B, S, H, D] (framework layout) -> [B, H, S, D]
+_LANES = 128  # lse/delta carry a lane-replicated trailing dim for layout
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                    need_lse):
+    """Returns (out [B,S,H,D], lse [B,H,Sq_padded,LANES] or None).
+
+    `need_lse=False` (the primal/serving path) omits the lse output
+    entirely — pallas outputs can't be dead-code-eliminated, so an unused
+    lse would cost real HBM writes on every inference forward."""
     B, S, H, D = q.shape
     qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)
     kt = _pad_seq(k.transpose(0, 2, 1, 3), block_k)
@@ -111,22 +230,11 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=S)
-    kw = {}
-    if _VMEM is not None:
-        kw["scratch_shapes"] = [
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, D), jnp.float32),
-        ]
-    else:  # pragma: no cover - CPU-only jaxlib
-        kw["scratch_shapes"] = [
-            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
-            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
-            jax.ShapeDtypeStruct((block_q, D), jnp.float32),
-        ]
-
-    out = pl.pallas_call(
+        block_q=block_q, block_k=block_k, seq_len=S, need_lse=need_lse)
+    o_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    lse_spec = pl.BlockSpec((1, 1, block_q, _LANES),
+                            lambda b, h, i, j: (b, h, i, 0))
+    result = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
         in_specs=[
@@ -134,19 +242,77 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=[o_spec] + ([lse_spec] if need_lse else []),
+        out_shape=[jax.ShapeDtypeStruct(qt.shape, q.dtype)] + (
+            [jax.ShapeDtypeStruct((B, H, Sq, _LANES), jnp.float32)]
+            if need_lse else []),
+        scratch_shapes=[
+            _scratch((block_q, _LANES)),
+            _scratch((block_q, _LANES)),
+            _scratch((block_q, D)),
+        ],
         interpret=interpret,
-        **kw,
     )(qt, kt, vt)
-    return out[:, :, :S].transpose(0, 2, 1, 3)
+    out = result[0][:, :, :S].transpose(0, 2, 1, 3)
+    return out, (result[1] if need_lse else None)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
+                    interpret):
+    B, S, H, D = q.shape
+    qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)
+    kt = _pad_seq(k.transpose(0, 2, 1, 3), block_k)
+    vt = _pad_seq(v.transpose(0, 2, 1, 3), block_k)
+    dot = _pad_seq(g.transpose(0, 2, 1, 3), block_q)
+    Sq, Sk = qt.shape[2], kt.shape[2]
+    nq, nk = Sq // block_q, Sk // block_k
+
+    # delta = rowsum(dO * O): [B, H, Sq] — O(B·S·H·D) elementwise, jax-side
+    delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
+                       out.astype(jnp.float32))
+    delta = jnp.pad(delta, ((0, 0), (0, 0), (0, Sq - S)))
+    delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, _LANES))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
+    r_spec = pl.BlockSpec((1, 1, block_q, _LANES),
+                          lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(B, H, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[_scratch((block_q, D))],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # swap grid roles: (b, h, k-block, q-block), q innermost
+    qk_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
+    kk_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+    rk_spec = pl.BlockSpec((1, 1, block_q, _LANES),
+                           lambda b, h, j, i: (b, h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(B, H, nk, nq),
+        in_specs=[qk_spec, kk_spec, kk_spec, qk_spec, rk_spec, rk_spec],
+        out_specs=[kk_spec, kk_spec],
+        out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vt.shape, v.dtype)],
+        scratch_shapes=[_scratch((block_k, D)), _scratch((block_k, D))],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    tr = lambda x, s: x[:, :, :s].transpose(0, 2, 1, 3)
+    return tr(dq, S), tr(dk, S), tr(dv, S)
 
 
 def attention_reference(q, k, v, causal=True, sm_scale=None):
     """Dense reference with semantics identical to the kernel (f32 softmax,
-    large-finite mask).  Used for tests and as the recompute path in the
-    custom VJP."""
+    large-finite mask).  Used for tests and as the dense fallback."""
     D = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
@@ -161,21 +327,21 @@ def attention_reference(q, k, v, causal=True, sm_scale=None):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                           interpret)
+    out, _ = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                             interpret, need_lse=False)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                               interpret, need_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: attention_reference(q, k, v, causal, sm_scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale,
+                           block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -185,9 +351,10 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
                     block_q=512, block_k=512, interpret=None):
     """Flash attention over [B, S, H, D] q/k/v.
 
-    Sequence lengths need not be multiples of the block sizes (padded keys
-    are masked out).  `interpret=None` auto-selects: native Mosaic on TPU,
-    interpreter elsewhere (the CPU test mesh).
+    Sequence lengths need not be multiples of the block sizes (padded rows
+    and keys are masked out of both passes).  `interpret=None`
+    auto-selects: native Mosaic on TPU, interpreter elsewhere (the CPU test
+    mesh).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)

@@ -140,3 +140,40 @@ def test_transformer_attention_impl_validated():
     tokens = jnp.zeros((1, 8), jnp.int32)
     with pytest.raises(ValueError, match="attention_impl"):
         Transformer(cfg).init(jax.random.key(0), tokens)
+
+
+@pytest.mark.parametrize("causal,S", [(True, 48), (False, 40)])
+def test_flash_attention_grad_ragged(causal, S):
+    # multi-block accumulation with padded rows/keys in BOTH bwd kernels
+    q, k, v = _qkv(S=S)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=32,
+                                       block_k=32, interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_grad_bf16():
+    q, k, v = _qkv(S=32, dtype=jnp.bfloat16)
+
+    def f(fn, q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(lambda *a: f(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=16,
+                                        block_k=16, interpret=True),
+        *a), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda *a: f(
+        lambda q, k, v: attention_reference(q, k, v, causal=True),
+        *a), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(a.astype(np.float32),
+                                   b.astype(np.float32), atol=0.15, rtol=0.15)
