@@ -35,6 +35,7 @@ class TransformerConfig:
     moe_every: int = 2            # every k-th layer is MoE (when enabled)
     remat: bool = False
     ring_attention_axis: Optional[str] = None  # e.g. "tp" to enable CP
+    ulysses_axis: Optional[str] = None  # all-to-all sequence parallelism
     sp_axis: Optional[str] = None  # Megatron-SP: shard residual stream's
     # sequence dim over this axis between blocks (usually "tp")
     attention_impl: str = "auto"  # auto | flash (pallas) | dense
@@ -60,16 +61,18 @@ class Attention(nn.Module):
             raise ValueError(
                 f"attention_impl={cfg.attention_impl!r} not in "
                 "('auto', 'flash', 'dense')")
-        if cfg.ring_attention_axis:
+        if cfg.ring_attention_axis and cfg.ulysses_axis:
+            raise ValueError(
+                "ring_attention_axis and ulysses_axis are mutually "
+                "exclusive context-parallel strategies")
+        if cfg.ring_attention_axis or cfg.ulysses_axis:
             if mask is not None:
                 raise NotImplementedError(
-                    "key-padding masks are not supported with ring "
-                    "attention; pad/pack sequences to full length or use "
-                    "attention_impl='dense'")
-            from tensorflowonspark_tpu.parallel.ring_attention import (
-                ring_attention)
-            out = ring_attention(q, k, v, axis_name=cfg.ring_attention_axis,
-                                 causal=cfg.causal)
+                    "key-padding masks are not supported with "
+                    "sequence-parallel attention; pad/pack sequences to "
+                    "full length, or unset ring_attention_axis/"
+                    "ulysses_axis to use non-sequence-parallel attention")
+            out = _seqpar_dispatch(q, k, v, cfg)
         elif mask is None and (cfg.attention_impl == "flash" or (
                 cfg.attention_impl == "auto"
                 and jax.default_backend() == "tpu")):
@@ -86,6 +89,62 @@ class Attention(nn.Module):
                                         mask=mask)
         out = out.reshape(B, S, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, name="out", dtype=dtype)(out)
+
+
+def _seqpar_dispatch(q, k, v, cfg):
+    """Route to ring / Ulysses context-parallel attention.
+
+    Both collectives need their mesh axis *bound* (shard_map).  Two call
+    shapes work: the whole model already under shard_map with the axis
+    manual (detected via the ambient mesh's `manual_axes`) — call the local
+    body directly; or the model under plain jit with a mesh active — wrap
+    just the attention core in shard_map here, sequence over the CP axis,
+    batch over whichever dp/fsdp axes divide it.
+    """
+    axis = cfg.ring_attention_axis or cfg.ulysses_axis
+    impl_kwargs = {}
+    if cfg.ring_attention_axis:
+        from tensorflowonspark_tpu.parallel.ring_attention import (
+            ring_attention as fn)
+        if cfg.attention_impl == "dense":
+            impl_kwargs["use_flash"] = False
+    else:
+        from tensorflowonspark_tpu.parallel.ulysses import (
+            ulysses_attention as fn)
+        if cfg.attention_impl == "dense":
+            impl_kwargs["attn_fn"] = (
+                lambda q, k, v, causal: dot_product_attention(
+                    q, k, v, causal=causal))
+
+    mesh = jax.sharding.get_abstract_mesh()
+    in_mesh = mesh is not None and not mesh.empty and axis in mesh.axis_names
+    bound = in_mesh and axis in getattr(mesh, "manual_axes", ())
+    if bound or not in_mesh:
+        # axis already bound by an enclosing shard_map (or no mesh at all,
+        # in which case the collective will raise an unbound-axis error
+        # rather than silently computing something else)
+        return fn(q, k, v, axis_name=axis, causal=cfg.causal, **impl_kwargs)
+
+    if q.shape[1] % mesh.shape[axis]:
+        raise ValueError(
+            f"seq_len={q.shape[1]} must be divisible by the {axis!r} axis "
+            f"size {mesh.shape[axis]} for context-parallel attention")
+    manual = getattr(mesh, "manual_axes", ())
+    batch_axes = tuple(
+        a for a in ("dp", "fsdp")
+        if a in mesh.axis_names and a != axis and a not in manual
+        and mesh.shape[a] > 1)
+    import numpy as _np
+    if batch_axes and q.shape[0] % int(_np.prod(
+            [mesh.shape[a] for a in batch_axes])):
+        logging.getLogger(__name__).warning(
+            "batch=%d not divisible by mesh axes %s (sizes %s); context-"
+            "parallel attention will replicate the batch over them — every "
+            "member recomputes full-batch attention", q.shape[0], batch_axes,
+            [mesh.shape[a] for a in batch_axes])
+        batch_axes = ()
+    return fn(q, k, v, axis_name=axis, causal=cfg.causal, mesh=mesh,
+              batch_axes=batch_axes or None, **impl_kwargs)
 
 
 def _flash_dispatch(q, k, v, cfg):

@@ -258,7 +258,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 
 
 def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
-                    interpret):
+                    interpret, g_lse=None):
     B, S, H, D = q.shape
     qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)
     kt = _pad_seq(k.transpose(0, 2, 1, 3), block_k)
@@ -270,6 +270,10 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
     # delta = rowsum(dO * O): [B, H, Sq] — O(B·S·H·D) elementwise, jax-side
     delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
                        out.astype(jnp.float32))
+    # an lse cotangent folds exactly into delta: ds_ij = p_ij*(dp_ij -
+    # delta_i + g_lse_i), since dlse_i/ds_ij = p_ij
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.pad(delta, ((0, 0), (0, 0), (0, Sq - S)))
     delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, _LANES))
 
@@ -347,6 +351,57 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse_full = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q,
+                                    block_k, interpret, need_lse=True)
+    return out, lse_full[:, :, :q.shape[1], 0]
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                       interpret):
+    out, lse_full = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q,
+                                    block_k, interpret, need_lse=True)
+    lse = lse_full[:, :, :q.shape[1], 0]
+    return (out, lse), (q, k, v, out, lse_full)
+
+
+def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res,
+                       cotangents):
+    q, k, v, out, lse_full = res
+    g, g_lse = cotangents
+    return _flash_bwd_impl(q, k, v, out, lse_full, g, causal, sm_scale,
+                           block_q, block_k, interpret, g_lse=g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def _resolve_call_args(q, k, sm_scale, block_q, block_k, interpret):
+    """Shared prologue of the public wrappers: default scale, interpret
+    auto-select (native Mosaic on TPU, interpreter elsewhere), and block
+    sizes clamped into the padded sequence range."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        from tensorflowonspark_tpu.ops import default_interpret
+        interpret = default_interpret()
+    block_q = min(block_q, max(q.shape[1], 16))
+    block_k = min(block_k, max(k.shape[1], 16))
+    return float(sm_scale), int(block_q), int(block_k), bool(interpret)
+
+
+def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None,
+                             block_q=512, block_k=512, interpret=None):
+    """Like flash_attention but also returns the per-row logsumexp
+    [B, H, S] — the merge key for combining attention computed over
+    key/value shards (ring attention's per-step local compute).  Fully
+    differentiable in both outputs."""
+    sm_scale, block_q, block_k, interpret = _resolve_call_args(
+        q, k, sm_scale, block_q, block_k, interpret)
+    return _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
 def flash_attention(q, k, v, causal=True, sm_scale=None,
                     block_q=512, block_k=512, interpret=None):
     """Flash attention over [B, S, H, D] q/k/v.
@@ -356,13 +411,6 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
     auto-selects: native Mosaic on TPU, interpreter elsewhere (the CPU test
     mesh).
     """
-    if sm_scale is None:
-        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    if interpret is None:
-        from tensorflowonspark_tpu.ops import default_interpret
-        interpret = default_interpret()
-    S = q.shape[1]
-    block_q = min(block_q, max(S, 16))
-    block_k = min(block_k, max(k.shape[1], 16))
-    return _flash(q, k, v, causal, float(sm_scale), int(block_q),
-                  int(block_k), bool(interpret))
+    sm_scale, block_q, block_k, interpret = _resolve_call_args(
+        q, k, sm_scale, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)

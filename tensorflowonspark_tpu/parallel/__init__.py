@@ -6,7 +6,8 @@ distribution strategies (SURVEY.md §2.3): where TFoS assembled TF_CONFIG and
 let `MultiWorkerMirroredStrategy` allreduce over gRPC, this framework owns
 the parallelism — a `jax.sharding.Mesh` over dp/fsdp/pp/tp axes, pjit-sharded
 train steps with gradient allreduce over ICI, Megatron-style tensor/sequence
-parallel layers, ring attention for context parallelism, expert parallelism
-for MoE, and pipeline parallelism via collective permutes.
+parallel layers, ring and Ulysses (all-to-all) attention for context
+parallelism, expert parallelism for MoE, and pipeline parallelism via
+collective permutes.
 """
 from .mesh import MeshSpec, build_mesh, local_mesh_spec  # noqa: F401

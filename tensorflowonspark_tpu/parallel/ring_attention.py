@@ -11,6 +11,16 @@ dense attention.
 
 Collectives ride ICI: each step's ppermute is a neighbor exchange, which is
 the optimal pattern on a TPU torus.
+
+Two local-compute paths:
+- `use_flash=True` (default on TPU): each ring step runs the pallas flash
+  kernel on (q_local, k_blk, v_blk) and merges the per-block outputs by
+  their logsumexp — ring handles the cross-device axis, the kernel the
+  on-device blocks, and the [S/N, S/N] score tile never hits HBM.  Causal
+  steps pick the right kernel mode per device via `lax.switch` (past block
+  → non-causal, diagonal → causal, future → skipped with zero weight).
+- `use_flash=False`: a pure-jnp online-softmax update (the CPU test mesh
+  path, and the reference semantics the kernel path is tested against).
 """
 import functools
 
@@ -38,7 +48,7 @@ def _online_update(o, m, l, logits, v_blk):
     return o_new, m_new, l_new
 
 
-def _ring_attention_local(q, k, v, axis_name, causal):
+def _ring_jnp_local(q, k, v, axis_name, causal):
     """Body running under shard_map: q/k/v are the LOCAL sequence blocks."""
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -76,22 +86,96 @@ def _ring_attention_local(q, k, v, axis_name, causal):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name="tp", causal=True, mesh=None):
+def _ring_flash_local(q, k, v, axis_name, causal, interpret):
+    """Ring body whose per-step local compute is the pallas flash kernel.
+
+    Per step the kernel returns (out_blk normalized within the block,
+    lse_blk); blocks merge by logsumexp weights — algebraically identical
+    to the online update, so the result stays exact.
+    """
+    from tensorflowonspark_tpu.ops.flash_attention import (
+        flash_attention_with_lse)
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+
+    attn = functools.partial(flash_attention_with_lse, interpret=interpret)
+    o = jnp.zeros((B, Sq, H, D), jnp.float32)   # lse-weighted accumulator
+    m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)  # running max lse
+    l = jnp.zeros((B, H, Sq), jnp.float32)      # running total weight
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step_fn(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        blk_idx = (my_idx - step) % axis_size
+
+        if causal:
+            # 0: past block (fully visible), 1: diagonal (causal within),
+            # 2: future block (fully masked — contribute zero weight)
+            case = jnp.where(blk_idx < my_idx, 0,
+                             jnp.where(blk_idx == my_idx, 1, 2))
+            out_blk, lse_blk = lax.switch(
+                case,
+                [lambda q, k, v: attn(q, k, v, causal=False),
+                 lambda q, k, v: attn(q, k, v, causal=True),
+                 lambda q, k, v: (jnp.zeros_like(q),
+                                  jnp.full((B, H, Sq), -jnp.inf,
+                                           jnp.float32))],
+                q, k_blk, v_blk)
+        else:
+            out_blk, lse_blk = attn(q, k_blk, v_blk, causal=False)
+
+        # merge by lse: out_blk carries weight exp(lse_blk)
+        m_new = jnp.maximum(m, lse_blk)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        w = jnp.where(jnp.isfinite(lse_blk), jnp.exp(lse_blk - m_safe), 0.0)
+        o = (o * alpha.transpose(0, 2, 1)[..., None]
+             + out_blk.astype(jnp.float32)
+             * w.transpose(0, 2, 1)[..., None])
+        l = l * alpha + w
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m_new, l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = lax.scan(step_fn, (o, m, l, k, v),
+                                  jnp.arange(axis_size))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, use_flash=None,
+                          interpret=None):
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        return _ring_flash_local(q, k, v, axis_name, causal, interpret)
+    return _ring_jnp_local(q, k, v, axis_name, causal)
+
+
+def ring_attention(q, k, v, axis_name="tp", causal=True, mesh=None,
+                   use_flash=None, interpret=None, batch_axes=None):
     """Exact attention with q/k/v sequence-sharded over `axis_name`.
 
     Call either (a) inside an existing shard_map/jit context where
     `axis_name` is bound — then this runs the local body directly — or
-    (b) at top level with `mesh` provided, in which case it wraps itself in
-    shard_map with the sequence dim of [B, S, H, D] sharded over the axis.
+    (b) at top level with `mesh` provided (concrete, or abstract under
+    jit), in which case it wraps itself in shard_map with the sequence dim
+    of [B, S, H, D] sharded over the axis and the batch dim over
+    `batch_axes` (None = replicated).
     """
     if mesh is None:
-        return _ring_attention_local(q, k, v, axis_name, causal)
+        return _ring_attention_local(q, k, v, axis_name, causal,
+                                     use_flash=use_flash,
+                                     interpret=interpret)
 
     from jax.sharding import PartitionSpec as P
     shard_map = _get_shard_map()
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axes, axis_name, None, None)
     fn = functools.partial(_ring_attention_local, axis_name=axis_name,
-                           causal=causal)
+                           causal=causal, use_flash=use_flash,
+                           interpret=interpret)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
 

@@ -48,3 +48,33 @@ def test_ring_under_jit_and_grad(qkv):
     g_dense = jax.grad(f_dense)(q, k, v)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
                                atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_local_matches_dense(qkv, causal):
+    # kernel-backed ring (interpret mode) must stay exactly dense attention
+    q, k, v = qkv
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=1, tp=8))
+    dense = dot_product_attention(q, k, v, causal=causal)
+    ring = ring_attention(q, k, v, axis_name="tp", causal=causal, mesh=mesh,
+                          use_flash=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_grad_matches_jnp_path(qkv):
+    q, k, v = qkv
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=1, tp=8))
+
+    def loss(impl_kwargs):
+        def f(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, axis_name="tp",
+                                          causal=True, mesh=mesh,
+                                          **impl_kwargs) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_flash = loss(dict(use_flash=True, interpret=True))
+    g_jnp = loss(dict(use_flash=False))
+    for a, b in zip(g_flash, g_jnp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
