@@ -1,0 +1,178 @@
+"""Raw-distributed UNet segmentation — NO framework cluster layer.
+
+Middle rung of the reference's 3-stage conversion ladder (single-node →
+raw-distributed → cluster-managed; reference:
+examples/segmentation/README.md:5, segmentation_dist.py:1-163, which
+hand-writes TF_CONFIG per process).  The TPU-native equivalent of
+hand-written TF_CONFIG is hand-wiring `jax.distributed.initialize`: every
+process is told the coordinator address, world size, and its process id on
+the command line, then SPMD training runs over the GLOBAL mesh.  Everything
+this script does by hand — coordinator bootstrap, global-mesh construction,
+per-process shard placement, chief-only checkpointing — is what
+`cluster.run()` + `ctx.init_distributed()` automate in the third rung
+(segmentation_spark.py).
+
+Run one process per host/slice (what a scheduler would do):
+
+    python segmentation_dist.py --coordinator host0:9898 \
+        --num_processes 2 --process_id 0 ...   # on host 0
+    python segmentation_dist.py --coordinator host0:9898 \
+        --num_processes 2 --process_id 1 ...   # on host 1
+
+Or let the script fork a local demo cluster (process_id omitted):
+
+    python examples/segmentation/segmentation_dist.py --num_processes 2 --steps 10
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import argparse
+import subprocess
+
+from segmentation import synthetic_shapes
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch_size", type=int, default=8,
+                   help="per-process batch size")
+    p.add_argument("--image_size", type=int, default=32)
+    p.add_argument("--num_examples", type=int, default=128)
+    p.add_argument("--model_dir", default=None)
+    p.add_argument("--platform", choices=["cpu", "tpu"], default="cpu")
+    p.add_argument("--coordinator", default="127.0.0.1:9898",
+                   help="host:port of process 0 (the coordination service)")
+    p.add_argument("--num_processes", type=int, default=2)
+    p.add_argument("--process_id", type=int, default=None,
+                   help="this process's rank; omit to fork a local demo "
+                        "cluster of --num_processes ranks")
+    return p
+
+
+def train_dist(args):
+    """One SPMD process of the hand-wired cluster."""
+    from tensorflowonspark_tpu import util as fw_util
+
+    if args.platform == "cpu":
+        fw_util.pin_platform("cpu")
+    import jax
+
+    # The boilerplate the framework's reservation server + NodeContext
+    # normally derive for you (node.py NodeContext.init_distributed):
+    if args.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models.unet import UNet, pixel_cross_entropy
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import train as train_mod
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt_mod
+
+    rank = jax.process_index()
+    images, masks = synthetic_shapes(args.num_examples, args.image_size,
+                                     seed=rank)
+
+    model = UNet(num_classes=3)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, args.image_size, args.image_size, 3)))["params"]
+
+    def loss_fn(params, batch, rng):
+        X, y = batch
+        return pixel_cross_entropy(model.apply({"params": params}, X), y)
+
+    # GLOBAL mesh over every process's devices; gradient allreduce over
+    # ICI/DCN comes from the batch sharding alone.
+    mesh = mesh_mod.build_mesh()
+    opt = optax.adam(1e-3)
+    state = train_mod.create_train_state(params, opt, mesh)
+    step = train_mod.make_train_step(loss_fn, opt, mesh)
+    bsharding = mesh_mod.batch_sharding(mesh)
+
+    n_local = jax.local_device_count()
+    bs = max(args.batch_size - args.batch_size % n_local, n_local)
+    rng = np.random.RandomState(rank)
+    jrng = jax.random.key(0)  # identical across ranks: one SPMD program
+    for i in range(args.steps):
+        idx = rng.randint(0, len(images), bs)
+        # each rank contributes ITS batch shard to the global array
+        batch = mesh_mod.put_batch((jnp.asarray(images[idx]),
+                                    jnp.asarray(masks[idx])), bsharding)
+        jrng, sub = jax.random.split(jrng)
+        state, metrics = step(state, batch, sub)
+        if i % 10 == 0 and rank == 0:
+            print(f"[rank {rank}/{jax.process_count()}] step {i} "
+                  f"loss {float(metrics['loss']):.4f}", flush=True)
+    if args.model_dir:
+        # EVERY rank calls save: orbax coordinates the multi-process write
+        # internally (chief-only gating is a single-process convenience —
+        # see utils/checkpoint.save_checkpoint's docstring)
+        ckpt_mod.save_checkpoint(args.model_dir, state.params, args.steps)
+    if rank == 0:
+        print("dist segmentation training complete", flush=True)
+
+
+def fork_local_cluster(args):
+    """Demo launcher: one subprocess per rank on this machine (the role a
+    real scheduler or one-command-per-host plays)."""
+    import socket
+    import time
+
+    if args.coordinator == build_argparser().get_default("coordinator"):
+        # default port may be held by a previous/parallel run: pick a free
+        # ephemeral one so local demos and tests never collide
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            args.coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    procs = []
+    try:
+        for pid in range(args.num_processes):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--process_id", str(pid)]
+            for flag in ("steps", "batch_size", "image_size", "num_examples",
+                         "coordinator", "num_processes", "platform"):
+                cmd += [f"--{flag}", str(getattr(args, flag))]
+            if args.model_dir:
+                cmd += ["--model_dir", args.model_dir]
+            procs.append(subprocess.Popen(cmd))
+        # a dead rank leaves the others blocked in collectives: as soon as
+        # any rank exits nonzero, take the rest down instead of hanging
+        while any(p.poll() is None for p in procs):
+            if any(p.poll() not in (None, 0) for p in procs):
+                break
+            time.sleep(0.2)
+    finally:
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+    rc = [p.returncode for p in procs]
+    if any(rc):
+        raise SystemExit(f"rank exit codes: {rc}")
+
+
+if __name__ == "__main__":
+    a = build_argparser().parse_args()
+    if a.model_dir:
+        a.model_dir = os.path.abspath(a.model_dir)
+    if a.process_id is None and a.num_processes > 1:
+        fork_local_cluster(a)
+    else:
+        a.process_id = a.process_id or 0
+        train_dist(a)

@@ -96,6 +96,17 @@ def test_segmentation_single_and_cluster(tmp_path):
     assert "segmentation training complete" in out
 
 
+def test_segmentation_dist_two_ranks(tmp_path):
+    # middle rung of the conversion ladder: hand-wired jax.distributed over
+    # 2 real OS processes, collective orbax checkpoint at the end
+    out = _run("segmentation/segmentation_dist.py", "--num_processes", "2",
+               "--steps", "2", "--batch_size", "4", "--image_size", "32",
+               "--num_examples", "16", "--model_dir", "segdist_ckpt",
+               cwd=tmp_path)
+    assert "dist segmentation training complete" in out
+    assert (tmp_path / "segdist_ckpt" / "step_2").exists()
+
+
 def test_bert_pretrain_pipeline(tmp_path):
     out = _run("bert/bert_pretrain.py", "--cluster_size", "1",
                "--epochs", "1", "--num_records", "64", "--batch_size", "16",
