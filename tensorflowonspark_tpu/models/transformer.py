@@ -26,11 +26,15 @@ class TransformerConfig:
     vocab_size: int = 32000
     d_model: int = 512
     n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # GQA: kv heads < query heads (1 = MQA)
     n_layers: int = 6
     d_ff: int = 2048
     max_seq_len: int = 2048
     causal: bool = True
     dtype: str = "bfloat16"
+    rope: bool = False            # rotary position embeddings instead of
+    # a learned absolute pos_embed table
+    rope_theta: float = 10000.0
     num_experts: int = 0          # 0 = dense MLP; >0 = MoE with EP sharding
     moe_every: int = 2            # every k-th layer is MoE (when enabled)
     remat: bool = False
@@ -41,6 +45,28 @@ class TransformerConfig:
     attention_impl: str = "auto"  # auto | flash (pallas) | dense
 
 
+def apply_rope(x, positions, theta=10000.0):
+    """Rotary position embedding over [..., S, H, D] (split-half pairing).
+
+    `positions`: [S] (or [B, S]) absolute token positions; q·k after
+    rotation depends only on relative position, so RoPE composes with
+    sequence-parallel attention (rotation happens before the CP dispatch,
+    on globally-indexed activations).
+    """
+    D = x.shape[-1]
+    if D % 2:
+        raise ValueError(f"head_dim={D} must be even for RoPE")
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, half]
+    cos = jnp.cos(angles)[..., None, :]                        # [..., S, 1, half]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -49,13 +75,34 @@ class Attention(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         head_dim = cfg.d_model // cfg.n_heads
+        n_kv = cfg.n_heads if cfg.n_kv_heads is None else cfg.n_kv_heads
+        if n_kv < 1:
+            raise ValueError(f"n_kv_heads={n_kv} must be >= 1 (or None)")
+        if cfg.n_heads % n_kv:
+            raise ValueError(
+                f"n_heads={cfg.n_heads} must be divisible by "
+                f"n_kv_heads={n_kv}")
         q = nn.Dense(cfg.d_model, use_bias=False, name="query", dtype=dtype)(x)
-        k = nn.Dense(cfg.d_model, use_bias=False, name="key", dtype=dtype)(x)
-        v = nn.Dense(cfg.d_model, use_bias=False, name="value", dtype=dtype)(x)
+        k = nn.Dense(n_kv * head_dim, use_bias=False, name="key",
+                     dtype=dtype)(x)
+        v = nn.Dense(n_kv * head_dim, use_bias=False, name="value",
+                     dtype=dtype)(x)
         B, S = x.shape[0], x.shape[1]
         q = q.reshape(B, S, cfg.n_heads, head_dim)
-        k = k.reshape(B, S, cfg.n_heads, head_dim)
-        v = v.reshape(B, S, cfg.n_heads, head_dim)
+        k = k.reshape(B, S, n_kv, head_dim)
+        v = v.reshape(B, S, n_kv, head_dim)
+        if cfg.rope:
+            pos = jnp.arange(S)
+            cp_axis = cfg.ring_attention_axis or cfg.ulysses_axis
+            if cp_axis:
+                # under an enclosing shard_map the activations are the LOCAL
+                # sequence shard; rotate with global token positions
+                mesh = jax.sharding.get_abstract_mesh()
+                if (mesh is not None and not mesh.empty
+                        and cp_axis in getattr(mesh, "manual_axes", ())):
+                    pos = pos + jax.lax.axis_index(cp_axis) * S
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
 
         if cfg.attention_impl not in ("auto", "flash", "dense"):
             raise ValueError(
@@ -72,21 +119,31 @@ class Attention(nn.Module):
                     "sequence-parallel attention; pad/pack sequences to "
                     "full length, or unset ring_attention_axis/"
                     "ulysses_axis to use non-sequence-parallel attention")
+            # GQA kv stay NARROW through the CP collectives (the bandwidth
+            # win: ring ppermutes / ulysses all-to-alls move n_kv/n_heads of
+            # the bytes); the local cores broadcast to full heads on-device
             out = _seqpar_dispatch(q, k, v, cfg)
-        elif mask is None and (cfg.attention_impl == "flash" or (
-                cfg.attention_impl == "auto"
-                and jax.default_backend() == "tpu")):
-            out = _flash_dispatch(q, k, v, cfg)
         else:
-            if mask is not None and cfg.attention_impl == "flash":
-                # arbitrary key-padding masks aren't implemented in the
-                # pallas kernel; an explicit 'flash' request must not
-                # silently lose its O(S) memory promise
-                logging.getLogger(__name__).warning(
-                    "attention_impl='flash' with a key-padding mask falls "
-                    "back to dense O(S^2) attention")
-            out = dot_product_attention(q, k, v, causal=cfg.causal,
-                                        mask=mask)
+            # non-CP paths: broadcast back to full heads for the attention
+            # cores (the narrow projection already saved the params +
+            # kv-cache HBM; XLA fuses the repeat)
+            from tensorflowonspark_tpu.parallel.ring_attention import (
+                _kv_repeat)
+            k, v = _kv_repeat(q, k, v)
+            if mask is None and (cfg.attention_impl == "flash" or (
+                    cfg.attention_impl == "auto"
+                    and jax.default_backend() == "tpu")):
+                out = _flash_dispatch(q, k, v, cfg)
+            else:
+                if mask is not None and cfg.attention_impl == "flash":
+                    # arbitrary key-padding masks aren't implemented in the
+                    # pallas kernel; an explicit 'flash' request must not
+                    # silently lose its O(S) memory promise
+                    logging.getLogger(__name__).warning(
+                        "attention_impl='flash' with a key-padding mask "
+                        "falls back to dense O(S^2) attention")
+                out = dot_product_attention(q, k, v, causal=cfg.causal,
+                                            mask=mask)
         out = out.reshape(B, S, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, name="out", dtype=dtype)(out)
 
@@ -295,9 +352,10 @@ class Transformer(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
                      dtype=dtype)(tokens)
-        pos = nn.Embed(cfg.max_seq_len, cfg.d_model, name="pos_embed",
-                       dtype=dtype)(jnp.arange(tokens.shape[1])[None])
-        x = x + pos
+        if not cfg.rope:  # RoPE rotates q/k inside attention instead
+            pos = nn.Embed(cfg.max_seq_len, cfg.d_model, name="pos_embed",
+                           dtype=dtype)(jnp.arange(tokens.shape[1])[None])
+            x = x + pos
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block)

@@ -48,8 +48,24 @@ def _online_update(o, m, l, logits, v_blk):
     return o_new, m_new, l_new
 
 
+def _kv_repeat(q, k_blk, v_blk):
+    """Broadcast narrow (GQA) k/v heads to the query head count — on-device,
+    after the collectives moved only the narrow tensors."""
+    H, H_kv = q.shape[2], k_blk.shape[2]
+    if H == H_kv:
+        return k_blk, v_blk
+    if H % H_kv:
+        raise ValueError(
+            f"q heads {H} must be divisible by kv heads {H_kv}")
+    rep = H // H_kv
+    return jnp.repeat(k_blk, rep, axis=2), jnp.repeat(v_blk, rep, axis=2)
+
+
 def _ring_jnp_local(q, k, v, axis_name, causal):
-    """Body running under shard_map: q/k/v are the LOCAL sequence blocks."""
+    """Body running under shard_map: q/k/v are the LOCAL sequence blocks.
+
+    k/v may carry fewer (GQA) heads than q — they ride the ring narrow and
+    are broadcast per step."""
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -67,7 +83,8 @@ def _ring_jnp_local(q, k, v, axis_name, causal):
         # which global block is currently resident: blocks rotate forward,
         # so at `step` we hold block (my_idx - step) mod N
         blk_idx = (my_idx - step) % axis_size
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk).astype(jnp.float32)
+        k_use, v_use = _kv_repeat(q, k_blk, v_blk)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_use).astype(jnp.float32)
         logits = logits * scale
         if causal:
             Sk = k_blk.shape[1]
@@ -75,7 +92,7 @@ def _ring_jnp_local(q, k, v, axis_name, causal):
             k_pos = blk_idx * Sk + jnp.arange(Sk)
             mask = q_pos[:, None] >= k_pos[None, :]
             logits = jnp.where(mask[None, None], logits, -1e30)
-        o, m, l = _online_update(o, m, l, logits, v_blk)
+        o, m, l = _online_update(o, m, l, logits, v_use)
         k_next = lax.ppermute(k_blk, axis_name, perm)
         v_next = lax.ppermute(v_blk, axis_name, perm)
         return (o, m, l, k_next, v_next), None
@@ -109,6 +126,7 @@ def _ring_flash_local(q, k, v, axis_name, causal, interpret):
     def step_fn(carry, step):
         o, m, l, k_blk, v_blk = carry
         blk_idx = (my_idx - step) % axis_size
+        k_use, v_use = _kv_repeat(q, k_blk, v_blk)
 
         if causal:
             # 0: past block (fully visible), 1: diagonal (causal within),
@@ -122,9 +140,9 @@ def _ring_flash_local(q, k, v, axis_name, causal, interpret):
                  lambda q, k, v: (jnp.zeros_like(q),
                                   jnp.full((B, H, Sq), -jnp.inf,
                                            jnp.float32))],
-                q, k_blk, v_blk)
+                q, k_use, v_use)
         else:
-            out_blk, lse_blk = attn(q, k_blk, v_blk, causal=False)
+            out_blk, lse_blk = attn(q, k_use, v_use, causal=False)
 
         # merge by lse: out_blk carries weight exp(lse_blk)
         m_new = jnp.maximum(m, lse_blk)

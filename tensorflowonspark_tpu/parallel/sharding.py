@@ -79,9 +79,16 @@ def infer_param_shardings(params, mesh, rules=DEFAULT_RULES, axis_map=None,
                         for p in path_parts)
         spec = spec_for_path(path, rules, axis_map)
         # Axes of extent 1 on this mesh carry no sharding but still trigger
-        # sharding-in-types checks downstream — drop them.
-        spec = P(*(ax if ax is not None and mesh.shape.get(ax, 1) > 1 else None
-                   for ax in spec))
+        # sharding-in-types checks downstream — drop them.  Likewise drop a
+        # mesh axis whose size doesn't divide the parameter dim (e.g. a GQA
+        # kv-projection narrower than the tp degree): device_put on an
+        # indivisible NamedSharding is an error, replication is just slower.
+        shape = getattr(leaf, "shape", ())
+        spec = P(*(
+            ax if (ax is not None and mesh.shape.get(ax, 1) > 1
+                   and i < len(shape) and shape[i] % mesh.shape[ax] == 0)
+            else None
+            for i, ax in enumerate(spec)))
         if fsdp and fsdp_size > 1:
             spec = _add_fsdp(spec, leaf, fsdp_size)
         # Drop specs that exceed the leaf's rank (scalar params etc.)

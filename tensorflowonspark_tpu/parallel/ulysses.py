@@ -37,11 +37,29 @@ def _ulysses_local(q, k, v, axis_name, causal, attn_fn):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    if q.shape[2] % axis_size:
+    H_q, H_kv = q.shape[2], k.shape[2]
+    if H_q % axis_size:
         raise ValueError(
-            f"n_heads={q.shape[2]} must be divisible by the ulysses axis "
+            f"n_heads={H_q} must be divisible by the ulysses axis "
             f"size {axis_size}")
+    if H_q != H_kv:
+        # GQA: exchange kv as narrow as the axis allows — pre-repeat only
+        # until the axis divides the head count (bytes moved scale with
+        # pre/rep), broadcast the rest locally after the all-to-all.  The
+        # jnp.repeat ordering keeps kv group g aligned with the q heads
+        # that land on the same device.
+        if H_q % H_kv:
+            raise ValueError(
+                f"n_heads={H_q} must be divisible by n_kv_heads={H_kv}")
+        rep = H_q // H_kv
+        pre = next(p for p in range(1, rep + 1)
+                   if rep % p == 0 and (H_kv * p) % axis_size == 0)
+        if pre > 1:
+            k = jnp.repeat(k, pre, axis=2)
+            v = jnp.repeat(v, pre, axis=2)
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
+    kg, vg = _kv_repeat(qg, kg, vg)
     out = attn_fn(qg, kg, vg, causal)
     return heads_to_seq(out)
 

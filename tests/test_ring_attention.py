@@ -78,3 +78,18 @@ def test_ring_flash_grad_matches_jnp_path(qkv):
     for a, b in zip(g_flash, g_jnp):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ring_narrow_kv_matches_repeated(qkv, use_flash):
+    # GQA: kv ride the ring narrow, broadcast per step on-device
+    q, k, v = qkv
+    kn, vn = k[:, :, :2], v[:, :, :2]
+    rep = q.shape[2] // 2
+    dense = dot_product_attention(q, jnp.repeat(kn, rep, axis=2),
+                                  jnp.repeat(vn, rep, axis=2), causal=True)
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=1, tp=8))
+    out = ring_attention(q, kn, vn, axis_name="tp", causal=True, mesh=mesh,
+                         use_flash=use_flash, interpret=use_flash or None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)

@@ -120,3 +120,18 @@ def test_transformer_cp_dense_impl_matches(cp_field):
             lambda p, t: cp_model.apply({"params": p}, t))(params, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("n_kv", [1, 2, 4])
+def test_ulysses_narrow_kv_matches_repeated(qkv, n_kv):
+    # GQA: narrow kv through the all-to-alls == dense with repeated kv
+    q, k, v = qkv                      # H=8 over the 8-way axis
+    kn, vn = k[:, :, :n_kv], v[:, :, :n_kv]
+    rep = q.shape[2] // n_kv
+    dense = dot_product_attention(q, jnp.repeat(kn, rep, axis=2),
+                                  jnp.repeat(vn, rep, axis=2), causal=True)
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=1, tp=8))
+    out = ulysses_attention(q, kn, vn, axis_name="tp", causal=True,
+                            mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
