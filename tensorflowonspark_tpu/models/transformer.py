@@ -37,6 +37,10 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     num_experts: int = 0          # 0 = dense MLP; >0 = MoE with EP sharding
     moe_every: int = 2            # every k-th layer is MoE (when enabled)
+    moe_router: str = "dense"     # dense (every token through every expert,
+    # exact, test-friendly) | topk (GShard-style capacity dispatch)
+    moe_top_k: int = 1            # experts per token under the topk router
+    moe_capacity_factor: float = 1.25  # per-expert slots = factor*k*T/E
     remat: bool = False
     ring_attention_axis: Optional[str] = None  # e.g. "tp" to enable CP
     ulysses_axis: Optional[str] = None  # all-to-all sequence parallelism
@@ -271,13 +275,14 @@ class DenseMLP(nn.Module):
 
 
 class MoEMLP(nn.Module):
-    """Mixture-of-experts MLP with top-1 routing (Switch-style).
+    """Mixture-of-experts MLP (Switch/GShard-style).
 
     Expert weights carry a leading [num_experts] dim that the sharding rules
-    place on the ep axis; routing uses dense einsum dispatch (one-hot
-    combine) — static shapes, MXU-friendly, no sorting, at the cost of
-    capacity = full batch per expert.  Fine at test scale; a capacity-based
-    dispatch is a later optimization.
+    place on the ep axis.  Two routers, both static-shape and sort-free:
+    `dense` sends every token through every expert slot and masks (exact,
+    the numerics reference); `topk` is the production path — GShard
+    capacity dispatch where each expert computes a fixed C slots and
+    overflow tokens fall back to the residual stream.
     """
     cfg: TransformerConfig
 
@@ -287,29 +292,91 @@ class MoEMLP(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         B, S, D = x.shape
         E = cfg.num_experts
+        if cfg.moe_router not in ("dense", "topk"):
+            raise ValueError(
+                f"moe_router={cfg.moe_router!r} not in ('dense', 'topk')")
         gate_logits = nn.Dense(E, use_bias=False, name="router")(
             x.astype(jnp.float32))
         probs = jax.nn.softmax(gate_logits, axis=-1)
-        top_idx = jnp.argmax(probs, axis=-1)                 # [B, S]
-        top_p = jnp.take_along_axis(probs, top_idx[..., None], axis=-1)
-        dispatch = jax.nn.one_hot(top_idx, E, dtype=dtype)   # [B, S, E]
 
         wi = self.param("experts_wi/kernel", nn.initializers.lecun_normal(),
                         (E, D, cfg.d_ff)).astype(dtype)
         wo = self.param("experts_wo/kernel", nn.initializers.lecun_normal(),
                         (E, cfg.d_ff, D)).astype(dtype)
-        # dispatch every token to every expert slot densely, mask by routing
-        xe = jnp.einsum("bsd,bse->ebsd", x, dispatch)
-        h = jnp.einsum("ebsd,edf->ebsf", xe, wi)
-        h = nn.gelu(h)
-        ye = jnp.einsum("ebsf,efd->ebsd", h, wo)
-        y = jnp.einsum("ebsd->bsd", ye)
+
+        def expert_mlp(xe):
+            """xe: [E, ..., D] -> [E, ..., D], batched over the expert dim."""
+            h = jnp.einsum("e...d,edf->e...f", xe, wi)
+            h = nn.gelu(h)
+            return jnp.einsum("e...f,efd->e...d", h, wo)
+
+        if cfg.moe_router == "dense":
+            top_idx = jnp.argmax(probs, axis=-1)             # [B, S]
+            top_p = jnp.take_along_axis(probs, top_idx[..., None], axis=-1)
+            dispatch = jax.nn.one_hot(top_idx, E, dtype=dtype)  # [B, S, E]
+            # every token through every expert slot, masked by routing
+            xe = jnp.einsum("bsd,bse->ebsd", x, dispatch)
+            y = jnp.einsum("ebsd->bsd", expert_mlp(xe)) * top_p.astype(dtype)
+            frac_tokens = jnp.mean(dispatch.astype(jnp.float32), axis=(0, 1))
+        else:
+            y, frac_tokens = self._topk_route(x, probs, expert_mlp)
         # aux load-balancing loss (Switch): E * sum_e (frac_tokens * frac_prob)
-        frac_tokens = jnp.mean(dispatch.astype(jnp.float32), axis=(0, 1))
         frac_probs = jnp.mean(probs, axis=(0, 1))
         aux = E * jnp.sum(frac_tokens * frac_probs)
         self.sow("intermediates", "moe_aux_loss", aux)
-        return y * top_p.astype(dtype)
+        return y
+
+    def _topk_route(self, x, probs, expert_mlp):
+        """GShard-style capacity dispatch: each token picks its top-k
+        experts; each expert processes a STATIC number of slots C =
+        ceil(capacity_factor * k * T / E).  Tokens claim slots by cumsum
+        priority (all first choices before second choices); overflow tokens
+        are dropped (their residual branch contributes zero — the residual
+        connection still carries them).  Static shapes, sort-free, and
+        compute per expert is C instead of the dense router's full T.
+        """
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, D = x.shape
+        E, k = cfg.num_experts, cfg.moe_top_k
+        if not 1 <= k <= E:
+            raise ValueError(f"moe_top_k={k} must be in [1, {E}]")
+        T = B * S
+        C = int(max(1, -(-cfg.moe_capacity_factor * k * T // E)))
+        C = min(C, T)
+        xt = x.reshape(T, D)
+        pt = probs.reshape(T, E)                              # f32
+
+        topk_p, topk_idx = jax.lax.top_k(pt, k)               # [T, k]
+        if k > 1:
+            # renormalize combine weights over the chosen experts (GShard
+            # top-2 convention); k=1 keeps the raw probability as the scale
+            # (Switch convention — and the router's gradient signal)
+            topk_p = topk_p / jnp.maximum(
+                jnp.sum(topk_p, axis=-1, keepdims=True), 1e-9)
+
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        counts = jnp.zeros((E,), jnp.int32)
+        for c in range(k):                                    # k is tiny
+            onehot = jax.nn.one_hot(topk_idx[:, c], E, dtype=jnp.int32)
+            pos = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]  # [T, E]
+            counts = counts + jnp.sum(onehot, axis=0)
+            keep = (onehot > 0) & (pos < C)
+            slot = jax.nn.one_hot(jnp.where(keep, pos, -1), C,
+                                  dtype=jnp.float32)          # [T, E, C]
+            combine = combine + slot * topk_p[:, c, None, None]
+        dispatch = (combine > 0).astype(dtype)                # [T, E, C]
+
+        expert_in = jnp.einsum("td,tec->ecd", xt, dispatch)   # [E, C, D]
+        expert_out = expert_mlp(expert_in)                    # [E, C, D]
+        yt = jnp.einsum("ecd,tec->td", expert_out.astype(jnp.float32),
+                        combine)
+        # aux-loss token fractions come from the router's PRE-drop first
+        # choices (Switch/GShard): post-capacity fractions saturate at C/T,
+        # muting the balancing gradient exactly when the router collapses
+        frac_tokens = jnp.mean(
+            jax.nn.one_hot(topk_idx[:, 0], E, dtype=jnp.float32), axis=0)
+        return yt.reshape(B, S, D).astype(dtype), frac_tokens
 
 
 def _sp_constrain(x, cfg):
@@ -360,7 +427,10 @@ class Transformer(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(Block)
         for i in range(cfg.n_layers):
-            use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
+            # every k-th layer is MoE, counting so that moe_every=1 means
+            # every layer (k=2 keeps the old odd-layer placement)
+            use_moe = cfg.num_experts > 0 and (
+                i % cfg.moe_every == cfg.moe_every - 1)
             x = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(x)
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",

@@ -209,3 +209,50 @@ def test_rope_cp_under_enclosing_shard_map(toy_batch):
         got = jax.jit(fn)(params, toy_batch)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=3e-5, rtol=3e-5)
+
+
+def test_moe_topk_full_capacity_matches_dense_router(toy_batch):
+    # with k=1 and capacity >= all tokens, the GShard dispatch must equal
+    # the dense (mask-every-expert) router exactly
+    base = {**CFG.__dict__, "num_experts": 4, "moe_every": 1}
+    dense = Transformer(TransformerConfig(**base))
+    params = dense.init(jax.random.key(2), toy_batch)["params"]
+    want = dense.apply({"params": params}, toy_batch)
+
+    assert any("moe" in params[k] for k in params
+               if k.startswith("layer")), "no MoE layer materialized"
+    topk = Transformer(TransformerConfig(
+        **{**base, "moe_router": "topk", "moe_top_k": 1,
+           "moe_capacity_factor": 4.0}))  # C = 4*T/E = T: no drops
+    got = topk.apply({"params": params}, toy_batch)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_moe_topk_tight_capacity_drops_but_stays_finite(toy_batch):
+    cfg = TransformerConfig(**{**CFG.__dict__, "num_experts": 4,
+                               "moe_every": 1, "moe_router": "topk",
+                               "moe_top_k": 2, "moe_capacity_factor": 0.25})
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(2), toy_batch)["params"]
+
+    def loss(p):
+        return lm_loss(model.apply({"params": p}, toy_batch[:, :-1]),
+                       toy_batch[:, 1:])
+
+    val, g = jax.value_and_grad(loss)(params)
+    assert bool(jnp.isfinite(val))
+    assert all(bool(jnp.all(jnp.isfinite(x)))
+               for x in jax.tree_util.tree_leaves(g))
+
+
+def test_moe_router_validation(toy_batch):
+    bad = TransformerConfig(**{**CFG.__dict__, "num_experts": 4,
+                               "moe_every": 1, "moe_router": "sorted"})
+    with pytest.raises(ValueError, match="moe_router"):
+        Transformer(bad).init(jax.random.key(0), toy_batch)
+    bad_k = TransformerConfig(**{**CFG.__dict__, "num_experts": 4,
+                                 "moe_every": 1, "moe_router": "topk",
+                                 "moe_top_k": 9})
+    with pytest.raises(ValueError, match="moe_top_k"):
+        Transformer(bad_k).init(jax.random.key(0), toy_batch)
