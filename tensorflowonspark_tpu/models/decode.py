@@ -1,0 +1,94 @@
+"""Autoregressive generation loop over the Transformer's kv cache.
+
+Net-new relative to the reference (its inference paths are batch
+feed-forward only: pipeline.py:585-644, TFModel.scala:245-292 map batches
+through a saved model).  TPU-idiomatic generation: the per-token step is one
+jitted function with STATIC shapes — the kv cache is a fixed
+[B, max_seq_len, n_kv_heads, head_dim] buffer updated in place via
+dynamic_update_slice (models/transformer.py Attention._decode_attention) —
+and the token loop is a lax.scan, so the whole generation compiles once and
+stays on-device.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model_or_cfg, batch_size):
+    """Build the decode-mode model + empty cache.
+
+    Accepts a Transformer (or its config); returns (decode_model, cache).
+    The cache is all-zeros by construction, so only its SHAPES are derived
+    from the model (jax.eval_shape — no throwaway parameter init, no
+    transient 2x parameter HBM).
+    """
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg = (model_or_cfg.cfg if isinstance(model_or_cfg, Transformer)
+           else model_or_cfg)
+    if not isinstance(cfg, TransformerConfig):
+        raise TypeError(f"expected Transformer or TransformerConfig, "
+                        f"got {type(model_or_cfg)}")
+    decode_model = Transformer(dataclasses.replace(cfg, decode=True))
+    shapes = jax.eval_shape(
+        lambda: decode_model.init(jax.random.key(0),
+                                  jnp.zeros((batch_size, 1), jnp.int32)))
+    cache = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), shapes["cache"])
+    return decode_model, cache
+
+
+def generate(model, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None, eos_id=None):
+    """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
+
+    temperature=0 is greedy argmax; >0 samples from softmax(logits/T).
+    With `eos_id`, sequences that emit it keep emitting eos_id (shapes stay
+    static; trim host-side).  Runs as prefill (one call over the prompt)
+    + lax.scan of single-token steps.
+    """
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires `rng`")
+    if max_new_tokens <= 0:
+        return prompt
+    decode_model, cache = init_cache(model, prompt.shape[0])
+    cfg = decode_model.cfg
+    if prompt.shape[1] + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq_len {cfg.max_seq_len}")
+
+    def step(tokens, cache):
+        logits, mut = decode_model.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+        return logits[:, -1], mut["cache"]
+
+    def pick(logits, rng):
+        if temperature > 0:
+            return jax.random.categorical(rng, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    rng = rng if rng is not None else jax.random.key(0)
+    last_logits, cache = step(prompt, cache)                  # prefill
+    rng, sub = jax.random.split(rng)
+    tok = pick(last_logits, sub)                              # [B]
+    done = jnp.zeros(tok.shape, bool)
+    if eos_id is not None:
+        done = done | (tok == eos_id)
+        tok = jnp.where(done, eos_id, tok)
+
+    def scan_body(carry, rng_t):
+        tok, cache, done = carry
+        logits, cache = step(tok[:, None], cache)
+        nxt = pick(logits, rng_t)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, cache, done), nxt
+
+    rngs = jax.random.split(rng, max(max_new_tokens - 1, 0))
+    (_, _, _), rest = jax.lax.scan(scan_body, (tok, cache, done), rngs)
+    new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
+    return jnp.concatenate([prompt, new_tokens], axis=1)

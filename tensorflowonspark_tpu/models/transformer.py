@@ -47,6 +47,8 @@ class TransformerConfig:
     sp_axis: Optional[str] = None  # Megatron-SP: shard residual stream's
     # sequence dim over this axis between blocks (usually "tp")
     attention_impl: str = "auto"  # auto | flash (pallas) | dense
+    decode: bool = False          # autoregressive mode: kv cache of
+    # max_seq_len (narrow n_kv_heads — the GQA HBM win), incremental steps
 
 
 def apply_rope(x, positions, theta=10000.0):
@@ -95,8 +97,16 @@ class Attention(nn.Module):
         q = q.reshape(B, S, cfg.n_heads, head_dim)
         k = k.reshape(B, S, n_kv, head_dim)
         v = v.reshape(B, S, n_kv, head_dim)
+        decoding = cfg.decode and self.has_variable("cache", "cached_key")
+        cache_index = None
+        if decoding:
+            cache_index = self.get_variable("cache", "cache_index")
+
         if cfg.rope:
             pos = jnp.arange(S)
+            if decoding:
+                pos = pos + cache_index  # absolute positions of the new
+                # tokens; cached keys were rotated at their own positions
             cp_axis = cfg.ring_attention_axis or cfg.ulysses_axis
             if cp_axis:
                 # under an enclosing shard_map the activations are the LOCAL
@@ -116,7 +126,17 @@ class Attention(nn.Module):
             raise ValueError(
                 "ring_attention_axis and ulysses_axis are mutually "
                 "exclusive context-parallel strategies")
-        if cfg.ring_attention_axis or cfg.ulysses_axis:
+        if cfg.decode:
+            if cfg.ring_attention_axis or cfg.ulysses_axis:
+                raise NotImplementedError(
+                    "decode mode with sequence-parallel attention is not "
+                    "supported; decode on a tp/dp mesh instead")
+            if not cfg.causal:
+                raise NotImplementedError(
+                    "decode mode is autoregressive (causal) generation; "
+                    "causal=False has no incremental form")
+            out = self._decode_attention(q, k, v, mask)
+        elif cfg.ring_attention_axis or cfg.ulysses_axis:
             if mask is not None:
                 raise NotImplementedError(
                     "key-padding masks are not supported with "
@@ -150,6 +170,53 @@ class Attention(nn.Module):
                                             mask=mask)
         out = out.reshape(B, S, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, name="out", dtype=dtype)(out)
+
+    def _decode_attention(self, q, k, v, mask):
+        """Incremental attention against the kv cache.
+
+        The cache holds max_seq_len slots of the NARROW n_kv_heads k/v (the
+        GQA memory win); new tokens are written at cache_index via
+        dynamic_update_slice — static shapes, so one compiled step serves
+        the whole generation.  Works uniformly for prefill (S>1) and
+        single-token steps: key j is visible to query s iff j <= index + s.
+
+        CONTRACT: the caller must keep total decoded length within
+        cfg.max_seq_len (models/decode.generate enforces this).  Past it,
+        dynamic_update_slice clamps the write index and results are
+        silently wrong — a data-dependent bound cannot raise under jit.
+        """
+        cfg = self.cfg
+        if mask is not None:
+            raise NotImplementedError(
+                "key-padding masks are not supported in decode mode")
+        from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
+        B, S, n_kv, Dh = k.shape
+        L = cfg.max_seq_len
+        dtype = k.dtype
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (B, L, n_kv, Dh), dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (B, L, n_kv, Dh), dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        if self.is_initializing():
+            kf, vf = _kv_repeat(q, k, v)
+            return dot_product_attention(q, kf, vf, causal=cfg.causal)
+        idx = ci.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(dtype),
+                                                (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v.astype(dtype),
+                                                (0, idx, 0, 0))
+        ci.value = idx + S
+        kf, vf = _kv_repeat(q, ck.value, cv.value)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf).astype(jnp.float32)
+        logits = logits * scale
+        visible = (jnp.arange(L)[None, :]
+                   <= (idx + jnp.arange(S))[:, None])     # [S, L]
+        logits = jnp.where(visible[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
 
 
 def _seqpar_dispatch(q, k, v, cfg):
@@ -420,8 +487,16 @@ class Transformer(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
                      dtype=dtype)(tokens)
         if not cfg.rope:  # RoPE rotates q/k inside attention instead
+            pos_ids = jnp.arange(tokens.shape[1])
+            if cfg.decode:
+                # incremental steps look up absolute positions
+                pi = self.variable("cache", "pos_index",
+                                   lambda: jnp.zeros((), jnp.int32))
+                if not self.is_initializing():
+                    pos_ids = pos_ids + pi.value
+                    pi.value = pi.value + tokens.shape[1]
             pos = nn.Embed(cfg.max_seq_len, cfg.d_model, name="pos_embed",
-                           dtype=dtype)(jnp.arange(tokens.shape[1])[None])
+                           dtype=dtype)(pos_ids[None])
             x = x + pos
         block_cls = Block
         if cfg.remat:

@@ -1,0 +1,127 @@
+"""KV-cache decode: incremental steps must reproduce the full causal
+forward exactly (the cache is an optimization, not a different model)."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import dataclasses
+
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models.decode import generate, init_cache
+from tensorflowonspark_tpu.models.transformer import (
+    Transformer, TransformerConfig)
+
+BASE = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32, dtype="float32")
+
+
+@pytest.fixture(scope="module", params=["learned", "rope", "rope_gqa"])
+def model_and_params(request):
+    extra = {"learned": {},
+             "rope": {"rope": True},
+             "rope_gqa": {"rope": True, "n_kv_heads": 2}}[request.param]
+    cfg = TransformerConfig(**BASE, **extra)
+    model = Transformer(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    return model, params
+
+
+def test_incremental_matches_full_forward(model_and_params):
+    model, params = model_and_params
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 10)), jnp.int32)
+    full = model.apply({"params": params}, tokens)   # causal full forward
+
+    decode_model, cache = init_cache(model, batch_size=2)
+    got = []
+    for t in range(tokens.shape[1]):                 # one token at a time
+        logits, mut = decode_model.apply(
+            {"params": params, "cache": cache}, tokens[:, t:t + 1],
+            mutable=["cache"])
+        cache = mut["cache"]
+        got.append(logits[:, 0])
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_prefill_then_steps_matches_full(model_and_params):
+    model, params = model_and_params
+    rng = np.random.RandomState(4)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 12)), jnp.int32)
+    full = model.apply({"params": params}, tokens)
+
+    decode_model, cache = init_cache(model, batch_size=2)
+    logits_p, mut = decode_model.apply(
+        {"params": params, "cache": cache}, tokens[:, :7],
+        mutable=["cache"])   # prefill 7 tokens in one call
+    cache = mut["cache"]
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(full[:, :7]),
+                               atol=2e-5, rtol=2e-5)
+    for t in range(7, 12):
+        logits, mut = decode_model.apply(
+            {"params": params, "cache": cache}, tokens[:, t:t + 1],
+            mutable=["cache"])
+        cache = mut["cache"]
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_generate_greedy_matches_manual_loop(model_and_params):
+    model, params = model_and_params
+    prompt = jnp.asarray([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    assert bool(jnp.all(out[:, :5] == prompt))
+
+    # manual greedy teacher-forcing with the full model must agree
+    seq = prompt
+    for _ in range(6):
+        logits = model.apply({"params": params}, seq)
+        seq = jnp.concatenate(
+            [seq, jnp.argmax(logits[:, -1], axis=-1)[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_generate_sampling_and_eos(model_and_params):
+    model, params = model_and_params
+    prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=5, temperature=0.8,
+                   rng=jax.random.key(7))
+    assert out.shape == (1, 8)
+    with pytest.raises(ValueError, match="requires"):
+        generate(model, params, prompt, max_new_tokens=2, temperature=0.5)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(model, params, prompt, max_new_tokens=500)
+
+    # eos pinning: whatever greedy emits first, force it as eos and the
+    # rest of that sequence must be eos too
+    g = generate(model, params, prompt, max_new_tokens=4)
+    eos = int(g[0, 3])
+    pinned = generate(model, params, prompt, max_new_tokens=4, eos_id=eos)
+    assert bool(jnp.all(pinned[0, 3:] == eos))
+
+
+def test_decode_rejects_cp_axes():
+    cfg = TransformerConfig(**BASE, rope=True, ulysses_axis="tp",
+                            decode=True)
+    model = Transformer(cfg)
+    with pytest.raises(NotImplementedError, match="sequence-parallel"):
+        model.init(jax.random.key(0), jnp.zeros((1, 1), jnp.int32))
+
+
+def test_generate_zero_new_tokens(model_and_params):
+    model, params = model_and_params
+    prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_decode_rejects_noncausal():
+    cfg = TransformerConfig(**{**BASE, "causal": False}, decode=True)
+    with pytest.raises(NotImplementedError, match="causal"):
+        Transformer(cfg).init(jax.random.key(0),
+                              jnp.zeros((1, 1), jnp.int32))
