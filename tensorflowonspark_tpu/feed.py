@@ -52,8 +52,106 @@ class DataFeed:
         self.qname_out = qname_out
         self.input_mapping = input_mapping
         self.done_feeding = False
-        self._buffer = []          # records drained from chunks, not yet returned
+        # drained-but-unreturned records, as segments: ("rows", list) or a
+        # PackedChunk kept COLUMNAR so next_numpy_batch never materializes
+        # python row objects (the packed-transport fast path)
+        self._segments = []
         self._partition_break = False
+
+    @property
+    def _buffer(self):
+        """Pending record count (kept as the reference-era name)."""
+        return sum(self._seg_len(s) for s in self._segments)
+
+    @staticmethod
+    def _seg_len(seg):
+        return len(seg[1]) if isinstance(seg, tuple) else len(seg)
+
+    def _take_blocks(self, batch_size, timeout=None):
+        """Collect up to `batch_size` records as blocks (row lists or
+        columnar PackedChunk slices), handling the marker protocol."""
+        import queue as queue_mod
+
+        q = self.mgr.get_queue(self.qname_in)
+        blocks, n = [], 0
+        while n < batch_size:
+            if self._segments:
+                seg = self._segments[0]
+                take = min(batch_size - n, self._seg_len(seg))
+                if isinstance(seg, tuple):
+                    rows = seg[1]
+                    blocks.append(("rows", rows[:take]))
+                    rest = rows[take:]
+                    if rest:
+                        self._segments[0] = ("rows", rest)
+                    else:
+                        self._segments.pop(0)
+                else:  # PackedChunk: slice columns, stay columnar
+                    blocks.append(("cols", marker.PackedChunk(
+                        tuple(c[:take] for c in seg.columns), seg.row_type,
+                        seg.matrix)))
+                    if take < len(seg):
+                        self._segments[0] = marker.PackedChunk(
+                            tuple(c[take:] for c in seg.columns),
+                            seg.row_type, seg.matrix)
+                    else:
+                        self._segments.pop(0)
+                n += take
+                continue
+            if self.done_feeding or self._partition_break:
+                break
+            try:
+                item = q.get(timeout=timeout) if timeout is not None else q.get()
+            except queue_mod.Empty:
+                break
+            if item is None:
+                self.done_feeding = True
+                q.task_done()
+            elif isinstance(item, marker.EndPartition):
+                q.task_done()
+                if n:
+                    self._partition_break = True  # flush current batch first
+                    break
+                # nothing collected yet: partition boundary is invisible
+            elif isinstance(item, marker.PackedChunk):
+                self._segments.append(item)
+                q.task_done()
+            elif isinstance(item, marker.Chunk):
+                self._segments.append(("rows", list(item.items)))
+                q.task_done()
+            elif blocks and blocks[-1][0] == "rows":
+                # coalesce consecutive raw items into one rows block so the
+                # numpy path stacks once instead of per record
+                blocks[-1][1].append(item)
+                n += 1
+                q.task_done()
+            else:
+                blocks.append(("rows", [item]))
+                n += 1
+                q.task_done()
+        if self._partition_break and not self._segments:
+            self._partition_break = False
+        return blocks
+
+    @staticmethod
+    def _rows_of(block):
+        """Materialize a block into records.  Array-valued fields of packed
+        field-records come back as numpy views (the values are identical;
+        only list-vs-ndarray container type differs from what the feeder
+        iterated)."""
+        kind, data = block
+        if kind == "rows":
+            return data
+        cols, row_type = data.columns, data.row_type
+        if row_type is None:
+            return list(cols[0])
+        if row_type in (int, float, bool):
+            # python-scalar records: tolist restores the exact scalar type
+            return cols[0].tolist()
+        if data.matrix:  # [N, F] matrix of flat rows: tolist is C-speed
+            rows = cols[0].tolist()
+            return rows if row_type is list else [row_type(r) for r in rows]
+        return [row_type(c[i] for c in cols) for i in range(len(data))]
 
     def next_batch(self, batch_size, timeout=None):
         """Return up to `batch_size` records.
@@ -72,37 +170,9 @@ class DataFeed:
         parallel.train.feed_consensus); a bounded probe instead lets the
         worker vote "dry" and the cluster stop in lockstep.
         """
-        import queue as queue_mod
-
-        q = self.mgr.get_queue(self.qname_in)
         batch = []
-        while len(batch) < batch_size:
-            if self._buffer:
-                batch.append(self._buffer.pop(0))
-                continue
-            if self.done_feeding or self._partition_break:
-                break
-            try:
-                item = q.get(timeout=timeout) if timeout is not None else q.get()
-            except queue_mod.Empty:
-                break
-            if item is None:
-                self.done_feeding = True
-                q.task_done()
-            elif isinstance(item, marker.EndPartition):
-                q.task_done()
-                if batch:
-                    self._partition_break = True  # flush current batch first
-                    break
-                # empty batch so far: partition boundary is invisible, continue
-            elif isinstance(item, marker.Chunk):
-                self._buffer.extend(item.items)
-                q.task_done()
-            else:
-                batch.append(item)
-                q.task_done()
-        if self._partition_break and not self._buffer:
-            self._partition_break = False
+        for block in self._take_blocks(batch_size, timeout):
+            batch.extend(self._rows_of(block))
         if self.input_mapping:
             return self._apply_mapping(batch)
         return batch
@@ -114,25 +184,68 @@ class DataFeed:
                 cols[name].append(rec[key])
         return cols
 
-    def next_numpy_batch(self, batch_size, dtype=None):
+    def next_numpy_batch(self, batch_size, dtype=None, timeout=None):
         """Like next_batch but stacks records into numpy arrays.
 
         Records that are tuples/lists of fields become a tuple of arrays
-        (one per field); scalar/array records become one array.  This is the
-        shape `jax.device_put` wants.
+        (one per field); scalar/array records become one array; wide flat
+        scalar records (feeder-packed as a matrix) become per-field column
+        views.  This is the shape `jax.device_put` wants.  Feeder-packed
+        chunks (marker.PackedChunk) pass through columnar — no python row
+        objects are ever materialized on this path.  `timeout` bounds each
+        blocking wait like next_batch's.
         """
         import numpy as np
-        batch = self.next_batch(batch_size)
+
         if self.input_mapping:
+            batch = self.next_batch(batch_size, timeout=timeout)
             return {k: np.asarray(v, dtype=dtype) for k, v in batch.items()}
-        if not batch:
+
+        blocks = self._take_blocks(batch_size, timeout)
+        if not blocks:
             return None
-        first = batch[0]
-        if isinstance(first, (tuple, list)) and not np.isscalar(first):
-            ncols = len(first)
-            return tuple(np.asarray([r[i] for r in batch], dtype=dtype)
-                         for i in range(ncols))
-        return np.asarray(batch, dtype=dtype)
+        if all(kind == "cols" and data.matrix for kind, data in blocks):
+            # wide flat records: concatenate the [N, F] matrices once and
+            # expose per-field column views
+            mats = [data.columns[0] for _, data in blocks]
+            big = mats[0] if len(mats) == 1 else np.concatenate(mats)
+            if dtype is not None:
+                big = np.asarray(big, dtype=dtype)
+            return tuple(big[:, i] for i in range(big.shape[1]))
+        field_blocks = []   # per block: tuple of per-field arrays
+        singles = []        # per block: records are single values (not field
+        # tuples), so the result is one array instead of a tuple of arrays
+        for kind, data in blocks:
+            if kind == "cols":
+                if data.matrix:
+                    # mixed with non-matrix blocks (rare): expand to fields
+                    mat = data.columns[0]
+                    singles.append(False)
+                    field_blocks.append(tuple(
+                        mat[:, i] for i in range(mat.shape[1])))
+                    continue
+                singles.append(data.row_type not in (tuple, list))
+                field_blocks.append(data.columns)
+            else:
+                first = data[0]
+                if isinstance(first, (tuple, list)) and not np.isscalar(first):
+                    singles.append(False)
+                    field_blocks.append(tuple(
+                        np.asarray([r[i] for r in data])
+                        for i in range(len(first))))
+                else:
+                    singles.append(True)
+                    field_blocks.append((np.asarray(data),))
+        nf = len(field_blocks[0])
+        if (any(len(fb) != nf for fb in field_blocks)
+                or any(s != singles[0] for s in singles)):
+            raise ValueError("inconsistent record shapes across feed chunks")
+        fields = tuple(
+            np.asarray(np.concatenate([fb[i] for fb in field_blocks])
+                       if len(field_blocks) > 1 else field_blocks[0][i],
+                       dtype=dtype)
+            for i in range(nf))
+        return fields[0] if singles[0] else fields
 
     @staticmethod
     def _is_empty(batch):

@@ -38,3 +38,97 @@ class Chunk:
 
     def __repr__(self):
         return f"Chunk(n={len(self.items)})"
+
+
+class PackedChunk:
+    """A record chunk transported as contiguous numpy buffers.
+
+    Pickling a Chunk of N records x F python-object fields costs O(N*F)
+    object serialization on both sides of the queue — the dominant feed
+    cost.  Packing the chunk first makes the same transfer a handful of
+    buffer copies.  Three layouts:
+
+    - field records (``row_type`` tuple/list, ``matrix`` False):
+      ``columns`` holds one [N, ...] array per record field — the
+      (image_array, label) shape.
+    - wide flat records (``row_type`` tuple/list, ``matrix`` True):
+      ``columns`` is a single [N, F] matrix (per-field arrays would mean F
+      tiny objects each way); fields share one promoted dtype.
+    - single-value records (``row_type`` None): ``columns[0]`` is the [N,
+      ...] stack.
+    """
+
+    __slots__ = ("columns", "row_type", "matrix")
+
+    def __init__(self, columns, row_type, matrix=False):
+        self.columns = columns
+        self.row_type = row_type
+        self.matrix = matrix
+
+    def __len__(self):
+        return len(self.columns[0])
+
+    def __repr__(self):
+        return (f"PackedChunk(n={len(self)}, fields={len(self.columns)}, "
+                f"matrix={self.matrix}, "
+                f"row_type={self.row_type and self.row_type.__name__})")
+
+
+# Field-record packing is per-field; past this many fields a flat scalar
+# record packs as one matrix instead (F small arrays each way would cost
+# more than they save).
+_MAX_FIELDS = 16
+
+
+def pack_records(items):
+    """Return a PackedChunk for a uniform numeric record list, or a plain
+    Chunk when the records don't pack (ragged, object-dtype, mixed types).
+
+    Packable shapes: every record a scalar/ndarray of one dtype+shape;
+    every record a same-length tuple/list of <= 16 fields each stacking to
+    a non-object array; or wide flat scalar rows, packed as one [N, F]
+    matrix (fields are promoted to a common dtype there).
+    """
+    import numpy as np
+
+    if not items:
+        return Chunk(items)
+    first = items[0]
+    try:
+        # EXACT tuple/list only: subclasses (namedtuple, pyspark Row, ...)
+        # don't reconstruct from an iterable, so they ride plain Chunks
+        if type(first) in (tuple, list):
+            row_type = type(first)
+            nf = len(first)
+            if any(type(r) is not row_type or len(r) != nf
+                   for r in items):
+                return Chunk(items)
+            if nf <= _MAX_FIELDS:
+                cols = tuple(np.asarray([r[i] for r in items])
+                             for i in range(nf))
+                if any(c.dtype == object for c in cols):
+                    return Chunk(items)
+                return PackedChunk(cols, row_type)
+            mat = np.asarray(items)
+            if mat.dtype == object or mat.ndim < 2:
+                return Chunk(items)
+            return PackedChunk((mat,), row_type, matrix=True)
+        # single-value records: require ONE exact python scalar type (so
+        # values round-trip via tolist without int->float promotion) or
+        # uniform ndarrays/np scalars (which list() restores exactly);
+        # anything else (tuple subclasses, decimals, ...) rides a Chunk
+        t0 = type(first)
+        if not (t0 in (int, float, bool)
+                or isinstance(first, (np.ndarray, np.generic))):
+            return Chunk(items)
+        if any(type(x) is not t0 for x in items):
+            return Chunk(items)
+        col = np.asarray(items)
+        if col.dtype == object:
+            return Chunk(items)
+        if t0 in (int, float, bool):
+            return PackedChunk((col,), t0)  # row_type = scalar type:
+            # materialize via tolist() -> exact python scalars back
+        return PackedChunk((col,), None)
+    except (ValueError, TypeError, OverflowError):
+        return Chunk(items)

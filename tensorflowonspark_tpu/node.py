@@ -367,19 +367,23 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
 
 
 def _push_chunks(q, iterator):
-    """Push records as Chunk batches (one queue item per CHUNK_SIZE records);
+    """Push records as chunk batches (one queue item per CHUNK_SIZE records);
     returns the record count.  Shared by the train and inference feeders —
-    inference's 1:1 result accounting depends on this count being exact."""
+    inference's 1:1 result accounting depends on this count being exact.
+    Uniform numeric chunks go as columnar PackedChunks (contiguous buffers
+    through the pickle boundary) instead of O(records x fields) python
+    objects — the throughput fix for SURVEY.md §7's "process-boundary feed
+    throughput" hard part."""
     count = 0
     chunk = []
     for item in iterator:
         chunk.append(item)
         if len(chunk) >= CHUNK_SIZE:
-            q.put(marker.Chunk(chunk))
+            q.put(marker.pack_records(chunk))
             count += len(chunk)
             chunk = []
     if chunk:
-        q.put(marker.Chunk(chunk))
+        q.put(marker.pack_records(chunk))
         count += len(chunk)
     return count
 

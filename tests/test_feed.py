@@ -129,3 +129,134 @@ def test_hdfs_path_matrix():
     ctx2.default_fs = "file://"
     assert feed.hdfs_path(ctx2, "/abs/path") == "/abs/path"
     assert feed.hdfs_path(ctx2, "rel/path") == "/tmp/wd/rel/path"
+
+
+def test_pack_records_layouts():
+    # field records -> per-field columns
+    recs = [([1.0, 2.0], 3), ([4.0, 5.0], 6)]
+    pk = marker.pack_records(recs)
+    assert isinstance(pk, marker.PackedChunk) and not pk.matrix
+    assert pk.columns[0].shape == (2, 2) and pk.columns[1].shape == (2,)
+    # wide flat rows -> one matrix
+    wide = [[float(i + j) for j in range(32)] for i in range(4)]
+    pm = marker.pack_records(wide)
+    assert isinstance(pm, marker.PackedChunk) and pm.matrix
+    assert pm.columns[0].shape == (4, 32)
+    # scalars -> single column; row_type remembers the exact python type
+    ps = marker.pack_records([1, 2, 3])
+    assert isinstance(ps, marker.PackedChunk) and ps.row_type is int
+    # ragged/object data falls back to plain Chunk
+    assert isinstance(marker.pack_records([[1, 2], [3]]), marker.Chunk)
+    assert isinstance(marker.pack_records([object(), object()]), marker.Chunk)
+    assert isinstance(marker.pack_records([]), marker.Chunk)
+
+
+def test_packed_chunk_roundtrip_next_batch():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        recs = [([1.0 * i, 2.0 * i], i) for i in range(7)]
+        q.put(marker.pack_records(recs))
+        wide = [[float(i * 100 + j) for j in range(20)] for i in range(3)]
+        q.put(marker.pack_records(wide))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        got = df.next_batch(5)          # spans only the field-record chunk
+        assert len(got) == 5
+        for g, r in zip(got, recs):
+            np.testing.assert_array_equal(np.asarray(g[0]), r[0])
+            assert g[1] == r[1]
+        rest = df.next_batch(100)       # rest of chunk 1 + matrix chunk
+        assert len(rest) == 5
+        assert rest[2:] == wide         # matrix rows come back as lists
+        assert df.should_stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_packed_chunk_numpy_fast_path():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        recs = [([1.0 * i, 2.0 * i], i) for i in range(6)]
+        q.put(marker.pack_records(recs))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        X, y = df.next_numpy_batch(4)
+        assert X.shape == (4, 2) and y.shape == (4,)
+        np.testing.assert_array_equal(y, np.arange(4))
+        X2, y2 = df.next_numpy_batch(4, dtype="float32")
+        assert X2.shape == (2, 2) and X2.dtype == np.float32
+        assert df.should_stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_packed_matrix_numpy_columns():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        wide = [[float(i * 100 + j) for j in range(20)] for i in range(5)]
+        q.put(marker.pack_records(wide[:3]))
+        q.put(marker.pack_records(wide[3:]))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        cols = df.next_numpy_batch(5)   # spans both matrix chunks
+        assert isinstance(cols, tuple) and len(cols) == 20
+        np.testing.assert_array_equal(cols[0], [0.0, 100.0, 200.0, 300.0, 400.0])
+        assert df.next_numpy_batch(1) is None  # consumes the sentinel
+        assert df.should_stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_packed_chunk_partition_break():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        q.put(marker.pack_records([1, 2, 3]))
+        q.put(marker.EndPartition())
+        q.put(marker.pack_records([4, 5]))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        assert df.next_batch(10) == [1, 2, 3]   # flushes at the boundary
+        assert df.next_batch(10) == [4, 5]
+        assert df.should_stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_pack_records_preserves_exotic_records():
+    import collections
+    NT = collections.namedtuple("NT", ["a", "b"])
+    # namedtuples don't reconstruct from a generator: must NOT pack
+    assert isinstance(marker.pack_records([NT(1, 2), NT(3, 4)]), marker.Chunk)
+    # mixed int/float scalars must not be silently promoted
+    assert isinstance(marker.pack_records([1, 2.5, 3]), marker.Chunk)
+    # homogeneous python ints round-trip as exact ints
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        q.put(marker.pack_records([7, 8, 9]))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        got = df.next_batch(10)
+        assert got == [7, 8, 9]
+        assert all(type(x) is int for x in got)
+    finally:
+        mgr.shutdown()
+
+
+def test_raw_items_coalesce_in_numpy_path():
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        for i in range(6):
+            q.put((float(i), i))    # legacy per-record puts
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        X, y = df.next_numpy_batch(6)
+        np.testing.assert_array_equal(y, np.arange(6))
+        assert X.dtype == np.float64
+    finally:
+        mgr.shutdown()
