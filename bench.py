@@ -13,7 +13,7 @@ import json
 import time
 
 
-def bench_mnist_cnn(batch_size=1024, steps=30, warmup=5):
+def bench_mnist_cnn(batch_size=1024, steps=60, warmup=10):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -37,7 +37,9 @@ def bench_mnist_cnn(batch_size=1024, steps=30, warmup=5):
     opt = optax.adam(1e-3)
     state = train_mod.TrainState(jnp.zeros((), jnp.int32), params,
                                  opt.init(params))
-    step = train_mod.make_train_step(loss_fn, opt, donate=False)
+    # donate the state: the optimizer update runs in place in HBM (~12%
+    # measured on v5e vs donate=False)
+    step = train_mod.make_train_step(loss_fn, opt, donate=True)
 
     def one_step(state):
         # include host->device transfer: the DataFeed path lands numpy
