@@ -78,35 +78,47 @@ def mnist_map_fun(args, ctx):
     df = ctx.get_data_feed(train_mode=True)
     rng = jax.random.key(ctx.process_id)
     steps = losses = 0
-    while True:
-        # bounded probe, not a blocking get: a worker stuck in q.get() while
-        # its peers sit in the gradient collective would deadlock the
-        # cluster; timing out lets it vote "dry" in the consensus below
-        recs = [] if df.should_stop() else df.next_batch(batch_size, timeout=probe)
-        # stop-consensus: ALL workers stop on the same step the first time
-        # any feed runs dry, so the sharded step's collectives never go
-        # ragged (the deadlock the reference dodges with its 90%-of-steps
-        # heuristic, examples/mnist/keras/mnist_spark.py:58-64)
-        if not train_mod.feed_consensus(bool(recs)):
-            if recs or not df.should_stop():
-                df.terminate()  # drain the dropped tail so feeders unblock
-            break
-        # repeat-pad the ragged final batch up to the fixed batch_size: the
-        # jitted step keeps ONE static shape (no tail recompiles) and every
-        # process contributes an identical local shard shape, which the
-        # multi-process put_batch requires (the reference instead *skips*
-        # 10% of steps to dodge ragged feeds — mnist_spark.py:58-64)
-        while len(recs) < batch_size:
-            recs.append(recs[-1])
-        X = np.asarray([r[0] for r in recs], "float32").reshape(-1, 28, 28, 1) / 255.0
-        y = np.asarray([r[1] for r in recs], "int64")
-        batch = mesh_mod.put_batch((jnp.asarray(X), jnp.asarray(y)), bsharding)
-        rng, sub = jax.random.split(rng)
-        state, metrics = step(state, batch, sub)
-        losses += float(metrics["loss"])
-        steps += 1
-        if model_dir and ctx.is_chief and steps % 100 == 0:
-            ckpt_mod.save_checkpoint(model_dir, state.params, steps)
+    sw = None
+    if ctx.is_chief and getattr(args, "log_dir", None):
+        from tensorflowonspark_tpu.utils.summary import SummaryWriter
+        sw = SummaryWriter(args.log_dir)  # TensorBoard scalar curves
+    try:
+        while True:
+            # bounded probe, not a blocking get: a worker stuck in q.get() while
+            # its peers sit in the gradient collective would deadlock the
+            # cluster; timing out lets it vote "dry" in the consensus below
+            recs = [] if df.should_stop() else df.next_batch(batch_size, timeout=probe)
+            # stop-consensus: ALL workers stop on the same step the first time
+            # any feed runs dry, so the sharded step's collectives never go
+            # ragged (the deadlock the reference dodges with its 90%-of-steps
+            # heuristic, examples/mnist/keras/mnist_spark.py:58-64)
+            if not train_mod.feed_consensus(bool(recs)):
+                if recs or not df.should_stop():
+                    df.terminate()  # drain the dropped tail so feeders unblock
+                break
+            # repeat-pad the ragged final batch up to the fixed batch_size: the
+            # jitted step keeps ONE static shape (no tail recompiles) and every
+            # process contributes an identical local shard shape, which the
+            # multi-process put_batch requires (the reference instead *skips*
+            # 10% of steps to dodge ragged feeds — mnist_spark.py:58-64)
+            while len(recs) < batch_size:
+                recs.append(recs[-1])
+            X = np.asarray([r[0] for r in recs], "float32").reshape(-1, 28, 28, 1) / 255.0
+            y = np.asarray([r[1] for r in recs], "int64")
+            batch = mesh_mod.put_batch((jnp.asarray(X), jnp.asarray(y)), bsharding)
+            rng, sub = jax.random.split(rng)
+            state, metrics = step(state, batch, sub)
+            losses += float(metrics["loss"])
+            steps += 1
+            if sw is not None:
+                sw.scalars({k: float(v) for k, v in metrics.items()}, steps,
+                           prefix="train/")
+            if model_dir and ctx.is_chief and steps % 100 == 0:
+                ckpt_mod.save_checkpoint(model_dir, state.params, steps)
+    finally:
+        # always flush the metric tail, even when a step raises
+        if sw is not None:
+            sw.close()
 
     if steps:
         print(f"[{ctx.job_name}:{ctx.task_index}] trained {steps} steps, "
@@ -131,6 +143,9 @@ def add_common_args(parser):
     parser.add_argument("--data_dir", default="data/mnist")
     parser.add_argument("--model_dir", default=None)
     parser.add_argument("--export_dir", default=None)
+    parser.add_argument("--log_dir", default=None,
+                        help="chief writes TensorBoard scalar curves here "
+                             "(utils.summary.SummaryWriter)")
     parser.add_argument("--feed_probe_secs", type=float, default=30,
                         help="worker feed-probe timeout before voting dry "
                              "in the stop-consensus")

@@ -145,7 +145,7 @@ def pin_platform(platform):
 
 
 def absolutize_args(args, keys=("data_dir", "model_dir", "export_dir",
-                                "output", "tfrecord_dir")):
+                                "output", "tfrecord_dir", "log_dir")):
     """Resolve path-valued args on the DRIVER: executor processes run in
     their own per-executor workdirs, so relative paths would land there
     (the reference routes paths through ctx.absolute_path/hdfs_path for the

@@ -43,9 +43,15 @@ def test_mnist_data_setup(mnist_data):
 def test_mnist_spark_trains_and_exports(mnist_data):
     out = _run("mnist/mnist_spark.py", "--cluster_size", "2",
                "--batch_size", "16", "--export_dir", "mnist_export",
-               cwd=mnist_data)
+               "--log_dir", "tb_logs", cwd=mnist_data)
     assert "training complete" in out
     assert (mnist_data / "mnist_export").exists()
+    # chief wrote TensorBoard scalar curves readable by our event reader
+    from tensorflowonspark_tpu.utils import summary as summary_mod
+    events = list((mnist_data / "tb_logs").glob("events.out.tfevents.*"))
+    assert events, "no tfevents file written"
+    scalars = summary_mod.read_scalars(str(events[0]))
+    assert any(tag == "train/loss" for _, tag, _ in scalars)
 
 
 def test_mnist_native(mnist_data):

@@ -1,0 +1,51 @@
+"""SummaryWriter: hand-encoded tfevents must round-trip through our own
+reader AND parse with TensorBoard's real event loader (ground truth)."""
+import math
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.utils import summary
+
+
+def test_scalar_roundtrip_own_reader(tmp_path):
+    with summary.SummaryWriter(tmp_path) as sw:
+        sw.scalar("train/loss", 2.5, step=0)
+        sw.scalar("train/loss", 1.25, step=1)
+        sw.scalar("lr", 1e-3, step=1)
+        sw.scalars({"loss": 0.5, "grad_norm": 3.0}, step=2, prefix="t/")
+        path = sw.path
+    got = summary.read_scalars(path)
+    assert (0, "train/loss", 2.5) in got
+    assert (1, "train/loss", 1.25) in got
+    assert any(t == "lr" and math.isclose(v, 1e-3, rel_tol=1e-6)
+               for _, t, v in got)
+    assert (2, "t/loss", 0.5) in got and (2, "t/grad_norm", 3.0) in got
+
+
+def test_events_parse_with_tensorboard_loader(tmp_path):
+    tb = pytest.importorskip("tensorboard.backend.event_processing.event_file_loader")
+    with summary.SummaryWriter(tmp_path) as sw:
+        sw.scalar("acc", 0.75, step=7)
+        sw.scalar("acc", 0.875, step=8)
+        path = sw.path
+    events = list(tb.EventFileLoader(path).Load())
+    assert events[0].file_version == "brain.Event:2"
+    # the loader migrates simple_value -> tensor proto (data_compat)
+    scalars = [(e.step, v.tag,
+                v.tensor.float_val[0] if v.tensor.float_val
+                else v.simple_value)
+               for e in events for v in e.summary.value]
+    assert (7, "acc", 0.75) in scalars
+    assert (8, "acc", 0.875) in scalars
+
+
+def test_numpy_and_jax_scalars_accepted(tmp_path):
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    with summary.SummaryWriter(tmp_path) as sw:
+        sw.scalar("np", np.float32(1.5), step=np.int64(3))
+        sw.scalar("jax", jnp.asarray(2.5), step=3)
+        path = sw.path
+    got = summary.read_scalars(path)
+    assert (3, "np", 1.5) in got and (3, "jax", 2.5) in got
