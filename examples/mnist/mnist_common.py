@@ -113,7 +113,9 @@ def mnist_map_fun(args, ctx):
             if sw is not None:
                 sw.scalars({k: float(v) for k, v in metrics.items()}, steps,
                            prefix="train/")
-            if model_dir and ctx.is_chief and steps % 100 == 0:
+            if model_dir and steps % 100 == 0:
+                # every trainer calls save (orbax coordinates multi-process
+                # writes; chief-only gating deadlocks under jax.distributed)
                 ckpt_mod.save_checkpoint(model_dir, state.params, steps)
     finally:
         # always flush the metric tail, even when a step raises
@@ -123,9 +125,9 @@ def mnist_map_fun(args, ctx):
     if steps:
         print(f"[{ctx.job_name}:{ctx.task_index}] trained {steps} steps, "
               f"mean loss {losses / steps:.4f}")
+    if model_dir:
+        ckpt_mod.save_checkpoint(model_dir, state.params, max(steps, 1))
     if ctx.is_chief:
-        if model_dir:
-            ckpt_mod.save_checkpoint(model_dir, state.params, max(steps, 1))
         if export_dir:
             export.export_saved_model(
                 export_dir, jax.device_get(state.params),
@@ -166,3 +168,82 @@ def pin_platform(platform):
         from tensorflowonspark_tpu import util
 
         util.pin_platform("cpu")
+
+
+def mnist_evaluator(args, ctx):
+    """Evaluator-role loop (reference analog: the eval_node in
+    examples/mnist/estimator/mnist_tf.py): watch model_dir for new
+    checkpoints, score them on a held-out shard, stop when the driver
+    pushes the control sentinel at shutdown (TFCluster.py:186-194)."""
+    import glob
+    import queue as queue_mod
+
+    from tensorflowonspark_tpu import util as fw_util
+
+    if getattr(args, "platform", "cpu") == "cpu":
+        fw_util.pin_platform("cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu import manager as manager_mod
+    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu.models.cnn import MnistCNN
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt_mod
+
+    paths = sorted(glob.glob(os.path.join(
+        ctx.absolute_path(args.data_dir), "tfrecords", "*.tfrecord")))
+    if not paths:
+        raise ValueError(
+            f"no tfrecords under {args.data_dir!r}/tfrecords — run "
+            f"mnist_data_setup.py first")
+    records = []
+    for ex in tfrecord.read_examples(paths[-1]):  # held-out last shard
+        # (trainers exclude this shard when an evaluator is present)
+        records.append((np.asarray(ex["image"][1], "float32"),
+                        int(ex["label"][1][0])))
+        if len(records) >= 512:
+            break
+    X = jnp.asarray(np.stack([r[0] for r in records])
+                    .reshape(-1, 28, 28, 1) / 255.0)
+    y = np.asarray([r[1] for r in records])
+
+    model = MnistCNN()
+    params0 = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    sw = None
+    if getattr(args, "log_dir", None):
+        from tensorflowonspark_tpu.utils.summary import SummaryWriter
+        sw = SummaryWriter(args.log_dir, filename_suffix=".eval")
+    last, evals, stopping = None, 0, False
+    control_q = ctx.mgr.get_queue("control")
+    try:
+        while True:
+            step_n = (ckpt_mod.latest_step(args.model_dir)
+                      if getattr(args, "model_dir", None) else None)
+            if step_n is not None and step_n != last:
+                params, _ = ckpt_mod.restore_checkpoint(
+                    args.model_dir, params0, step=step_n)
+                logits = model.apply({"params": params}, X)
+                acc = float((np.asarray(jnp.argmax(logits, -1)) == y).mean())
+                print(f"[evaluator] checkpoint step {step_n} "
+                      f"eval_acc {acc:.3f}", flush=True)
+                if sw is not None:
+                    sw.scalar("eval/accuracy", acc, step_n)
+                last, evals = step_n, evals + 1
+            if stopping:
+                break  # the loop head just scored the FINAL checkpoint
+            try:
+                item = control_q.get(timeout=1.0)
+                control_q.task_done()
+                if item is None:
+                    stopping = True  # one more pass to catch the last save
+                    continue
+            except queue_mod.Empty:
+                pass
+            if manager_mod.get_value(ctx.mgr, "state") in ("stopped",
+                                                           "terminating"):
+                stopping = True
+    finally:
+        if sw is not None:
+            sw.close()
+    print(f"[evaluator] done after {evals} evals", flush=True)

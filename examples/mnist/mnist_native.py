@@ -16,7 +16,8 @@ sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
 
 import argparse
 
-from mnist_common import absolutize_args, add_common_args, pin_platform
+from mnist_common import (absolutize_args, add_common_args, mnist_evaluator,
+                          pin_platform)
 
 from tensorflowonspark_tpu import backend, cluster, pipeline
 
@@ -24,6 +25,9 @@ from tensorflowonspark_tpu import backend, cluster, pipeline
 def map_fun(args, ctx):
     import glob
     import os
+
+    if ctx.job_name == "evaluator":
+        return mnist_evaluator(args, ctx)
 
     from tensorflowonspark_tpu import util as fw_util
 
@@ -40,11 +44,14 @@ def map_fun(args, ctx):
     from tensorflowonspark_tpu.models.mlp import cross_entropy_loss
     from tensorflowonspark_tpu.parallel import mesh as mesh_mod
     from tensorflowonspark_tpu.parallel import train as train_mod
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt_mod
 
     # deterministic shard: every worker takes files round-robin by rank
     # (maps ds.shard(num_workers, worker_index), mnist_tf_ds.py:41-50)
     paths = sorted(glob.glob(
         os.path.join(ctx.absolute_path(args.data_dir), "tfrecords", "*.tfrecord")))
+    if any(n["job_name"] == "evaluator" for n in ctx.cluster_info):
+        paths = paths[:-1]  # last shard is the evaluator's held-out set
     shard = paths[ctx.process_id::max(ctx.num_processes, 1)]
     records = []
     for path in shard:
@@ -82,17 +89,27 @@ def map_fun(args, ctx):
         if i % 20 == 0:
             print(f"[{ctx.job_name}:{ctx.task_index}] step {i} "
                   f"loss {float(metrics['loss']):.4f}")
+        if args.model_dir and (i + 1) % max(args.steps // 3, 1) == 0:
+            # periodic checkpoints feed the eval_node's watch loop.  EVERY
+            # trainer calls save: orbax coordinates the multi-process write
+            # (chief-only gating deadlocks the Gloo barrier under
+            # jax.distributed — see utils/checkpoint docstring)
+            ckpt_mod.save_checkpoint(args.model_dir, state.params, i + 1)
 
 
 def main(argv=None):
     p = add_common_args(argparse.ArgumentParser())
     p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--eval_node", action="store_true",
+                   help="dedicate the last executor to a checkpoint-watching "
+                        "evaluator (reference: eval_node=True)")
     args = absolutize_args(p.parse_args(argv))
     pin_platform(args.platform)
 
     bk = backend.LocalBackend(args.cluster_size)
     c = cluster.run(bk, map_fun, pipeline.Namespace(vars(args)), num_executors=args.cluster_size,
-                    input_mode=cluster.InputMode.NATIVE)
+                    input_mode=cluster.InputMode.NATIVE,
+                    eval_node=args.eval_node)
     c.shutdown(grace_secs=0)
     print("native-mode training complete")
 

@@ -52,10 +52,15 @@ class NodeContext:
         self.working_dir = working_dir or os.getcwd()
         self.mgr = mgr
         self.user_name = os.environ.get("USER", "user")
-        # process_id = rank in the sorted node list (chief first); the
-        # jax.distributed bootstrap identity for this node.
-        ordered = sorted(self.cluster_info,
-                         key=lambda n: (n.get("job_name") != "chief", n.get("executor_id", 0)))
+        # process_id = rank in the sorted TRAINING node list (chief first).
+        # Only chief+workers form the jax.distributed SPMD world — an
+        # evaluator joining it would deadlock the gradient collectives (it
+        # never enters the train step); like the reference's evaluator, it
+        # runs outside the cluster's collective group (TFSparkNode.py:261).
+        ordered = sorted(
+            (n for n in self.cluster_info
+             if n.get("job_name") in ("chief", "worker")),
+            key=lambda n: (n.get("job_name") != "chief", n.get("executor_id", 0)))
         self.process_id = next(
             (i for i, n in enumerate(ordered)
              if n.get("executor_id") == executor_id), 0)
@@ -85,6 +90,10 @@ class NodeContext:
         other jax API.  No-op for single-process clusters (local testing) —
         where the full mesh is already visible to the one process.
         """
+        if self.job_name not in ("chief", "worker"):
+            logger.info("%s node runs outside the training SPMD world; "
+                        "skipping jax.distributed init", self.job_name)
+            return False
         if self.num_processes <= 1 or self.coordinator_address is None:
             logger.info("single-process cluster; skipping jax.distributed init")
             return False
@@ -158,13 +167,16 @@ def _wrapper_fn_background(map_fun, tf_args, ctx, error_q_addr, authkey,
     a silent death here (OOM, SIGKILL) is what the coordinator's monitor
     exists to catch."""
     hb_client = None
-    if server_addr is not None and hb_interval > 0:
+    if server_addr is not None:
         # connect=False: the beat thread makes its own connections and
         # retries forever, so a briefly-unreachable server at node start
         # must not leave the node permanently unmonitored (the seeded
-        # monitor would flag it dead).
+        # monitor would flag it dead).  The client exists even with
+        # heartbeats disabled: BYE (normal-exit announcement) rides it, and
+        # shutdown's wait-for-trainers ordering depends on BYE arriving.
         hb_client = reservation.Client(tuple(server_addr), connect=False)
-        hb_client.start_heartbeat(ctx.executor_id, interval=hb_interval)
+        if hb_interval > 0:
+            hb_client.start_heartbeat(ctx.executor_id, interval=hb_interval)
     mgr = None
     try:
         mgr = manager.connect(error_q_addr, authkey)

@@ -247,6 +247,14 @@ class Server(MessageSocket):
             return [eid for eid, t in self._beats.items()
                     if eid not in self._finished and now - t > timeout]
 
+    def finished_ids(self):
+        """Snapshot of executor ids that announced a normal exit (BYE) —
+        the driver's signal that a node's user fn returned (the analog of
+        the reference polling Spark's statusTracker for finished worker
+        tasks, TFCluster.py:154-169)."""
+        with self._beat_lock:
+            return set(self._finished)
+
     def start_monitor(self, heartbeat_timeout, interval=None, expected=None):
         """Flag silently-dead nodes as cluster errors (net-new vs the
         reference, which only noticed errors nodes *reported*; a SIGKILLed

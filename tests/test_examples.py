@@ -121,3 +121,20 @@ def test_bert_pretrain_pipeline(tmp_path):
     assert "bert pretraining complete" in out
     assert "transform produced 16 rows" in out
     assert (tmp_path / "bert_export").exists()
+
+
+def test_mnist_native_eval_node(mnist_data):
+    # reference parity: eval_node=True dedicates an executor to a
+    # checkpoint-watching evaluator OUTSIDE the training SPMD world
+    # (reference: examples/mnist/estimator/mnist_tf.py)
+    out = _run("mnist/mnist_native.py", "--cluster_size", "3", "--eval_node",
+               "--steps", "9", "--batch_size", "8",
+               "--model_dir", "eval_ckpts", "--log_dir", "eval_tb",
+               cwd=mnist_data)
+    assert "[evaluator] checkpoint step" in out
+    assert "native-mode training complete" in out
+    from tensorflowonspark_tpu.utils import summary as summary_mod
+    events = list((mnist_data / "eval_tb").glob("*.eval"))
+    assert events, "evaluator wrote no tfevents file"
+    scalars = summary_mod.read_scalars(str(events[0]))
+    assert any(tag == "eval/accuracy" for _, tag, _ in scalars)
