@@ -21,16 +21,45 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_checkpoint(ckpt_dir, state, step, is_chief=True, keep=None):
+_async_ckptr = None
+
+
+def _async_checkpointer():
+    global _async_ckptr
+    if _async_ckptr is None:
+        import orbax.checkpoint as ocp
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _async_ckptr
+
+
+def save_checkpoint(ckpt_dir, state, step, is_chief=True, keep=None,
+                    asynchronous=False):
     """Save `state` (a pytree) under ckpt_dir/step_N.
 
     Non-chief processes no-op (single-controller semantics; under real
     multi-host jax.distributed, orbax coordinates internally and every
     process must call — pass is_chief=True on all hosts in that case).
+
+    `asynchronous=True` returns as soon as the device->host copy is done
+    and the write continues on a background thread — training resumes
+    while bytes land on disk (the multi-host async checkpointing SURVEY.md
+    §5 calls for).  Call `wait_for_saves()` before reading the checkpoint
+    back or exiting the process.
     """
     if not is_chief:
         return None
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{int(step)}")
+    if asynchronous:
+        import orbax.checkpoint as ocp
+        if keep:
+            # prune completed steps down to keep-1 BEFORE enqueueing: once
+            # this save commits, exactly `keep` checkpoints remain — the
+            # same steady state as the sync path
+            _prune(ckpt_dir, keep - 1)
+        ckptr = _async_checkpointer()
+        ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
+        logger.info("async checkpoint save started: %s", path)
+        return path
     ckptr = _checkpointer()
     ckptr.save(path, state, force=True)
     ckptr.wait_until_finished()
@@ -38,6 +67,12 @@ def save_checkpoint(ckpt_dir, state, step, is_chief=True, keep=None):
     if keep:
         _prune(ckpt_dir, keep)
     return path
+
+
+def wait_for_saves():
+    """Block until every in-flight asynchronous save has committed."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
 
 
 def restore_checkpoint(ckpt_dir, target, step=None):
@@ -67,9 +102,10 @@ def latest_step(ckpt_dir):
 
 
 def _prune(ckpt_dir, keep):
+    """Remove all but the newest `keep` completed checkpoints (0 = all)."""
     import shutil
     steps = sorted(int(m.group(1)) for d in os.listdir(ckpt_dir)
                    if (m := _STEP_DIR.match(d)))
-    for s in steps[:-keep]:
+    for s in steps[:-keep] if keep else steps:
         shutil.rmtree(os.path.join(ckpt_dir, f"step_{s}"), ignore_errors=True)
         logger.info("pruned checkpoint step_%d", s)

@@ -50,3 +50,36 @@ def test_prune_keeps_newest(tmp_path):
     assert step == 3  # still present
     with pytest.raises(Exception):
         ckpt.restore_checkpoint(d, _state(0), step=1)  # pruned
+
+
+def test_async_save_and_wait(tmp_path):
+    import numpy as np
+
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+    tree = {"w": jnp.arange(8.0), "b": jnp.ones((3,))}
+    p1 = ckpt.save_checkpoint(tmp_path, tree, 1, asynchronous=True)
+    p2 = ckpt.save_checkpoint(tmp_path, tree, 2, asynchronous=True)
+    assert p1.endswith("step_1") and p2.endswith("step_2")
+    ckpt.wait_for_saves()
+    assert ckpt.latest_step(tmp_path) == 2
+    restored, step = ckpt.restore_checkpoint(
+        tmp_path, {"w": jnp.zeros(8), "b": jnp.zeros(3)})
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8.0))
+
+
+def test_async_save_keep_retention(tmp_path):
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+    tree = {"w": jnp.ones((4,))}
+    for s in range(1, 6):
+        ckpt.save_checkpoint(tmp_path, tree, s, asynchronous=True, keep=2)
+        ckpt.wait_for_saves()
+    steps = sorted(int(d.name.split("_")[1]) for d in tmp_path.iterdir()
+                   if d.name.startswith("step_"))
+    assert steps == [4, 5]  # same steady state as the sync path
