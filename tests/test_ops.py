@@ -177,3 +177,13 @@ def test_flash_attention_grad_bf16():
         assert a.dtype == jnp.bfloat16
         np.testing.assert_allclose(a.astype(np.float32),
                                    b.astype(np.float32), atol=0.15, rtol=0.15)
+
+
+def test_flash_block_pick_avoids_padding():
+    from tensorflowonspark_tpu.ops.flash_attention import _pick_block
+    assert _pick_block(1024, 2048) == 1024   # divides: keep
+    assert _pick_block(1024, 1536) == 512    # 1024 pads 33%; 512 divides
+    assert _pick_block(1024, 768) == 768     # S <= block: one full block
+    assert _pick_block(1024, 3000) == 1024   # no divisor: keep (2.4% pad)
+    assert _pick_block(512, 64) == 64        # small sequences clamp
+    assert _pick_block(16, 1536) == 16       # explicit small block honored
