@@ -1,0 +1,107 @@
+"""Pipeline-parallel LM == sequential Transformer (exactness), and it
+trains end to end on the 8-device mesh."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu.models.pipelined import PipelinedLM
+from tensorflowonspark_tpu.models.transformer import (
+    Transformer, TransformerConfig, lm_loss)
+from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+CFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+                        d_ff=64, max_seq_len=16, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+
+
+@pytest.mark.parametrize("spec,rope", [
+    (dict(dp=2, pp=4), False),
+    (dict(dp=4, pp=2), True),
+])
+def test_pipelined_matches_sequential(tokens, spec, rope):
+    cfg = TransformerConfig(**{**CFG.__dict__, "rope": rope})
+    seq = Transformer(cfg)
+    params = seq.init(jax.random.key(0), tokens)["params"]
+    want = seq.apply({"params": params}, tokens)
+
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(**spec))
+    plm = PipelinedLM(cfg, n_stages=spec["pp"])
+    pp_params = plm.from_transformer(params)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: plm.apply(p, t, mesh))(pp_params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_pipelined_trains(tokens):
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=2, pp=4))
+    plm = PipelinedLM(CFG, n_stages=4)
+    params = plm.init(jax.random.key(1), tokens)
+
+    def loss_fn(p, toks):
+        logits = plm.apply(p, toks[:, :-1], mesh)
+        return lm_loss(logits, toks[:, 1:])
+
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, toks):
+        loss, g = jax.value_and_grad(loss_fn)(params, toks)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    with jax.set_mesh(mesh):
+        losses = []
+        for _ in range(8):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_pipelined_validation(tokens):
+    with pytest.raises(ValueError, match="divisible"):
+        PipelinedLM(CFG, n_stages=3)
+    moe = TransformerConfig(**{**CFG.__dict__, "num_experts": 2})
+    with pytest.raises(ValueError, match="num_experts"):
+        PipelinedLM(moe, n_stages=2)
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=2, pp=4))
+    plm = PipelinedLM(CFG, n_stages=4)
+    params = plm.init(jax.random.key(0), tokens)
+    with pytest.raises(ValueError, match="n_micro"):
+        with jax.set_mesh(mesh):
+            plm.apply(params, tokens[:5], mesh)  # 5 % 4 != 0
+
+
+def test_pipelined_rejects_mesh_mismatch_and_decode(tokens):
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=4, pp=2))
+    plm = PipelinedLM(CFG, n_stages=4)  # pp=2 mesh: exact multiple
+    params = plm.init(jax.random.key(0), tokens)
+    with pytest.raises(ValueError, match="pp axis"):
+        with jax.set_mesh(mesh):
+            plm.apply(params, tokens, mesh)
+    dec = TransformerConfig(**{**CFG.__dict__, "decode": True})
+    with pytest.raises(NotImplementedError, match="decode"):
+        PipelinedLM(dec, n_stages=2)
+
+
+def test_pipelined_remat_matches(tokens):
+    cfg = TransformerConfig(**{**CFG.__dict__, "remat": True})
+    seq = Transformer(cfg)
+    params = seq.init(jax.random.key(0), tokens)["params"]
+    want = seq.apply({"params": params}, tokens)
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=2, pp=4))
+    plm = PipelinedLM(cfg, n_stages=4)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: plm.apply(p, t, mesh))(
+            plm.from_transformer(params), tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5, rtol=3e-5)
