@@ -49,3 +49,16 @@ def test_connect_from_other_process(mp_ctx):
         assert item == "from-child"
     finally:
         mgr.shutdown()
+
+
+def test_connect_rejects_wrong_authkey():
+    import multiprocessing as mp
+    import uuid
+
+    mgr = manager.start(uuid.uuid4().bytes, ["input"], mode="local")
+    try:
+        # the digest handshake fails at connect() itself
+        with pytest.raises(mp.AuthenticationError):
+            manager.connect(mgr.address, uuid.uuid4().bytes)
+    finally:
+        mgr.shutdown()

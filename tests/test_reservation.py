@@ -122,3 +122,17 @@ def test_status_flag_aborts_await():
     with pytest.raises(RuntimeError, match="launch failed"):
         server.await_reservations(timeout=10, status={"error": "driver thread died"})
     server.stop()
+
+
+def test_client_connect_to_dead_server_fails_cleanly(monkeypatch):
+    import socket
+
+    from tensorflowonspark_tpu import reservation
+    monkeypatch.setattr(reservation, "CONNECT_RETRY_DELAY_SECS", 0.05)
+    # bind-then-close to get a port nothing listens on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()
+    s.close()
+    with pytest.raises(ConnectionError, match="could not reach"):
+        reservation.Client(addr)
