@@ -10,6 +10,7 @@ and the token loop is a lax.scan, so the whole generation compiles once and
 stays on-device.
 """
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,23 @@ def init_cache(model_or_cfg, batch_size):
     return decode_model, cache
 
 
+@functools.lru_cache(maxsize=32)
+def _jitted_step(decode_model):
+    """One compiled decode step per model config (cached across generate()
+    calls — linen modules hash by their config fields).  Params are an
+    ARGUMENT, not a closure constant, so repeated calls hit the jit cache
+    and sharded (e.g. Megatron-TP) params work: the compiler propagates
+    their shardings through the cache update."""
+
+    @jax.jit
+    def step(params, tokens, cache):
+        logits, mut = decode_model.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+        return logits[:, -1], mut["cache"]
+
+    return step
+
+
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
              rng=None, eos_id=None):
     """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
@@ -60,10 +78,10 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
             f"prompt {prompt.shape[1]} + max_new_tokens {max_new_tokens} "
             f"exceeds max_seq_len {cfg.max_seq_len}")
 
+    _step = _jitted_step(decode_model)
+
     def step(tokens, cache):
-        logits, mut = decode_model.apply(
-            {"params": params, "cache": cache}, tokens, mutable=["cache"])
-        return logits[:, -1], mut["cache"]
+        return _step(params, tokens, cache)
 
     def pick(logits, rng):
         if temperature > 0:

@@ -125,3 +125,24 @@ def test_decode_rejects_noncausal():
     with pytest.raises(NotImplementedError, match="causal"):
         Transformer(cfg).init(jax.random.key(0),
                               jnp.zeros((1, 1), jnp.int32))
+
+
+def test_generate_with_tp_sharded_params():
+    # distributed inference: Megatron-TP sharded weights must generate the
+    # exact same tokens as the unsharded model (the jitted decode step
+    # propagates param shardings through the cache update)
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import sharding as sharding_mod
+    cfg = TransformerConfig(**{**BASE, "n_heads": 8}, rope=True,
+                            n_kv_heads=2)
+    model = Transformer(cfg)
+    prompt = jnp.asarray([[3, 1, 4, 1], [2, 7, 1, 8]], jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    want = generate(model, params, prompt, max_new_tokens=6)
+
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=2, tp=4))
+    sh = sharding_mod.infer_param_shardings(params, mesh)
+    sharded = sharding_mod.shard_params(params, sh)
+    with jax.set_mesh(mesh):
+        got = generate(model, sharded, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
