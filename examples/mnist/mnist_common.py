@@ -87,24 +87,31 @@ def mnist_map_fun(args, ctx):
             # bounded probe, not a blocking get: a worker stuck in q.get() while
             # its peers sit in the gradient collective would deadlock the
             # cluster; timing out lets it vote "dry" in the consensus below
-            recs = [] if df.should_stop() else df.next_batch(batch_size, timeout=probe)
+            # columnar fast path: feeder-packed chunks arrive as numpy
+            # buffers and never materialize python row objects
+            cols = (None if df.should_stop()
+                    else df.next_numpy_batch(batch_size, timeout=probe))
+            got = 0 if cols is None else len(cols[0])
             # stop-consensus: ALL workers stop on the same step the first time
             # any feed runs dry, so the sharded step's collectives never go
             # ragged (the deadlock the reference dodges with its 90%-of-steps
             # heuristic, examples/mnist/keras/mnist_spark.py:58-64)
-            if not train_mod.feed_consensus(bool(recs)):
-                if recs or not df.should_stop():
+            if not train_mod.feed_consensus(got > 0):
+                if got or not df.should_stop():
                     df.terminate()  # drain the dropped tail so feeders unblock
                 break
+            X, y = cols
             # repeat-pad the ragged final batch up to the fixed batch_size: the
             # jitted step keeps ONE static shape (no tail recompiles) and every
             # process contributes an identical local shard shape, which the
             # multi-process put_batch requires (the reference instead *skips*
             # 10% of steps to dodge ragged feeds — mnist_spark.py:58-64)
-            while len(recs) < batch_size:
-                recs.append(recs[-1])
-            X = np.asarray([r[0] for r in recs], "float32").reshape(-1, 28, 28, 1) / 255.0
-            y = np.asarray([r[1] for r in recs], "int64")
+            if got < batch_size:
+                pad = batch_size - got
+                X = np.concatenate([X, np.repeat(X[-1:], pad, axis=0)])
+                y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)])
+            X = np.asarray(X, "float32").reshape(-1, 28, 28, 1) / 255.0
+            y = np.asarray(y, "int64")
             batch = mesh_mod.put_batch((jnp.asarray(X), jnp.asarray(y)), bsharding)
             rng, sub = jax.random.split(rng)
             state, metrics = step(state, batch, sub)
