@@ -59,6 +59,16 @@ def mnist_map_fun(args, ctx):
 
     model = MnistCNN()
     params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    resume_step = 0
+    if model_dir:
+        # weights-only resume (optimizer moments restart cold; checkpoint
+        # the full TrainState via utils.checkpoint for exact resumption) —
+        # the model_dir continuation the reference got from TF callbacks
+        restored, found = ckpt_mod.restore_checkpoint(model_dir, params)
+        if restored is not None:
+            params, resume_step = restored, found
+            print(f"[{ctx.job_name}:{ctx.task_index}] resumed from "
+                  f"checkpoint step {resume_step}", flush=True)
 
     def loss_fn(params, batch, rng):
         X, y = batch
@@ -77,7 +87,8 @@ def mnist_map_fun(args, ctx):
     probe = getattr(args, "feed_probe_secs", 30)
     df = ctx.get_data_feed(train_mode=True)
     rng = jax.random.key(ctx.process_id)
-    steps = losses = 0
+    steps = resume_step  # step numbering continues monotonically on resume
+    losses = trained = 0
     sw = None
     if ctx.is_chief and getattr(args, "log_dir", None):
         from tensorflowonspark_tpu.utils.summary import SummaryWriter
@@ -117,6 +128,7 @@ def mnist_map_fun(args, ctx):
             state, metrics = step(state, batch, sub)
             losses += float(metrics["loss"])
             steps += 1
+            trained += 1
             if sw is not None:
                 sw.scalars({k: float(v) for k, v in metrics.items()}, steps,
                            prefix="train/")
@@ -129,9 +141,9 @@ def mnist_map_fun(args, ctx):
         if sw is not None:
             sw.close()
 
-    if steps:
-        print(f"[{ctx.job_name}:{ctx.task_index}] trained {steps} steps, "
-              f"mean loss {losses / steps:.4f}")
+    if trained:
+        print(f"[{ctx.job_name}:{ctx.task_index}] trained {trained} steps, "
+              f"mean loss {losses / trained:.4f}")
     if model_dir:
         ckpt_mod.save_checkpoint(model_dir, state.params, max(steps, 1))
     if ctx.is_chief:

@@ -138,3 +138,13 @@ def test_mnist_native_eval_node(mnist_data):
     assert events, "evaluator wrote no tfevents file"
     scalars = summary_mod.read_scalars(str(events[0]))
     assert any(tag == "eval/accuracy" for _, tag, _ in scalars)
+
+
+def test_mnist_spark_resumes_from_checkpoint(mnist_data):
+    # first run saves a final checkpoint; the second run must restore it
+    _run("mnist/mnist_spark.py", "--cluster_size", "1", "--batch_size", "16",
+         "--model_dir", "resume_ckpts", cwd=mnist_data)
+    out = _run("mnist/mnist_spark.py", "--cluster_size", "1",
+               "--batch_size", "16", "--model_dir", "resume_ckpts",
+               cwd=mnist_data)
+    assert "resumed from checkpoint step" in out
