@@ -10,4 +10,5 @@ parallel layers, ring and Ulysses (all-to-all) attention for context
 parallelism, expert parallelism for MoE, and pipeline parallelism via
 collective permutes.
 """
-from .mesh import MeshSpec, build_mesh, local_mesh_spec  # noqa: F401
+from .mesh import (MeshSpec, build_hybrid_mesh, build_mesh,  # noqa: F401
+                   detect_num_slices, local_mesh_spec)
