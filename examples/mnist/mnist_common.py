@@ -44,6 +44,7 @@ def mnist_map_fun(args, ctx):
     import optax
 
     from tensorflowonspark_tpu import export
+    from tensorflowonspark_tpu import feed as feed_mod
     from tensorflowonspark_tpu.models.cnn import MnistCNN
     from tensorflowonspark_tpu.models.mlp import cross_entropy_loss
     from tensorflowonspark_tpu.parallel import mesh as mesh_mod
@@ -88,11 +89,15 @@ def mnist_map_fun(args, ctx):
     df = ctx.get_data_feed(train_mode=True)
     rng = jax.random.key(ctx.process_id)
     steps = resume_step  # step numbering continues monotonically on resume
-    losses = trained = 0
     sw = None
     if ctx.is_chief and getattr(args, "log_dir", None):
         from tensorflowonspark_tpu.utils.summary import SummaryWriter
         sw = SummaryWriter(args.log_dir)  # TensorBoard scalar curves
+    # device-side metric buffer: no per-step host readback (a d2h round
+    # trip per step would serialize dispatch with execution)
+    from tensorflowonspark_tpu.utils.summary import DeferredScalars
+    scalars = DeferredScalars(sink=sw, every=64, prefix="train/")
+    train_raised = False
     try:
         while True:
             # bounded probe, not a blocking get: a worker stuck in q.get() while
@@ -118,32 +123,41 @@ def mnist_map_fun(args, ctx):
             # multi-process put_batch requires (the reference instead *skips*
             # 10% of steps to dodge ragged feeds — mnist_spark.py:58-64)
             if got < batch_size:
-                pad = batch_size - got
-                X = np.concatenate([X, np.repeat(X[-1:], pad, axis=0)])
-                y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)])
+                X, y = feed_mod.pad_batch((X, y), batch_size)
             X = np.asarray(X, "float32").reshape(-1, 28, 28, 1) / 255.0
             y = np.asarray(y, "int64")
             batch = mesh_mod.put_batch((jnp.asarray(X), jnp.asarray(y)), bsharding)
             rng, sub = jax.random.split(rng)
             state, metrics = step(state, batch, sub)
-            losses += float(metrics["loss"])
             steps += 1
-            trained += 1
-            if sw is not None:
-                sw.scalars({k: float(v) for k, v in metrics.items()}, steps,
-                           prefix="train/")
+            scalars.append(metrics, steps)
             if model_dir and steps % 100 == 0:
                 # every trainer calls save (orbax coordinates multi-process
                 # writes; chief-only gating deadlocks under jax.distributed)
                 ckpt_mod.save_checkpoint(model_dir, state.params, steps)
+    except BaseException:
+        train_raised = True
+        raise
     finally:
-        # always flush the metric tail, even when a step raises
-        if sw is not None:
-            sw.close()
+        # always flush the metric tail, even when a step raises — but a
+        # failed step can poison the buffered device scalars, so don't let
+        # the flush mask the original exception or skip the writer close
+        try:
+            scalars.flush()
+        except Exception as e:
+            if not train_raised:
+                raise  # clean exit: surface the flush failure, don't
+                # silently misreport trained-step stats
+            print(f"[{ctx.job_name}:{ctx.task_index}] metric flush failed "
+                  f"({e}); keeping original exception", flush=True)
+        finally:
+            if sw is not None:
+                sw.close()
 
+    trained = scalars.count("loss")
     if trained:
         print(f"[{ctx.job_name}:{ctx.task_index}] trained {trained} steps, "
-              f"mean loss {losses / trained:.4f}")
+              f"mean loss {scalars.mean('loss'):.4f}")
     if model_dir:
         ckpt_mod.save_checkpoint(model_dir, state.params, max(steps, 1))
     if ctx.is_chief:

@@ -241,5 +241,11 @@ def put_batch(tree, sharding):
 
     if jax.process_count() <= 1:
         return jax.device_put(tree, sharding)
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            tree)
+    # pytree of shardings matching the batch structure
     return jax.tree_util.tree_map(
-        lambda x: jax.make_array_from_process_local_data(sharding, x), tree)
+        lambda x, s: jax.make_array_from_process_local_data(s, x),
+        tree, sharding)

@@ -94,10 +94,11 @@ class SummaryWriter:
 
     def scalars(self, metrics, step, prefix=""):
         """Log a dict of name -> value at one step (e.g. a train_step's
-        metrics pytree of scalars)."""
+        metrics pytree of scalars).  Flushing rides `scalar`'s
+        event-count/age policy so batched callers (DeferredScalars) don't
+        pay one file flush per step."""
         for name, value in metrics.items():
             self.scalar(prefix + name, value, step)
-        self.flush()
 
     def flush(self):
         self._writer.flush()
@@ -112,6 +113,82 @@ class SummaryWriter:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class DeferredScalars:
+    """Buffers per-step metric pytrees as *device* scalars and reads them
+    back in batches.
+
+    ``float(metrics["loss"])`` every step forces a host<->device round
+    trip per step, serializing dispatch with execution (a pipeline bubble
+    that can dwarf the step itself on high-latency links).  Appending the
+    raw device scalars instead lets the device run ahead; `flush()`
+    stacks each tag's buffered scalars into one array and performs ONE
+    readback per tag, forwarding the floats to an optional sink
+    (`SummaryWriter.scalars`-compatible) and accumulating running means.
+    """
+
+    def __init__(self, sink=None, every=64, prefix=""):
+        self._sink = sink
+        self._every = max(1, int(every))
+        self._prefix = prefix
+        self._buf = []                      # [(step, {tag: device scalar})]
+        self._totals = {}                   # tag -> (sum, count)
+
+    def append(self, metrics, step):
+        """Record one step's metrics dict WITHOUT reading back; flushes
+        automatically every `every` appends."""
+        self._buf.append((int(step), dict(metrics)))
+        if len(self._buf) >= self._every:
+            self.flush()
+
+    def flush(self):
+        """Read back all buffered scalars (one transfer per tag) and
+        forward them to the sink.  Returns [(step, {tag: float})]."""
+        if not self._buf:
+            return []
+        import numpy as np
+
+        # union of tags across entries: tags may appear late or
+        # intermittently (e.g. eval metrics every k steps)
+        tags = []
+        for _, m in self._buf:
+            for tag in m:
+                if tag not in tags:
+                    tags.append(tag)
+        cols = {}                           # tag -> iterator of floats
+        for tag in tags:
+            vals = [m[tag] for _, m in self._buf if tag in m]
+            try:
+                import jax.numpy as jnp
+                col = np.asarray(jnp.stack(vals))
+            except Exception:   # non-array values (plain floats/ints)
+                col = np.asarray(vals)
+            cols[tag] = iter([float(v) for v in col])
+        out = [(step, {tag: next(cols[tag]) for tag in tags if tag in m})
+               for step, m in self._buf]
+        # commit before side effects: a sink failure must not leave the
+        # buffer re-flushable (double-counting totals, duplicate events)
+        self._buf.clear()
+        for _, fm in out:
+            for tag, v in fm.items():
+                s, c = self._totals.get(tag, (0.0, 0))
+                self._totals[tag] = (s + v, c + 1)
+        if self._sink is not None:
+            for step, fm in out:
+                self._sink.scalars(fm, step, prefix=self._prefix)
+            if hasattr(self._sink, "flush"):
+                self._sink.flush()  # one file flush per batch, not per step
+        return out
+
+    def mean(self, tag):
+        """Running mean of a tag over everything flushed so far."""
+        s, c = self._totals.get(tag, (0.0, 0))
+        return s / c if c else float("nan")
+
+    def count(self, tag):
+        s, c = self._totals.get(tag, (0.0, 0))
+        return c
 
 
 def read_scalars(path):

@@ -260,3 +260,79 @@ def test_raw_items_coalesce_in_numpy_path():
         assert X.dtype == np.float64
     finally:
         mgr.shutdown()
+
+
+def test_device_prefetch_preserves_order_and_content():
+    import jax
+
+    batches = [(np.full((4, 2), i, np.float32), np.arange(4) + i)
+               for i in range(5)]
+    out = list(feed.device_prefetch(iter(batches), depth=2))
+    assert len(out) == 5
+    for i, (X, y) in enumerate(out):
+        assert isinstance(X, jax.Array)
+        np.testing.assert_array_equal(np.asarray(X), batches[i][0])
+        np.testing.assert_array_equal(np.asarray(y), batches[i][1])
+
+
+def test_device_prefetch_sharded_on_mesh():
+    import jax
+
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=-1))
+    sharding = mesh_mod.batch_sharding(mesh)
+    batches = [np.arange(16.0, dtype=np.float32).reshape(8, 2) * (i + 1)
+               for i in range(3)]
+    out = list(feed.device_prefetch(iter(batches), sharding=sharding))
+    assert len(out) == 3
+    assert out[0].sharding.is_equivalent_to(sharding, ndim=2)
+    np.testing.assert_array_equal(np.asarray(out[2]), batches[2])
+
+
+def test_iter_device_batches_end_to_end():
+    import jax
+
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        for i in range(10):
+            q.put((np.float32(i), i))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        seen = []
+        for batch in df.iter_device_batches(4, depth=2):
+            X, y = batch
+            assert isinstance(X, jax.Array)
+            seen.extend(np.asarray(y).tolist())
+        assert seen == list(range(10))
+        assert df.should_stop()
+    finally:
+        mgr.shutdown()
+
+
+def test_iter_device_batches_pads_ragged_tail_for_sharding():
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=-1))
+    sharding = mesh_mod.batch_sharding(mesh)
+    mgr = _mgr()
+    try:
+        q = mgr.get_queue("input")
+        for i in range(10):                # 10 records, batch 8 -> tail of 2
+            q.put((np.float32(i), i))
+        q.put(None)
+        df = feed.DataFeed(mgr)
+        out = list(df.iter_device_batches(8, sharding=sharding))
+        assert len(out) == 2
+        X, y = out[1]
+        assert X.shape[0] == 8             # tail repeat-padded to batch_size
+        assert np.asarray(y).tolist() == [8, 9, 9, 9, 9, 9, 9, 9]
+    finally:
+        mgr.shutdown()
+
+
+def test_pad_batch_shapes():
+    b = feed.pad_batch({"x": np.zeros((3, 2)), "y": np.arange(3)}, 5)
+    assert b["x"].shape == (5, 2) and b["y"].tolist() == [0, 1, 2, 2, 2]
+    assert feed.pad_batch(np.ones((4,)), 4).shape == (4,)

@@ -49,3 +49,49 @@ def test_numpy_and_jax_scalars_accepted(tmp_path):
         path = sw.path
     got = summary.read_scalars(path)
     assert (3, "np", 1.5) in got and (3, "jax", 2.5) in got
+
+
+def test_deferred_scalars_batches_readbacks(tmp_path):
+    import jax.numpy as jnp
+
+    class Sink:
+        def __init__(self):
+            self.calls = []
+
+        def scalars(self, metrics, step, prefix=""):
+            self.calls.append((step, prefix, dict(metrics)))
+
+    sink = Sink()
+    ds = summary.DeferredScalars(sink=sink, every=3, prefix="train/")
+    for i in range(7):
+        ds.append({"loss": jnp.float32(i), "grad_norm": float(10 * i)}, i + 1)
+    # every=3 -> two auto-flushes so far (6 steps), one buffered
+    assert len(sink.calls) == 6
+    ds.flush()
+    assert len(sink.calls) == 7
+    assert sink.calls[0] == (1, "train/", {"loss": 0.0, "grad_norm": 0.0})
+    assert sink.calls[6][2]["loss"] == 6.0
+    assert ds.count("loss") == 7
+    assert math.isclose(ds.mean("loss"), 3.0)
+    assert ds.flush() == []  # empty buffer is a no-op
+
+
+def test_deferred_scalars_without_sink():
+    ds = summary.DeferredScalars(every=100)
+    for i in range(4):
+        ds.append({"loss": float(i)}, i)
+    out = ds.flush()
+    assert [fm["loss"] for _, fm in out] == [0.0, 1.0, 2.0, 3.0]
+    assert ds.mean("loss") == 1.5
+
+
+def test_deferred_scalars_mixed_tags():
+    ds = summary.DeferredScalars(every=100)
+    ds.append({"loss": 1.0}, 1)
+    ds.append({"loss": 2.0, "acc": 0.5}, 2)   # late-appearing tag
+    ds.append({"loss": 3.0}, 3)               # tag goes missing again
+    out = ds.flush()
+    assert out == [(1, {"loss": 1.0}), (2, {"loss": 2.0, "acc": 0.5}),
+                   (3, {"loss": 3.0})]
+    assert ds.count("acc") == 1 and ds.mean("acc") == 0.5
+    assert ds.count("loss") == 3 and ds.mean("loss") == 2.0
