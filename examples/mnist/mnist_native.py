@@ -39,7 +39,7 @@ def map_fun(args, ctx):
     import numpy as np
     import optax
 
-    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu import data
     from tensorflowonspark_tpu.models.cnn import MnistCNN
     from tensorflowonspark_tpu.models.mlp import cross_entropy_loss
     from tensorflowonspark_tpu.parallel import mesh as mesh_mod
@@ -53,13 +53,8 @@ def map_fun(args, ctx):
     if any(n["job_name"] == "evaluator" for n in ctx.cluster_info):
         paths = paths[:-1]  # last shard is the evaluator's held-out set
     shard = paths[ctx.process_id::max(ctx.num_processes, 1)]
-    records = []
-    for path in shard:
-        for ex in tfrecord.read_examples(path):
-            records.append((np.asarray(ex["image"][1], "float32"),
-                            int(ex["label"][1][0])))
-    print(f"[{ctx.job_name}:{ctx.task_index}] {len(records)} records "
-          f"from {len(shard)} shards")
+    print(f"[{ctx.job_name}:{ctx.task_index}] reading {len(shard)} shards",
+          flush=True)
 
     model = MnistCNN()
     params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
@@ -74,16 +69,23 @@ def map_fun(args, ctx):
     step = train_mod.make_train_step(loss_fn, opt, mesh)
     bsharding = mesh_mod.batch_sharding(mesh)
 
-    rng = np.random.RandomState(ctx.process_id)
     jrng = jax.random.key(ctx.process_id)
     bs = max(args.batch_size - args.batch_size % mesh.devices.size,
              mesh.devices.size)
+
+    # the framework-owned input pipeline (tf.data analog): this process's
+    # shard files -> parse -> windowed shuffle (reseeded per epoch) ->
+    # endless epochs -> static-shape batches -> device prefetch
+    def parse(ex):
+        return (np.asarray(ex["image"][1], "float32")
+                .reshape(28, 28, 1) / 255.0,
+                np.int64(ex["label"][1][0]))
+
+    ds = (data.Dataset.from_tfrecords(shard, parse=parse)
+          .shuffle(8192, seed=ctx.process_id).repeat(None).batch(bs))
+    batches = ds.prefetch_to_device(bsharding, depth=2)
     for i in range(args.steps):
-        idx = rng.randint(0, len(records), bs)
-        X = np.stack([records[j][0] for j in idx]).reshape(-1, 28, 28, 1) / 255.0
-        y = np.asarray([records[j][1] for j in idx], "int64")
-        batch = mesh_mod.put_batch((jnp.asarray(X), jnp.asarray(y)),
-                                   bsharding)
+        batch = next(batches)
         jrng, sub = jax.random.split(jrng)
         state, metrics = step(state, batch, sub)
         if i % 20 == 0:

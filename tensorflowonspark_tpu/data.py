@@ -1,0 +1,281 @@
+"""TPU-first input pipeline: the tf.data equivalent for InputMode.NATIVE.
+
+The reference's file-fed path delegates shard/shuffle/repeat/batch/prefetch
+to ``tf.data`` inside the user map_fun (reference:
+examples/mnist/keras/mnist_tf_ds.py:41-50 — ``ds.shard(num_workers,
+worker_index).shuffle(...).batch(...)``; examples/mnist/keras/mnist_tf.py).
+This framework owns that pipeline instead: a lazy, re-iterable `Dataset`
+over TFRecord shards (or any record source) whose terminal stage hands
+device-resident, mesh-sharded batches to the jitted train step via
+`feed.device_prefetch`.
+
+Design points (TPU-first):
+- **file-granular sharding** before any IO: each process opens only its own
+  shards (`shard(n, i)`), the multi-host analog of ``ds.shard``;
+- **windowed shuffle** with a fixed-size buffer and a per-epoch seed —
+  streaming, O(buffer) memory, deterministic under a fixed seed like
+  ``tf.data.Dataset.shuffle``;
+- **static batch shapes**: `batch(..., drop_remainder=True)` is the default
+  for training so the jitted step never recompiles; the ragged tail can
+  instead be repeat-padded (`pad_tail=True`) to keep every record;
+- **device prefetch** as the terminal stage: N host->HBM transfers kept in
+  flight (max(compute, transfer) steady state, SURVEY.md §7).
+
+Example::
+
+    ds = (data.Dataset.from_tfrecords(glob_pattern)
+              .shard(ctx.num_processes, ctx.process_id)
+              .map(parse)
+              .shuffle(4096, seed=epoch)
+              .repeat(epochs)
+              .batch(512, drop_remainder=True))
+    for batch in ds.prefetch_to_device(sharding):
+        state, metrics = step(state, batch, rng)
+"""
+import glob as glob_mod
+import logging
+import random
+
+logger = logging.getLogger(__name__)
+
+
+class Dataset:
+    """Lazy, composable, re-iterable record pipeline.
+
+    Every transformation returns a NEW Dataset; iterating builds a fresh
+    generator chain, so one Dataset can be iterated many times (each
+    `repeat`/`shuffle` epoch reseeds deterministically from its base seed).
+    """
+
+    def __init__(self, source, parent=None, op=None):
+        # source: () -> iterator of records (only for root datasets)
+        self._source = source
+        self._parent = parent
+        self._op = op or (lambda it: it)
+
+    # ---------------------------------------------------------------- roots
+
+    @classmethod
+    def from_records(cls, records):
+        """Root dataset over an in-memory sequence (list of tuples/dicts)."""
+        return cls(lambda: iter(records))
+
+    @classmethod
+    def from_generator(cls, gen_fn):
+        """Root dataset over `gen_fn() -> iterator` (fresh per iteration)."""
+        return cls(gen_fn)
+
+    @classmethod
+    def from_files(cls, paths, reader):
+        """Root over files: `reader(path) -> iterator of records`.
+
+        `paths` may be a glob pattern, a list, or a directory.  File order
+        is sorted for determinism; `shard()` before iteration splits at
+        file granularity when possible.
+        """
+        ds = cls(None)
+        ds._files = _expand_paths(paths)
+        ds._reader = reader
+        ds._shard_spec = None
+        ds._source = ds._file_source
+        return ds
+
+    @classmethod
+    def from_tfrecords(cls, paths, parse=None):
+        """Root over TFRecord shards of `tf.train.Example` records.
+
+        Records arrive as `{name: (kind, values)}` dicts (tfrecord module
+        decode format); `parse` maps each decoded example (e.g. to a
+        (features, label) tuple).  Maps the reference's
+        ``TFRecordDataset -> parse_fn`` idiom (mnist_tf_ds.py:41-50).
+        """
+        from . import tfrecord
+
+        def reader(path):
+            it = tfrecord.read_examples(path)
+            return (parse(ex) for ex in it) if parse else it
+
+        return cls.from_files(paths, reader)
+
+    def _file_source(self):
+        files = self._my_files()
+        if not files:
+            raise ValueError("dataset matched no input files")
+
+        def gen():
+            for path in files:
+                yield from self._reader(path)
+        return gen()
+
+    def _my_files(self):
+        files = self._files
+        if self._shard_spec:
+            n, i = self._shard_spec
+            files = files[i::n]
+        return files
+
+    # ------------------------------------------------------------ transforms
+
+    def _chain(self, op):
+        return Dataset(None, parent=self, op=op)
+
+    def shard(self, num_shards, index):
+        """Keep 1/num_shards of the data for this process.
+
+        File-granular when called directly on a file root (shard FIRST,
+        before map/shuffle — then each process only ever opens its own
+        shard files) with at least `num_shards` files; record-granular
+        (round-robin) otherwise.  The multi-host analog of
+        ``ds.shard(num_workers, worker_index)`` (mnist_tf_ds.py:41).
+        """
+        if not 0 <= index < num_shards:
+            raise ValueError(f"shard index {index} not in [0, {num_shards})")
+        if (self._parent is None
+                and getattr(self, "_files", None) is not None
+                and self._shard_spec is None
+                and len(self._files) >= num_shards):
+            new = Dataset(None)
+            new._files = self._files
+            new._reader = self._reader
+            new._shard_spec = (num_shards, index)
+            new._source = new._file_source
+            return new
+        return self._chain(
+            lambda it: (r for j, r in enumerate(it) if j % num_shards == index))
+
+    def map(self, fn):
+        """Apply `fn` to every record."""
+        return self._chain(lambda it: (fn(r) for r in it))
+
+    def filter(self, pred):
+        """Keep records where `pred(record)` is true."""
+        return self._chain(lambda it: (r for r in it if pred(r)))
+
+    def shuffle(self, buffer_size, seed=0):
+        """Windowed shuffle with an O(buffer_size) reservoir, like
+        ``tf.data.Dataset.shuffle``: deterministic for a fixed seed, and
+        `repeat()` reseeds per epoch (seed + epoch index)."""
+        if buffer_size < 1:
+            raise ValueError("buffer_size must be >= 1")
+
+        def op(it, _epoch=0, _seed=seed, _n=buffer_size):
+            rng = random.Random(_seed * 1_000_003 + _epoch)
+            buf = []
+            for r in it:
+                buf.append(r)
+                if len(buf) >= _n:
+                    j = rng.randrange(len(buf))
+                    buf[j], buf[-1] = buf[-1], buf[j]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            yield from buf
+        return self._chain(op)
+
+    def repeat(self, epochs=None):
+        """Iterate the upstream pipeline `epochs` times (None = forever).
+        Each epoch rebuilds the chain with the epoch index threaded into
+        shuffle ops, so shuffle order differs per epoch but is reproducible."""
+
+        ds = Dataset(None, parent=self, op=None)
+        ds._repeat_epochs = epochs
+        return ds
+
+    def batch(self, batch_size, drop_remainder=True, pad_tail=False):
+        """Stack consecutive records into columnar numpy batches.
+
+        Tuples become tuples of arrays, dicts become dicts of arrays,
+        scalars one array (the `DataFeed.next_numpy_batch` conventions).
+        `drop_remainder=True` (default) keeps every batch the same shape —
+        no jit recompiles; `pad_tail=True` instead repeat-pads the final
+        short batch up to `batch_size`.
+        """
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+
+        def op(it):
+            from .feed import pad_batch
+            buf = []
+            for r in it:
+                buf.append(r)
+                if len(buf) == batch_size:
+                    yield _stack(buf)
+                    buf = []
+            if buf and not drop_remainder:
+                b = _stack(buf)
+                yield pad_batch(b, batch_size) if pad_tail else b
+            elif buf and pad_tail:
+                yield pad_batch(_stack(buf), batch_size)
+        return self._chain(op)
+
+    # ------------------------------------------------------------- terminals
+
+    def __iter__(self):
+        return self._build(epoch=0)
+
+    def _build(self, epoch):
+        if getattr(self, "_repeat_epochs", _MISSING) is not _MISSING:
+            return self._iter_repeated()
+        if self._parent is None:
+            return iter(self._source())
+        upstream = self._parent._build(epoch)
+        return iter(self._apply_op(upstream, epoch))
+
+    def _apply_op(self, upstream, epoch):
+        try:
+            return self._op(upstream, _epoch=epoch)
+        except TypeError:
+            return self._op(upstream)
+
+    def _iter_repeated(self):
+        epochs = self._repeat_epochs
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            yield from self._parent._build(epoch)
+            epoch += 1
+
+    def prefetch_to_device(self, sharding=None, depth=2):
+        """Terminal stage: device-resident batches with `depth` host->HBM
+        transfers in flight (see `feed.device_prefetch`)."""
+        from .feed import device_prefetch
+        return device_prefetch(iter(self), sharding=sharding, depth=depth)
+
+    def take(self, n):
+        """First `n` records (a terminal convenience for tests/debugging)."""
+        out = []
+        if n <= 0:
+            return out
+        for r in self:
+            out.append(r)
+            if len(out) >= n:
+                break
+        return out
+
+
+_MISSING = object()
+
+
+def _expand_paths(paths):
+    if isinstance(paths, str):
+        import os
+        if os.path.isdir(paths):
+            out = sorted(
+                p for f in os.listdir(paths)
+                if not f.startswith(("_", "."))
+                and os.path.isfile(p := os.path.join(paths, f)))
+        else:
+            out = sorted(glob_mod.glob(paths))
+        return out
+    return sorted(str(p) for p in paths)
+
+
+def _stack(records):
+    """Columnar stack following the DataFeed conventions."""
+    import numpy as np
+
+    first = records[0]
+    if isinstance(first, dict):
+        return {k: np.asarray([r[k] for r in records]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(np.asarray([r[i] for r in records])
+                     for i in range(len(first)))
+    return np.asarray(records)
