@@ -108,7 +108,7 @@ def bert_map_fun(args, ctx):
     df = ctx.get_data_feed(train_mode=True)
     rng = jax.random.key(ctx.process_id)
     steps = 0
-    last_loss = float("nan")
+    last_loss = None          # device reference only; read back once at end
     while True:
         recs = [] if df.should_stop() else df.next_batch(batch_size, timeout=probe)
         if not train_mod.feed_consensus(bool(recs)):
@@ -128,13 +128,14 @@ def bert_map_fun(args, ctx):
              jnp.asarray(targets), jnp.asarray(labels)), bsharding)
         rng, sub = jax.random.split(rng)
         state, metrics = step(state, batch, sub)
-        last_loss = float(metrics["loss"])
         steps += 1
+        last_loss = metrics["loss"]   # no per-step d2h readback
         if model_dir and ctx.is_chief and steps % 200 == 0:
             ckpt_mod.save_checkpoint(model_dir, state.params, steps)
 
+    final = float(last_loss) if last_loss is not None else float("nan")
     print(f"[{ctx.job_name}:{ctx.task_index}] bert pretrained {steps} steps, "
-          f"final loss {last_loss:.4f}")
+          f"final loss {final:.4f}")
     if ctx.is_chief:
         if model_dir:
             ckpt_mod.save_checkpoint(model_dir, state.params, max(steps, 1))

@@ -60,7 +60,9 @@ def main_fun(args, ctx):
 
     from tensorflowonspark_tpu.models.mlp import cross_entropy_loss
     from tensorflowonspark_tpu.models.resnet import ResNet56Cifar
+    from tensorflowonspark_tpu import feed as feed_mod
     from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.utils import summary as summary_mod
     from tensorflowonspark_tpu.parallel import train as train_mod
     from tensorflowonspark_tpu.utils import checkpoint as ckpt_mod
 
@@ -89,15 +91,30 @@ def main_fun(args, ctx):
              mesh.devices.size)
     rng = np.random.RandomState(task)
     jrng = jax.random.key(task)
+
+    def batch_gen():
+        # epochless uniform sampling (the reference's tf.data shuffle-repeat
+        # equivalent for this small in-memory dataset)
+        while True:
+            idx = rng.randint(0, len(images), bs)
+            yield (images[idx], labels[idx])
+
+    batches = feed_mod.device_prefetch(batch_gen(), bsharding, depth=2)
+
+    who = f"worker:{task}" if ctx else "local"
+
+    class _PrintSink:     # batched progress: one readback per flush, not
+        def scalars(self, m, step, prefix=""):   # one stall per print
+            if step % 10 == 0:
+                print(f"[{who}] step {step} loss {m['loss']:.4f}")
+
+    scalars = summary_mod.DeferredScalars(sink=_PrintSink(), every=20)
     for i in range(args.steps):
-        idx = rng.randint(0, len(images), bs)
-        batch = mesh_mod.put_batch((jnp.asarray(images[idx]),
-                                    jnp.asarray(labels[idx])), bsharding)
+        batch = next(batches)
         jrng, sub = jax.random.split(jrng)
         state, metrics = step(state, batch, sub)
-        if i % 10 == 0:
-            who = f"worker:{task}" if ctx else "local"
-            print(f"[{who}] step {i} loss {float(metrics['loss']):.4f}")
+        scalars.append(metrics, i)
+    scalars.flush()
     if args.model_dir and (ctx is None or ctx.is_chief):
         ckpt_mod.save_checkpoint(args.model_dir, state.params, args.steps)
 

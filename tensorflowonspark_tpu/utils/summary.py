@@ -134,6 +134,7 @@ class DeferredScalars:
         self._prefix = prefix
         self._buf = []                      # [(step, {tag: device scalar})]
         self._totals = {}                   # tag -> (sum, count)
+        self._last = {}                     # tag -> most recent flushed value
 
     def append(self, metrics, step):
         """Record one step's metrics dict WITHOUT reading back; flushes
@@ -174,6 +175,7 @@ class DeferredScalars:
             for tag, v in fm.items():
                 s, c = self._totals.get(tag, (0.0, 0))
                 self._totals[tag] = (s + v, c + 1)
+                self._last[tag] = v
         if self._sink is not None:
             for step, fm in out:
                 self._sink.scalars(fm, step, prefix=self._prefix)
@@ -189,6 +191,10 @@ class DeferredScalars:
     def count(self, tag):
         s, c = self._totals.get(tag, (0.0, 0))
         return c
+
+    def last(self, tag):
+        """Most recently flushed value of a tag (nan before any flush)."""
+        return self._last.get(tag, float("nan"))
 
 
 def read_scalars(path):

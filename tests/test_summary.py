@@ -95,3 +95,14 @@ def test_deferred_scalars_mixed_tags():
                    (3, {"loss": 3.0})]
     assert ds.count("acc") == 1 and ds.mean("acc") == 0.5
     assert ds.count("loss") == 3 and ds.mean("loss") == 2.0
+
+
+def test_deferred_scalars_last():
+    ds = summary.DeferredScalars(every=2)
+    assert math.isnan(ds.last("loss"))
+    ds.append({"loss": 5.0}, 1)
+    ds.append({"loss": 4.0}, 2)      # auto-flush at every=2
+    assert ds.last("loss") == 4.0
+    ds.append({"loss": 3.0}, 3)
+    ds.flush()
+    assert ds.last("loss") == 3.0
