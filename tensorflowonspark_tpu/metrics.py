@@ -1,0 +1,98 @@
+"""Evaluation metrics — jit-friendly functions + a device-side accumulator.
+
+The reference delegates metrics to Keras ``model.compile(metrics=...)``
+(reference: examples/mnist/keras/mnist_spark.py:45-49 compiles accuracy;
+the estimator examples use ``tf.metrics``).  Here the framework owns them:
+pure functions over (logits, labels) that run inside jit (so eval stays on
+the MXU/VPU, sharded like the forward pass), and `MetricAccumulator` which
+keeps running sums AS DEVICE SCALARS — accumulation composes with async
+dispatch and the final `result()` is the only host readback.
+
+All functions accept an optional boolean/0-1 `mask` (padding-aware eval,
+e.g. repeat-padded tail batches from `feed.pad_batch`: mask off the
+duplicated rows so they don't bias the metric).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _masked_mean(values, mask):
+    values = values.astype(jnp.float32)
+    if mask is None:
+        return values.mean(), values.size * jnp.ones((), jnp.float32)
+    m = mask.astype(jnp.float32).reshape(values.shape)
+    n = jnp.maximum(m.sum(), 1.0)
+    return (values * m).sum() / n, m.sum()
+
+
+def accuracy(logits, labels, mask=None):
+    """Top-1 accuracy over [..., num_classes] logits."""
+    hit = (jnp.argmax(logits, axis=-1) == labels)
+    return _masked_mean(hit, mask)[0]
+
+
+def topk_accuracy(logits, labels, k=5, mask=None):
+    """Top-k accuracy: label within the k highest logits."""
+    topk = jnp.argsort(logits, axis=-1)[..., -k:]
+    hit = (topk == labels[..., None]).any(axis=-1)
+    return _masked_mean(hit, mask)[0]
+
+
+def cross_entropy(logits, labels, mask=None):
+    """Mean softmax cross entropy with integer labels (f32 accumulators)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None],
+                               axis=-1)[..., 0]
+    return _masked_mean(logz - gold, mask)[0]
+
+
+def perplexity(logits, labels, mask=None):
+    """exp(mean token cross entropy) — LM eval."""
+    return jnp.exp(cross_entropy(logits, labels, mask))
+
+
+def mean_squared_error(pred, target, mask=None):
+    return _masked_mean((pred.astype(jnp.float32)
+                         - target.astype(jnp.float32)) ** 2, mask)[0]
+
+
+class MetricAccumulator:
+    """Running weighted means kept on device until `result()`.
+
+    Usage (inside an eval loop over batches)::
+
+        acc = MetricAccumulator()
+        for batch in ds:
+            logits = eval_step(params, batch)      # jitted
+            acc.update(n=labels.size,
+                       accuracy=metrics.accuracy(logits, labels),
+                       loss=metrics.cross_entropy(logits, labels))
+        print(acc.result())                        # ONE host readback
+
+    `update` values AND the weight `n` may be device scalars (preferred —
+    nothing syncs until `result()`) or plain numbers; `n` weights the
+    batch (defaults to 1 per update).  With masked metrics, pass the
+    VALID count as the weight so padding rows don't bias the aggregate::
+
+        n = mask.sum() if mask is not None else labels.size   # device scalar
+        acc.update(n=n, accuracy=metrics.accuracy(logits, labels, mask))
+    """
+
+    def __init__(self):
+        self._sums = {}
+        self._weights = {}
+
+    def update(self, n=1, **values):
+        for tag, v in values.items():
+            prev_s, prev_w = self._sums.get(tag), self._weights.get(tag)
+            s = v * n
+            self._sums[tag] = s if prev_s is None else prev_s + s
+            self._weights[tag] = n if prev_w is None else prev_w + n
+
+    def result(self):
+        """{tag: float} — the only device->host sync."""
+        import numpy as np
+        return {tag: float(np.asarray(s)) / float(np.asarray(self._weights[tag]))
+                for tag, s in self._sums.items()}
+

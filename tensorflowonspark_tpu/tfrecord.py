@@ -148,15 +148,31 @@ _load_native()
 # --------------------------------------------------------------------------
 
 class TFRecordWriter:
-    """Writes framed records to a file-like or path."""
+    """Writes framed records to a file-like or path.
 
-    def __init__(self, path_or_file):
+    `compression="gzip"` writes a gzip stream (the Hadoop/tf.data
+    ``TFRecordOptions(compression_type="GZIP")`` format; auto-enabled for
+    paths ending in ``.gz``) — the reader auto-detects it by magic bytes.
+    """
+
+    def __init__(self, path_or_file, compression=None):
+        if compression not in (None, "", "gzip"):
+            raise ValueError(f"unsupported compression {compression!r}")
         if hasattr(path_or_file, "write"):
-            self._f = path_or_file
+            self._raw = path_or_file
             self._own = False
         else:
-            self._f = open(path_or_file, "wb")
+            if compression is None and str(path_or_file).endswith(".gz"):
+                compression = "gzip"
+            self._raw = open(path_or_file, "wb")
             self._own = True
+        if compression == "gzip":
+            import gzip
+            self._f = gzip.GzipFile(fileobj=self._raw, mode="wb")
+            self._gz = True
+        else:
+            self._f = self._raw
+            self._gz = False
 
     def write(self, record_bytes):
         data = bytes(record_bytes)
@@ -174,10 +190,14 @@ class TFRecordWriter:
 
     def flush(self):
         self._f.flush()
+        if self._gz:
+            self._raw.flush()
 
     def close(self):
+        if self._gz:
+            self._f.close()         # writes the gzip trailer; leaves _raw open
         if self._own:
-            self._f.close()
+            self._raw.close()
 
     def __enter__(self):
         return self
@@ -186,12 +206,36 @@ class TFRecordWriter:
         self.close()
 
 
+def _is_gzip(path):
+    """True when `path` is a gzip stream and NOT a plain TFRecord.
+
+    The gzip magic (1f 8b) can collide with the little-endian uint64
+    length prefix of a plain record, so a valid plain-TFRecord header
+    (length CRC checks out — 2^-32 false-positive odds for real gzip
+    bytes) wins over the magic."""
+    with open(path, "rb") as f:
+        head = f.read(12)
+    if len(head) == 12:
+        (len_crc,) = struct.unpack("<I", head[8:12])
+        if masked_crc32c(head[:8]) == len_crc:
+            return False            # valid plain TFRecord frame header
+    return head[:2] == b"\x1f\x8b"
+
+
 def read_records(path_or_file, verify_crc=True):
     """Yield raw record payloads from a TFRecord file.
 
-    Uses the native indexer over an mmapped file when available (one pass of
-    C CRC + zero-copy slicing); falls back to the pure-Python frame parser.
+    Gzip-compressed files (tf.data GZIP / Hadoop codec) are auto-detected
+    by magic bytes and streamed through the pure-Python parser.  Plain
+    files use the native indexer over an mmapped file when available (one
+    pass of C CRC + zero-copy slicing); falls back to the pure-Python
+    frame parser.
     """
+    if not hasattr(path_or_file, "read") and _is_gzip(path_or_file):
+        import gzip
+        with gzip.open(path_or_file, "rb") as gz:
+            yield from read_records(gz, verify_crc=verify_crc)
+        return
     if _native is not None and not hasattr(path_or_file, "read"):
         size = os.path.getsize(path_or_file)
         if size == 0:
@@ -416,10 +460,11 @@ def decode_example(data):
 # Convenience: dict-of-values <-> files
 # --------------------------------------------------------------------------
 
-def write_examples(path, dicts):
-    """Write an iterable of {name: values} dicts as a TFRecord file."""
+def write_examples(path, dicts, compression=None):
+    """Write an iterable of {name: values} dicts as a TFRecord file
+    (gzip-compressed when `compression="gzip"` or the path ends in .gz)."""
     count = 0
-    with TFRecordWriter(path) as w:
+    with TFRecordWriter(path, compression=compression) as w:
         for d in dicts:
             w.write(encode_example(d))
             count += 1

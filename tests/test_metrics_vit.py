@@ -1,0 +1,158 @@
+"""ViT model family + metrics module + gzip TFRecords."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import metrics, tfrecord
+from tensorflowonspark_tpu.models.vit import ViT, ViTConfig, ViTTiny
+
+
+def test_vit_forward_and_grad():
+    model = ViTTiny(num_classes=10)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.key(0), x)["params"]
+    logits = jax.jit(lambda p, x: model.apply({"params": p}, x))(params, x)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+    def loss(p):
+        lg = model.apply({"params": p}, x)
+        return metrics.cross_entropy(lg, jnp.array([1, 2]))
+    g = jax.jit(jax.grad(loss))(params)
+    import optax
+    assert np.isfinite(float(optax.global_norm(g)))
+
+
+def test_vit_mean_pool_and_validation():
+    model = ViT(ViTConfig(image_size=16, patch_size=8, num_classes=3,
+                          d_model=32, n_heads=2, n_layers=1, d_ff=64,
+                          pool="mean"))
+    x = jnp.zeros((1, 16, 16, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    assert model.apply({"params": params}, x).shape == (1, 3)
+    assert "cls_token" not in params
+    with pytest.raises(ValueError):
+        ViTConfig(image_size=30, patch_size=16)
+    with pytest.raises(ValueError):
+        ViTConfig(pool="max")
+
+
+def test_vit_trains_on_mesh():
+    import optax
+
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=-1))
+    model = ViTTiny(num_classes=2, image_size=16, patch_size=8)
+    rs = np.random.RandomState(0)
+    # separable toy task: class = brightness
+    X = np.concatenate([rs.rand(16, 16, 16, 3) * 0.3,
+                        rs.rand(16, 16, 16, 3) * 0.3 + 0.7]).astype("float32")
+    y = np.array([0] * 16 + [1] * 16, np.int32)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)))["params"]
+
+    def loss_fn(p, batch, rng):
+        Xb, yb = batch
+        return metrics.cross_entropy(model.apply({"params": p}, Xb), yb)
+
+    opt = optax.adam(1e-3)
+    state = train_mod.create_train_state(params, opt, mesh)
+    step = train_mod.make_train_step(loss_fn, opt, mesh)
+    batch = jax.device_put((X, y), mesh_mod.batch_sharding(mesh))
+    for _ in range(30):
+        state, m = step(state, batch, jax.random.key(0))
+    logits = model.apply({"params": state.params}, X)
+    assert float(metrics.accuracy(logits, y)) > 0.9
+
+
+def test_metric_functions_against_numpy():
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(8, 5).astype("float32"))
+    labels = jnp.asarray(rs.randint(0, 5, 8))
+    acc = float(metrics.accuracy(logits, labels))
+    np_acc = (np.argmax(np.asarray(logits), -1) == np.asarray(labels)).mean()
+    assert acc == pytest.approx(np_acc)
+    assert float(metrics.topk_accuracy(logits, labels, k=5)) == 1.0
+    ce = float(metrics.cross_entropy(logits, labels))
+    lse = np.log(np.exp(np.asarray(logits)).sum(-1))
+    gold = np.asarray(logits)[np.arange(8), np.asarray(labels)]
+    assert ce == pytest.approx((lse - gold).mean(), rel=1e-5)
+    assert float(metrics.perplexity(logits, labels)) == pytest.approx(
+        np.exp((lse - gold).mean()), rel=1e-5)
+
+
+def test_metrics_mask_ignores_padding():
+    logits = jnp.asarray([[9.0, 0.0], [9.0, 0.0], [0.0, 9.0]])
+    labels = jnp.asarray([0, 0, 0])      # last row wrong...
+    mask = jnp.asarray([1, 1, 0])        # ...but masked out
+    assert float(metrics.accuracy(logits, labels, mask)) == 1.0
+    assert float(metrics.accuracy(logits, labels)) == pytest.approx(2 / 3)
+
+
+def test_metric_accumulator_weighted():
+    acc = metrics.MetricAccumulator()
+    acc.update(n=4, acc=jnp.float32(1.0), loss=jnp.float32(2.0))
+    acc.update(n=12, acc=jnp.float32(0.5), loss=0.0)
+    out = acc.result()
+    assert out["acc"] == pytest.approx((4 * 1.0 + 12 * 0.5) / 16)
+    assert out["loss"] == pytest.approx(0.5)
+
+
+def test_gzip_tfrecords_roundtrip(tmp_path):
+    recs = [{"x": [float(i)], "y": [i]} for i in range(20)]
+    plain, gz = str(tmp_path / "a.tfrecord"), str(tmp_path / "b.tfrecord.gz")
+    tfrecord.write_examples(plain, recs)
+    tfrecord.write_examples(gz, recs)               # .gz implies gzip
+    import gzip as gzip_mod
+    with open(gz, "rb") as f:
+        assert f.read(2) == b"\x1f\x8b"             # really compressed
+    got = [int(ex["y"][1][0]) for ex in tfrecord.read_examples(gz)]
+    assert got == list(range(20))
+    # explicit compression flag, no .gz suffix — reader detects by magic
+    gz2 = str(tmp_path / "c.tfrecord")
+    tfrecord.write_examples(gz2, recs, compression="gzip")
+    assert [int(e["y"][1][0]) for e in tfrecord.read_examples(gz2)] == list(range(20))
+    # plain files still take the native indexer path
+    assert [int(e["y"][1][0]) for e in tfrecord.read_examples(plain)] == list(range(20))
+    with pytest.raises(ValueError):
+        tfrecord.TFRecordWriter(str(tmp_path / "d"), compression="snappy")
+
+
+def test_gzip_dataset_pipeline(tmp_path):
+    from tensorflowonspark_tpu import data
+
+    tfrecord.write_examples(str(tmp_path / "part-0.tfrecord.gz"),
+                            [{"v": [i]} for i in range(6)])
+    ds = data.Dataset.from_tfrecords(
+        str(tmp_path), parse=lambda ex: int(ex["v"][1][0]))
+    assert sorted(ds) == list(range(6))
+
+
+def test_accumulator_masked_padding_weighted_correctly():
+    # batch1: 2 valid rows of 4 (all correct); batch2: 4 valid (half right)
+    acc = metrics.MetricAccumulator()
+    l1 = jnp.asarray([[9.0, 0], [9.0, 0], [0, 9.0], [0, 9.0]])
+    y1 = jnp.asarray([0, 0, 0, 0])
+    m1 = jnp.asarray([1, 1, 0, 0])
+    acc.update(n=m1.sum(), acc=metrics.accuracy(l1, y1, m1))  # device n
+    l2 = jnp.asarray([[9.0, 0], [9.0, 0], [0, 9.0], [0, 9.0]])
+    y2 = jnp.asarray([0, 0, 0, 0])
+    acc.update(n=4, acc=metrics.accuracy(l2, y2))
+    assert acc.result()["acc"] == pytest.approx((2 * 1.0 + 4 * 0.5) / 6)
+
+
+def test_plain_tfrecord_with_gzip_magic_length(tmp_path):
+    # a first record of exactly 35615 bytes makes the length prefix start
+    # 1f 8b — the reader must still take the plain-TFRecord path
+    path = str(tmp_path / "collide.tfrecord")
+    payload = b"z" * 0x8b1f
+    with tfrecord.TFRecordWriter(path) as w:
+        w.write(payload)
+        w.write(b"second")
+    with open(path, "rb") as f:
+        assert f.read(2) == b"\x1f\x8b"       # the collision is real
+    got = list(tfrecord.read_records(path))
+    assert got[0] == payload and got[1] == b"second"
