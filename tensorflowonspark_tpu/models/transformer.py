@@ -47,6 +47,9 @@ class TransformerConfig:
     sp_axis: Optional[str] = None  # Megatron-SP: shard residual stream's
     # sequence dim over this axis between blocks (usually "tp")
     attention_impl: str = "auto"  # auto | flash (pallas) | dense
+    use_bias: bool = False        # bias terms on qkv/out/mlp denses
+    # (True matches GPT-2-family checkpoints; see convert.py)
+    ln_eps: float = 1e-6          # layernorm epsilon (GPT-2 ckpts: 1e-5)
     decode: bool = False          # autoregressive mode: kv cache of
     # max_seq_len (narrow n_kv_heads — the GQA HBM win), incremental steps
 
@@ -88,10 +91,11 @@ class Attention(nn.Module):
             raise ValueError(
                 f"n_heads={cfg.n_heads} must be divisible by "
                 f"n_kv_heads={n_kv}")
-        q = nn.Dense(cfg.d_model, use_bias=False, name="query", dtype=dtype)(x)
-        k = nn.Dense(n_kv * head_dim, use_bias=False, name="key",
+        q = nn.Dense(cfg.d_model, use_bias=cfg.use_bias, name="query",
                      dtype=dtype)(x)
-        v = nn.Dense(n_kv * head_dim, use_bias=False, name="value",
+        k = nn.Dense(n_kv * head_dim, use_bias=cfg.use_bias, name="key",
+                     dtype=dtype)(x)
+        v = nn.Dense(n_kv * head_dim, use_bias=cfg.use_bias, name="value",
                      dtype=dtype)(x)
         B, S = x.shape[0], x.shape[1]
         q = q.reshape(B, S, cfg.n_heads, head_dim)
@@ -169,7 +173,8 @@ class Attention(nn.Module):
                 out = dot_product_attention(q, k, v, causal=cfg.causal,
                                             mask=mask)
         out = out.reshape(B, S, cfg.d_model)
-        return nn.Dense(cfg.d_model, use_bias=False, name="out", dtype=dtype)(out)
+        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias, name="out",
+                        dtype=dtype)(out)
 
     def _decode_attention(self, q, k, v, mask):
         """Incremental attention against the kv cache.
@@ -336,9 +341,11 @@ class DenseMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         dtype = jnp.dtype(self.cfg.dtype)
-        h = nn.Dense(self.cfg.d_ff, use_bias=False, name="wi", dtype=dtype)(x)
+        h = nn.Dense(self.cfg.d_ff, use_bias=self.cfg.use_bias, name="wi",
+                     dtype=dtype)(x)
         h = nn.gelu(h)
-        return nn.Dense(self.cfg.d_model, use_bias=False, name="wo", dtype=dtype)(h)
+        return nn.Dense(self.cfg.d_model, use_bias=self.cfg.use_bias,
+                        name="wo", dtype=dtype)(h)
 
 
 class MoEMLP(nn.Module):
@@ -468,10 +475,12 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None):
         x = _sp_constrain(x, self.cfg)
-        h = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x)
+        h = nn.LayerNorm(name="ln1", dtype=jnp.float32,
+                         epsilon=self.cfg.ln_eps)(x)
         x = x + Attention(self.cfg, name="attn")(h, mask=mask)
         x = _sp_constrain(x, self.cfg)
-        h = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x)
+        h = nn.LayerNorm(name="ln2", dtype=jnp.float32,
+                         epsilon=self.cfg.ln_eps)(x)
         mlp = (MoEMLP(self.cfg, name="moe") if self.use_moe
                else DenseMLP(self.cfg, name="mlp"))
         return x + mlp(h)
@@ -507,7 +516,8 @@ class Transformer(nn.Module):
             use_moe = cfg.num_experts > 0 and (
                 i % cfg.moe_every == cfg.moe_every - 1)
             x = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(x)
-        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x)
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
+                         epsilon=cfg.ln_eps)(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
                           dtype=dtype)(x)
         return logits
