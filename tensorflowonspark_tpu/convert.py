@@ -59,6 +59,135 @@ def gpt2_config(hf_cfg, **overrides):
     return TransformerConfig(**kw)
 
 
+def _finalize(params, label, n_layers):
+    """float32 master copies + a conversion log line."""
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.float32), params)
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    logger.info("converted %s (%d layers, %.1fM params)", label, n_layers,
+                n / 1e6)
+    return params
+
+
+def _linear(sd, key):
+    """HF torch.nn.Linear stores weight [out, in]; flax Dense kernel is
+    [in, out]."""
+    return {"kernel": _t(sd[key + ".weight"]).T,
+            "bias": _t(sd[key + ".bias"])}
+
+
+def bert_config(hf_cfg, **overrides):
+    """models.bert.BertConfig matching a ``transformers.BertConfig``."""
+    from .models.bert import BertConfig
+
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    act_map = {"gelu": "gelu_exact", "gelu_new": "gelu_tanh",
+               "gelu_pytorch_tanh": "gelu_tanh", "relu": "relu"}
+    if act not in act_map:
+        raise ValueError(f"unsupported hidden_act={act!r}")
+    if getattr(hf_cfg, "position_embedding_type", "absolute") != "absolute":
+        raise ValueError("only absolute position embeddings are supported")
+    kw = dict(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_heads=hf_cfg.num_attention_heads,
+        n_layers=hf_cfg.num_hidden_layers,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        type_vocab_size=hf_cfg.type_vocab_size,
+        ln_eps=hf_cfg.layer_norm_eps,
+        activation=act_map[act],
+    )
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+def from_hf_bert(model_or_path, dtype="float32", **config_overrides):
+    """Convert an HF BERT to (BertConfig, params).
+
+    Accepts ``BertModel`` or ``BertForPreTraining`` (instance or local
+    path).  Returns encoder params under the layout `models.bert.
+    BertEncoder` expects; with a BertForPreTraining input the MLM/NSP
+    head weights (`mlm_dense`, `mlm_ln`, `mlm_bias`, `pooler`,
+    `nsp_head`) are included for `models.bert.BertForPreTraining` (whose
+    encoder lives under the "encoder" scope).
+    """
+    if isinstance(model_or_path, str):
+        from transformers import AutoConfig, AutoModel, AutoModelForPreTraining
+        archs = getattr(AutoConfig.from_pretrained(model_or_path),
+                        "architectures", None) or []
+        loader = (AutoModelForPreTraining if "BertForPreTraining" in archs
+                  else AutoModel)
+        model = loader.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    sd = model.state_dict()
+    cfg = bert_config(model.config, dtype=dtype, **config_overrides)
+    # BertModel keys have no prefix; BertForPreTraining prefixes "bert."
+    kind = type(model).__name__
+    if "embeddings.word_embeddings.weight" in sd:
+        pre = ""
+    elif ("bert.embeddings.word_embeddings.weight" in sd
+          and "cls.seq_relationship.weight" in sd
+          and "cls.predictions.transform.dense.weight" in sd):
+        pre = "bert."
+    else:
+        raise ValueError(
+            f"unsupported model class {kind}: pass a BertModel (encoder) "
+            "or BertForPreTraining (encoder + MLM/NSP heads)")
+    dec = sd.get(pre and "cls.predictions.decoder.weight")
+    if dec is not None:
+        import torch as _torch
+        if not _torch.equal(dec, sd[pre + "embeddings.word_embeddings.weight"]):
+            raise ValueError(
+                "untied MLM decoder (tie_word_embeddings=False) is not "
+                "supported: models.bert ties MLM logits to the embedding")
+
+    enc = {
+        "token_embed": {"embedding":
+                        _t(sd[pre + "embeddings.word_embeddings.weight"])},
+        "pos_embed": {"embedding":
+                      _t(sd[pre + "embeddings.position_embeddings.weight"])},
+        "type_embed": {"embedding":
+                       _t(sd[pre + "embeddings.token_type_embeddings.weight"])},
+        "ln_embed": {"scale": _t(sd[pre + "embeddings.LayerNorm.weight"]),
+                     "bias": _t(sd[pre + "embeddings.LayerNorm.bias"])},
+    }
+    for i in range(cfg.n_layers):
+        lp = f"{pre}encoder.layer.{i}."
+        enc[f"layer_{i}"] = {
+            "attn": {
+                "query": _linear(sd, lp + "attention.self.query"),
+                "key": _linear(sd, lp + "attention.self.key"),
+                "value": _linear(sd, lp + "attention.self.value"),
+                "out": _linear(sd, lp + "attention.output.dense"),
+            },
+            # post-LN: ln1 follows attention, ln2 follows the MLP
+            "ln1": {"scale": _t(sd[lp + "attention.output.LayerNorm.weight"]),
+                    "bias": _t(sd[lp + "attention.output.LayerNorm.bias"])},
+            "mlp": {
+                "wi": _linear(sd, lp + "intermediate.dense"),
+                "wo": _linear(sd, lp + "output.dense"),
+            },
+            "ln2": {"scale": _t(sd[lp + "output.LayerNorm.weight"]),
+                    "bias": _t(sd[lp + "output.LayerNorm.bias"])},
+        }
+    params = enc
+    if pre:  # BertForPreTraining: heads + pooler around the encoder scope
+        params = {"encoder": enc}
+        params["mlm_dense"] = _linear(sd, "cls.predictions.transform.dense")
+        params["mlm_ln"] = {
+            "scale": _t(sd["cls.predictions.transform.LayerNorm.weight"]),
+            "bias": _t(sd["cls.predictions.transform.LayerNorm.bias"])}
+        params["mlm_bias"] = _t(sd["cls.predictions.bias"])
+        params["pooler"] = _linear(sd, "bert.pooler.dense")
+        params["nsp_head"] = _linear(sd, "cls.seq_relationship")
+    return cfg, _finalize(params, f"BERT[{kind}]", cfg.n_layers)
+
+
 def from_hf_gpt2(model_or_path, dtype="float32", **config_overrides):
     """Convert a GPT-2 LM to (TransformerConfig, params).
 
@@ -110,14 +239,6 @@ def from_hf_gpt2(model_or_path, dtype="float32", **config_overrides):
                        "bias": _t(sd[pre + "mlp.c_proj.bias"])},
             },
         }
-    import jax
-    import jax.numpy as jnp
-
     # params are float32 master copies regardless of the compute dtype;
     # cfg.dtype controls activation precision inside the model
-    params = jax.tree_util.tree_map(
-        lambda x: jnp.asarray(x, jnp.float32), params)
-    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    logger.info("converted GPT-2 (%d layers, %.1fM params)", cfg.n_layers,
-                n / 1e6)
-    return cfg, params
+    return cfg, _finalize(params, "GPT-2", cfg.n_layers)

@@ -35,6 +35,12 @@ class BertConfig:
     remat: bool = False
     attention_impl: str = "auto"
     mask_token_id: int = 103  # [MASK] in the canonical BERT vocab
+    # faithful original-BERT numerics (checkpoint-compatible with
+    # convert.from_hf_bert): post-LN blocks, biased denses, erf GELU
+    norm_style: str = "post"
+    use_bias: bool = True
+    activation: str = "gelu_exact"
+    ln_eps: float = 1e-12
 
     def block_config(self):
         """The shared transformer-block config, bidirectional."""
@@ -42,7 +48,9 @@ class BertConfig:
             vocab_size=self.vocab_size, d_model=self.d_model,
             n_heads=self.n_heads, n_layers=self.n_layers, d_ff=self.d_ff,
             max_seq_len=self.max_seq_len, causal=False, dtype=self.dtype,
-            remat=self.remat, attention_impl=self.attention_impl)
+            remat=self.remat, attention_impl=self.attention_impl,
+            norm_style=self.norm_style, use_bias=self.use_bias,
+            activation=self.activation, ln_eps=self.ln_eps)
 
 
 class BertEncoder(nn.Module):
@@ -65,12 +73,17 @@ class BertEncoder(nn.Module):
                 type_ids = jnp.zeros_like(tokens)
             x = x + nn.Embed(cfg.type_vocab_size, cfg.d_model,
                              name="type_embed", dtype=dtype)(type_ids)
-        x = nn.LayerNorm(name="ln_embed", dtype=jnp.float32)(x)
+        x = nn.LayerNorm(name="ln_embed", dtype=jnp.float32,
+                         epsilon=cfg.ln_eps)(x).astype(dtype)
         bcfg = cfg.block_config()
         block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.n_layers):
             x = block_cls(bcfg, name=f"layer_{i}")(x, mask=attention_mask)
-        return nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x), embed
+        if cfg.norm_style == "post":
+            # post-LN blocks end normalized; a final LN is a pre-LN artifact
+            return x, embed
+        return nn.LayerNorm(name="ln_f", dtype=jnp.float32,
+                            epsilon=cfg.ln_eps)(x), embed
 
 
 class BertForPreTraining(nn.Module):
@@ -87,10 +100,12 @@ class BertForPreTraining(nn.Module):
             tokens, type_ids=type_ids, attention_mask=attention_mask)
         # MLM transform: dense + gelu + LN, then decode against the tied
         # embedding table (attend = h @ E^T) with a free bias
+        from tensorflowonspark_tpu.models.transformer import _activation
         t = nn.Dense(cfg.d_model, name="mlm_dense",
                      dtype=jnp.dtype(cfg.dtype))(h)
-        t = nn.gelu(t)
-        t = nn.LayerNorm(name="mlm_ln", dtype=jnp.float32)(t)
+        t = _activation(t, cfg.activation)
+        t = nn.LayerNorm(name="mlm_ln", dtype=jnp.float32,
+                         epsilon=cfg.ln_eps)(t)
         mlm_logits = embed.attend(t.astype(embed.embedding.dtype))
         mlm_logits = mlm_logits + self.param(
             "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,))

@@ -50,6 +50,8 @@ class TransformerConfig:
     use_bias: bool = False        # bias terms on qkv/out/mlp denses
     # (True matches GPT-2-family checkpoints; see convert.py)
     ln_eps: float = 1e-6          # layernorm epsilon (GPT-2 ckpts: 1e-5)
+    norm_style: str = "pre"       # pre-LN (GPT/LLaMA) | post-LN (BERT)
+    activation: str = "gelu_tanh"  # gelu_tanh | gelu_exact | relu | silu
     decode: bool = False          # autoregressive mode: kv cache of
     # max_seq_len (narrow n_kv_heads — the GQA HBM win), incremental steps
 
@@ -335,6 +337,19 @@ def dot_product_attention(q, k, v, causal=True, mask=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _activation(x, name):
+    if name == "gelu_tanh":
+        return nn.gelu(x, approximate=True)
+    if name == "gelu_exact":
+        return nn.gelu(x, approximate=False)
+    if name == "relu":
+        return nn.relu(x)
+    if name == "silu":
+        return nn.silu(x)
+    raise ValueError(f"activation={name!r} not in "
+                     "('gelu_tanh', 'gelu_exact', 'relu', 'silu')")
+
+
 class DenseMLP(nn.Module):
     cfg: TransformerConfig
 
@@ -343,7 +358,7 @@ class DenseMLP(nn.Module):
         dtype = jnp.dtype(self.cfg.dtype)
         h = nn.Dense(self.cfg.d_ff, use_bias=self.cfg.use_bias, name="wi",
                      dtype=dtype)(x)
-        h = nn.gelu(h)
+        h = _activation(h, self.cfg.activation)
         return nn.Dense(self.cfg.d_model, use_bias=self.cfg.use_bias,
                         name="wo", dtype=dtype)(h)
 
@@ -469,21 +484,35 @@ def _sp_constrain(x, cfg):
 
 
 class Block(nn.Module):
+    """One transformer block; ``cfg.norm_style`` picks the residual form:
+    pre-LN ``x + f(ln(x))`` (GPT/LLaMA-style, the training-stable default)
+    or post-LN ``ln(x + f(x))`` (original-BERT-style, needed for faithful
+    BERT checkpoints — see convert.from_hf_bert)."""
     cfg: TransformerConfig
     use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None):
-        x = _sp_constrain(x, self.cfg)
-        h = nn.LayerNorm(name="ln1", dtype=jnp.float32,
-                         epsilon=self.cfg.ln_eps)(x)
-        x = x + Attention(self.cfg, name="attn")(h, mask=mask)
-        x = _sp_constrain(x, self.cfg)
-        h = nn.LayerNorm(name="ln2", dtype=jnp.float32,
-                         epsilon=self.cfg.ln_eps)(x)
-        mlp = (MoEMLP(self.cfg, name="moe") if self.use_moe
-               else DenseMLP(self.cfg, name="mlp"))
-        return x + mlp(h)
+        cfg = self.cfg
+        if cfg.norm_style not in ("pre", "post"):
+            raise ValueError(
+                f"norm_style={cfg.norm_style!r} not in ('pre', 'post')")
+        ln1 = nn.LayerNorm(name="ln1", dtype=jnp.float32,
+                           epsilon=cfg.ln_eps)
+        ln2 = nn.LayerNorm(name="ln2", dtype=jnp.float32,
+                           epsilon=cfg.ln_eps)
+        attn = Attention(cfg, name="attn")
+        mlp = (MoEMLP(cfg, name="moe") if self.use_moe
+               else DenseMLP(cfg, name="mlp"))
+        x = _sp_constrain(x, cfg)
+        if cfg.norm_style == "pre":
+            x = x + attn(ln1(x), mask=mask)
+            x = _sp_constrain(x, cfg)
+            return x + mlp(ln2(x))
+        dtype = jnp.dtype(cfg.dtype)
+        x = ln1(x + attn(x, mask=mask)).astype(dtype)
+        x = _sp_constrain(x, cfg)
+        return ln2(x + mlp(x)).astype(dtype)
 
 
 class Transformer(nn.Module):

@@ -105,3 +105,63 @@ def test_untied_lm_head_uses_real_projection():
     model = Transformer(c)
     got = np.asarray(model.apply({"params": params}, jnp.asarray(tokens)))
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_cfg():
+    return transformers.BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=48, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+def test_bert_encoder_matches_torch(tiny_bert_cfg):
+    from tensorflowonspark_tpu.models.bert import BertEncoder
+
+    torch.manual_seed(0)
+    hf = transformers.BertModel(tiny_bert_cfg, add_pooling_layer=False).eval()
+    cfg, params = convert.from_hf_bert(hf, attention_impl="dense",
+                                       dtype="float32")
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(0, 120, (2, 12))
+    types = rs.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens),
+                 token_type_ids=torch.tensor(types)).last_hidden_state.numpy()
+    enc = BertEncoder(cfg)
+    got, _ = enc.apply({"params": params}, jnp.asarray(tokens),
+                       type_ids=jnp.asarray(types))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_pretraining_heads_match_torch(tiny_bert_cfg):
+    from tensorflowonspark_tpu.models.bert import BertForPreTraining
+
+    torch.manual_seed(1)
+    hf = transformers.BertForPreTraining(tiny_bert_cfg).eval()
+    cfg, params = convert.from_hf_bert(hf, attention_impl="dense",
+                                       dtype="float32")
+    rs = np.random.RandomState(1)
+    tokens = rs.randint(0, 120, (2, 10))
+    with torch.no_grad():
+        out = hf(torch.tensor(tokens))
+        ref_mlm = out.prediction_logits.numpy()
+        ref_nsp = out.seq_relationship_logits.numpy()
+    model = BertForPreTraining(cfg)
+    mlm, nsp = model.apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(mlm), ref_mlm, atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(nsp), ref_nsp, atol=3e-4, rtol=3e-4)
+
+
+def test_bert_unsupported_classes_and_untied_rejected(tiny_bert_cfg):
+    mlm_only = transformers.BertForMaskedLM(tiny_bert_cfg).eval()
+    with pytest.raises(ValueError, match="unsupported model class"):
+        convert.from_hf_bert(mlm_only)
+    untied_cfg = transformers.BertConfig(
+        vocab_size=60, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        tie_word_embeddings=False)
+    untied = transformers.BertForPreTraining(untied_cfg).eval()
+    with pytest.raises(ValueError, match="untied MLM decoder"):
+        convert.from_hf_bert(untied)
