@@ -29,12 +29,13 @@ def gpt2_config(hf_cfg, **overrides):
     """TransformerConfig matching a ``transformers.GPT2Config``."""
     from .models.transformer import TransformerConfig
 
-    # the flax model hardcodes tanh-GELU and 1/sqrt(head_dim) attention
-    # scaling; refuse configs whose numerics would silently diverge
+    # refuse configs whose attention numerics would silently diverge;
+    # activations map onto the configurable MLP activation
     act = getattr(hf_cfg, "activation_function", "gelu_new")
-    if act not in ("gelu_new", "gelu_pytorch_tanh"):
-        raise ValueError(f"unsupported activation_function={act!r} "
-                         "(the model uses tanh-approximate GELU)")
+    act_map = {"gelu_new": "gelu_tanh", "gelu_pytorch_tanh": "gelu_tanh",
+               "gelu": "gelu_exact", "relu": "relu", "silu": "silu"}
+    if act not in act_map:
+        raise ValueError(f"unsupported activation_function={act!r}")
     for flag, bad in (("scale_attn_weights", False),
                       ("scale_attn_by_inverse_layer_idx", True),
                       ("reorder_and_upcast_attn", True)):
@@ -54,6 +55,7 @@ def gpt2_config(hf_cfg, **overrides):
         rope=False,                          # learned absolute positions
         use_bias=True,
         ln_eps=hf_cfg.layer_norm_epsilon,
+        activation=act_map[act],
     )
     kw.update(overrides)
     return TransformerConfig(**kw)
@@ -90,6 +92,11 @@ def bert_config(hf_cfg, **overrides):
         raise ValueError(f"unsupported hidden_act={act!r}")
     if getattr(hf_cfg, "position_embedding_type", "absolute") != "absolute":
         raise ValueError("only absolute position embeddings are supported")
+    if getattr(hf_cfg, "is_decoder", False) or getattr(
+            hf_cfg, "add_cross_attention", False):
+        raise ValueError("decoder-style BERT (is_decoder/add_cross_attention)"
+                         " is not supported: models.bert is a bidirectional"
+                         " encoder with no cross-attention")
     kw = dict(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,

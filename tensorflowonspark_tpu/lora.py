@@ -1,0 +1,124 @@
+"""LoRA — low-rank adaptation for parameter-efficient fine-tuning.
+
+Pairs with `convert` (import a GPT-2/BERT checkpoint, then fine-tune
+adapters only): instead of touching the model definition, LoRA here is a
+functional transform over the param tree —
+
+    adapters = lora.init(rng, params, rank=8)          # A/B per target kernel
+    tuned = lora.merge(params, adapters, scale=1.0)    # W + scale·A@B
+    logits = model.apply({"params": tuned}, tokens)
+
+Training freezes the base params by construction — they are a captured
+constant, not an argument, so differentiating the wrapped loss w.r.t. the
+adapter tree is all it takes (no stop_gradient bookkeeping).  Adapters
+are `rank*(d_in+d_out)` per `d_in*d_out` kernel.  This composes with
+every framework feature unchanged:
+the merged tree has the SAME structure as `params`, so sharding rules,
+checkpointing, export, and `models.decode.generate` all apply.
+
+TPU notes: `merge` is two skinny matmuls + an add per target — negligible
+next to a forward pass and fully fusable by XLA; merged once per step
+under jit, not per layer-call.
+"""
+import logging
+import re
+
+logger = logging.getLogger(__name__)
+
+# kernels adapted by default: attention projections (the standard LoRA
+# placement) — match path segments like "attn/query/kernel"
+DEFAULT_TARGETS = r"(query|key|value|out)/kernel$"
+
+
+def _flatten(params):
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(getattr(p, "key", str(getattr(p, "idx", p)))
+                       for p in path)
+        out[key] = leaf
+    return out, treedef
+
+
+def target_paths(params, targets=DEFAULT_TARGETS):
+    """Paths (slash-joined) of the kernels a pattern selects."""
+    flat, _ = _flatten(params)
+    pat = re.compile(targets)
+    return [k for k, v in flat.items()
+            if pat.search(k) and getattr(v, "ndim", 0) == 2]
+
+
+def init(rng, params, rank=8, targets=DEFAULT_TARGETS):
+    """Build the adapter tree: {path: {"a": [in, r], "b": [r, out]}}.
+
+    `a` is gaussian-initialized, `b` zeros — so the merged model starts
+    EXACTLY at the base model (standard LoRA init).  The tree contains
+    only float arrays, so it IS the trainable pytree (differentiate and
+    optimize it directly); the usual alpha/rank factor is the `scale`
+    argument of `merge`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    flat, _ = _flatten(params)
+    pat = re.compile(targets)
+    paths = [k for k, v in flat.items()
+             if pat.search(k) and getattr(v, "ndim", 0) == 2]
+    if not paths:
+        raise ValueError(f"no 2-D kernels match targets={targets!r}")
+    adapters = {}
+    keys = jax.random.split(rng, len(paths))
+    for key, path in zip(keys, paths):
+        w = flat[path]
+        d_in, d_out = w.shape
+        adapters[path] = {
+            "a": (jax.random.normal(key, (d_in, rank), jnp.float32)
+                  * (1.0 / rank)),
+            "b": jnp.zeros((rank, d_out), jnp.float32),
+        }
+    logger.info("LoRA: rank=%d adapters on %d kernels (%.2fM trainable)",
+                rank, len(paths),
+                sum(a["a"].size + a["b"].size
+                    for a in adapters.values()) / 1e6)
+    return adapters
+
+
+def merge(params, adapters, scale=1.0):
+    """Return params with `W + scale * A @ B` on every adapted kernel —
+    same tree structure as `params` (jit/vjp-friendly).  `scale` is the
+    usual LoRA alpha/rank factor."""
+    import jax
+    import jax.numpy as jnp
+
+    flat, treedef = _flatten(params)
+    unused = set(adapters) - set(flat)
+    if unused:
+        raise ValueError(
+            "adapter paths not found in params (trained on a different "
+            f"tree/scope?): {sorted(unused)[:4]}...")
+    leaves = []
+    for key in flat:
+        w = flat[key]
+        ad = adapters.get(key)
+        if ad is None:
+            leaves.append(w)
+        else:
+            delta = (ad["a"] @ ad["b"]) * scale
+            leaves.append((w.astype(jnp.float32) + delta).astype(w.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def make_lora_loss(loss_fn, base_params, scale=1.0):
+    """Wrap `loss_fn(params, batch, rng)` into
+    `lora_loss(adapters, batch, rng)` that differentiates only the
+    adapters (base params are captured, not arguments — so
+    `parallel.train.make_train_step(lora_loss, opt)` trains adapters
+    only, with optimizer state sized to the adapters)."""
+    def lora_loss(adapters, batch, rng):
+        return loss_fn(merge(base_params, adapters, scale), batch, rng)
+    return lora_loss
+
+
+def num_trainable(adapters):
+    return sum(a["a"].size + a["b"].size for a in adapters.values())

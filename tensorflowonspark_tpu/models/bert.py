@@ -19,7 +19,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from tensorflowonspark_tpu.models.transformer import (
-    Block, TransformerConfig, lm_loss)
+    Block, TransformerConfig, _activation, lm_loss)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,7 +100,6 @@ class BertForPreTraining(nn.Module):
             tokens, type_ids=type_ids, attention_mask=attention_mask)
         # MLM transform: dense + gelu + LN, then decode against the tied
         # embedding table (attend = h @ E^T) with a free bias
-        from tensorflowonspark_tpu.models.transformer import _activation
         t = nn.Dense(cfg.d_model, name="mlm_dense",
                      dtype=jnp.dtype(cfg.dtype))(h)
         t = _activation(t, cfg.activation)

@@ -78,9 +78,12 @@ def test_converted_model_generates(tiny_gpt2):
 
 def test_unsupported_configs_rejected(tiny_gpt2):
     bad = transformers.GPT2Config(
-        vocab_size=97, n_embd=32, n_layer=1, n_head=4,
-        activation_function="relu")
-    model = transformers.GPT2LMHeadModel(bad).eval()
+        vocab_size=97, n_embd=32, n_layer=1, n_head=4)
+    bad.activation_function = "tanh"        # not a supported MLP activation
+    model = transformers.GPT2LMHeadModel(
+        transformers.GPT2Config(vocab_size=97, n_embd=32, n_layer=1,
+                                n_head=4)).eval()
+    model.config.activation_function = "tanh"
     with pytest.raises(ValueError, match="activation_function"):
         convert.from_hf_gpt2(model)
     bad2 = transformers.GPT2Config(
@@ -165,3 +168,30 @@ def test_bert_unsupported_classes_and_untied_rejected(tiny_bert_cfg):
     untied = transformers.BertForPreTraining(untied_cfg).eval()
     with pytest.raises(ValueError, match="untied MLM decoder"):
         convert.from_hf_bert(untied)
+
+
+def test_decoder_style_bert_rejected():
+    cfg = transformers.BertConfig(
+        vocab_size=60, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32, is_decoder=True,
+        add_cross_attention=True)
+    model = transformers.BertModel(cfg).eval()
+    with pytest.raises(ValueError, match="decoder-style BERT"):
+        convert.from_hf_bert(model)
+
+
+def test_gpt2_erf_gelu_maps_to_exact(tiny_gpt2):
+    cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=32, n_embd=32, n_layer=1, n_head=4,
+        activation_function="gelu", resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0)
+    torch.manual_seed(2)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    c, params = convert.from_hf_gpt2(hf, attention_impl="dense")
+    assert c.activation == "gelu_exact"
+    tokens = np.random.RandomState(4).randint(0, 97, (1, 8))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(Transformer(c).apply({"params": params},
+                                          jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)

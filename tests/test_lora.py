@@ -1,0 +1,119 @@
+"""LoRA fine-tuning over imported/base models."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu import lora
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig,
+                                                      lm_loss)
+
+
+@pytest.fixture(scope="module")
+def base():
+    cfg = TransformerConfig(vocab_size=61, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq_len=32,
+                            dtype="float32", attention_impl="dense")
+    model = Transformer(cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    return cfg, model, params
+
+
+def test_init_targets_attention_kernels(base):
+    _, _, params = base
+    adapters = lora.init(jax.random.key(1), params, rank=4)
+    # 2 layers x (query, key, value, out)
+    assert len(adapters) == 8
+    assert all("attn" in k for k in adapters)
+    a = next(iter(adapters.values()))
+    assert a["a"].shape[1] == 4 and a["b"].shape[0] == 4
+    assert lora.num_trainable(adapters) == sum(
+        x["a"].size + x["b"].size for x in adapters.values())
+    with pytest.raises(ValueError):
+        lora.init(jax.random.key(1), params, targets="nonexistent/kernel$")
+
+
+def test_zero_b_starts_at_base_model(base):
+    _, model, params = base
+    adapters = lora.init(jax.random.key(1), params, rank=4)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 61, (2, 16)))
+    ref = model.apply({"params": params}, tokens)
+    got = model.apply({"params": lora.merge(params, adapters)}, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+def test_custom_targets_mlp(base):
+    _, _, params = base
+    adapters = lora.init(jax.random.key(1), params, rank=2,
+                         targets=r"mlp/(wi|wo)/kernel$")
+    assert len(adapters) == 4
+    assert all("mlp" in k for k in adapters)
+
+
+def test_lora_training_moves_only_adapters(base):
+    cfg, model, params = base
+
+    def loss_fn(p, batch, rng):
+        return lm_loss(model.apply({"params": p}, batch[:, :-1]),
+                       batch[:, 1:])
+
+    adapters = lora.init(jax.random.key(1), params, rank=4)
+    lora_loss = lora.make_lora_loss(loss_fn, params, scale=2.0)
+    opt = optax.adam(1e-2)
+
+    from tensorflowonspark_tpu.parallel import train as train_mod
+    state = train_mod.create_train_state(adapters, opt)
+    step = train_mod.make_train_step(lora_loss, opt, donate=False)
+    batch = jnp.asarray(np.random.RandomState(1).randint(0, 61, (4, 17)))
+    losses = []
+    for i in range(12):
+        state, m = step(state, batch, jax.random.key(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    # b matrices moved away from zero; base params untouched by design
+    moved = jax.tree_util.tree_map(
+        lambda x: float(jnp.abs(x).max()), state.params)
+    assert any(v["b"] > 0 for v in moved.values())
+    # the tuned model differs from base but shares the tree structure
+    tuned = lora.merge(params, state.params, scale=2.0)
+    assert (jax.tree_util.tree_structure(tuned)
+            == jax.tree_util.tree_structure(params))
+
+
+def test_lora_on_converted_gpt2():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from tensorflowonspark_tpu import convert
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=67, n_positions=32, n_embd=16, n_layer=1, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    cfg, params = convert.from_hf_gpt2(
+        transformers.GPT2LMHeadModel(hf_cfg).eval(),
+        attention_impl="dense")
+    model = Transformer(cfg)
+    adapters = lora.init(jax.random.key(0), params, rank=2)
+
+    def loss_fn(p, batch, rng):
+        return lm_loss(model.apply({"params": p}, batch[:, :-1]),
+                       batch[:, 1:])
+
+    lora_loss = lora.make_lora_loss(loss_fn, params)
+    g = jax.jit(jax.grad(lora_loss))(
+        adapters, jnp.asarray(np.random.RandomState(0).randint(0, 67, (2, 9))),
+        jax.random.key(0))
+    assert np.isfinite(float(optax.global_norm(g)))
+
+
+def test_merge_rejects_mismatched_adapter_paths(base):
+    _, _, params = base
+    adapters = lora.init(jax.random.key(1), params, rank=2)
+    wrong_scope = {"encoder/" + k: v for k, v in adapters.items()}
+    with pytest.raises(ValueError, match="adapter paths not found"):
+        lora.merge(params, wrong_scope)
