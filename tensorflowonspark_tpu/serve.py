@@ -1,0 +1,169 @@
+"""Online inference server over an exported model.
+
+Complements the batch CLI (`tensorflowonspark_tpu.inference`, the
+Inference.scala analog) with a long-lived HTTP endpoint — the online half
+of the serving story the reference delegated to external TF Serving.
+Stdlib-only (http.server), TF-Serving-compatible request shape:
+
+    python -m tensorflowonspark_tpu.serve --export_dir /models/m --port 8501
+
+    POST /v1/models/default:predict   {"instances": [{"x": [...]}, ...]}
+        -> {"predictions": [{"y": [...]}, ...]}
+    GET  /v1/models/default           -> model/engine metadata + health
+
+Engine selection mirrors the batch CLI: the AOT artifact (native PJRT
+runner where available) when the export carries one, else the rebuilt
+jitted model.  Requests batch within themselves; the device is guarded by
+a lock so concurrent requests serialize instead of interleaving
+executions.
+"""
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger(__name__)
+
+
+def build_argparser():
+    p = argparse.ArgumentParser(
+        prog="tensorflowonspark_tpu.serve",
+        description="online inference HTTP server over an exported model")
+    p.add_argument("--export_dir", required=True)
+    p.add_argument("--model_name", default="default",
+                   help="name served under /v1/models/<name>")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8501)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--signature_def_key", default=None)
+    p.add_argument("--input_mapping", default=None)
+    p.add_argument("--output_mapping", default=None)
+    p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
+                   default="auto")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def _instances_to_columns(instances):
+    """[{feature: value}, ...] -> ({feature: [values]}, n)."""
+    if not isinstance(instances, list) or not instances:
+        raise ValueError('"instances" must be a non-empty list')
+    first = instances[0]
+    if not isinstance(first, dict):
+        raise ValueError("each instance must be a {feature: value} object")
+    cols = {k: [] for k in first}
+    for i, inst in enumerate(instances):
+        if set(inst) != set(cols):
+            raise ValueError(f"instance {i} features {sorted(inst)} differ "
+                             f"from instance 0 {sorted(cols)}")
+        for k, v in inst.items():
+            cols[k].append(v)
+    return cols, len(instances)
+
+
+def _rows_from_outputs(outputs, n):
+    """{out_col: array-like [n, ...]} -> [{out_col: value}, ...]."""
+    import numpy as np
+
+    listed = {name: np.asarray(col).tolist() for name, col in outputs.items()}
+    return [{name: listed[name][i] for name in listed} for i in range(n)]
+
+
+class ModelService:
+    """Loads the predictor once; thread-safe predict over JSON instances."""
+
+    def __init__(self, args):
+        from . import inference
+
+        self._predict_rows, self.desc = inference._load_predictor(args)
+        self._lock = threading.Lock()
+        self.export_dir = args.export_dir
+        self.model_name = getattr(args, "model_name", "default")
+        self.requests = 0
+
+    def predict(self, instances):
+        cols, n = _instances_to_columns(instances)
+        with self._lock:   # one device: serialize executions
+            outputs = self._predict_rows(cols, n)
+            self.requests += 1
+        return _rows_from_outputs(outputs, n)
+
+    def metadata(self):
+        return {"model": {"export_dir": self.export_dir,
+                          "engine": self.desc,
+                          "requests_served": self.requests},
+                "status": "ok"}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service = None   # injected by make_server
+
+    def _send(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        name = self.service.model_name
+        if self.path.rstrip("/").endswith(f"/v1/models/{name}") or \
+                self.path in ("/healthz", "/"):
+            self._send(200, self.service.metadata())
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path != f"/v1/models/{self.service.model_name}:predict":
+            self._send(404, {"error": f"unknown path {self.path} (serving "
+                             f"model {self.service.model_name!r})"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object with "
+                                 '"instances"')
+            preds = self.service.predict(req.get("instances"))
+            self._send(200, {"predictions": preds})
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            # malformed client input in any shape -> 400
+            self._send(400, {"error": str(e) or type(e).__name__})
+        except Exception as e:   # keep the server alive on model errors
+            logger.exception("predict failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def log_message(self, fmt, *args):
+        logger.debug("http: " + fmt, *args)
+
+
+def make_server(args):
+    """Build (server, service); caller runs serve_forever()."""
+    service = ModelService(args)
+    handler = type("BoundHandler", (_Handler,), {"service": service})
+    server = ThreadingHTTPServer((args.host, args.port), handler)
+    return server, service
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(message)s")
+    server, service = make_server(args)
+    host, port = server.server_address[:2]
+    logger.info("serving %s (%s) on http://%s:%d", args.export_dir,
+                service.desc, host, port)
+    print(f"serving on http://{host}:{port} ({service.desc})", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+
+
+if __name__ == "__main__":
+    main()

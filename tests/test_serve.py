@@ -1,0 +1,101 @@
+"""Online inference server: real HTTP round trips against a live server."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tensorflowonspark_tpu import export, serve
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve")
+    from tensorflowonspark_tpu.models.linear import Linear
+
+    params = Linear(features=1).init(
+        jax.random.key(0), np.zeros((1, 2), "float32"))["params"]
+    export.export_saved_model(
+        str(tmp / "m"), params,
+        builder="tensorflowonspark_tpu.models.linear:Linear",
+        builder_kwargs={"features": 1},
+        signatures={"serving_default": {
+            "inputs": {"x": {"shape": [2], "dtype": "float32"}},
+            "outputs": ["y"]}})
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp / "m"), "--port", "0"])
+    srv, service = serve.make_server(args)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    host, port = srv.server_address[:2]
+    yield f"http://{host}:{port}", params
+    srv.shutdown()
+    srv.server_close()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_predict_round_trip(server):
+    base, params = server
+    out = _post(base + "/v1/models/default:predict",
+                {"instances": [{"x": [1.0, 2.0]}, {"x": [3.0, 4.0]}]})
+    preds = out["predictions"]
+    assert len(preds) == 2
+    w = np.asarray(params["dense"]["kernel"]).reshape(2)
+    b = float(np.asarray(params["dense"]["bias"]).reshape(()))
+    expect = np.array([1.0 * w[0] + 2.0 * w[1] + b,
+                       3.0 * w[0] + 4.0 * w[1] + b])
+    got = np.array([p["y"] for p in preds]).reshape(2)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_metadata_and_health(server):
+    base, _ = server
+    with urllib.request.urlopen(base + "/v1/models/default", timeout=30) as r:
+        meta = json.loads(r.read())
+    assert meta["status"] == "ok"
+    assert meta["model"]["requests_served"] >= 0
+    with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_bad_requests_get_400_server_stays_up(server):
+    base, _ = server
+    for payload in ({"instances": []},
+                    {"instances": [{"x": [1.0, 2.0]}, {"z": [1.0]}]},
+                    {}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/v1/models/default:predict", payload)
+        assert e.value.code == 400
+        body = json.loads(e.value.read())
+        assert "error" in body
+    # server still serves after errors
+    out = _post(base + "/v1/models/default:predict",
+                {"instances": [{"x": [0.0, 0.0]}]})
+    assert len(out["predictions"]) == 1
+
+
+def test_unknown_paths_404(server):
+    base, _ = server
+    for path in ("/v1/models/other:explain", "/v1/models/resnet:predict"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + path, {"instances": [{"x": [0.0, 0.0]}]})
+        assert e.value.code == 404
+
+
+def test_non_object_bodies_get_400(server):
+    base, _ = server
+    for payload in ([1, 2], "x", {"instances": [{"x": [1.0, 2.0]}, 2.0]}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/v1/models/default:predict", payload)
+        assert e.value.code == 400
