@@ -1,0 +1,97 @@
+"""Weight-only int8 quantization for serving."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import quantize
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_seq_len=32,
+                            dtype="float32", attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 16), jnp.int32))
+    return model, params["params"]
+
+
+def test_roundtrip_error_bounded(lm):
+    _, params = lm
+    qtree = quantize.quantize_tree(params, min_elements=64)
+    # symmetric int8: error <= scale/2 <= max|w|/254 per channel
+    err = quantize.max_abs_error(params, qtree)
+    worst_w = max(float(jnp.max(jnp.abs(x)))
+                  for x in jax.tree_util.tree_leaves(params))
+    assert err <= worst_w / 254 + 1e-6
+
+
+def test_structure_and_size(lm):
+    _, params = lm
+    qtree = quantize.quantize_tree(params, min_elements=64)
+    qb, fb = quantize.quantized_bytes(qtree)
+    assert qb < fb / 3.5                     # ~4x smaller
+    # embeddings (2-D, name 'embedding') pass through by default targets
+    assert hasattr(qtree["token_embed"]["embedding"], "dtype")
+    assert qtree["layer_0"]["attn"]["query"]["kernel"]["q"].dtype == jnp.int8
+    # layernorm scales untouched
+    assert hasattr(qtree["ln_f"]["scale"], "dtype")
+
+
+def test_quantized_model_close_and_jittable(lm):
+    model, params = lm
+    qtree = quantize.quantize_tree(params, min_elements=64)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
+    ref = model.apply({"params": params}, tokens)
+
+    @jax.jit
+    def qforward(qtree, tokens):
+        return model.apply({"params": quantize.dequantize_tree(qtree)},
+                           tokens)
+
+    got = qforward(qtree, tokens)
+    # rank agreement on the argmax plus small numeric drift
+    assert (jnp.argmax(got, -1) == jnp.argmax(ref, -1)).mean() > 0.9
+    rel = float(jnp.max(jnp.abs(got - ref)) / (jnp.max(jnp.abs(ref)) + 1e-9))
+    assert rel < 0.1
+
+
+def test_checkpoint_roundtrip(tmp_path, lm):
+    _, params = lm
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+    qtree = quantize.quantize_tree(params, min_elements=64)
+    ckpt.save_checkpoint(str(tmp_path), qtree, 1)
+    ckpt.wait_for_saves()
+    restored, step = ckpt.restore_checkpoint(str(tmp_path), qtree)
+    assert step == 1
+    q0 = qtree["layer_0"]["attn"]["query"]["kernel"]
+    r0 = restored["layer_0"]["attn"]["query"]["kernel"]
+    assert np.array_equal(np.asarray(q0["q"]), np.asarray(r0["q"]))
+    assert r0["q"].dtype == jnp.int8
+
+
+def test_no_match_raises(lm):
+    _, params = lm
+    with pytest.raises(ValueError):
+        quantize.quantize_tree(params, targets="nothing$")
+
+
+def test_param_dict_named_q_scale_not_misdetected():
+    # a real (float) param subtree using the key names q/scale must pass
+    # through both walks untouched
+    params = {"attn": {"q": jnp.ones((64, 64)), "scale": jnp.ones((64,))},
+              "proj": {"kernel": jnp.ones((64, 64))}}
+    qtree = quantize.quantize_tree(params, min_elements=16)
+    assert qtree["proj"]["kernel"]["q"].dtype == jnp.int8
+    # the float 'q' leaf is a plain array in the output (quantize targets
+    # only names matching 'kernel$'), and dequantize leaves it alone
+    deq = quantize.dequantize_tree(qtree)
+    np.testing.assert_array_equal(np.asarray(deq["attn"]["q"]),
+                                  np.ones((64, 64)))
+    np.testing.assert_array_equal(np.asarray(deq["attn"]["scale"]),
+                                  np.ones((64,)))
