@@ -30,15 +30,7 @@ logger = logging.getLogger(__name__)
 DEFAULT_TARGETS = r"(query|key|value|out)/kernel$"
 
 
-def _flatten(params):
-    import jax
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    out = {}
-    for path, leaf in flat:
-        key = "/".join(getattr(p, "key", str(getattr(p, "idx", p)))
-                       for p in path)
-        out[key] = leaf
-    return out, treedef
+from .treeutil import flatten_with_paths as _flatten  # shared path scheme
 
 
 def target_paths(params, targets=DEFAULT_TARGETS):

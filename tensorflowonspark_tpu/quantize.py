@@ -40,10 +40,17 @@ def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
     pass through unquantized.  Returns a tree with the same nesting —
     quantized leaves become 2-key dicts that `dequantize_tree` recognizes.
     """
-    import jax
     import jax.numpy as jnp
 
+    from .treeutil import flatten_with_paths
+
     pat = re.compile(targets)
+    flat, _ = flatten_with_paths(params)
+    selected = {
+        path for path, leaf in flat.items()
+        if (hasattr(leaf, "ndim") and leaf.ndim >= 2
+            and pat.search(path) and leaf.size >= min_elements
+            and jnp.issubdtype(leaf.dtype, jnp.floating))}
     n_quant = [0]
 
     def walk(node, path):
@@ -51,9 +58,7 @@ def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
             return {k: walk(v, f"{path}/{k}" if path else k)
                     for k, v in node.items()}
         leaf = node
-        if (hasattr(leaf, "ndim") and leaf.ndim >= 2
-                and pat.search(path) and leaf.size >= min_elements
-                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+        if path in selected:
             w = jnp.asarray(leaf, jnp.float32)
             reduce_axes = tuple(i for i in range(w.ndim)
                                 if i != (axis % w.ndim))

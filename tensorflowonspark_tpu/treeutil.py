@@ -1,0 +1,19 @@
+"""Shared param-tree path utilities (used by `lora` and `quantize`).
+
+Paths are slash-joined key sequences ("layer_0/attn/query/kernel"), the
+addressing scheme both modules expose to users for selecting kernels by
+regex.
+"""
+
+
+def flatten_with_paths(params):
+    """-> ({path: leaf} in canonical flatten order, treedef)."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(getattr(p, "key", str(getattr(p, "idx", p)))
+                       for p in path)
+        out[key] = leaf
+    return out, treedef

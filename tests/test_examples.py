@@ -148,3 +148,16 @@ def test_mnist_spark_resumes_from_checkpoint(mnist_data):
                "--batch_size", "16", "--model_dir", "resume_ckpts",
                cwd=mnist_data)
     assert "resumed from checkpoint step" in out
+
+
+def test_gpt2_finetune_end_to_end(tmp_path):
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    out = _run("lm/gpt2_finetune.py", "--steps", "6", "--batch_size", "4",
+               "--seq_len", "32", "--platform", "cpu",
+               "--out_dir", "ft_out", cwd=tmp_path)
+    assert "imported GPT-2" in out
+    assert "trained 6 steps" in out
+    assert "sample:" in out
+    assert "int8 artifact" in out
+    assert (tmp_path / "ft_out" / "int8").exists()
