@@ -7,7 +7,8 @@ tune it on my data, serve it"), entirely framework-native:
    `--model_path`, or a small randomly-initialized GPT-2 when absent so
    the example runs fully offline);
 2. a byte-level `data.Dataset` pipeline streams a text corpus as fixed
-   `--seq_len` windows (shard/shuffle/repeat/batch/prefetch);
+   `--seq_len` windows (shuffle/repeat/host-prefetch/batch, then
+   device prefetch);
 3. `lora` fine-tunes adapters only (base weights frozen) with the jitted
    donated train step; full fine-tuning via `--full`;
 4. `models.decode.generate` samples a continuation;
@@ -62,9 +63,9 @@ def main(argv=None):
 
     import jax
     import jax.numpy as jnp
-    import optax
 
-    from tensorflowonspark_tpu import convert, data, lora, quantize
+    from tensorflowonspark_tpu import (convert, data, lora, optim,
+                                       quantize)
     from tensorflowonspark_tpu.models import decode
     from tensorflowonspark_tpu.models.transformer import Transformer, lm_loss
     from tensorflowonspark_tpu.parallel import train as train_mod
@@ -98,7 +99,7 @@ def main(argv=None):
                          f"least {S + 1} bytes, have {len(tokens)}")
     ds = (data.Dataset.from_records(windows)
           .shuffle(min(4096, len(windows)), seed=0)
-          .repeat(None).batch(args.batch_size))
+          .repeat(None).prefetch(4).batch(args.batch_size))
     print(f"corpus: {len(tokens)} bytes -> {len(windows)} windows of {S+1}")
 
     # 3. fine-tune (adapters by default)
@@ -117,7 +118,10 @@ def main(argv=None):
         lr = args.learning_rate or 1e-2
         print(f"LoRA: {lora.num_trainable(trainable):,} trainable params")
 
-    opt = optax.adamw(lr)
+    opt, _sched = optim.make_optimizer(
+        "adamw", learning_rate=lr, schedule="cosine",
+        warmup_steps=max(args.steps // 10, 1), total_steps=args.steps,
+        clip_norm=1.0)
     state = train_mod.create_train_state(trainable, opt)
     step = train_mod.make_train_step(step_loss, opt)  # donated state
     scalars = DeferredScalars(every=max(args.steps // 4, 1))

@@ -233,6 +233,62 @@ class Dataset:
             yield from self._parent._build(epoch)
             epoch += 1
 
+    def prefetch(self, buffer_size=2):
+        """Host-side pipeline stage: upstream records are produced by a
+        background daemon thread into a bounded queue, so file reads,
+        parsing, and batching overlap the consumer's compute (the
+        tf.data ``prefetch`` analog, for the host half; pair with
+        `prefetch_to_device` for the HBM half).  Upstream exceptions
+        re-raise in the consumer."""
+        if buffer_size < 1:
+            raise ValueError("buffer_size must be >= 1")
+
+        def op(it):
+            import queue as queue_mod
+            import threading
+
+            q = queue_mod.Queue(maxsize=buffer_size)
+            stop = threading.Event()
+            END, ERR = object(), object()
+
+            def _put(item):
+                # bounded put that gives up when the consumer is gone, so
+                # an abandoned iteration never pins this thread (plus the
+                # upstream iterator's open files/buffers) forever
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        return True
+                    except queue_mod.Full:
+                        continue
+                return False
+
+            def producer():
+                try:
+                    for item in it:
+                        if not _put(item):
+                            return
+                    _put(END)
+                except BaseException as e:   # surface in the consumer
+                    if _put(ERR):
+                        q.put(e)
+
+            t = threading.Thread(target=producer, daemon=True,
+                                 name="dataset-prefetch")
+            t.start()
+            try:
+                while True:
+                    item = q.get()
+                    if item is END:
+                        return
+                    if item is ERR:
+                        raise q.get()
+                    yield item
+            finally:
+                # consumer done, broken out, or GC'd: release the producer
+                stop.set()
+        return self._chain(op)
+
     def prefetch_to_device(self, sharding=None, depth=2):
         """Terminal stage: device-resident batches with `depth` host->HBM
         transfers in flight (see `feed.device_prefetch`)."""

@@ -1,0 +1,106 @@
+"""Optimizer/schedule factory + host-side Dataset.prefetch."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import data, optim
+
+
+def test_schedule_shapes():
+    s = optim.make_schedule(1e-3, "cosine", warmup_steps=10, total_steps=110)
+    assert float(s(0)) == 0.0
+    assert float(s(10)) == pytest.approx(1e-3, rel=1e-6)
+    assert float(s(110)) == pytest.approx(0.0, abs=1e-9)
+    lin = optim.make_schedule(1.0, "linear", total_steps=100, end_value=0.5)
+    assert float(lin(50)) == pytest.approx(0.75, rel=1e-6)
+    r = optim.make_schedule(1.0, "rsqrt", warmup_steps=100)
+    assert float(r(100)) == pytest.approx(1.0, rel=1e-6)   # peak at warmup end
+    assert float(r(300)) == pytest.approx((100 / 300) ** 0.5, rel=1e-6)
+    with pytest.raises(ValueError):
+        optim.make_schedule(1e-3, "cosine")           # needs total_steps
+    with pytest.raises(ValueError):
+        optim.make_schedule(1e-3, "exponential")
+
+
+def test_optimizer_trains_with_decay_mask_and_clip():
+    import optax
+
+    params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros(4)}}
+    opt, sched = optim.make_optimizer(
+        "adamw", learning_rate=1e-2, schedule="cosine", warmup_steps=2,
+        total_steps=50, weight_decay=0.1, clip_norm=1.0,
+        decay_mask=optim.default_decay_mask(params))
+    state = opt.init(params)
+
+    def loss(p):
+        return jnp.sum(p["dense"]["kernel"] ** 2) + jnp.sum(
+            p["dense"]["bias"] ** 2)
+
+    for i in range(5):
+        g = jax.grad(loss)(params)
+        updates, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+    assert float(loss(params)) < 16.0
+    for name in optim.OPTIMIZERS:
+        o, _ = optim.make_optimizer(name, total_steps=10)
+        o.init(params)
+    with pytest.raises(ValueError):
+        optim.make_optimizer("rmsprop")
+
+
+def test_dataset_prefetch_overlaps_and_preserves_order():
+    ds = (data.Dataset.from_records(list(range(50)))
+          .map(lambda x: x * 2).prefetch(4))
+    assert list(ds) == [2 * i for i in range(50)]
+    # re-iterable (fresh thread per pass)
+    assert list(ds) == [2 * i for i in range(50)]
+    with pytest.raises(ValueError):
+        data.Dataset.from_records([1]).prefetch(0)
+
+
+def test_dataset_prefetch_propagates_errors():
+    def bad(x):
+        if x == 3:
+            raise RuntimeError("parse exploded")
+        return x
+
+    ds = data.Dataset.from_records(list(range(6))).map(bad).prefetch(2)
+    with pytest.raises(RuntimeError, match="parse exploded"):
+        list(ds)
+
+
+def test_prefetch_composes_with_batch_and_repeat():
+    ds = (data.Dataset.from_records([(float(i), i) for i in range(10)])
+          .repeat(2).prefetch(3).batch(5))
+    batches = list(ds)
+    assert len(batches) == 4
+    assert batches[0][1].tolist() == [0, 1, 2, 3, 4]
+
+
+def test_weight_decay_refused_for_plain_adam():
+    with pytest.raises(ValueError, match="no decoupled weight decay"):
+        optim.make_optimizer("adam", weight_decay=0.1)
+    with pytest.raises(ValueError, match="no decoupled weight decay"):
+        optim.make_optimizer("sgd", decay_mask={})
+
+
+def test_prefetch_abandoned_consumer_releases_producer():
+    import threading
+
+    before = {t.name for t in threading.enumerate()}
+    ds = data.Dataset.from_records(list(range(10_000))).repeat(None).prefetch(2)
+    it = iter(ds)
+    assert next(it) == 0
+    it.close()          # abandon mid-stream (GeneratorExit -> stop event)
+    import time
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "dataset-prefetch" and t.is_alive()
+                 and t.name not in before]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, "producer thread still alive after consumer close"
