@@ -41,7 +41,8 @@ def _resolve_builder(spec):
 
 def export_saved_model(export_dir, params, builder, builder_kwargs=None,
                        signatures=None, is_chief=True, aot_batch_sizes=None,
-                       aot_platforms=None):
+                       aot_platforms=None, quantize_int8=False,
+                       quantize_kwargs=None):
     """Write the serving artifact (maps TFNode.export_saved_model).
 
     - ``builder``: ``"module:callable"`` import path.  Called with
@@ -54,6 +55,13 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
     - ``aot_batch_sizes``: additionally AOT-compile the default signature to
       StableHLO at these serving batch sizes (aot.export_aot) so the C++
       PJRT runner / CLI can serve the model with no Python model code.
+    - ``quantize_int8``: store kernels as per-channel int8
+      (quantize.quantize_tree; ``quantize_kwargs`` forwards
+      targets/min_elements/axis) — ~4x smaller artifact and weight HBM
+      traffic; `load_saved_model` transparently dequantizes inside the
+      apply fn (fused into the matmuls under jit, in the model's
+      serving dtype), and an AOT artifact bakes the int8 weights +
+      dequant into the StableHLO.
     """
     if not is_chief:
         logger.info("non-chief process skipping export to %s", export_dir)
@@ -61,6 +69,22 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
     _resolve_builder(builder)  # fail fast on a bad spec
     import flax.serialization
 
+    dequant_dtype = None
+    if quantize_int8:
+        import jax
+        import jax.numpy as jnp
+
+        from . import quantize as quantize_mod
+        dtypes = {str(x.dtype) for x in jax.tree_util.tree_leaves(params)
+                  if jnp.issubdtype(getattr(x, "dtype", jnp.int32),
+                                    jnp.floating)}
+        # remember the narrowest float dtype so serving dequantizes back
+        # into the model's compute precision (W8A16), not f32
+        dequant_dtype = ("bfloat16" if "bfloat16" in dtypes
+                         else ("float16" if "float16" in dtypes
+                               else "float32"))
+        params = quantize_mod.quantize_tree(params,
+                                            **(quantize_kwargs or {}))
     os.makedirs(export_dir, exist_ok=True)
     spec = {
         "format": "tfos-tpu-saved-model",
@@ -70,6 +94,9 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
         "signatures": signatures or {
             DEFAULT_SIGNATURE: {"inputs": {"input": {}}, "outputs": ["output"]}},
     }
+    if quantize_int8:
+        spec["quantized"] = "int8"
+        spec["dequant_dtype"] = dequant_dtype
     with open(os.path.join(export_dir, MODEL_SPEC), "w") as f:
         json.dump(spec, f, indent=2)
     with open(os.path.join(export_dir, PARAMS_FILE), "wb") as f:
@@ -127,6 +154,15 @@ def load_saved_model(export_dir, signature_def_key=None):
     params = flax.serialization.msgpack_restore(raw)
     if isinstance(params, dict) and set(params) == {"params"}:
         params = params["params"]
+    if spec.get("quantized") == "int8":
+        from . import quantize as quantize_mod
+        inner_apply = apply_fn
+        deq_dtype = spec.get("dequant_dtype")
+
+        def apply_fn(qtree, *inputs):   # dequant fuses under the caller's jit
+            return inner_apply(
+                quantize_mod.dequantize_tree(qtree, dtype=deq_dtype),
+                *inputs)
     return apply_fn, params, signature
 
 

@@ -63,3 +63,42 @@ def test_coerce_inputs_reshapes_flat_columns():
     assert arr.dtype == np.float32
     with pytest.raises(KeyError):
         export.coerce_inputs(sig, {"other": []})
+
+
+def test_quantized_export_round_trip(tmp_path):
+    import os
+
+    import jax
+
+    from tensorflowonspark_tpu.models.mlp import MnistMLP
+
+    model = MnistMLP(hidden=512)
+    params = model.init(jax.random.key(0), np.zeros((1, 16), "float32"))["params"]
+    sig = {"serving_default": {
+        "inputs": {"x": {"shape": [16], "dtype": "float32"}},
+        "outputs": ["y"]}}
+    export.export_saved_model(
+        str(tmp_path / "f32"), params,
+        builder="tensorflowonspark_tpu.models.mlp:MnistMLP",
+        builder_kwargs={"hidden": 512}, signatures=sig)
+    export.export_saved_model(
+        str(tmp_path / "int8"), params,
+        builder="tensorflowonspark_tpu.models.mlp:MnistMLP",
+        builder_kwargs={"hidden": 512}, signatures=sig,
+        quantize_int8=True)
+    # small-kernel models export via quantize_kwargs passthrough
+    export.export_saved_model(
+        str(tmp_path / "int8_small"), params,
+        builder="tensorflowonspark_tpu.models.mlp:MnistMLP",
+        builder_kwargs={"hidden": 512}, signatures=sig,
+        quantize_int8=True, quantize_kwargs={"min_elements": 64})
+    size_f32 = os.path.getsize(tmp_path / "f32" / "params.msgpack")
+    size_q = os.path.getsize(tmp_path / "int8" / "params.msgpack")
+    assert size_q < size_f32 / 2
+
+    x = np.random.RandomState(0).rand(4, 16).astype("float32")
+    apply_fn, p, _ = export.load_saved_model(str(tmp_path / "f32"))
+    ref = np.asarray(apply_fn(p, x))
+    qapply, qp, _ = export.load_saved_model(str(tmp_path / "int8"))
+    got = np.asarray(jax.jit(qapply)(qp, x))
+    assert np.max(np.abs(got - ref)) < 0.05 * (np.max(np.abs(ref)) + 1e-6)
