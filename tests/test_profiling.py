@@ -1,0 +1,26 @@
+"""Profiler-trace parsing (utils.profiling)."""
+import pytest
+
+pytest.importorskip("jax")
+def test_parse_perfetto_trace_aggregates_device_ops():
+    from tensorflowonspark_tpu.utils import profiling
+
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 3, "dur": 100, "name": "fusion.1"},
+        {"ph": "X", "pid": 3, "dur": 50, "name": "fusion.2"},
+        {"ph": "X", "pid": 3, "dur": 30, "name": "convert_reduce_fusion.7"},
+        {"ph": "X", "pid": 9, "dur": 9999, "name": "host_noise"},
+        {"ph": "B", "pid": 3, "name": "not_complete"},
+    ]
+    rows = profiling.parse_perfetto_trace(events)
+    assert rows[0] == ("fusion", 150, 2)
+    assert rows[1] == ("convert_reduce_fusion", 30, 1)
+    assert all(name != "host_noise" for name, _, _ in rows)
+    ungrouped = profiling.parse_perfetto_trace(events, group=False)
+    assert ("fusion.1", 100, 1) in ungrouped
+    host_too = profiling.parse_perfetto_trace(events, device_only=False)
+    assert any(n == "host_noise" for n, _, _ in host_too)
