@@ -270,8 +270,10 @@ class Dataset:
                             return
                     _put(END)
                 except BaseException as e:   # surface in the consumer
-                    if _put(ERR):
-                        q.put(e)
+                    # single bounded put: the marker and payload travel
+                    # together so an abandoned consumer can't strand this
+                    # thread between the two enqueues
+                    _put((ERR, e))
 
             t = threading.Thread(target=producer, daemon=True,
                                  name="dataset-prefetch")
@@ -281,8 +283,9 @@ class Dataset:
                     item = q.get()
                     if item is END:
                         return
-                    if item is ERR:
-                        raise q.get()
+                    if type(item) is tuple and len(item) == 2 \
+                            and item[0] is ERR:
+                        raise item[1]
                     yield item
             finally:
                 # consumer done, broken out, or GC'd: release the producer

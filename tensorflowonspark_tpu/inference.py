@@ -139,6 +139,12 @@ def _load_predictor(args):
                 arrays.append(arr)
             outs = aot.predict_batched(predict, arrays, bs)
             return _name_outputs(outs, out_names, out_map)
+
+        # feature names as fed (post input_mapping inversion), so bare-row
+        # requests key their column the way predict_rows looks it up
+        _inv = {v: k for k, v in (in_map or {}).items()}
+        predict_rows.input_names = [_inv.get(name, name)
+                                    for name, _ in spec_inputs]
     else:
         import jax
 
@@ -146,7 +152,12 @@ def _load_predictor(args):
             args.export_dir, args.signature_def_key)
         jit_apply = jax.jit(apply_fn)
         out_names = signature.get("outputs", ["output"])
-        desc = "builder"
+        bs = max(1, int(getattr(args, "batch_size", 64) or 64))
+        desc = f"builder(batch={bs})"
+
+        def _apply_chunk(chunk):
+            outs = jit_apply(params, *chunk)
+            return outs if isinstance(outs, (tuple, list)) else (outs,)
 
         def predict_rows(columns, n):
             cols = {}
@@ -159,10 +170,14 @@ def _load_predictor(args):
                         f"(have {sorted(columns)})")
                 cols[name] = columns[feat]
             arrays = export.coerce_inputs(signature, cols)
-            outs = jit_apply(params, *arrays)
-            if not isinstance(outs, (tuple, list)):
-                outs = (outs,)
+            # split/repeat-pad to the fixed compile batch so novel request
+            # sizes never trigger an XLA recompile inside the request path
+            outs = aot.predict_batched(_apply_chunk, arrays, bs)
             return _name_outputs(outs, out_names, out_map)
+
+        _inv = {v: k for k, v in (in_map or {}).items()}
+        predict_rows.input_names = [_inv.get(name, name)
+                                    for name in signature["inputs"]]
 
     return predict_rows, desc
 

@@ -116,6 +116,32 @@ class BertForPreTraining(nn.Module):
         return mlm_logits, nsp_logits
 
 
+def check_checkpoint_layout(cfg, params):
+    """Raise a targeted error when a restored param tree's norm layout
+    disagrees with `cfg.norm_style`.
+
+    The pre-LN layout carries `encoder/ln_f`; the (default, HF-faithful)
+    post-LN layout does not.  Checkpoints written before the post-LN
+    default would otherwise fail deep inside `apply` with an opaque
+    missing-param error — see MIGRATION.md "BERT checkpoint layout".
+    """
+    if isinstance(params, dict):
+        params = params.get("params", params)   # flax variables wrapper
+    enc = params.get("encoder", params) if isinstance(params, dict) else {}
+    has_ln_f = isinstance(enc, dict) and "ln_f" in enc
+    if cfg.norm_style == "post" and has_ln_f:
+        raise ValueError(
+            "checkpoint contains encoder/ln_f (pre-LN layout) but the "
+            "config is norm_style='post' (the default since the HF-faithful "
+            "change); load with BertConfig(norm_style='pre', use_bias=False, "
+            "activation='gelu', ln_eps=1e-6) or re-save the checkpoint")
+    if cfg.norm_style != "post" and isinstance(enc, dict) and enc \
+            and not has_ln_f:
+        raise ValueError(
+            "checkpoint lacks encoder/ln_f but the config is pre-LN; this "
+            "looks like a post-LN checkpoint — use the default BertConfig")
+
+
 def build_bert(**kwargs):
     """Builder-spec target for export_saved_model ('module:callable' with
     JSON kwargs — BertConfig fields)."""

@@ -57,6 +57,14 @@ def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
         if isinstance(node, dict) and not _is_qleaf(node):
             return {k: walk(v, f"{path}/{k}" if path else k)
                     for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            if hasattr(node, "_fields"):   # namedtuple: flatten names
+                return type(node)(*[      # fields GetAttrKey-style (".f")
+                    walk(v, f"{path}/.{f}" if path else f".{f}")
+                    for f, v in zip(node._fields, node)])
+            return type(node)(
+                [walk(v, f"{path}/{i}" if path else str(i))
+                 for i, v in enumerate(node)])
         leaf = node
         if path in selected:
             w = jnp.asarray(leaf, jnp.float32)
@@ -73,6 +81,15 @@ def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
     if not n_quant[0]:
         raise ValueError(f"no kernels matched targets={targets!r} with "
                          f">= {min_elements} elements")
+    if n_quant[0] != len(selected):
+        # flatten_with_paths saw leaves the dict/list walk couldn't reach
+        # (e.g. a custom pytree node) — fail loudly rather than silently
+        # leaving matched kernels unquantized
+        raise ValueError(
+            f"selected {len(selected)} kernels but quantized {n_quant[0]}; "
+            "the param tree contains containers quantize_tree cannot "
+            "rewrite (only dict/list/tuple nesting is supported — convert "
+            "with e.g. flax.core.unfreeze first)")
     qb, fb = quantized_bytes(out)
     logger.info("quantized %d kernels to int8 (weight bytes %.2fx smaller)",
                 n_quant[0], fb / max(qb, 1))
@@ -93,6 +110,10 @@ def dequantize_tree(qtree, dtype=None):
                     * node["scale"]).astype(target)
         if isinstance(node, dict):
             return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            walked = [walk(v) for v in node]
+            return (type(node)(*walked) if hasattr(node, "_fields")
+                    else type(node)(walked))
         return node
 
     return walk(qtree)
@@ -109,6 +130,9 @@ def quantized_bytes(qtree):
             fb += node["q"].size * 4
         elif isinstance(node, dict):
             for v in node.values():
+                walk(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
                 walk(v)
 
     walk(qtree)
@@ -128,6 +152,9 @@ def max_abs_error(params, qtree):
         if isinstance(a, dict):
             for k in a:
                 walk(a[k], b[k])
+        elif isinstance(a, (list, tuple)):
+            for x, y in zip(a, b):
+                walk(x, y)
         else:
             worst = max(worst, float(jnp.max(jnp.abs(
                 jnp.asarray(a, jnp.float32) - jnp.asarray(b, jnp.float32)))))

@@ -45,13 +45,21 @@ def build_argparser():
     return p
 
 
-def _instances_to_columns(instances):
-    """[{feature: value}, ...] -> ({feature: [values]}, n)."""
+def _instances_to_columns(instances, input_names=None):
+    """[{feature: value}, ...] -> ({feature: [values]}, n).
+
+    Also accepts TF Serving's bare row format ([[...], [...]] or scalars)
+    when the model has exactly one input: the values map onto that input.
+    """
     if not isinstance(instances, list) or not instances:
         raise ValueError('"instances" must be a non-empty list')
     first = instances[0]
     if not isinstance(first, dict):
-        raise ValueError("each instance must be a {feature: value} object")
+        if input_names is not None and len(input_names) == 1:
+            return {input_names[0]: list(instances)}, len(instances)
+        raise ValueError(
+            "each instance must be a {feature: value} object (bare rows are "
+            "only accepted for single-input models)")
     cols = {k: [] for k in first}
     for i, inst in enumerate(instances):
         if set(inst) != set(cols):
@@ -83,7 +91,8 @@ class ModelService:
         self.requests = 0
 
     def predict(self, instances):
-        cols, n = _instances_to_columns(instances)
+        cols, n = _instances_to_columns(
+            instances, getattr(self._predict_rows, "input_names", None))
         with self._lock:   # one device: serialize executions
             outputs = self._predict_rows(cols, n)
             self.requests += 1

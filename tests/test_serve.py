@@ -59,6 +59,21 @@ def test_predict_round_trip(server):
     np.testing.assert_allclose(got, expect, rtol=1e-5)
 
 
+def test_bare_row_instances_single_input(server):
+    # TF Serving's row format without feature names maps onto the single
+    # model input; sizes differing from batch_size exercise the pad path
+    base, params = server
+    out = _post(base + "/v1/models/default:predict",
+                {"instances": [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]})
+    preds = out["predictions"]
+    assert len(preds) == 3
+    w = np.asarray(params["dense"]["kernel"]).reshape(2)
+    b = float(np.asarray(params["dense"]["bias"]).reshape(()))
+    got = np.array([p["y"] for p in preds]).reshape(3)
+    expect = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]) @ w + b
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
 def test_metadata_and_health(server):
     base, _ = server
     with urllib.request.urlopen(base + "/v1/models/default", timeout=30) as r:
