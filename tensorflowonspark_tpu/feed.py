@@ -13,6 +13,7 @@ arrays ready for `jax.device_put`; `iter_batches` wraps the loop.
 import logging
 
 from . import marker
+from . import shm as shm_mod
 
 logger = logging.getLogger(__name__)
 
@@ -115,6 +116,51 @@ class DataFeed:
         # python row objects (the packed-transport fast path)
         self._segments = []
         self._partition_break = False
+        self._ring = None
+        self._ring_checked = False
+        # queue proxies are cached: every mgr.get_queue() builds a fresh
+        # AutoProxy over a fresh socket (several ms of server round trips)
+        self._q_in = None
+        self._q_out = None
+
+    def _queue_in(self):
+        if self._q_in is None:
+            self._q_in = self.mgr.get_queue(self.qname_in)
+        return self._q_in
+
+    def _queue_out(self):
+        if self._q_out is None:
+            self._q_out = self.mgr.get_queue(self.qname_out)
+        return self._q_out
+
+    def _ring_handle(self):
+        """Attach to the node's shm data plane on first use (the queue then
+        carries ShmRefs whose payloads live in the ring)."""
+        if not self._ring_checked:
+            self._ring_checked = True
+            try:
+                info = shm_mod.discover(self.mgr)
+                if info:
+                    self._ring = shm_mod.attach_cached(info)
+            except Exception:
+                logger.warning("could not attach shm ring; expecting "
+                               "queue-borne chunks", exc_info=True)
+        return self._ring
+
+    def _resolve_ref(self, ref):
+        """ShmRef -> list of segments (PackedChunks / ("rows", list))."""
+        ring = self._ring_handle()
+        if ring is None:
+            raise RuntimeError(
+                "received a ShmRef but the node advertises no shm ring — "
+                "feeder and consumer disagree about the data plane")
+        payload = ring.read(ref)
+        if isinstance(payload, shm_mod.MultiPayload):
+            return [p if isinstance(p, marker.PackedChunk)
+                    else ("rows", list(p)) for p in payload]
+        if isinstance(payload, marker.PackedChunk):
+            return [payload]
+        return [("rows", list(payload))]
 
     @property
     def _buffer(self):
@@ -130,7 +176,7 @@ class DataFeed:
         columnar PackedChunk slices), handling the marker protocol."""
         import queue as queue_mod
 
-        q = self.mgr.get_queue(self.qname_in)
+        q = self._queue_in()
         blocks, n = [], 0
         while n < batch_size:
             if self._segments:
@@ -171,6 +217,9 @@ class DataFeed:
                     self._partition_break = True  # flush current batch first
                     break
                 # nothing collected yet: partition boundary is invisible
+            elif isinstance(item, shm_mod.ShmRef):
+                self._segments.extend(self._resolve_ref(item))
+                q.task_done()
             elif isinstance(item, marker.PackedChunk):
                 self._segments.append(item)
                 q.task_done()
@@ -359,7 +408,7 @@ class DataFeed:
 
     def batch_results(self, results):
         """Push inference results to the output queue (reference: TFNode.py:294-305)."""
-        q = self.mgr.get_queue(self.qname_out)
+        q = self._queue_out()
         for item in results:
             q.put(item)
 
@@ -368,13 +417,19 @@ class DataFeed:
         logger.info("terminate() requested; marking state terminating")
         self.mgr.set("state", "terminating")
         # Drain whatever is in flight so feeder queue.join() can complete.
-        q = self.mgr.get_queue(self.qname_in)
+        q = self._queue_in()
         import queue as queue_mod
         count = 0
         done = False
         while not done:
             try:
                 item = q.get(timeout=3)
+                if isinstance(item, shm_mod.ShmRef):
+                    # free the ring frames so a feeder blocked on a full
+                    # ring unblocks and sees the 'terminating' state
+                    ring = self._ring_handle()
+                    if ring is not None:
+                        ring.skip(item)
                 q.task_done()
                 count += 1
                 if item is None:

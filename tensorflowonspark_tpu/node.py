@@ -24,7 +24,7 @@ import traceback
 import uuid
 
 from . import feed as feed_mod
-from . import manager, marker, reservation, tpu_info, util
+from . import manager, marker, reservation, shm, tpu_info, util
 
 logger = logging.getLogger(__name__)
 
@@ -286,6 +286,21 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
         mgr.set("state", f"running/{job_name}")
         util.write_executor_id(executor_id)
 
+        # 4b. shared-memory data plane: created BEFORE registration so any
+        #     feeder that can discover this manager also finds the ring —
+        #     both sides then use one transport for the whole feed (payload
+        #     bytes ride /dev/shm; the queue carries ShmRefs + markers).
+        if shm.ring_enabled():
+            try:
+                ring = shm.ShmChunkRing.create()
+                mgr.set("shm_ring", ring.info())
+                shm.advertise_file(ring.info())
+                import atexit
+                atexit.register(ring.unlink)
+            except Exception:
+                logger.warning("shm ring unavailable; data feed falls back "
+                               "to manager-queue transport", exc_info=True)
+
         # 5. chief offers a jax.distributed coordinator port; every node
         #    learns it from the reservation list (replaces TF_CONFIG assembly,
         #    TFSparkNode.py:366-374).
@@ -378,25 +393,106 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
             raise  # _mapfn's outer handler reports to the server, then BYEs
 
 
-def _push_chunks(q, iterator):
-    """Push records as chunk batches (one queue item per CHUNK_SIZE records);
-    returns the record count.  Shared by the train and inference feeders —
-    inference's 1:1 result accounting depends on this count being exact.
-    Uniform numeric chunks go as columnar PackedChunks (contiguous buffers
-    through the pickle boundary) instead of O(records x fields) python
-    objects — the throughput fix for SURVEY.md §7's "process-boundary feed
-    throughput" hard part."""
+def _push_chunks(q, iterator, mgr=None, timeout=600.0, equeue=None):
+    """Push records as chunk batches; returns the record count.  Shared by
+    the train and inference feeders — inference's 1:1 result accounting
+    depends on this count being exact.
+
+    Transport: when the node advertises a shared-memory ring
+    (`shm.discover`), chunk payloads are copied into the ring and the
+    queue carries tiny `shm.ShmRef` handles — the SURVEY.md §7
+    "process-boundary feed throughput" fix.  Packed sub-chunks coalesce
+    into ~TFOS_TPU_CHUNK_BYTES payloads first, because each queue
+    operation costs a manager round trip and per-item overhead (not
+    bandwidth) dominates once bytes ride shared memory.  Without a ring,
+    uniform numeric chunks go through the queue as columnar PackedChunks
+    (round-1 behavior, still the fallback when rings cannot be created)."""
+    ring = None
+    if mgr is not None and shm.ring_enabled():
+        try:
+            info = shm.discover(mgr)
+            if info:
+                ring = shm.attach_cached(info)
+        except Exception:
+            logger.warning("could not attach shm ring; using queue "
+                           "transport", exc_info=True)
+    target_bytes = int(os.environ.get("TFOS_TPU_CHUNK_BYTES", 8 << 20))
+    if ring is not None:
+        target_bytes = min(target_bytes, ring.capacity_bytes // 4)
+
+    pending = []        # packed sub-chunks awaiting one coalesced write
+    pending_bytes = 0
+
+    def _abort_on_error():
+        # polled while a ring write blocks on a full ring: a dead/failed
+        # consumer should surface its error, not a generic RingTimeout
+        # (maps the reference's error polling during queue.join(),
+        # TFSparkNode.py:488-495)
+        tb = _peek_error(equeue) if equeue is not None else None
+        if tb is not None:
+            raise RuntimeError(f"training function failed:\n{tb}")
+
+    def _flush():
+        nonlocal pending, pending_bytes
+        if not pending:
+            return
+        subs, pending, pending_bytes = pending, [], 0
+        try:
+            parts, n = (shm.encode_multi(subs) if len(subs) > 1
+                        else shm.encode_chunk(subs[0]))
+            q.put(ring.write(parts, n, timeout=timeout,
+                             should_abort=_abort_on_error))
+            return
+        except (shm.RingTimeout, RuntimeError):
+            raise
+        except Exception:
+            # codec surprise: the queue still works
+            logger.warning("ring write failed; chunks ride the queue",
+                           exc_info=True)
+        for sub in subs:
+            q.put(sub)
+
+    def _send(records):
+        nonlocal pending_bytes
+        packed = marker.pack_records(records)
+        if ring is None:
+            q.put(packed)
+            return
+        if isinstance(packed, marker.PackedChunk):
+            nb = sum(c.nbytes for c in packed.columns)
+            if nb > ring.capacity_bytes - (1 << 16):
+                # larger than the ring itself: this one rides the queue
+                _flush()
+                q.put(packed)
+                return
+            # flush BEFORE the payload would cross the target (the 64 KiB
+            # margin covers codec metadata), so each ring write stays
+            # within its intended frame budget instead of spilling into
+            # an extra mostly-empty slot
+            if pending and pending_bytes + nb > target_bytes - (1 << 16):
+                _flush()
+            pending.append(packed)
+            pending_bytes += nb
+            if len(pending) >= 64:
+                _flush()
+        else:
+            # object records: size unknowable without pickling; ship the
+            # coalesced buffer right away
+            pending.append(packed)
+            _flush()
+
     count = 0
     chunk = []
     for item in iterator:
         chunk.append(item)
         if len(chunk) >= CHUNK_SIZE:
-            q.put(marker.pack_records(chunk))
+            _send(chunk)
             count += len(chunk)
             chunk = []
     if chunk:
-        q.put(marker.pack_records(chunk))
+        _send(chunk)
         count += len(chunk)
+    _flush()
     return count
 
 
@@ -425,7 +521,8 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
         q = mgr.get_queue(qname)
         equeue = mgr.get_queue("error")
-        count = _push_chunks(q, iterator)
+        count = _push_chunks(q, iterator, mgr=mgr, timeout=feed_timeout,
+                             equeue=equeue)
         logger.info("pushed %d records into %s queue", count, qname)
 
         _join_with_watchdog(q, equeue, feed_timeout)
@@ -442,7 +539,7 @@ def inference(cluster_info, cluster_meta, qname="input"):
         mgr = _get_manager(cluster_info, util.get_ip_address(), util.read_executor_id())
         q = mgr.get_queue(qname)
         equeue = mgr.get_queue("error")
-        count = _push_chunks(q, iterator)
+        count = _push_chunks(q, iterator, mgr=mgr, equeue=equeue)
         q.put(marker.EndPartition())
         logger.info("pushed %d records (+EndPartition) into %s queue", count, qname)
         if count == 0:
@@ -462,6 +559,19 @@ def inference(cluster_info, cluster_meta, qname="input"):
     return _inference
 
 
+def _peek_error(equeue):
+    """Return the first queued error traceback without consuming it
+    (get/task_done then re-put, the reference's peek/re-put trick that
+    keeps the error visible to the shutdown path too,
+    TFSparkNode.py:624-630), or None when the queue is empty."""
+    if equeue.empty():
+        return None
+    tb = equeue.get()
+    equeue.task_done()
+    equeue.put(tb)
+    return tb
+
+
 def _join_with_watchdog(q, equeue, timeout):
     """queue.join() with error propagation + feed timeout (maps
     TFSparkNode.py:485-495)."""
@@ -477,12 +587,8 @@ def _join_with_watchdog(q, equeue, timeout):
     t.start()
     deadline = time.time() + timeout
     while not joined.is_set():
-        if not equeue.empty():
-            tb = equeue.get()
-            equeue.task_done()
-            # Re-put so the error stays visible to the shutdown path too
-            # (the reference's peek/re-put trick, TFSparkNode.py:624-630).
-            equeue.put(tb)
+        tb = _peek_error(equeue)
+        if tb is not None:
             raise RuntimeError(f"training function failed:\n{tb}")
         if time.time() > deadline:
             raise TimeoutError(
@@ -507,16 +613,19 @@ def shutdown(cluster_info, queues=("input",), grace_secs=0):
                 logger.warning("could not push sentinel into %s", qname)
         if grace_secs:
             time.sleep(grace_secs)
-        # Late-error surfacing with the peek/re-put trick
-        # (maps TFSparkNode.py:624-630): leave the error visible for other
-        # shutdown paths while still raising here.
-        equeue = mgr.get_queue("error")
-        late_error = None
-        if not equeue.empty():
-            tb = equeue.get()
-            equeue.task_done()
-            equeue.put(tb)
-            late_error = tb
+        # Late-error surfacing (maps TFSparkNode.py:624-630): leave the
+        # error visible for other shutdown paths while still raising here.
+        late_error = _peek_error(mgr.get_queue("error"))
+        # The ring name is removed here (mappings survive on POSIX, so a
+        # consumer still draining is unaffected; the creator's atexit
+        # unlink is then a no-op).
+        try:
+            info = shm.discover(mgr)
+            if info:
+                shm.ShmChunkRing.unlink_by_name(info["name"])
+            shm.remove_advertisement()
+        except Exception:
+            pass
         # Marking 'stopped' is the manager's death warrant: the executor's
         # bootstrap process waits for this state, then stops the manager and
         # exits (backend._bootstrap_trampoline) — the node process gets its

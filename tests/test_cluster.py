@@ -82,12 +82,19 @@ def test_train_then_shutdown(tmp_path):
     c.shutdown(grace_secs=1)
 
 
-def test_error_during_feeding_raises(tmp_path):
-    # maps reference test_TFCluster.py:50-68 (feed_timeout path)
+def test_error_during_feeding_raises(tmp_path, monkeypatch):
+    # maps reference test_TFCluster.py:50-68 (feed_timeout path).  The
+    # backlog must exceed the shm ring + consumed batch, or the whole feed
+    # is DELIVERED before the node's crash can block the feeder (the
+    # ring buffers in-flight bytes the way the reference's unbounded
+    # queue never bounded): shrink the ring so the feeder must block.
+    import numpy as np
+    monkeypatch.setenv("TFOS_TPU_RING_MB", "1")   # 4 MB min capacity
     c = cluster.run(_local_backend(tmp_path), fn_fail_during_feed, tf_args={},
                     num_executors=NUM_EXECUTORS,
                     input_mode=cluster.InputMode.SPARK)
-    parts = [list(range(1000)), list(range(1000))]
+    row = np.zeros(512, dtype=np.float32)         # 2 KB/record
+    parts = [[row] * 4000, [row] * 4000]          # 8 MB per partition
     with pytest.raises(Exception, match="injected failure mid-feed|task .* failed"):
         c.train(parts, feed_timeout=15)
     with pytest.raises(Exception):
