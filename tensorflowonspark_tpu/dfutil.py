@@ -13,7 +13,6 @@ and works at two levels:
   `part-rXXXXX` shard, schema is inferred from the first record, and
   `loadedDF` tracks provenance for `isLoadedDF` (reference: dfutil.py:15-26).
 """
-import glob
 import logging
 import os
 
@@ -140,12 +139,13 @@ def read_tfrecords(path_or_dir, binary_features=(), schema=None):
     Returns (rows, schema); schema is inferred from the first record unless
     given (the reference's loadTFRecords contract, dfutil.py:44-81).
     """
-    if os.path.isdir(path_or_dir):
-        paths = sorted(glob.glob(os.path.join(path_or_dir, "part-*")))
+    from . import fsio
+    if fsio.isdir(path_or_dir):
+        paths = fsio.glob(fsio.join(path_or_dir, "part-*"))
         if not paths:
-            paths = sorted(p for p in glob.glob(os.path.join(path_or_dir, "*"))
-                           if os.path.isfile(p) and not
-                           os.path.basename(p).startswith(("_", ".")))
+            paths = [p for p in fsio.glob(fsio.join(path_or_dir, "*"))
+                     if fsio.isfile(p) and not
+                     os.path.basename(p).startswith(("_", "."))]
     else:
         paths = [path_or_dir]
     rows = []
@@ -169,12 +169,13 @@ def saveAsTFRecords(df, output_dir):
 
     def write_partition(index, iterator):
         # makedirs must run on the EXECUTOR, not the driver: on a multi-node
-        # cluster the driver's filesystem is a different machine.  Note the
-        # shards land on a shared filesystem iff output_dir is one (NFS/
-        # GCS-fuse); unlike the reference's Hadoop output format there is no
-        # HDFS client underneath.
-        os.makedirs(output_dir, exist_ok=True)
-        part = os.path.join(output_dir, f"part-r-{index:05d}")
+        # cluster the driver's filesystem is a different machine.  Remote
+        # schemes (gs://, s3://, hdfs://, ...) write through fsio/fsspec —
+        # the analog of the reference's Hadoop output format; plain local
+        # paths land on a shared filesystem iff output_dir is one.
+        from tensorflowonspark_tpu import fsio
+        fsio.makedirs(output_dir)
+        part = fsio.join(output_dir, f"part-r-{index:05d}")
         count = write_tfrecords(
             (dict(zip(columns, row)) for row in iterator), part)
         yield (index, count)
@@ -192,8 +193,10 @@ def loadTFRecords(sc, input_dir, binary_features=(), schema_hint=None):
     this module's type strings."""
     from pyspark.sql import SparkSession
 
+    from . import fsio
+
     spark = SparkSession.builder.getOrCreate()
-    paths = sorted(glob.glob(os.path.join(input_dir, "part-*"))) or [input_dir]
+    paths = fsio.glob(fsio.join(input_dir, "part-*")) or [input_dir]
 
     # infer schema from the first record of the first shard
     schema = dict(schema_hint or {})

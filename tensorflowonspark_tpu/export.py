@@ -85,7 +85,15 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
                                else "float32"))
         params = quantize_mod.quantize_tree(params,
                                             **(quantize_kwargs or {}))
-    os.makedirs(export_dir, exist_ok=True)
+    from . import fsio
+    if aot_batch_sizes and fsio.is_remote(export_dir):
+        # checked BEFORE any write so a multi-GB params upload is not
+        # wasted on an export that cannot finish
+        raise ValueError(
+            "aot_batch_sizes requires a local export_dir: AOT artifacts "
+            "(compiled executables / native runner inputs) must be local "
+            "files — export locally, then copy the directory")
+    fsio.makedirs(export_dir)
     spec = {
         "format": "tfos-tpu-saved-model",
         "version": 1,
@@ -97,9 +105,9 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
     if quantize_int8:
         spec["quantized"] = "int8"
         spec["dequant_dtype"] = dequant_dtype
-    with open(os.path.join(export_dir, MODEL_SPEC), "w") as f:
+    with fsio.fopen(fsio.join(export_dir, MODEL_SPEC), "w") as f:
         json.dump(spec, f, indent=2)
-    with open(os.path.join(export_dir, PARAMS_FILE), "wb") as f:
+    with fsio.fopen(fsio.join(export_dir, PARAMS_FILE), "wb") as f:
         f.write(flax.serialization.to_bytes(params))
     logger.info("exported saved model to %s", export_dir)
 
@@ -126,7 +134,8 @@ def load_saved_model(export_dir, signature_def_key=None):
     the reference's ``tf.saved_model.load`` + signature lookup
     (pipeline.py:596-613).
     """
-    with open(os.path.join(export_dir, MODEL_SPEC)) as f:
+    from . import fsio
+    with fsio.fopen(fsio.join(export_dir, MODEL_SPEC), "r") as f:
         spec = json.load(f)
     if spec.get("format") != "tfos-tpu-saved-model":
         raise ValueError(f"{export_dir} is not a tfos-tpu saved model")
@@ -148,7 +157,7 @@ def load_saved_model(export_dir, signature_def_key=None):
         apply_fn = built
 
     import flax.serialization
-    with open(os.path.join(export_dir, PARAMS_FILE), "rb") as f:
+    with fsio.fopen(fsio.join(export_dir, PARAMS_FILE), "rb") as f:
         raw = f.read()
     # msgpack restore needs no target template for plain dict pytrees
     params = flax.serialization.msgpack_restore(raw)

@@ -84,7 +84,9 @@ def hdfs_path(ctx, path):
     the cluster's default FS (for remote schemes) or the node's working dir.
     """
     schemes = ("hdfs://", "viewfs://", "file://", "gs://", "s3://", "s3a://",
-               "s3n://", "wasb://", "abfs://", "maprfs://", "oss://", "swift://")
+               "s3n://", "wasb://", "abfs://", "maprfs://", "oss://",
+               "swift://", "memory://")  # memory:// = fsspec's in-memory FS
+    # (all are openable through fsio/fsspec wherever a local path works)
     if path.startswith(schemes):
         return path
     local_fs = ctx.default_fs.startswith("file://") or not ctx.default_fs.startswith(schemes)

@@ -1,0 +1,111 @@
+"""Filesystem seam: one open/stat/glob surface for local AND remote paths.
+
+The reference reaches HDFS through Spark's Hadoop formats
+(reference: dfutil.py:39,63) and normalizes ten filesystem schemes in
+`TFNode.hdfs_path` (reference: TFNode.py:29-64).  Here the same reach
+comes from fsspec: any scheme fsspec knows (``gs://``, ``s3://``,
+``hdfs://``, ``memory://``, ...) works wherever a local path works —
+TFRecord shards, saved-model exports, dfutil save/load — so the paths
+`feed.hdfs_path` produces are actually openable.
+
+Local paths (no scheme, or ``file://``) bypass fsspec entirely: plain
+builtins keep the hot TFRecord path eligible for the native mmap indexer.
+"""
+import builtins
+import glob as glob_mod
+import os
+
+_SCHEME_SEP = "://"
+
+
+def is_remote(path):
+    """True for scheme-qualified non-local paths (``gs://...``); false for
+    plain paths and ``file://`` URLs."""
+    s = str(path)
+    return _SCHEME_SEP in s and not s.startswith("file://")
+
+
+def local_path(path):
+    """Strip a ``file://`` prefix; other paths pass through unchanged."""
+    s = str(path)
+    return s[len("file://"):] if s.startswith("file://") else s
+
+
+def _fs(path):
+    import fsspec
+    return fsspec.core.url_to_fs(str(path))
+
+
+def fopen(path, mode="rb"):
+    """Open a local or remote path; returns a file object."""
+    if is_remote(path):
+        import fsspec
+        return fsspec.open(str(path), mode).open()
+    return builtins.open(local_path(path), mode)
+
+
+def exists(path):
+    if is_remote(path):
+        fs, p = _fs(path)
+        return fs.exists(p)
+    return os.path.exists(local_path(path))
+
+
+def isdir(path):
+    if is_remote(path):
+        fs, p = _fs(path)
+        return fs.isdir(p)
+    return os.path.isdir(local_path(path))
+
+
+def getsize(path):
+    if is_remote(path):
+        fs, p = _fs(path)
+        return fs.size(p)
+    return os.path.getsize(local_path(path))
+
+
+def makedirs(path):
+    if is_remote(path):
+        fs, p = _fs(path)
+        fs.makedirs(p, exist_ok=True)
+        return
+    os.makedirs(local_path(path), exist_ok=True)
+
+
+def join(path, *parts):
+    """Path join that preserves the scheme (os.path.join would mangle
+    ``gs://bucket`` + ``part`` on some inputs)."""
+    s = str(path)
+    if is_remote(s):
+        return "/".join([s.rstrip("/")] + [p.strip("/") for p in parts])
+    return os.path.join(local_path(s), *parts)
+
+
+def glob(pattern):
+    """Sorted glob across local and remote filesystems.
+
+    Remote results come back scheme-qualified so they stay openable by
+    `fopen` (fsspec's fs.glob strips the scheme).
+    """
+    if is_remote(pattern):
+        fs, p = _fs(pattern)
+        scheme = str(pattern).split(_SCHEME_SEP, 1)[0]
+        return sorted(f"{scheme}://{m}" for m in fs.glob(p))
+    return sorted(glob_mod.glob(local_path(pattern)))
+
+
+def listdir(path):
+    """Base names of entries under a directory (files and dirs)."""
+    if is_remote(path):
+        fs, p = _fs(path)
+        return sorted(os.path.basename(e.rstrip("/"))
+                      for e in fs.ls(p, detail=False))
+    return sorted(os.listdir(local_path(path)))
+
+
+def isfile(path):
+    if is_remote(path):
+        fs, p = _fs(path)
+        return fs.isfile(p)
+    return os.path.isfile(local_path(path))

@@ -15,10 +15,8 @@ file per input shard.
         --output /tmp/predictions [--engine auto|native|jax]
 """
 import argparse
-import glob
 import json
 import logging
-import os
 
 logger = logging.getLogger(__name__)
 
@@ -49,11 +47,12 @@ def build_argparser():
 
 
 def _input_files(pattern):
-    if os.path.isdir(pattern):
-        files = sorted(glob.glob(os.path.join(pattern, "*.tfrecord"))) or \
-            sorted(glob.glob(os.path.join(pattern, "part-*")))
+    from . import fsio
+    if fsio.isdir(pattern):
+        files = fsio.glob(fsio.join(pattern, "*.tfrecord")) or \
+            fsio.glob(fsio.join(pattern, "part-*"))
     else:
-        files = sorted(glob.glob(pattern))
+        files = fsio.glob(pattern)
     if not files:
         raise FileNotFoundError(f"no input files match {pattern!r}")
     return files
@@ -207,16 +206,18 @@ def main(argv=None):
     predict_rows, desc = _load_predictor(args)
     logger.info("inference over %d shards with engine %s", len(files), desc)
 
-    os.makedirs(args.output, exist_ok=True)
+    from . import fsio
+
+    fsio.makedirs(args.output)
     total = 0
     for i, path in enumerate(files):
         columns, n = _decode_shard(path, fields)
-        out_path = os.path.join(args.output, f"part-{i:05d}.json")
+        out_path = fsio.join(args.output, f"part-{i:05d}.json")
         if n == 0:
-            open(out_path, "w").close()
+            fsio.fopen(out_path, "w").close()
             continue
         named = predict_rows(columns, n)
-        with open(out_path, "w") as out:
+        with fsio.fopen(out_path, "w") as out:
             for r in range(n):
                 row = {k: v[r].tolist() for k, v in named.items()}
                 out.write(json.dumps(row) + "\n")

@@ -46,10 +46,13 @@ and consumers attach by name. `TFOS_TPU_SHM_RING=0` disables the data
 plane (the queue then carries whole chunks, as in round 1);
 `TFOS_TPU_RING_MB` sizes it (default 64).
 """
+import contextlib
+import json
 import logging
 import os
 import pickle
 import struct
+import threading
 import time
 import uuid
 
@@ -97,10 +100,6 @@ class ShmRef:
     def __reduce__(self):
         return (ShmRef, (self.seq, self.nframes, self.nbytes, self.count))
 
-
-import contextlib
-import json
-import threading
 
 RING_FILE = ".tfos_shm_ring"
 

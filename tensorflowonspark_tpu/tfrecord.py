@@ -164,7 +164,8 @@ class TFRecordWriter:
         else:
             if compression is None and str(path_or_file).endswith(".gz"):
                 compression = "gzip"
-            self._raw = open(path_or_file, "wb")
+            from . import fsio
+            self._raw = fsio.fopen(path_or_file, "wb")
             self._own = True
         if compression == "gzip":
             import gzip
@@ -213,7 +214,8 @@ def _is_gzip(path):
     length prefix of a plain record, so a valid plain-TFRecord header
     (length CRC checks out — 2^-32 false-positive odds for real gzip
     bytes) wins over the magic."""
-    with open(path, "rb") as f:
+    from . import fsio
+    with fsio.fopen(path, "rb") as f:
         head = f.read(12)
     if len(head) == 12:
         (len_crc,) = struct.unpack("<I", head[8:12])
@@ -231,25 +233,32 @@ def read_records(path_or_file, verify_crc=True):
     pass of C CRC + zero-copy slicing); falls back to the pure-Python
     frame parser.
     """
+    from . import fsio
+
     if not hasattr(path_or_file, "read") and _is_gzip(path_or_file):
         import gzip
-        with gzip.open(path_or_file, "rb") as gz:
-            yield from read_records(gz, verify_crc=verify_crc)
+        with fsio.fopen(path_or_file, "rb") as raw:
+            with gzip.GzipFile(fileobj=raw, mode="rb") as gz:
+                yield from read_records(gz, verify_crc=verify_crc)
         return
-    if _native is not None and not hasattr(path_or_file, "read"):
-        size = os.path.getsize(path_or_file)
+    if _native is not None and not hasattr(path_or_file, "read") \
+            and not fsio.is_remote(path_or_file):
+        path = fsio.local_path(path_or_file)
+        size = os.path.getsize(path)
         if size == 0:
             return
         # One C pass mmaps + CRC-checks + indexes the file, then records are
         # streamed with seek/read — O(record) resident memory for any shard
-        # size, and CRC cost stays in native code.
-        offsets, lengths = _native_index_file(path_or_file, size, verify_crc)
-        with open(path_or_file, "rb") as f:
+        # size, and CRC cost stays in native code.  (Local files only; remote
+        # paths stream through the Python parser below.)
+        offsets, lengths = _native_index_file(path, size, verify_crc)
+        with open(path, "rb") as f:
             for off, ln in zip(offsets, lengths):
                 f.seek(off)
                 yield f.read(ln)
         return
-    f = path_or_file if hasattr(path_or_file, "read") else open(path_or_file, "rb")
+    f = path_or_file if hasattr(path_or_file, "read") \
+        else fsio.fopen(path_or_file, "rb")
     try:
         while True:
             header = f.read(12)
