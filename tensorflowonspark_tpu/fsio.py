@@ -90,18 +90,10 @@ def glob(pattern):
     """
     if is_remote(pattern):
         fs, p = _fs(pattern)
-        scheme = str(pattern).split(_SCHEME_SEP, 1)[0]
-        return sorted(f"{scheme}://{m}" for m in fs.glob(p))
+        # unstrip_protocol restores scheme AND authority (hdfs://nn:8020/...)
+        # — fs.glob strips both, and the netloc lives in the fs object
+        return sorted(fs.unstrip_protocol(m) for m in fs.glob(p))
     return sorted(glob_mod.glob(local_path(pattern)))
-
-
-def listdir(path):
-    """Base names of entries under a directory (files and dirs)."""
-    if is_remote(path):
-        fs, p = _fs(path)
-        return sorted(os.path.basename(e.rstrip("/"))
-                      for e in fs.ls(p, detail=False))
-    return sorted(os.listdir(local_path(path)))
 
 
 def isfile(path):

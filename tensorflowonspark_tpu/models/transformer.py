@@ -468,6 +468,27 @@ class MoEMLP(nn.Module):
         return yt.reshape(B, S, D).astype(dtype), frac_tokens
 
 
+def _embed_out_constrain(x, cfg):
+    """Pin the token-embed gather OUTPUT to its natural sharding: batch
+    over dp, d_model over tp (matching the table's P(None, 'tp') layout).
+
+    Without this, the first block's sp constraint (P(dp, sp, None))
+    propagates back onto the gather itself, and XLA's SPMD partitioner
+    cannot reshard a gather efficiently — it falls back to "involuntary
+    full rematerialization" (replicate everything, then re-partition).
+    Staging the layouts — gather at its natural spec, then the
+    seq-shard/d-gather transition on a separate copy op — turns that into
+    the ordinary Megatron-SP all-to-all at block entry."""
+    if not cfg.sp_axis:
+        return x
+    from jax.sharding import PartitionSpec as P
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, P("dp", None, cfg.sp_axis))
+    except Exception:
+        return x  # no mesh context active (single-device runs)
+
+
 def _sp_constrain(x, cfg):
     """Megatron sequence parallelism: between blocks the residual stream is
     sharded over sequence on the sp axis, so the layernorms and elementwise
@@ -524,6 +545,7 @@ class Transformer(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
                      dtype=dtype)(tokens)
+        x = _embed_out_constrain(x, cfg)
         if not cfg.rope:  # RoPE rotates q/k inside attention instead
             pos_ids = jnp.arange(tokens.shape[1])
             if cfg.decode:

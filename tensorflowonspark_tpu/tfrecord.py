@@ -207,21 +207,25 @@ class TFRecordWriter:
         self.close()
 
 
-def _is_gzip(path):
-    """True when `path` is a gzip stream and NOT a plain TFRecord.
+def _head_is_gzip(head):
+    """True when a 12-byte file head is a gzip stream and NOT a plain
+    TFRecord.
 
     The gzip magic (1f 8b) can collide with the little-endian uint64
     length prefix of a plain record, so a valid plain-TFRecord header
     (length CRC checks out — 2^-32 false-positive odds for real gzip
     bytes) wins over the magic."""
-    from . import fsio
-    with fsio.fopen(path, "rb") as f:
-        head = f.read(12)
     if len(head) == 12:
         (len_crc,) = struct.unpack("<I", head[8:12])
         if masked_crc32c(head[:8]) == len_crc:
             return False            # valid plain TFRecord frame header
     return head[:2] == b"\x1f\x8b"
+
+
+def _is_gzip(path):
+    from . import fsio
+    with fsio.fopen(path, "rb") as f:
+        return _head_is_gzip(f.read(12))
 
 
 def read_records(path_or_file, verify_crc=True):
@@ -235,14 +239,26 @@ def read_records(path_or_file, verify_crc=True):
     """
     from . import fsio
 
+    if not hasattr(path_or_file, "read") and fsio.is_remote(path_or_file):
+        # ONE remote open serves sniff + parse (each open is a round trip
+        # on object stores); gzip wraps the same handle
+        with fsio.fopen(path_or_file, "rb") as raw:
+            head = raw.read(12)
+            raw.seek(0)
+            if _head_is_gzip(head):
+                import gzip
+                with gzip.GzipFile(fileobj=raw, mode="rb") as gz:
+                    yield from read_records(gz, verify_crc=verify_crc)
+            else:
+                yield from read_records(raw, verify_crc=verify_crc)
+        return
     if not hasattr(path_or_file, "read") and _is_gzip(path_or_file):
         import gzip
         with fsio.fopen(path_or_file, "rb") as raw:
             with gzip.GzipFile(fileobj=raw, mode="rb") as gz:
                 yield from read_records(gz, verify_crc=verify_crc)
         return
-    if _native is not None and not hasattr(path_or_file, "read") \
-            and not fsio.is_remote(path_or_file):
+    if _native is not None and not hasattr(path_or_file, "read"):
         path = fsio.local_path(path_or_file)
         size = os.path.getsize(path)
         if size == 0:
@@ -250,7 +266,7 @@ def read_records(path_or_file, verify_crc=True):
         # One C pass mmaps + CRC-checks + indexes the file, then records are
         # streamed with seek/read — O(record) resident memory for any shard
         # size, and CRC cost stays in native code.  (Local files only; remote
-        # paths stream through the Python parser below.)
+        # paths stream through the Python parser above.)
         offsets, lengths = _native_index_file(path, size, verify_crc)
         with open(path, "rb") as f:
             for off, ln in zip(offsets, lengths):
