@@ -127,6 +127,24 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
     return export_dir
 
 
+def read_signature(export_dir, signature_def_key=None):
+    """Read ``(spec, signature)`` from an export dir without loading
+    params — the cheap metadata half of `load_saved_model` (format check
+    and signature lookup included)."""
+    from . import fsio
+    with fsio.fopen(fsio.join(export_dir, MODEL_SPEC), "r") as f:
+        spec = json.load(f)
+    if spec.get("format") != "tfos-tpu-saved-model":
+        raise ValueError(f"{export_dir} is not a tfos-tpu saved model")
+    sig_key = signature_def_key or DEFAULT_SIGNATURE
+    try:
+        return spec, spec["signatures"][sig_key]
+    except KeyError:
+        raise ValueError(
+            f"signature {sig_key!r} not found; available: "
+            f"{sorted(spec['signatures'])}") from None
+
+
 def load_saved_model(export_dir, signature_def_key=None):
     """Load ``(apply_fn, params, signature)`` from an export dir.
 
@@ -135,17 +153,7 @@ def load_saved_model(export_dir, signature_def_key=None):
     (pipeline.py:596-613).
     """
     from . import fsio
-    with fsio.fopen(fsio.join(export_dir, MODEL_SPEC), "r") as f:
-        spec = json.load(f)
-    if spec.get("format") != "tfos-tpu-saved-model":
-        raise ValueError(f"{export_dir} is not a tfos-tpu saved model")
-    sig_key = signature_def_key or DEFAULT_SIGNATURE
-    try:
-        signature = spec["signatures"][sig_key]
-    except KeyError:
-        raise ValueError(
-            f"signature {sig_key!r} not found; available: "
-            f"{sorted(spec['signatures'])}") from None
+    spec, signature = read_signature(export_dir, signature_def_key)
 
     built = _resolve_builder(spec["builder"])(**spec["builder_kwargs"])
     if hasattr(built, "apply") and hasattr(built, "init"):  # flax Module

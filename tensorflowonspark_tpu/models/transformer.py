@@ -21,6 +21,9 @@ import jax
 import jax.numpy as jnp
 
 
+logger = logging.getLogger(__name__)
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -468,6 +471,24 @@ class MoEMLP(nn.Module):
         return yt.reshape(B, S, D).astype(dtype), frac_tokens
 
 
+def _constrain_bsd(x, cfg, seq_axis, d_axis):
+    """`with_sharding_constraint` on a [B, S, D] stream with batch over dp
+    and the given mesh axes (or None) on the sequence/model dims; a no-op
+    without an sp config or an active mesh (single-device runs)."""
+    if not cfg.sp_axis:
+        return x
+    from jax.sharding import PartitionSpec as P
+    try:
+        return jax.lax.with_sharding_constraint(x, P("dp", seq_axis, d_axis))
+    except Exception:
+        # no mesh context (or mesh without dp/sp axes): run unconstrained —
+        # logged because under a REAL mesh this silently disables the
+        # sp sharding (and the embed-gather remat fix)
+        logger.debug("sharding constraint skipped (no active mesh?)",
+                     exc_info=True)
+        return x
+
+
 def _embed_out_constrain(x, cfg):
     """Pin the token-embed gather OUTPUT to its natural sharding: batch
     over dp, d_model over tp (matching the table's P(None, 'tp') layout).
@@ -479,14 +500,7 @@ def _embed_out_constrain(x, cfg):
     Staging the layouts — gather at its natural spec, then the
     seq-shard/d-gather transition on a separate copy op — turns that into
     the ordinary Megatron-SP all-to-all at block entry."""
-    if not cfg.sp_axis:
-        return x
-    from jax.sharding import PartitionSpec as P
-    try:
-        return jax.lax.with_sharding_constraint(
-            x, P("dp", None, cfg.sp_axis))
-    except Exception:
-        return x  # no mesh context active (single-device runs)
+    return _constrain_bsd(x, cfg, None, cfg.sp_axis)
 
 
 def _sp_constrain(x, cfg):
@@ -494,14 +508,7 @@ def _sp_constrain(x, cfg):
     sharded over sequence on the sp axis, so the layernorms and elementwise
     work are divided N_tp-ways and XLA turns the tp allreduces into
     reduce-scatter + all-gather pairs at block entry/exit."""
-    if not cfg.sp_axis:
-        return x
-    from jax.sharding import PartitionSpec as P
-    try:
-        return jax.lax.with_sharding_constraint(
-            x, P("dp", cfg.sp_axis, None))
-    except Exception:
-        return x  # no mesh context active (single-device runs)
+    return _constrain_bsd(x, cfg, cfg.sp_axis, None)
 
 
 class Block(nn.Module):

@@ -1,0 +1,93 @@
+"""Spark ML pipeline integration: true `pyspark.ml` Estimator/Model.
+
+The reference's headline pipeline capability is that `TFEstimator` /
+`TFModel` ARE Spark ML stages (`class TFEstimator(Estimator, TFParams...)`,
+reference: pipeline.py:351,435) and therefore compose in
+`Pipeline([...]).fit()` chains with param propagation.  The base
+`tensorflowonspark_tpu.pipeline` module keeps its no-pyspark-required
+API; this module is the import-gated Spark ML face over the same logic.
+
+Importable whenever `pyspark.ml` is (real pyspark, or the in-repo
+`minispark` test double after `minispark.install()` — same API).
+
+    from tensorflowonspark_tpu.pipeline_ml import TFEstimator, TFModel
+    model = Pipeline(stages=[est]).fit(df).stages[0]
+    preds = model.transform(df)          # DataFrame of output columns
+"""
+import logging
+
+from pyspark.ml import Estimator, Model
+
+from . import export as export_mod
+from . import pipeline as base
+
+logger = logging.getLogger(__name__)
+
+
+class TFEstimator(Estimator, base.TFParams):
+    """Spark ML estimator: `fit(df)` runs a cluster over the DataFrame and
+    returns a `TFModel` stage (maps reference TFEstimator,
+    pipeline.py:351-432)."""
+
+    def __init__(self, train_fn, tf_args=None, export_fn=None):
+        Estimator.__init__(self)
+        base.TFParams.__init__(self)
+        self.train_fn = train_fn
+        self.export_fn = export_fn
+        self.args = base.Namespace(tf_args if tf_args is not None else {})
+
+    def _fit(self, dataset):
+        inner = base.TFEstimator(self.train_fn, self.args,
+                                 export_fn=self.export_fn)
+        inner._paramMap = dict(self._paramMap)
+        fitted = inner._fit(dataset)
+        model = TFModel(fitted.args)
+        model._paramMap = dict(self._paramMap)
+        return model
+
+
+class TFModel(Model, base.TFParams):
+    """Spark ML model: `transform(df)` -> DataFrame of model outputs
+    (maps reference TFModel, pipeline.py:435-644; the reference likewise
+    returns a DataFrame of the OUTPUT columns)."""
+
+    def __init__(self, tf_args=None):
+        Model.__init__(self)
+        base.TFParams.__init__(self)
+        self.args = base.Namespace(tf_args if tf_args is not None else {})
+
+    def _output_columns(self, args):
+        """Output column names, in model-output order, honoring
+        output_mapping (tensor name -> column name)."""
+        serving_dir = args.export_dir or args.model_dir
+        _, signature = export_mod.read_signature(serving_dir,
+                                                 args.signature_def_key)
+        outs = signature.get("outputs", ["output"])
+        mapping = args.output_mapping or {}
+        if mapping:
+            outs = [o for o in outs if o in mapping]
+        return [mapping.get(o, o) for o in outs]
+
+    def _transform(self, dataset):
+        from pyspark.sql import SparkSession
+
+        args = self.merge_args_params()
+        inner = base.TFModel(self.args)
+        inner._paramMap = dict(self._paramMap)
+        preds = inner._transform(dataset)
+        columns = self._output_columns(args)
+        if hasattr(preds, "mapPartitions"):     # RDD of prediction rows
+            n_cols = len(columns)
+
+            def _as_row(r):
+                row = tuple(r) if isinstance(r, (tuple, list)) else (r,)
+                if len(row) != n_cols:
+                    raise ValueError(
+                        f"model emitted {len(row)} outputs but the schema "
+                        f"has {n_cols} columns {columns}")
+                return row
+
+            spark = SparkSession.builder.getOrCreate()
+            return spark.createDataFrame(preds.map(_as_row), list(columns))
+        # plain-list path (no Spark context): keep rows, as base does
+        return preds

@@ -1,0 +1,146 @@
+"""Unit tests for the minispark test double itself: the pyspark subset
+contract the Spark-surface integration tier stands on."""
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import minispark
+
+pytestmark = pytest.mark.skipif(
+    not minispark.install(), reason="real pyspark present")
+
+
+@pytest.fixture
+def sc(tmp_path):
+    import pyspark
+
+    context = pyspark.SparkContext(num_executors=2,
+                                   workdir=str(tmp_path / "ms"))
+    yield context
+    context.stop()
+
+
+class TestRDD:
+    def test_collect_and_transforms(self, sc):
+        rdd = sc.parallelize(range(10), 4)
+        assert rdd.collect() == list(range(10))
+        assert rdd.map(lambda x: x * x).collect() == \
+            [x * x for x in range(10)]
+        assert rdd.flatMap(lambda x: [x, -x]).count() == 20
+        assert rdd.filter(lambda x: x % 2 == 0).collect() == [0, 2, 4, 6, 8]
+
+    def test_partitioning_and_with_index(self, sc):
+        rdd = sc.parallelize(range(10), 4)
+        assert rdd.getNumPartitions() == 4
+        sums = rdd.mapPartitionsWithIndex(
+            lambda i, it: [(i, sum(it))]).collect()
+        assert sums == [(0, 3), (1, 12), (2, 13), (3, 17)]
+
+    def test_union_preserves_order(self, sc):
+        a = sc.parallelize([1, 2], 2)
+        b = sc.parallelize([3], 1)
+        assert a.union(b).collect() == [1, 2, 3]
+        assert a.union(b).getNumPartitions() == 3
+
+    def test_closures_cloudpickle(self, sc):
+        k = 41
+        assert sc.parallelize([1], 1).map(lambda x: x + k).collect() == [42]
+
+    def test_executors_are_separate_reused_processes(self, sc):
+        rdd = sc.parallelize(range(4), 4)
+        marks = rdd.mapPartitions(
+            lambda it: [(os.getpid(), os.getcwd())]).collect()
+        pids = {p for p, _ in marks}
+        dirs = {d for _, d in marks}
+        assert len(pids) == 2 and len(dirs) == 2      # 2 real processes
+        again = {p for p in rdd.mapPartitions(
+            lambda it: [os.getpid()]).collect()}
+        assert again == pids                           # reused, not fresh
+
+    def test_task_error_propagates_and_executor_survives(self, sc):
+        with pytest.raises(RuntimeError, match="ZeroDivisionError"):
+            sc.parallelize([1], 1).map(lambda x: 1 / 0).collect()
+        assert sc.parallelize([5], 1).collect() == [5]
+
+    def test_side_effect_state_persists_in_executor_dir(self, sc):
+        def write(it):
+            with open("state.txt", "w") as f:
+                f.write("x")
+            return []
+
+        def read(it):
+            return [os.path.exists("state.txt")]
+
+        sc.parallelize([0], 1).foreachPartition(write)
+        assert sc.parallelize([0], 1).mapPartitions(read).collect() == [True]
+
+
+class TestSql:
+    def test_dataframe_rows_and_select(self, sc):
+        from pyspark.sql import SparkSession
+        from pyspark.sql import types as T
+
+        spark = SparkSession.builder.getOrCreate()
+        df = spark.createDataFrame(
+            sc.parallelize([(1, "a"), (2, "b")], 2),
+            T.StructType([T.StructField("id", T.LongType()),
+                          T.StructField("s", T.StringType())]))
+        rows = df.collect()
+        assert rows == [(1, "a"), (2, "b")]
+        assert rows[0].id == 1 and rows[1]["s"] == "b"
+        assert rows[0].asDict() == {"id": 1, "s": "a"}
+        assert df.select("s", "id").collect()[0] == ("a", 1)
+        assert df.rdd.map(tuple).collect() == [(1, "a"), (2, "b")]
+        assert df.schema.simpleString() == "struct<id:bigint,s:string>"
+
+    def test_session_binds_active_context(self, sc):
+        from pyspark.sql import SparkSession
+
+        assert SparkSession.builder.getOrCreate().sparkContext is sc
+
+
+class TestStreaming:
+    def test_queue_stream_graceful_drain(self, sc):
+        from pyspark.streaming import StreamingContext
+
+        ssc = StreamingContext(sc, 0.05)
+        seen = []
+        stream = ssc.queueStream([sc.parallelize([1, 2], 1),
+                                  sc.parallelize([3], 1)])
+        stream.foreachRDD(lambda _t, rdd: seen.extend(rdd.collect()))
+        ssc.start()
+        ssc.stop(stopSparkContext=False, stopGraceFully=True)
+        assert seen == [1, 2, 3]
+
+
+class TestMl:
+    def test_pipeline_chains_estimators_and_transformers(self):
+        from pyspark.ml import Estimator, Model, Pipeline, Transformer
+
+        class AddOne(Transformer):
+            def _transform(self, data):
+                return [x + 1 for x in data]
+
+        class MeanModel(Model):
+            def __init__(self, mean):
+                super().__init__()
+                self.mean = mean
+
+            def _transform(self, data):
+                return [x - self.mean for x in data]
+
+        class MeanEstimator(Estimator):
+            def _fit(self, data):
+                return MeanModel(sum(data) / len(data))
+
+        pm = Pipeline(stages=[AddOne(), MeanEstimator()]).fit([1, 2, 3])
+        assert isinstance(pm.stages[1], MeanModel)
+        assert pm.stages[1].mean == 3.0
+        assert pm.transform([1, 2, 3]) == [-1.0, 0.0, 1.0]
+
+
+def test_install_is_idempotent_and_flagged():
+    import pyspark
+
+    assert getattr(pyspark, "__is_minispark__", False)
+    assert minispark.install() is True   # second call: no-op
