@@ -12,3 +12,18 @@ class Linear(nn.Module):
     def __call__(self, x):
         y = nn.Dense(self.features, name="dense")(x.astype(jnp.float32))
         return y[..., 0] if self.features == 1 else y
+
+
+class MLP(nn.Module):
+    """Relu MLP (`features` = per-layer widths) — the wide-serving shape
+    the marshalling benchmarks exercise (scripts/bench_serving.py)."""
+    features: tuple = (128, 128)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(jnp.float32)
+        for i, width in enumerate(self.features):
+            x = nn.Dense(width, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x

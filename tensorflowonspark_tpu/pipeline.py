@@ -21,6 +21,7 @@ import logging
 from . import backend as backend_mod
 from . import cluster as cluster_mod
 from . import export as export_mod
+from . import marker as marker_mod
 
 logger = logging.getLogger(__name__)
 
@@ -283,19 +284,42 @@ def _run_saved_model(export_dir, signature_def_key, batch_size,
         else:
             tensor_names = sig_inputs
 
+        def _columnarize(batch):
+            """Rows -> {tensor_name: column}, one C-speed pass when the
+            records pack (reuses the feed plane's columnar packer instead
+            of per-record python list building — the reference's JVM path
+            was columnar end-to-end too, TFModel.scala:121-239)."""
+            packed = marker_mod.pack_records(batch)
+            if isinstance(packed, marker_mod.PackedChunk):
+                if packed.matrix:           # [N, F] flat rows
+                    mat = packed.columns[0]
+                    return {name: mat[:, i]
+                            for i, name in enumerate(tensor_names)}
+                if packed.row_type in (tuple, list):
+                    return dict(zip(tensor_names, packed.columns))
+                # single-value records: every declared input sees the one
+                # column (matches the row path's `rec` fallback)
+                return {name: packed.columns[0] for name in tensor_names}
+            # non-uniform records: the original per-column comprehension
+            return {name: [rec[i] if isinstance(rec, (tuple, list)) else rec
+                           for rec in batch]
+                    for i, name in enumerate(tensor_names)}
+
         def _predict(batch):
-            columns = {name: [rec[i] if isinstance(rec, (tuple, list)) else rec
-                              for rec in batch]
-                       for i, name in enumerate(tensor_names)}
-            arrays = export_mod.coerce_inputs(signature, columns)
+            import numpy as np
+
+            arrays = export_mod.coerce_inputs(signature, _columnarize(batch))
             outputs = jit_apply(params, *arrays)
             if not isinstance(outputs, (tuple, list)):
                 outputs = (outputs,)
             named = dict(zip(signature.get("outputs", ["output"]), outputs))
-            import numpy as np
             picked = [np.asarray(named[n]) for n in out_names]
-            for row in zip(*(p.tolist() for p in picked)):
-                yield row[0] if len(row) == 1 else row
+            # rows come out as numpy views/scalars — no per-element python
+            # boxing (`.tolist()` on a wide output dominated serving cost)
+            if len(picked) == 1:
+                yield from picked[0]
+            else:
+                yield from zip(*picked)
 
         batch = []
         for rec in iterator:

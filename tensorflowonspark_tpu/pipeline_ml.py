@@ -80,12 +80,19 @@ class TFModel(Model, base.TFParams):
             n_cols = len(columns)
 
             def _as_row(r):
+                import numpy as np
+
                 row = tuple(r) if isinstance(r, (tuple, list)) else (r,)
                 if len(row) != n_cols:
                     raise ValueError(
                         f"model emitted {len(row)} outputs but the schema "
                         f"has {n_cols} columns {columns}")
-                return row
+                # serving emits numpy scalars/row views (the columnar fast
+                # path); real pyspark's type inference needs python values
+                # — box only here, at the DataFrame boundary
+                return tuple(v.item() if isinstance(v, np.generic)
+                             else v.tolist() if isinstance(v, np.ndarray)
+                             else v for v in row)
 
             spark = SparkSession.builder.getOrCreate()
             return spark.createDataFrame(preds.map(_as_row), list(columns))
