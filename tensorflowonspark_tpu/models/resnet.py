@@ -77,6 +77,15 @@ class ResNet(nn.Module):
 
     ``stage_sizes`` counts blocks per stage; ``small_inputs`` keeps the
     CIFAR-style 3x3 stem (no max-pool) vs the 7x7/stride-2 ImageNet stem.
+
+    ``norm``:
+    - "group" (default): stateless GroupNorm — SPMD-friendly, but its
+      statistics pass re-reads every conv output from HBM (the round-1
+      profile's dominant cost at ImageNet shapes);
+    - "batch": classic BN (caller threads ``batch_stats``);
+    - "none": normalizer-free — weight-standardized convs (common.WSConv)
+      + SkipInit residual scaling (common.IdentityNorm); no activation
+      statistics at all, the HBM-optimal variant (NF-ResNet recipe).
     """
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     num_classes: int = 1000
@@ -85,6 +94,12 @@ class ResNet(nn.Module):
     small_inputs: bool = False
     norm: str = "group"
     dtype: str = "bfloat16"
+    # "s2d": 2x2 space-to-depth stem — the 7x7/s2 conv over 3-channel
+    # images runs the MXU at 3/128 input-lane efficiency; reshaping to
+    # [H/2, W/2, 12] and using a 4x4/s1 conv (same output shape, ~8x8/s2
+    # receptive field) is the standard TPU ResNet stem optimization
+    # (MLPerf space-to-depth trick).
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -95,6 +110,10 @@ class ResNet(nn.Module):
             norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                      momentum=0.9, epsilon=1e-5,
                                      dtype=jnp.float32)
+        elif self.norm == "none":
+            from .common import IdentityNorm, WSConv
+            conv = functools.partial(WSConv, dtype=self.dtype)
+            norm = IdentityNorm
         else:
             from .common import ChannelGroupNorm
             norm = ChannelGroupNorm
@@ -104,6 +123,12 @@ class ResNet(nn.Module):
         x = x.astype(dtype)
         if self.small_inputs:
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        elif self.stem == "s2d":
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2,
+                                                      4 * c)
+            x = conv(self.num_filters, (4, 4), name="conv_init")(x)
         else:
             x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="norm_init")(x)
