@@ -51,9 +51,25 @@ class Backend:
         raise NotImplementedError
 
 
+def _loads_fn(fn_or_blob):
+    """Task fns cross the process boundary as cloudpickle blobs — the
+    standard pickler spawn uses for Process args cannot serialize the
+    nested closures cluster.run builds (node.run(...)'s _mapfn)."""
+    if isinstance(fn_or_blob, bytes):
+        import cloudpickle
+        return cloudpickle.loads(fn_or_blob)
+    return fn_or_blob
+
+
+def _dumps_fn(fn):
+    import cloudpickle
+    return cloudpickle.dumps(fn)
+
+
 def _task_trampoline(fn, part, result_q, index, workdir, collect):
     """Child-process shim: chdir to the executor dir, run, ship result/error."""
     try:
+        fn = _loads_fn(fn)
         if workdir:
             os.chdir(workdir)
         out = fn(iter(part))
@@ -83,6 +99,7 @@ def _bootstrap_trampoline(fn, executor_id, workdir, status_q, manager_linger=600
     """
     from tensorflowonspark_tpu import manager as manager_mod
     try:
+        fn = _loads_fn(fn)
         os.chdir(workdir)
         fn(iter([executor_id]))
         status_q.put((executor_id, "ok", None))
@@ -120,10 +137,21 @@ def _bootstrap_trampoline(fn, executor_id, workdir, status_q, manager_linger=600
 
 
 class LocalBackend(Backend):
-    """N-process local executor pool with per-executor working directories."""
+    """N-process local executor pool with per-executor working directories.
+
+    Defaults to ``start_method="fork"`` — unlike minispark's ExecutorPool,
+    which defaults to spawn.  This is the dev/CI backend: its tests fork
+    dozens of short-lived executors from a JAX-loaded runner, and spawn
+    would re-import jax (~10 s) in every one.  The fork-after-threads
+    hazard is real; a long-lived multithreaded driver should pass
+    ``start_method="spawn"`` (supported: task fns cross the process
+    boundary as cloudpickle blobs, so closure fns survive spawn's
+    standard pickler).
+    """
 
     def __init__(self, num_executors, workdir=None, start_method="fork"):
         self._n = num_executors
+        self._start_method = start_method
         self._ctx = mp.get_context(start_method)
         self._root = workdir or tempfile.mkdtemp(prefix="tfos-tpu-local-")
         self._dirs = []
@@ -142,12 +170,18 @@ class LocalBackend(Backend):
     def executor_dirs(self):
         return list(self._dirs)
 
+    def _ship_fn(self, fn):
+        # fork ships Process args for free; only spawn needs the
+        # cloudpickle blob (standard pickle rejects nested closures)
+        return fn if self._start_method == "fork" else _dumps_fn(fn)
+
     def run_on_executors(self, fn, n):
         assert n == self._n, f"backend has {self._n} executors, asked for {n}"
+        blob = self._ship_fn(fn)
         for i in range(n):
             p = self._ctx.Process(
                 target=_bootstrap_trampoline,
-                args=(fn, i, self._dirs[i], self._status_q),
+                args=(blob, i, self._dirs[i], self._status_q),
                 name=f"executor-{i}",
             )
             p.start()
@@ -179,6 +213,7 @@ class LocalBackend(Backend):
 
         live_procs = []
         cancelled = threading.Event()
+        blob = self._ship_fn(fn)
 
         def _run_serial(eid, tasks):
             for index, part in tasks:
@@ -186,7 +221,8 @@ class LocalBackend(Backend):
                     return
                 p = self._ctx.Process(
                     target=_task_trampoline,
-                    args=(fn, part, result_q, index, self._dirs[eid], collect),
+                    args=(blob, part, result_q, index, self._dirs[eid],
+                          collect),
                     name=f"task-{index}",
                 )
                 p.start()

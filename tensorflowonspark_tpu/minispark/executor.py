@@ -60,11 +60,15 @@ def _worker_main(index, workdir, task_q, result_q):
 
 
 class ExecutorPool:
-    """N persistent fork-started executor processes with stable workdirs."""
+    """N persistent executor processes with stable workdirs."""
 
-    def __init__(self, num_executors, root=None, start_method="fork"):
-        # tasks are cloudpickled, so spawn works too (fork is the cheap
-        # default on the Linux CI boxes, matching backend.LocalBackend)
+    def __init__(self, num_executors, root=None, start_method="spawn"):
+        # spawn by default: the driver that builds this pool is typically
+        # multithreaded with JAX already loaded (dispatcher threads, XLA
+        # runtime threads), and CPython's fork-after-threads is a latent
+        # deadlock.  Tasks are cloudpickled, so spawn is fully supported;
+        # callers on a single-threaded driver may pass 'fork' for cheaper
+        # startup.
         self._n = num_executors
         self._ctx = mp.get_context(start_method)
         self._root = root or tempfile.mkdtemp(prefix="minispark-")

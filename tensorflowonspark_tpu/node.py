@@ -295,8 +295,16 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
                 ring = shm.ShmChunkRing.create()
                 mgr.set("shm_ring", ring.info())
                 shm.advertise_file(ring.info())
+                # Creator-side last-resort unlink.  atexit alone is not
+                # enough: multiprocessing children exit via os._exit after
+                # running only mp.util finalizers, so in an executor
+                # process an atexit hook never fires (leaving the tracker
+                # to warn about an already-unlinked segment).  Register
+                # both — unlink is idempotent.
                 import atexit
+                from multiprocessing import util as mp_util
                 atexit.register(ring.unlink)
+                mp_util.Finalize(None, ring.unlink, exitpriority=10)
             except Exception:
                 logger.warning("shm ring unavailable; data feed falls back "
                                "to manager-queue transport", exc_info=True)
@@ -433,22 +441,37 @@ def _push_chunks(q, iterator, mgr=None, timeout=600.0, equeue=None):
             raise RuntimeError(f"training function failed:\n{tb}")
 
     def _flush():
-        nonlocal pending, pending_bytes
+        nonlocal pending, pending_bytes, ring
         if not pending:
             return
         subs, pending, pending_bytes = pending, [], 0
         try:
             parts, n = (shm.encode_multi(subs) if len(subs) > 1
                         else shm.encode_chunk(subs[0]))
-            q.put(ring.write(parts, n, timeout=timeout,
-                             should_abort=_abort_on_error))
-            return
-        except (shm.RingTimeout, RuntimeError):
-            raise
         except Exception:
-            # codec surprise: the queue still works
-            logger.warning("ring write failed; chunks ride the queue",
+            # codec surprise: the queue still works (ring untouched)
+            logger.warning("chunk encode failed; chunks ride the queue",
                            exc_info=True)
+        else:
+            try:
+                ref = ring.write(parts, n, timeout=timeout,
+                                 should_abort=_abort_on_error)
+            except (shm.RingTimeout, RuntimeError):
+                raise
+            except Exception:
+                # write() repaired its frame state, but a transport that
+                # failed generically once is not worth retrying — drop to
+                # queue transport for the remainder of this task
+                logger.warning("ring write failed; disabling ring for this "
+                               "task", exc_info=True)
+                ring = None
+            else:
+                # q.put stays OUTSIDE the handler: a manager failure after
+                # a successful write must fail the task (its frames are
+                # committed; re-sending the subs via the queue would both
+                # duplicate records and orphan the FULL frames)
+                q.put(ref)
+                return
         for sub in subs:
             q.put(sub)
 

@@ -193,6 +193,16 @@ class TFModel(TFParams):
         self.args = Namespace(tf_args if tf_args is not None else {})
 
     def transform(self, dataset, backend=None):
+        """Run batch inference over ``dataset``; returns rows in input order.
+
+        Output rows are numpy values — scalar outputs yield ``np.ndarray``
+        row views / numpy scalars, multi-output models yield tuples of
+        them — NOT boxed Python floats/lists (per-element ``.tolist()``
+        dominated serving cost; see BASELINE.md serving round 2).  Sinks
+        that need Python-native types (``createDataFrame``, JSON
+        serialization) must box at their own boundary the way
+        `pipeline_ml.TFModelML` does before building its DataFrame.
+        """
         return self._transform(dataset, backend)
 
     def _transform(self, dataset, backend=None):

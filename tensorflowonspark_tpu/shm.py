@@ -46,7 +46,6 @@ and consumers attach by name. `TFOS_TPU_SHM_RING=0` disables the data
 plane (the queue then carries whole chunks, as in round 1);
 `TFOS_TPU_RING_MB` sizes it (default 64).
 """
-import contextlib
 import json
 import logging
 import os
@@ -142,21 +141,45 @@ def discover(mgr=None, workdir=None):
 _attach_lock = threading.Lock()
 
 
-@contextlib.contextmanager
-def _untracked():
-    """Python 3.12's SharedMemory registers ATTACHES with the resource
+def _supports_track_kwarg():
+    import inspect
+    from multiprocessing import shared_memory
+    try:
+        return "track" in inspect.signature(
+            shared_memory.SharedMemory.__init__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+_HAS_TRACK = _supports_track_kwarg()
+
+
+def _attach_untracked(name):
+    """Open an existing segment WITHOUT resource-tracker registration.
+
+    Python 3.12's SharedMemory registers ATTACHES with the resource
     tracker too, whose exit handler would unlink the segment when a
-    short-lived feeder task exits (bpo-38119). Suppressing the attach-time
-    registration (3.13's ``track=False`` equivalent) keeps the tracker's
-    set-based accounting balanced: only the creator owns the name —
-    unregister-after-attach would instead delete the creator's entry in a
-    fork-shared tracker."""
-    from multiprocessing import resource_tracker
+    short-lived feeder task exits (bpo-38119). Only the creator may own
+    the name — unregister-after-attach would instead delete the creator's
+    entry in a fork-shared tracker.
+
+    On 3.13+ attaches pass ``track=False`` natively, so concurrent
+    SharedMemory creation on other threads is never affected.  On 3.12
+    the fallback patches ``resource_tracker.register`` process-wide for
+    the duration of the attach; `_attach_lock` serializes our own
+    attaches, and the window is a single shm_open — an unrelated create
+    racing it would skip tracker registration (leaking that name on
+    abnormal exit), which is why the native kwarg is preferred whenever
+    present."""
+    from multiprocessing import resource_tracker, shared_memory
+    if _HAS_TRACK:
+        return shared_memory.SharedMemory(name=name, create=False,
+                                          track=False)
     with _attach_lock:
         orig = resource_tracker.register
         resource_tracker.register = lambda name, rtype: None
         try:
-            yield
+            return shared_memory.SharedMemory(name=name, create=False)
         finally:
             resource_tracker.register = orig
 
@@ -198,11 +221,7 @@ class ShmChunkRing:
 
     @classmethod
     def attach(cls, info):
-        from multiprocessing import shared_memory
-
-        with _untracked():
-            shm_obj = shared_memory.SharedMemory(name=info["name"],
-                                                 create=False)
+        shm_obj = _attach_untracked(info["name"])
         buf = shm_obj.buf
         magic, nslots, _, _ = struct.unpack_from("<QIIQ", buf, 0)
         if magic != _MAGIC:
@@ -307,26 +326,45 @@ class ShmChunkRing:
         deadline = time.time() + timeout
         frame = 0                      # current frame index
         frame_used = 0                 # bytes already written in it
-        self._wait_free(seq0, deadline, should_abort)
-        base = _HEADER_BYTES + (seq0 % self.nslots) * self.slot_bytes
-        for part in parts:
-            view = memoryview(part).cast("B")
-            off = 0
-            while off < len(view):
-                if frame_used == self.slot_bytes:
-                    self._set_state(seq0 + frame, _FULL)
-                    frame += 1
-                    frame_used = 0
-                    self._wait_free(seq0 + frame, deadline, should_abort)
-                    base = _HEADER_BYTES + \
-                        ((seq0 + frame) % self.nslots) * self.slot_bytes
-                take = min(len(view) - off, self.slot_bytes - frame_used)
-                dst = base + frame_used
-                self._buf[dst:dst + take] = view[off:off + take]
-                frame_used += take
-                off += take
-            view.release()
-        self._set_state(seq0 + frame, _FULL)
+        marked = 0                     # frames this write has set FULL
+        try:
+            self._wait_free(seq0, deadline, should_abort)
+            base = _HEADER_BYTES + (seq0 % self.nslots) * self.slot_bytes
+            for part in parts:
+                view = memoryview(part).cast("B")
+                off = 0
+                while off < len(view):
+                    if frame_used == self.slot_bytes:
+                        self._set_state(seq0 + frame, _FULL)
+                        marked += 1
+                        frame += 1
+                        frame_used = 0
+                        self._wait_free(seq0 + frame, deadline, should_abort)
+                        base = _HEADER_BYTES + \
+                            ((seq0 + frame) % self.nslots) * self.slot_bytes
+                    take = min(len(view) - off, self.slot_bytes - frame_used)
+                    dst = base + frame_used
+                    self._buf[dst:dst + take] = view[off:off + take]
+                    frame_used += take
+                    off += take
+                view.release()
+            self._set_state(seq0 + frame, _FULL)
+            marked += 1
+        except BaseException:
+            # A partial write (timeout/abort on a later frame) has marked
+            # frames FULL without advancing produced_seq; since no ShmRef
+            # was enqueued the consumer will never free them, and the NEXT
+            # write would block in _wait_free forever.  Restore the
+            # invariant before propagating — but ONLY for frames this
+            # write marked: a slot whose _wait_free raised (on a wrapped
+            # ring) still holds an older un-consumed payload, and forcing
+            # it FREE would let a retrying feeder overwrite live data.
+            for k in range(marked):
+                try:
+                    self._set_state(seq0 + k, _FREE)
+                except Exception:
+                    break
+            raise
         assert frame + 1 == nframes, (frame, nframes, nbytes)
         self._set_produced_seq(seq0 + nframes)
         return ShmRef(seq0, nframes, nbytes, count)
@@ -466,15 +504,47 @@ def decode_payload(view, copy=True):
 # -- process-local attach cache ---------------------------------------
 
 _attached = {}
+_cache_lock = threading.Lock()
+_MAX_ATTACHED = 4
+
+
+def _segment_gone(name):
+    """True when the POSIX shm name has been unlinked (Linux exposes
+    segments under /dev/shm). Platforms without /dev/shm (macOS) report
+    False for everything so we never evict a live mapping."""
+    try:
+        if not os.path.isdir("/dev/shm"):
+            return False
+        return not os.path.exists("/dev/shm/" + name.lstrip("/"))
+    except OSError:
+        return False
 
 
 def attach_cached(info):
     """Attach once per (process, ring name); feeder tasks and DataFeeds
-    call this on every chunk."""
+    call this on every chunk.
+
+    Long-lived executor processes (SPARK_REUSE_WORKER) see a fresh ring
+    per cluster.run(); on every new attach, mappings whose segment has
+    since been unlinked are closed and dropped so /dev/shm usage stays
+    bounded across runs instead of accumulating one dead ~64MB mapping
+    per job.
+    """
     ring = _attached.get(info["name"])
     if ring is None:
-        ring = ShmChunkRing.attach(info)
-        _attached[info["name"]] = ring
+        with _cache_lock:
+            ring = _attached.get(info["name"])
+            if ring is None:
+                for name in [n for n in _attached if _segment_gone(n)]:
+                    _attached.pop(name).close()
+                # platform-independent bound (covers hosts with no
+                # /dev/shm, where _segment_gone cannot see unlinks):
+                # tasks run sequentially per executor, so all but the
+                # most recent rings are idle — drop the oldest
+                while len(_attached) >= _MAX_ATTACHED:
+                    _attached.pop(next(iter(_attached))).close()
+                ring = ShmChunkRing.attach(info)
+                _attached[info["name"]] = ring
     return ring
 
 

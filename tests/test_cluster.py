@@ -52,6 +52,18 @@ def fn_train_consume(args, ctx):
 
 # --- tests ---
 
+def test_spawn_backend_round_trip(tmp_path):
+    # spawn's standard pickler cannot ship cluster.run's nested closures;
+    # the backend must cloudpickle fns across the boundary (round-3 fix)
+    c = cluster.run(
+        backend.LocalBackend(NUM_EXECUTORS, workdir=str(tmp_path),
+                             start_method="spawn"),
+        fn_square, tf_args={}, input_mode=cluster.InputMode.SPARK)
+    out = c.inference([[1, 2], [3, 4]])
+    c.shutdown()
+    assert sorted(out) == [1, 4, 9, 16]
+
+
 def test_independent_fns(tmp_path):
     c = cluster.run(_local_backend(tmp_path), fn_independent,
                     tf_args={"expected": "something"},

@@ -93,6 +93,30 @@ class TestRingProtocol:
         with pytest.raises(shm.RingTimeout):
             ring.write(parts, n, timeout=0.3)
 
+    def test_timed_out_write_preserves_unread_payloads(self, ring):
+        # A write that times out waiting for a FULL slot (ring wrapped,
+        # consumer slow) must repair ONLY its own frames: the occupied
+        # slots still hold unread payloads a retrying feeder must not
+        # overwrite (round-3 partial-write repair).
+        rng = np.random.RandomState(0)
+        arrs = [rng.randint(0, 255, 1 << 15).astype(np.uint8)
+                for _ in range(4)]
+        refs = []
+        for a in arrs:
+            parts, n = shm.encode_chunk(marker.PackedChunk((a,), None))
+            refs.append(ring.write(parts, n, timeout=1))
+        big = rng.randint(0, 255, 2 * (1 << 15)).astype(np.uint8)
+        parts, n = shm.encode_chunk(marker.PackedChunk((big,), None))
+        with pytest.raises(shm.RingTimeout):
+            ring.write(parts, n, timeout=0.3)   # acquires nothing
+        # every earlier payload survives intact
+        for a, ref in zip(arrs, refs):
+            out = ring.read(ref)
+            np.testing.assert_array_equal(out.columns[0], a)
+        # and the ring is not wedged: the failed write now fits
+        ref = ring.write(parts, n, timeout=1)
+        np.testing.assert_array_equal(ring.read(ref).columns[0], big)
+
     def test_skip_frees_frames(self, ring):
         arr = np.zeros(1 << 15, dtype=np.uint8)
         parts, n = shm.encode_chunk(marker.PackedChunk((arr,), None))
