@@ -2,32 +2,69 @@
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
-Metric: MNIST CNN training-step throughput (images/sec) over device-resident
-batches — the TPU-native analog of the reference's canonical InputMode.SPARK
-MNIST example (examples/mnist/keras/mnist_spark.py), measuring the jitted
-donated train step the DataFeed pipeline lands batches into.  The reference
-publishes no numbers (BASELINE.md: "published: {}"), so vs_baseline is
-reported against our own recorded baseline (1.0 = the value itself is the
-baseline being established).
+Metric (round 3+): **flagship-LM training MFU** — a 0.87B-parameter
+decoder-only transformer (the frozen `benchmarks.FLAGSHIP_LM` config:
+d2048, 16 layers, GQA 16h/8kv, d_ff 8192, S=1024, batch 8, bf16, RoPE,
+flash attention, adamw with bf16 first moment), the framework's north-star
+workload class (BASELINE.json: large-model training at >60% MFU).  MFU
+uses the standard 6·N·T FLOP estimate over the chip's bf16 peak —
+conservative (attention FLOPs excluded).  Round 1-2 used MNIST CNN
+images/sec (416k-870k through tunnel dispatch noise); the round-1 VERDICT
+(item 4) asked for the bench to track the north-star workload instead —
+the MNIST number is still reported in "aux" for continuity.
 
-Timing methodology (fixed as of round 1, revised for correctness):
-- the timing barrier is a host readback of the final loss
-  (``np.asarray``) — ``jax.block_until_ready`` can return before remote
-  execution completes under tunneled device plugins, inflating results;
-- batches are device-resident: host->HBM feed transfer is overlapped by
-  the DataFeed prefetch pipeline in real training and is benchmarked
-  separately (BASELINE.md feed-IPC row), so the step metric stays
-  comparable across hosts with different interconnects;
-- per-step Python dispatch is included (no lax.scan fusing of steps).
+On a device whose bf16 peak is unknown (not in benchmarks.PEAK_BF16) the
+metric falls back to tokens/sec — an MFU percent against a guessed peak
+would be a fabricated number.
+
+vs_baseline compares against the round-1 recorded flagship-LM MFU (47%,
+BASELINE.md self-measured table) — the framework's own starting point,
+since the reference publishes no numbers (BASELINE.md: "published: {}").
+
+Timing methodology (unchanged from round 1): host-readback barrier
+(np.asarray of the scalar loss) — block_until_ready can return early under
+tunneled device plugins; device-resident batches; donated train state;
+best-of-3 windows against dispatch-latency noise.
 """
 import json
 import time
 
+from tensorflowonspark_tpu.benchmarks import (
+    FLAGSHIP_BATCH, ROUND1_LM_MFU, bf16_peak, make_flagship_step)
+
+
+def bench_flagship_lm(steps=10, windows=3):
+    """Best-of-`windows` step time for the flagship LM; returns
+    (mfu_pct_or_None, tokens_per_sec, step_ms, n_params)."""
+    import numpy as np
+
+    import jax
+
+    step, state, tokens, n_params = make_flagship_step()
+    B, S = tokens.shape[0], tokens.shape[1] - 1
+
+    state, m = step(state, tokens, jax.random.key(1))
+    np.asarray(m["loss"])                          # compile + sync
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, tokens, jax.random.key(1))
+        np.asarray(m["loss"])                      # host readback barrier
+        best = min(best, (time.perf_counter() - t0) / steps)
+
+    peak = bf16_peak(jax.devices()[0].device_kind)
+    mfu = (6 * n_params * B * S / best / peak * 100) if peak else None
+    return mfu, B * S / best, best * 1000, n_params
+
 
 def bench_mnist_cnn(batch_size=1024, steps=240, warmup=10):
+    """Round-1/2 continuity metric: MNIST CNN images/sec, same harness
+    (device-resident batches, donated state, readback-synced windows)."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
     from tensorflowonspark_tpu.models.cnn import MnistCNN
@@ -50,16 +87,11 @@ def bench_mnist_cnn(batch_size=1024, steps=240, warmup=10):
     opt = optax.adam(1e-3)
     state = train_mod.TrainState(jnp.zeros((), jnp.int32), params,
                                  opt.init(params))
-    # donate the state: the optimizer update runs in place in HBM
     step = train_mod.make_train_step(loss_fn, opt, donate=True)
 
     for _ in range(warmup):
         state, metrics = step(state, (X, y), rng)
-    np.asarray(metrics["loss"])  # true barrier: host readback
-
-    # best-of-3 windows: per-program dispatch latency through tunneled
-    # device plugins is noisy; the fastest window is closest to the
-    # framework's own steady-state cost
+    np.asarray(metrics["loss"])
     best = 0.0
     for _ in range(3):
         t0 = time.perf_counter()
@@ -72,13 +104,25 @@ def bench_mnist_cnn(batch_size=1024, steps=240, warmup=10):
 
 
 def main():
-    value = bench_mnist_cnn()
-    print(json.dumps({
-        "metric": "mnist_cnn_train_throughput",
-        "value": round(value, 1),
-        "unit": "images/sec",
-        "vs_baseline": 1.0,
-    }))
+    mfu, tps, step_ms, n_params = bench_flagship_lm()
+    mnist = bench_mnist_cnn()
+    aux = {
+        "lm_tokens_per_sec": round(tps, 0),
+        "lm_step_ms": round(step_ms, 1),
+        "lm_params": n_params,
+        "lm_batch": FLAGSHIP_BATCH,
+        "mnist_cnn_images_per_sec": round(mnist, 0),
+    }
+    if mfu is not None:
+        out = {"metric": "flagship_lm_train_mfu", "value": round(mfu, 1),
+               "unit": "percent_of_bf16_peak",
+               "vs_baseline": round(mfu / ROUND1_LM_MFU, 3), "aux": aux}
+    else:  # unknown chip peak: report throughput, never a guessed MFU
+        # (vs_baseline 1.0: no prior tokens/sec record exists for THIS
+        # config on an unknown chip — the run establishes its own baseline)
+        out = {"metric": "flagship_lm_tokens_per_sec", "value": round(tps, 0),
+               "unit": "tokens/sec", "vs_baseline": 1.0, "aux": aux}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

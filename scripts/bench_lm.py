@@ -1,11 +1,13 @@
-"""Flagship-LM training throughput harness (not driver-run; bench.py stays
-the single driver metric).  Reproduces the BASELINE.md self-measured row:
+"""LM training throughput harness (not driver-run; bench.py stays the
+single driver metric).  Reproduces the BASELINE.md self-measured rows:
 
     python scripts/bench_lm.py                 # 56M params, B16 S1024 bf16
     python scripts/bench_lm.py --attention dense   # XLA-dense comparison
+    python scripts/bench_lm.py --preset flagship   # the bench.py metric config
 
 Prints step time, tokens/sec, and a 6·N·T-FLOP MFU estimate against the
-chip's bf16 peak.
+chip's bf16 peak (from `tensorflowonspark_tpu.benchmarks.PEAK_BF16`, the
+same table bench.py uses).
 """
 import argparse
 import os
@@ -14,11 +16,14 @@ import time
 
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
 
-PEAK_BF16 = {"TPU v5 lite": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12}
-
 
 def main():
+    from tensorflowonspark_tpu import benchmarks
+
     p = argparse.ArgumentParser()
+    p.add_argument("--preset", default=None, choices=[None, "flagship"],
+                   help="flagship = benchmarks.FLAGSHIP_LM, exactly the "
+                        "bench.py round-3 driver-metric config")
     p.add_argument("--batch_size", type=int, default=16)
     p.add_argument("--seq_len", type=int, default=1024)
     p.add_argument("--d_model", type=int, default=512)
@@ -28,6 +33,8 @@ def main():
     p.add_argument("--d_ff", type=int, default=2048)
     p.add_argument("--vocab_size", type=int, default=32000)
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--mu_dtype", default=None,
+                   help="optimizer first-moment dtype, e.g. bfloat16")
     p.add_argument("--attention", default="auto",
                    choices=["auto", "flash", "dense"])
     args = p.parse_args()
@@ -35,34 +42,43 @@ def main():
     import numpy as np
 
     import jax
-    import jax.numpy as jnp
-    import optax
 
-    from tensorflowonspark_tpu.models.transformer import (
-        Transformer, TransformerConfig, lm_loss)
-    from tensorflowonspark_tpu.parallel import train as train_mod
+    if args.preset == "flagship":
+        # the EXACT driver-metric step — no reassembled look-alike
+        step, state, tokens, n_params = benchmarks.make_flagship_step()
+        B, S = tokens.shape[0], tokens.shape[1] - 1
+        attention = benchmarks.FLAGSHIP_LM["attention_impl"]
+    else:
+        import jax.numpy as jnp
 
-    cfg = TransformerConfig(
-        vocab_size=args.vocab_size, d_model=args.d_model,
-        n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
-        n_layers=args.n_layers, d_ff=args.d_ff,
-        max_seq_len=args.seq_len, dtype="bfloat16", rope=True,
-        attention_impl=args.attention)
-    model = Transformer(cfg)
-    B, S = args.batch_size, args.seq_len
-    tokens = jnp.asarray(
-        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S + 1)),
-        jnp.int32)
-    params = model.init(jax.random.key(0), tokens[:, :S])["params"]
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        from tensorflowonspark_tpu.models.transformer import (
+            Transformer, TransformerConfig, lm_loss)
+        from tensorflowonspark_tpu.optim import make_optimizer
+        from tensorflowonspark_tpu.parallel import train as train_mod
 
-    def loss_fn(p, batch, rng):
-        return lm_loss(model.apply({"params": p}, batch[:, :-1]),
-                       batch[:, 1:])
+        cfg = TransformerConfig(
+            vocab_size=args.vocab_size, d_model=args.d_model,
+            n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+            n_layers=args.n_layers, d_ff=args.d_ff,
+            max_seq_len=args.seq_len, dtype="bfloat16", rope=True,
+            attention_impl=args.attention)
+        model = Transformer(cfg)
+        B, S = args.batch_size, args.seq_len
+        attention = args.attention
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S + 1)),
+            jnp.int32)
+        params = model.init(jax.random.key(0), tokens[:, :S])["params"]
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
 
-    opt = optax.adamw(3e-4)
-    state = train_mod.create_train_state(params, opt)
-    step = train_mod.make_train_step(loss_fn, opt, donate=True)
+        def loss_fn(p, batch, rng):
+            return lm_loss(model.apply({"params": p}, batch[:, :-1]),
+                           batch[:, 1:])
+
+        opt, _ = make_optimizer("adamw", learning_rate=3e-4,
+                                mu_dtype=args.mu_dtype)
+        state = train_mod.create_train_state(params, opt)
+        step = train_mod.make_train_step(loss_fn, opt, donate=True)
 
     state, m = step(state, tokens, jax.random.key(1))
     _ = np.asarray(m["loss"])                       # warm + sync
@@ -73,9 +89,9 @@ def main():
     dt = (time.perf_counter() - t0) / args.steps
 
     kind = jax.devices()[0].device_kind
-    peak = next((v for k, v in PEAK_BF16.items() if k in kind), None)
+    peak = benchmarks.bf16_peak(kind)
     mfu = (6 * n_params * B * S / dt / peak * 100) if peak else float("nan")
-    print(f"device={kind} params={n_params / 1e6:.1f}M attention={args.attention}")
+    print(f"device={kind} params={n_params / 1e6:.1f}M attention={attention}")
     print(f"step={dt * 1000:.1f} ms  tokens/sec={B * S / dt:,.0f}  "
           f"MFU~{mfu:.1f}%")
 

@@ -1,0 +1,73 @@
+"""Shared benchmark definitions: chip peaks and the flagship-LM config.
+
+Single source of truth for the driver metric (bench.py) and the repro
+harness (scripts/bench_lm.py) so the two cannot drift — the recorded
+numbers in BASELINE.md are only comparable if every harness builds the
+exact same step.
+"""
+
+# bf16 matmul peaks by device_kind substring (public spec sheet numbers)
+PEAK_BF16 = {
+    "TPU v5 lite": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6": 918e12,
+}
+
+
+def bf16_peak(device_kind):
+    """Peak bf16 FLOP/s for a jax device_kind string, or None if unknown —
+    callers must NOT silently substitute a default: an MFU percent against
+    the wrong peak is a fabricated number."""
+    return next((v for k, v in PEAK_BF16.items() if k in device_kind), None)
+
+
+# The round-3 flagship-LM benchmark config (BASELINE.md round 3): 0.87B
+# params, the north-star workload class on one chip.  Frozen — changing any
+# value invalidates vs_baseline comparability and requires a BASELINE.md
+# methodology note.
+FLAGSHIP_LM = dict(
+    vocab_size=32000, d_model=2048, n_heads=16, n_kv_heads=8,
+    n_layers=16, d_ff=8192, max_seq_len=1024, dtype="bfloat16",
+    rope=True, attention_impl="auto")
+FLAGSHIP_BATCH = 8
+FLAGSHIP_MU_DTYPE = "bfloat16"
+ROUND1_LM_MFU = 47.0  # BASELINE.md round-1 flagship-LM row (vs_baseline denom)
+
+
+def make_flagship_step(batch_size=None, seq_len=None):
+    """Build the flagship-LM training step exactly as the driver metric
+    runs it: returns (step, state, tokens, n_params).  Donated state —
+    call as ``state, m = step(state, tokens, rng)``."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig, lm_loss)
+    from tensorflowonspark_tpu.optim import make_optimizer
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    cfg_kw = dict(FLAGSHIP_LM)
+    if seq_len:
+        cfg_kw["max_seq_len"] = seq_len
+    B = batch_size or FLAGSHIP_BATCH
+    S = cfg_kw["max_seq_len"]
+    cfg = TransformerConfig(**cfg_kw)
+    model = Transformer(cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S + 1)),
+        jnp.int32)
+    params = model.init(jax.random.key(0), tokens[:, :S])["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    def loss_fn(p, batch, rng):
+        return lm_loss(model.apply({"params": p}, batch[:, :-1]),
+                       batch[:, 1:])
+
+    opt, _ = make_optimizer("adamw", learning_rate=3e-4,
+                            mu_dtype=FLAGSHIP_MU_DTYPE)
+    state = train_mod.create_train_state(params, opt)
+    step = train_mod.make_train_step(loss_fn, opt, donate=True)
+    return step, state, tokens, n_params

@@ -547,7 +547,11 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden=False):
+        """Token ids -> logits; ``return_hidden=True`` returns the post-ln_f
+        hidden states instead, for losses that fuse the unembedding matmul
+        (ops.xent.fused_unembed_xent) — the lm_head params still exist and
+        receive their gradient through the fused op."""
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         x = nn.Embed(cfg.vocab_size, cfg.d_model, name="token_embed",
@@ -576,8 +580,12 @@ class Transformer(nn.Module):
             x = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(x)
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                          epsilon=cfg.ln_eps)(x)
+        if return_hidden and not self.is_initializing():
+            return x.astype(dtype)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
                           dtype=dtype)(x)
+        if return_hidden:
+            return x.astype(dtype)  # init pass: lm_head params were created
         return logits
 
 

@@ -8,11 +8,15 @@ interpret-mode path so the same kernels are testable on the CPU mesh.
 
 - flash_attention : blocked online-softmax attention, O(S) memory per core
 - fused_layernorm : single-pass layernorm, f32 accumulation in VMEM
+- fused_unembed_xent : chunked lm_head matmul + cross entropy, no
+  materialized logits (XLA scan, not Pallas — the MXU matmul is already
+  optimal; the win is memory, see ops/xent.py)
 """
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 from tensorflowonspark_tpu.ops.layernorm import fused_layernorm
+from tensorflowonspark_tpu.ops.xent import fused_unembed_xent
 
-__all__ = ["flash_attention", "fused_layernorm"]
+__all__ = ["flash_attention", "fused_layernorm", "fused_unembed_xent"]
 
 
 def default_interpret():
