@@ -1,0 +1,190 @@
+"""ResNet-50 / ImageNet-shape training from TFRecord image shards.
+
+The BASELINE north-star workload (BASELINE.json: RDD/record-fed ResNet-50)
+as a runnable example: file-sharded ImageNet-layout TFRecords ("image/
+encoded" JPEG + "image/class/label") -> parallel decode + Inception-crop
+augment -> shuffle -> batch -> device prefetch -> jitted donated train
+step.  Maps the reference's resnet example, whose input path is the
+upstream tf/models ImageNet pipeline (reference:
+examples/resnet/README.md:3, resnet_cifar_dist.py:1-285 for the
+conversion shape).
+
+TPU-first: uint8 pixels cross host->HBM (4x less transfer than f32);
+normalization fuses into the first conv inside the step
+(image.normalize_batch).  Default model is the normalizer-free ResNet-50
+(--norm none), the HBM-optimal variant (BASELINE.md round 3: 3,082 img/s
+vs 1,973 for GroupNorm on one v5e chip).
+
+Standalone:
+    python examples/resnet/resnet_imagenet.py --synth --steps 20
+Cluster (each worker reads its shard slice):
+    python examples/resnet/resnet_imagenet.py --data_dir /path/shards \
+        --cluster_size 2
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import argparse
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data_dir", default=None,
+                   help="dir of TFRecord shards (train-*); --synth to "
+                        "generate a small synthetic set")
+    p.add_argument("--synth", action="store_true",
+                   help="write synthetic JPEG shards into --data_dir "
+                        "(or a temp dir) first")
+    p.add_argument("--synth_examples", type=int, default=512)
+    p.add_argument("--num_classes", type=int, default=1000)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=50,
+                   help="step cap; 0 = train --epochs full passes instead")
+    p.add_argument("--epochs", type=int, default=1,
+                   help="passes over the shards (only when --steps 0)")
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--norm", default="none",
+                   choices=["none", "group", "batch"])
+    p.add_argument("--reader_threads", type=int, default=4)
+    p.add_argument("--shuffle_buffer", type=int, default=2048)
+    p.add_argument("--learning_rate", type=float, default=0.1)
+    p.add_argument("--model_dir", default=None)
+    p.add_argument("--platform", choices=["cpu", "tpu"], default="cpu")
+    p.add_argument("--cluster_size", type=int, default=1)
+    return p
+
+
+def write_synth_shards(out_dir, n, num_classes, size=64, num_shards=4):
+    """Class-template JPEGs (learnable, like the cifar example's synthetic
+    set) in the ImageNet shard layout."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import image
+
+    rng = np.random.RandomState(0)
+    templates = rng.randint(0, 255, (min(num_classes, 16), size, size, 3))
+
+    def records():
+        for i in range(n):
+            label = i % len(templates)
+            img = np.clip(0.7 * templates[label]
+                          + 0.3 * rng.randint(0, 255, (size, size, 3)),
+                          0, 255).astype(np.uint8)
+            yield img, label
+    return image.write_image_shards(records(), out_dir,
+                                    num_shards=num_shards)
+
+
+def main_fun(args, ctx):
+    """The training program (argv-style args, framework ctx)."""
+    if isinstance(args, list):
+        args = build_argparser().parse_args(args)
+    from tensorflowonspark_tpu import util as fw_util
+
+    if getattr(args, "platform", "cpu") == "cpu":
+        fw_util.pin_platform("cpu")
+    import glob
+
+    import jax
+    if ctx is not None:
+        ctx.init_distributed()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu import image
+    from tensorflowonspark_tpu.data import Dataset
+    from tensorflowonspark_tpu.models.resnet import ResNet50
+    from tensorflowonspark_tpu.optim import make_optimizer
+    from tensorflowonspark_tpu.parallel import train as train_mod
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt_mod
+
+    num_workers = ctx.num_processes if ctx is not None else 1
+    worker = ctx.process_id if ctx is not None else 0
+
+    paths = sorted(glob.glob(os.path.join(args.data_dir, "train-*")))
+    assert paths, f"no train-* shards under {args.data_dir}"
+
+    # each worker reads its slice of the shard list (file-level sharding,
+    # like the reference's per-executor RDD partitions)
+    if args.steps > 0 and args.epochs != 1:
+        print(f"[worker {worker}] note: --steps {args.steps} bounds "
+              "training; --epochs only applies with --steps 0", flush=True)
+    tf_fn = image.train_transform(args.image_size, seed=1234 + worker)
+    ds = (Dataset.from_tfrecords(paths)
+          .shard(num_workers, worker)
+          # shuffle compressed examples (KBs each), then decode in threads
+          .shuffle(args.shuffle_buffer, seed=worker)
+          .map(tf_fn, num_parallel=args.reader_threads)
+          .repeat(None if args.steps > 0 else args.epochs)
+          .batch(args.batch_size))
+
+    model = ResNet50(num_classes=args.num_classes, norm=args.norm)
+    rng = jax.random.key(worker)
+    init_img = jnp.zeros((1, args.image_size, args.image_size, 3),
+                         jnp.uint8)
+    params = model.init(rng, image.normalize_batch(init_img))["params"]
+
+    def loss_fn(p, batch, _rng):
+        imgs_u8, labels = batch
+        x = image.normalize_batch(imgs_u8)        # fuses into conv_init
+        logits = model.apply({"params": p}, x)
+        onehot = jax.nn.one_hot(labels, args.num_classes, dtype=jnp.float32)
+        return -jnp.mean(jnp.sum(
+            jax.nn.log_softmax(logits.astype(jnp.float32)) * onehot,
+            axis=-1))
+
+    opt, _ = make_optimizer("sgd", learning_rate=args.learning_rate,
+                            momentum=0.9)
+    state = train_mod.create_train_state(params, opt)
+    step = train_mod.make_train_step(loss_fn, opt, donate=True)
+
+    losses = []
+    metrics = None
+    for i, batch in enumerate(ds.prefetch_to_device()):
+        if args.steps > 0 and i >= args.steps:
+            break
+        state, metrics = step(state, batch, rng)
+        if i % 10 == 0:
+            losses.append(float(np.asarray(metrics["loss"])))
+            print(f"[worker {worker}] step {i} loss={losses[-1]:.4f}",
+                  flush=True)
+    if metrics is None:
+        raise RuntimeError(
+            f"worker {worker}: shard slice produced no full batches "
+            f"(batch_size={args.batch_size}, {len(paths)} shards, "
+            f"{num_workers} workers) — lower --batch_size or use fewer "
+            "workers than shard files")
+    final = float(np.asarray(metrics["loss"]))
+    print(f"[worker {worker}] done: first={losses[0]:.4f} final={final:.4f}",
+          flush=True)
+    if args.model_dir and (ctx is None or ctx.is_chief):
+        ckpt_mod.save_checkpoint(args.model_dir, state, step=int(
+            np.asarray(state.step)))
+    return final
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    if args.synth:
+        import tempfile
+        args.data_dir = args.data_dir or tempfile.mkdtemp(
+            prefix="imagenet-synth-")
+        if not os.path.exists(os.path.join(
+                args.data_dir, "train-00000-of-00004")):
+            write_synth_shards(args.data_dir, args.synth_examples,
+                               args.num_classes)
+            print(f"synthetic shards in {args.data_dir}")
+    if args.cluster_size > 1:
+        from tensorflowonspark_tpu import backend, cluster
+        c = cluster.run(backend.LocalBackend(args.cluster_size),
+                        main_fun, tf_args=args,
+                        input_mode=cluster.InputMode.NATIVE)
+        c.shutdown()
+    else:
+        main_fun(args, None)
+
+
+if __name__ == "__main__":
+    main()

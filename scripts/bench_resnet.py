@@ -21,7 +21,8 @@ FLOP_PER_IMAGE = 3 * 4.1e9
 PEAK_BF16 = {"TPU v5 lite": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12}
 
 
-def build_step(norm="group", batch_size=256, image_size=224, num_classes=1000):
+def build_step(norm="group", batch_size=256, image_size=224,
+               num_classes=1000, stem="conv"):
     """Returns (step, state, batch, labels); step is donated + jitted."""
     import numpy as np
 
@@ -32,7 +33,7 @@ def build_step(norm="group", batch_size=256, image_size=224, num_classes=1000):
     from tensorflowonspark_tpu.models.resnet import ResNet50
     from tensorflowonspark_tpu.parallel import train as train_mod
 
-    model = ResNet50(norm=norm)
+    model = ResNet50(norm=norm, stem=stem)
     rng = np.random.RandomState(0)
     images = jnp.asarray(
         rng.rand(batch_size, image_size, image_size, 3), jnp.bfloat16)
@@ -52,13 +53,15 @@ def build_step(norm="group", batch_size=256, image_size=224, num_classes=1000):
     return step, state, (images, labels), params
 
 
-def bench_step(norm="group", batch_size=256, steps=30, windows=3):
+def bench_step(norm="group", batch_size=256, steps=30, windows=3,
+               stem="conv"):
     """Best-of-`windows` images/sec over `steps`-step readback-synced runs."""
     import numpy as np
 
     import jax
 
-    step, state, batch, _ = build_step(norm=norm, batch_size=batch_size)
+    step, state, batch, _ = build_step(norm=norm, batch_size=batch_size,
+                                       stem=stem)
     state, m = step(state, batch, jax.random.key(1))
     _ = np.asarray(m["loss"])                       # compile + sync
     best = float("inf")
@@ -75,6 +78,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--norm", default="group",
                    choices=["group", "none", "batch"])
+    p.add_argument("--stem", default="conv", choices=["conv", "s2d"])
     p.add_argument("--batch_size", type=int, default=256)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--windows", type=int, default=3)
@@ -83,11 +87,13 @@ def main():
     import jax
 
     ips, dt = bench_step(norm=args.norm, batch_size=args.batch_size,
-                         steps=args.steps, windows=args.windows)
+                         steps=args.steps, windows=args.windows,
+                         stem=args.stem)
     kind = jax.devices()[0].device_kind
     peak = next((v for k, v in PEAK_BF16.items() if k in kind), None)
     mfu = (ips * FLOP_PER_IMAGE / peak * 100) if peak else float("nan")
-    print(f"device={kind} norm={args.norm} batch={args.batch_size}")
+    print(f"device={kind} norm={args.norm} stem={args.stem} "
+          f"batch={args.batch_size}")
     print(f"step={dt * 1000:.1f} ms  images/sec={ips:,.0f}  MFU~{mfu:.1f}%")
 
 

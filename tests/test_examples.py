@@ -93,6 +93,17 @@ def test_resnet_cifar_cluster(tmp_path):
     assert "resnet cifar training complete" in out
 
 
+def test_resnet_imagenet_shards_pipeline(tmp_path):
+    # the north-star input path: JPEG TFRecord shards -> parallel
+    # decode/augment -> device-prefetched train steps (round-3 addition)
+    out = _run("resnet/resnet_imagenet.py", "--synth", "--steps", "3",
+               "--batch_size", "8", "--image_size", "32",
+               "--synth_examples", "48", "--num_classes", "8",
+               "--reader_threads", "2", "--shuffle_buffer", "16",
+               cwd=tmp_path)
+    assert "done: first=" in out
+
+
 def test_segmentation_single_and_cluster(tmp_path):
     _run("segmentation/segmentation.py", "--steps", "2", "--batch_size", "4",
          "--image_size", "32", "--num_examples", "16", cwd=tmp_path)
