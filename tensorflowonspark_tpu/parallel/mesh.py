@@ -23,7 +23,6 @@ DCN when multi-slice.
 """
 import dataclasses
 import logging
-import math
 
 logger = logging.getLogger(__name__)
 

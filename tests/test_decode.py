@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
-import dataclasses
 
 import jax.numpy as jnp
 

@@ -1,7 +1,6 @@
 """dfutil round-trip tests (models reference tests/test_dfutil.py:30-73:
 save/load round trip for str/int/arrays/float/binary + binary_features
 hint + isLoadedDF identity)."""
-import pytest
 
 from tensorflowonspark_tpu import dfutil
 

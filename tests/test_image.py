@@ -1,7 +1,6 @@
 """Image input pipeline: JPEG codec, ImageNet augmentation, TFRecord
 shards, parallel decode (models the upstream ImageNet input pipeline the
 reference's resnet example defers to, examples/resnet/README.md:3)."""
-import glob
 import os
 
 import numpy as np

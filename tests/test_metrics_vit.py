@@ -106,7 +106,6 @@ def test_gzip_tfrecords_roundtrip(tmp_path):
     plain, gz = str(tmp_path / "a.tfrecord"), str(tmp_path / "b.tfrecord.gz")
     tfrecord.write_examples(plain, recs)
     tfrecord.write_examples(gz, recs)               # .gz implies gzip
-    import gzip as gzip_mod
     with open(gz, "rb") as f:
         assert f.read(2) == b"\x1f\x8b"             # really compressed
     got = [int(ex["y"][1][0]) for ex in tfrecord.read_examples(gz)]
