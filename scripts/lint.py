@@ -52,12 +52,6 @@ class ImportUsage(ast.NodeVisitor):
         if isinstance(node.ctx, ast.Load):
             self.used.add(node.id)
 
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-    def generic_visit(self, node):
-        super().generic_visit(node)
-
 
 def check_file(path):
     problems = []

@@ -1,8 +1,15 @@
 #!/bin/bash
-# Build the native libs and run the full suite (maps the reference's
+# Build the native libs and run the suite (maps the reference's
 # tests/run_tests.sh, which started a 2-worker Spark standalone cluster
 # first — the LocalBackend inside the suite plays that role here).
+#
+#   scripts/run_tests.sh            # full suite (>20 min on a 1-core box)
+#   scripts/run_tests.sh --fast     # core-runtime tier (<90 s)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 make -C native
+if [ "${1:-}" = "--fast" ]; then
+    shift
+    exec python -m pytest tests/ -q -m "not slow" "$@"
+fi
 exec python -m pytest tests/ -q "$@"

@@ -20,10 +20,13 @@ reshape + absmax + multiply, no tables):
   argument as optax's mu_dtype=bfloat16, just 2x smaller).
 - **v (second moment):** nonnegative with a huge dynamic range, and the
   update consumes ``1/(sqrt(v)+eps)`` — linear quantization of v would
-  crush small values.  Stored instead as int8-quantized ``sqrt(v)``
-  (uniform error in the sqrt domain ≈ uniform error in the
-  denominator), which keeps relative update error at the percent level
-  (see tests/test_optim8bit.py for the convergence check vs f32 adam).
+  crush small values.  Stored instead as ``sqrt(v)`` quantized with the
+  UNSIGNED mapping (``signed=False``: the full int8 range covers
+  [0, max], twice the resolution of the symmetric scheme on a
+  nonnegative tensor); uniform error in the sqrt domain ≈ uniform error
+  in the denominator, which keeps relative update error at the percent
+  level (see tests/test_optim8bit.py for the convergence check vs f32
+  adam).
 
 The transform is a drop-in `optax.GradientTransformation`; compose decay
 / clipping around it exactly like `optax.scale_by_adam`:
@@ -58,8 +61,15 @@ def _pad_len(n, block):
     return (-n) % block
 
 
-def quantize(x, block=DEFAULT_BLOCK):
-    """f32/bf16 array -> Quantized (symmetric linear absmax per block)."""
+def quantize(x, block=DEFAULT_BLOCK, signed=True):
+    """f32/bf16 array -> Quantized, linear absmax per block.
+
+    ``signed=True``: symmetric int8 in [-127, 127] (first moment).
+    ``signed=False``: for NONNEGATIVE tensors — the full int8 range maps
+    [0, max] via ``q = round(x/s*254) - 127``, halving the step size the
+    symmetric scheme would waste on the never-used negative half (matters
+    for nu_sqrt, which the update consumes as 1/(sqrt(v)+eps)).
+    """
     flat = x.reshape(-1).astype(jnp.float32)
     pad = _pad_len(flat.size, block)
     if pad:
@@ -67,12 +77,19 @@ def quantize(x, block=DEFAULT_BLOCK):
     blocks = flat.reshape(-1, block)
     scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
     safe = jnp.where(scale > 0, scale, 1.0)
-    q = jnp.clip(jnp.round(blocks / safe * 127.0), -127, 127).astype(jnp.int8)
-    return Quantized(q, scale)
+    if signed:
+        q = jnp.clip(jnp.round(blocks / safe * 127.0), -127, 127)
+    else:
+        q = jnp.clip(jnp.round(blocks / safe * 254.0) - 127.0, -127, 127)
+    return Quantized(q.astype(jnp.int8), scale)
 
 
-def dequantize(qt, shape, dtype=jnp.float32):
-    flat = (qt.q.astype(jnp.float32) * (qt.scale / 127.0)).reshape(-1)
+def dequantize(qt, shape, dtype=jnp.float32, signed=True):
+    if signed:
+        flat = (qt.q.astype(jnp.float32) * (qt.scale / 127.0)).reshape(-1)
+    else:
+        flat = ((qt.q.astype(jnp.float32) + 127.0)
+                * (qt.scale / 254.0)).reshape(-1)
     n = 1
     for d in shape:
         n *= d
@@ -102,12 +119,14 @@ def scale_by_adam_8bit(b1=0.9, b2=0.999, eps=1e-8, block_size=DEFAULT_BLOCK):
         # mu and nu_sqrt must be INDEPENDENT buffers: sharing one zero
         # tree would donate the same buffer twice under donated train
         # steps (XLA rejects `f(donate(a), donate(a))`)
-        def zeros_q(p):
-            return quantize(jnp.zeros(p.shape, jnp.float32), block_size)
+        def zeros_q(signed):
+            return lambda p: quantize(jnp.zeros(p.shape, jnp.float32),
+                                      block_size, signed=signed)
 
-        return Adam8bitState(jnp.zeros((), jnp.int32),
-                             jax.tree_util.tree_map(zeros_q, params),
-                             jax.tree_util.tree_map(zeros_q, params))
+        return Adam8bitState(
+            jnp.zeros((), jnp.int32),
+            jax.tree_util.tree_map(zeros_q(True), params),
+            jax.tree_util.tree_map(zeros_q(False), params))
 
     def update_fn(updates, state, params=None):
         count = state.count + 1
@@ -115,14 +134,14 @@ def scale_by_adam_8bit(b1=0.9, b2=0.999, eps=1e-8, block_size=DEFAULT_BLOCK):
         def upd(g, mu_q, nusq_q):
             g = g.astype(jnp.float32)
             mu = dequantize(mu_q, g.shape)
-            v = dequantize(nusq_q, g.shape) ** 2
+            v = dequantize(nusq_q, g.shape, signed=False) ** 2
             mu = b1 * mu + (1 - b1) * g
             v = b2 * v + (1 - b2) * g * g
             mu_hat = mu / (1 - b1 ** count.astype(jnp.float32))
             v_hat = v / (1 - b2 ** count.astype(jnp.float32))
             out = mu_hat / (jnp.sqrt(v_hat) + eps)
             return _UpdOut(out, quantize(mu, block_size),
-                           quantize(jnp.sqrt(v), block_size))
+                           quantize(jnp.sqrt(v), block_size, signed=False))
 
         # tree_map flattens the companion trees UP TO `updates`' leaf
         # positions, so each call sees the whole Quantized subtree for

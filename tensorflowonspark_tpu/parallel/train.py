@@ -125,24 +125,26 @@ def _map_state(state, param_shardings, repl):
     params_struct = jax.tree_util.tree_structure(param_shardings)
     if jax.tree_util.tree_structure(state) == params_struct:
         return param_shardings
-    if _has_quantized(state):
-        # optim8bit state: blockwise-quantized payloads are flat
-        # [n_blocks, block] views whose element order does not follow the
-        # parameter's sharded axes, so they are REPLICATED (loudly — this
-        # costs full-size int8 state per chip; still 4x smaller than
-        # replicated f32, but NOT sharded like f32 moments would be under
-        # fsdp).  Sharding quantized state needs per-shard quantization,
-        # which is future work — see optim8bit module doc.
-        logger.warning(
-            "8-bit optimizer state is replicated under explicit param "
-            "shardings (not fsdp-sharded); per-chip optimizer memory is "
-            "the full quantized state")
-        return jax.tree_util.tree_map(lambda _: repl, state)
     if hasattr(state, "_fields"):  # NamedTuple (ScaleByAdamState etc.)
         return type(state)(*(_map_state(getattr(state, f), param_shardings, repl)
                              for f in state._fields))
     if isinstance(state, (tuple, list)):
         return type(state)(_map_state(s, param_shardings, repl) for s in state)
+    if _has_quantized(state):
+        # optim8bit state (checked AFTER container recursion so only the
+        # subtrees that actually hold Quantized replicate — a chained f32
+        # ema/accumulator state still gets param shardings): blockwise-
+        # quantized payloads are flat [n_blocks, block] views whose
+        # element order does not follow the parameter's sharded axes, so
+        # they are REPLICATED (loudly — full-size int8 state per chip;
+        # still 4x smaller than replicated f32, but NOT sharded like f32
+        # moments would be under fsdp).  Sharding quantized state needs
+        # per-shard quantization, which is future work — see optim8bit
+        # module doc.
+        logger.warning(
+            "8-bit optimizer state is replicated under explicit param "
+            "shardings (not fsdp-sharded); per-chip optimizer memory is "
+            "the full quantized state")
     return jax.tree_util.tree_map(lambda _: repl, state)
 
 

@@ -33,3 +33,43 @@ def mp_ctx():
     # 'fork' keeps worker startup cheap on the 1-core CI box; the runtime
     # itself supports spawn (each executor re-execs its bootstrap closure).
     return mp.get_context("fork")
+
+
+# Test tiering (round-1 VERDICT item 8): the full suite is jit-compile
+# bound (>20 min on a 1-core box), so the core-runtime tier must stay
+# runnable in one sitting.  Inclusion rule: a file is slow if it measured
+# >=20 s standalone (timing sweep recorded 2026-07-31) OR is non-core
+# (models/parallelism/optimizer features) and the fast tier would
+# otherwise exceed its <90 s budget — that covers the two sub-20 s
+# entries (hybrid_mesh 11 s, optim8bit 14 s).  Everything else forms the
+# fast tier:
+#     pytest -m "not slow"        (also: scripts/run_tests.sh --fast)
+SLOW_FILES = {
+    "test_aot.py",              # 70 s — native lib + mock PJRT round trips
+    "test_bert.py",             # 45 s
+    "test_cluster.py",          # 86 s — multi-process integration
+    "test_convert.py",          # 31 s — HF checkpoint parity
+    "test_decode.py",           # 62 s — KV-cache generation compiles
+    "test_examples.py",         # >10 min — example subprocesses
+    "test_hybrid_mesh.py",      # 11 s — multi-slice mesh compiles
+    "test_lora.py",             # 25 s
+    "test_optim8bit.py",        # 14 s
+    "test_metrics_vit.py",      # 82 s
+    "test_minispark.py",        # 60 s — spawn-started executor pools
+    "test_models.py",           # 88 s
+    "test_ops.py",              # 47 s — pallas kernels (interpret mode)
+    "test_pipeline.py",         # 45 s
+    "test_pipelined_lm.py",     # 25 s
+    "test_ring_attention.py",   # 31 s
+    "test_spark_integration.py",  # 110 s — end-to-end Spark surface
+    "test_streaming.py",        # 41 s
+    "test_transformer.py",      # 47 s
+    "test_ulysses.py",          # 35 s
+    "test_xent.py",             # 20 s
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if os.path.basename(str(item.fspath)) in SLOW_FILES:
+            item.add_marker(pytest.mark.slow)

@@ -19,6 +19,17 @@ def test_quantize_round_trip_error():
     assert err.max() <= np.abs(np.asarray(x)).max() / 127 + 1e-6
 
 
+def test_unsigned_quantize_nonnegative():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(np.abs(rng.randn(1000)) * 2.0, jnp.float32)
+    qt = optim8bit.quantize(x, block=128, signed=False)
+    out = optim8bit.dequantize(qt, x.shape, signed=False)
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    # full int8 range over [0, max]: step = max/254, half the signed step
+    assert err.max() <= np.asarray(x).max() / 254 + 1e-6
+    assert np.all(np.asarray(out) >= 0)
+
+
 def test_quantize_handles_zero_and_padding():
     x = jnp.zeros((13,), jnp.float32)       # all-zero block + pad
     out = optim8bit.dequantize(optim8bit.quantize(x, block=8), x.shape)
@@ -120,6 +131,28 @@ def test_sharded_state_replicates_with_warning(caplog):
     assert jax.tree_util.tree_structure(mapped) == \
         jax.tree_util.tree_structure(state)
     assert "replicated" in caplog.text
+
+
+def test_chained_f32_state_still_sharded(caplog):
+    # replication must be scoped to the quantized subtrees: a sibling
+    # param-shaped f32 state (here optax.trace momentum) chained after
+    # the 8-bit transform keeps its param shardings
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("fsdp",))
+    params = {"w": jnp.ones((8, 4))}
+    shardings = {"w": NamedSharding(mesh, P("fsdp", None))}
+    opt = optax.chain(optim8bit.scale_by_adam_8bit(), optax.trace(0.9))
+    state = opt.init(params)
+    repl = NamedSharding(mesh, P())
+    mapped = train_mod._map_state(state, shardings, repl)
+    trace_state = mapped[1]
+    assert trace_state.trace == shardings          # sharded, not replicated
+    adam_q = jax.tree_util.tree_leaves(mapped[0].mu)
+    assert all(s == repl for s in adam_q)          # quantized: replicated
 
 
 def test_train_step_integration():
