@@ -53,6 +53,9 @@ class TransformerConfig:
     use_bias: bool = False        # bias terms on qkv/out/mlp denses
     # (True matches GPT-2-family checkpoints; see convert.py)
     ln_eps: float = 1e-6          # layernorm epsilon (GPT-2 ckpts: 1e-5)
+    fused_ln: bool = False        # Pallas fused layernorm fwd (single
+    # VMEM pass; falls back to the XLA reference under an active mesh —
+    # pallas_call is a custom call GSPMD cannot partition)
     norm_style: str = "pre"       # pre-LN (GPT/LLaMA) | post-LN (BERT)
     activation: str = "gelu_tanh"  # gelu_tanh | gelu_exact | relu | silu
     decode: bool = False          # autoregressive mode: kv cache of
@@ -511,6 +514,45 @@ def _sp_constrain(x, cfg):
     return _constrain_bsd(x, cfg, cfg.sp_axis, None)
 
 
+def _single_device():
+    # The ONLY configuration where an unpartitionable pallas custom call
+    # is always safe: one visible device means every jit — mesh context,
+    # in_shardings, or plain — is trivially single-shard.  An abstract-
+    # mesh check is NOT sufficient: make_train_step shards via
+    # in_shardings without jax.set_mesh, which traces with an EMPTY
+    # abstract mesh while still GSPMD-partitioning the program.
+    return len(jax.devices()) == 1
+
+
+class FusedLayerNorm(nn.Module):
+    """flax LayerNorm drop-in over the Pallas fused kernel (f32 stats,
+    one VMEM pass).  Param names match nn.LayerNorm ("scale"/"bias") so
+    checkpoints interchange.  The kernel runs only on a single-device
+    host (the serving/AOT and single-chip bench case); with multiple
+    devices visible the XLA reference runs instead — pallas_call is a
+    custom call GSPMD cannot partition, and sharded jits cannot be
+    detected reliably from inside a traced module (see _single_device).
+    Output dtype follows x."""
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        from tensorflowonspark_tpu.ops.layernorm import (
+            fused_layernorm, layernorm_reference)
+        D = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (D,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (D,), jnp.float32)
+        if not _single_device():
+            return layernorm_reference(x, scale, bias, self.epsilon)
+        return fused_layernorm(x, scale, bias, eps=self.epsilon)
+
+
+def _make_ln(cfg, name):
+    if cfg.fused_ln:
+        return FusedLayerNorm(epsilon=cfg.ln_eps, name=name)
+    return nn.LayerNorm(name=name, dtype=jnp.float32, epsilon=cfg.ln_eps)
+
+
 class Block(nn.Module):
     """One transformer block; ``cfg.norm_style`` picks the residual form:
     pre-LN ``x + f(ln(x))`` (GPT/LLaMA-style, the training-stable default)
@@ -525,10 +567,8 @@ class Block(nn.Module):
         if cfg.norm_style not in ("pre", "post"):
             raise ValueError(
                 f"norm_style={cfg.norm_style!r} not in ('pre', 'post')")
-        ln1 = nn.LayerNorm(name="ln1", dtype=jnp.float32,
-                           epsilon=cfg.ln_eps)
-        ln2 = nn.LayerNorm(name="ln2", dtype=jnp.float32,
-                           epsilon=cfg.ln_eps)
+        ln1 = _make_ln(cfg, "ln1")
+        ln2 = _make_ln(cfg, "ln2")
         attn = Attention(cfg, name="attn")
         mlp = (MoEMLP(cfg, name="moe") if self.use_moe
                else DenseMLP(cfg, name="mlp"))
@@ -578,8 +618,7 @@ class Transformer(nn.Module):
             use_moe = cfg.num_experts > 0 and (
                 i % cfg.moe_every == cfg.moe_every - 1)
             x = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(x)
-        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
-                         epsilon=cfg.ln_eps)(x)
+        x = _make_ln(cfg, "ln_f")(x)
         if return_hidden and not self.is_initializing():
             return x.astype(dtype)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
