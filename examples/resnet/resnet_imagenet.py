@@ -56,15 +56,18 @@ def build_argparser():
     return p
 
 
-def write_synth_shards(out_dir, n, num_classes, size=64, num_shards=4):
+def write_synth_shards(out_dir, n, num_classes, size=64, num_shards=4,
+                       prefix="train", seed=0):
     """Class-template JPEGs (learnable, like the cifar example's synthetic
     set) in the ImageNet shard layout."""
     import numpy as np
 
     from tensorflowonspark_tpu import image
 
-    rng = np.random.RandomState(0)
-    templates = rng.randint(0, 255, (min(num_classes, 16), size, size, 3))
+    rng = np.random.RandomState(seed)
+    tmpl_rng = np.random.RandomState(0)   # templates shared across splits
+    templates = tmpl_rng.randint(0, 255,
+                                 (min(num_classes, 16), size, size, 3))
 
     def records():
         for i in range(n):
@@ -74,7 +77,7 @@ def write_synth_shards(out_dir, n, num_classes, size=64, num_shards=4):
                           0, 255).astype(np.uint8)
             yield img, label
     return image.write_image_shards(records(), out_dir,
-                                    num_shards=num_shards)
+                                    num_shards=num_shards, prefix=prefix)
 
 
 def main_fun(args, ctx):
@@ -113,6 +116,13 @@ def main_fun(args, ctx):
               "training; --epochs only applies with --steps 0", flush=True)
     tf_fn = image.train_transform(args.image_size, seed=1234 + worker)
     ds = (Dataset.from_tfrecords(paths)
+          # interleave BEFORE shard so BOTH shard paths see mixed files:
+          # file-granular sharding copies the interleave spec (each worker
+          # round-robins its own files), and record-granular sharding
+          # (more workers than files) strides the already-interleaved
+          # stream — either way the reservoir shuffle mixes across the
+          # whole slice instead of a buffer-sized window of one file
+          .interleave(cycle_length=4)
           .shard(num_workers, worker)
           # shuffle compressed examples (KBs each), then decode in threads
           .shuffle(args.shuffle_buffer, seed=worker)
@@ -159,6 +169,43 @@ def main_fun(args, ctx):
     final = float(np.asarray(metrics["loss"]))
     print(f"[worker {worker}] done: first={losses[0]:.4f} final={final:.4f}",
           flush=True)
+
+    # validation pass (chief only): validation-* shards through the
+    # deterministic center-crop transform, top-1 accuracy on device
+    val_paths = sorted(glob.glob(os.path.join(args.data_dir,
+                                              "validation-*")))
+    if val_paths and (ctx is None or ctx.is_chief):
+        eval_ds = (Dataset.from_tfrecords(val_paths)
+                   .map(image.eval_transform(args.image_size),
+                        num_parallel=args.reader_threads)
+                   .batch(args.batch_size, drop_remainder=False,
+                          pad_tail=False))
+
+        @jax.jit
+        def eval_step(p, imgs_u8, labels):
+            logits = model.apply(
+                {"params": p}, image.normalize_batch(imgs_u8))
+            return jnp.sum(jnp.argmax(logits, -1) == labels)
+
+        correct = total = 0
+        for imgs_u8, labels in eval_ds:
+            n = len(labels)
+            if n < args.batch_size:
+                # pad the ragged tail up to the ONE compiled shape; padded
+                # labels are -1, which argmax never produces, so they
+                # cannot count as correct
+                reps = args.batch_size - n
+                imgs_u8 = np.concatenate(
+                    [imgs_u8, np.repeat(imgs_u8[-1:], reps, axis=0)])
+                labels = np.concatenate(
+                    [labels, np.full(reps, -1, labels.dtype)])
+            correct += int(np.asarray(eval_step(
+                state.params, jnp.asarray(imgs_u8), jnp.asarray(labels))))
+            total += n
+        if total:
+            print(f"[worker {worker}] validation top-1 "
+                  f"{correct / total:.4f} ({correct}/{total})", flush=True)
+
     if args.model_dir and (ctx is None or ctx.is_chief):
         ckpt_mod.save_checkpoint(args.model_dir, state, step=int(
             np.asarray(state.step)))
@@ -171,11 +218,19 @@ def main(argv=None):
         import tempfile
         args.data_dir = args.data_dir or tempfile.mkdtemp(
             prefix="imagenet-synth-")
+        # independent sentinels: a data_dir from an older run may hold
+        # train shards but no validation shards
         if not os.path.exists(os.path.join(
                 args.data_dir, "train-00000-of-00004")):
             write_synth_shards(args.data_dir, args.synth_examples,
                                args.num_classes)
-            print(f"synthetic shards in {args.data_dir}")
+        if not os.path.exists(os.path.join(
+                args.data_dir, "validation-00000-of-00002")):
+            write_synth_shards(args.data_dir,
+                               max(args.synth_examples // 8, 16),
+                               args.num_classes, num_shards=2,
+                               prefix="validation", seed=1)
+        print(f"synthetic shards in {args.data_dir}")
     if args.cluster_size > 1:
         from tensorflowonspark_tpu import backend, cluster
         c = cluster.run(backend.LocalBackend(args.cluster_size),

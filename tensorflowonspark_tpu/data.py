@@ -101,11 +101,69 @@ class Dataset:
         files = self._my_files()
         if not files:
             raise ValueError("dataset matched no input files")
+        cycle, block = getattr(self, "_interleave", (1, 1))
 
         def gen():
-            for path in files:
-                yield from self._reader(path)
+            if cycle <= 1 or len(files) <= 1:
+                for path in files:
+                    yield from self._reader(path)
+                return
+            # deterministic round-robin interleave (tf.data's default
+            # ordering): `cycle` files open at once, `block` records
+            # pulled from each in turn; an exhausted slot refills with
+            # the next file
+            pending = iter(files)
+            slots = []
+            for path in pending:
+                slots.append(self._reader(path))
+                if len(slots) == cycle:
+                    break
+            while slots:
+                for k in range(len(slots)):
+                    if slots[k] is None:
+                        continue
+                    for _ in range(block):
+                        try:
+                            yield next(slots[k])
+                        except StopIteration:
+                            nxt = next(pending, None)
+                            slots[k] = (self._reader(nxt)
+                                        if nxt is not None else None)
+                            break
+                slots = [s for s in slots if s is not None]
         return gen()
+
+    @property
+    def file_rooted(self):
+        """True when this dataset reads straight from a file list (so
+        `interleave()` applies and `shard()` is file-granular)."""
+        return (getattr(self, "_files", None) is not None
+                and self._parent is None)
+
+    def interleave(self, cycle_length=4, block_length=1):
+        """Mix records round-robin from `cycle_length` concurrently-open
+        files, `block_length` records at a time (the ordering of
+        tf.data's deterministic ``interleave``; reference analog: the
+        mnist_tf_ds shard readers).  Only valid directly on a file root
+        (call BEFORE map/shuffle).  The point is shuffle quality: with
+        file-sequential reading a reservoir shuffle only ever mixes
+        records ~buffer_size apart, while interleave spreads each file
+        across the whole epoch.  IO/decode parallelism rides
+        ``map(fn, num_parallel=N)``, which composes downstream.
+        """
+        if not self.file_rooted:
+            raise ValueError("interleave() applies to a file-rooted "
+                             "dataset (from_files/from_tfrecords), before "
+                             "other transforms")
+        if cycle_length < 1 or block_length < 1:
+            raise ValueError("cycle_length and block_length must be >= 1")
+        new = Dataset(None)
+        new._files = self._files
+        new._reader = self._reader
+        new._shard_spec = self._shard_spec
+        new._interleave = (int(cycle_length), int(block_length))
+        new._source = new._file_source
+        return new
 
     def _my_files(self):
         files = self._files
@@ -138,6 +196,8 @@ class Dataset:
             new._files = self._files
             new._reader = self._reader
             new._shard_spec = (num_shards, index)
+            if getattr(self, "_interleave", None):
+                new._interleave = self._interleave
             new._source = new._file_source
             return new
         return self._chain(

@@ -42,6 +42,35 @@ def test_file_granular_shard_disjoint_and_complete(tfr_dir):
     assert len(list(root)) == 32
 
 
+def test_interleave_round_robin_order(tfr_dir):
+    ds = data.Dataset.from_tfrecords(tfr_dir, parse=_parse)
+    ys = [y for _, y in ds.interleave(cycle_length=4)]
+    # 4 files x 8 records, block 1: first full cycle is file heads
+    assert ys[:4] == [0, 8, 16, 24]
+    assert sorted(ys) == list(range(32))
+
+
+def test_interleave_block_length(tfr_dir):
+    ds = data.Dataset.from_tfrecords(tfr_dir, parse=_parse)
+    ys = [y for _, y in ds.interleave(cycle_length=2, block_length=2)]
+    # cycle 2, block 2 over files [0..7] and [8..15] first
+    assert ys[:8] == [0, 1, 8, 9, 2, 3, 10, 11]
+    assert sorted(ys) == list(range(32))
+
+
+def test_interleave_composes_with_shard(tfr_dir):
+    ds = data.Dataset.from_tfrecords(tfr_dir, parse=_parse)
+    got = sorted(y for _, y in ds.interleave(cycle_length=2).shard(2, 0))
+    got += sorted(y for _, y in ds.interleave(cycle_length=2).shard(2, 1))
+    assert sorted(got) == list(range(32))
+
+
+def test_interleave_rejects_non_root(tfr_dir):
+    ds = data.Dataset.from_tfrecords(tfr_dir, parse=_parse).map(lambda r: r)
+    with pytest.raises(ValueError, match="file-rooted"):
+        ds.interleave()
+
+
 def test_record_granular_shard_after_map():
     ds = data.Dataset.from_records(list(range(10))).map(lambda x: x * 2)
     assert ds.shard(3, 0).take(99) == [0, 6, 12, 18]

@@ -102,6 +102,7 @@ def test_resnet_imagenet_shards_pipeline(tmp_path):
                "--reader_threads", "2", "--shuffle_buffer", "16",
                cwd=tmp_path)
     assert "done: first=" in out
+    assert "validation top-1" in out
 
 
 def test_segmentation_single_and_cluster(tmp_path):
