@@ -114,22 +114,6 @@ def main_fun(args, ctx):
     if args.steps > 0 and args.epochs != 1:
         print(f"[worker {worker}] note: --steps {args.steps} bounds "
               "training; --epochs only applies with --steps 0", flush=True)
-    tf_fn = image.train_transform(args.image_size, seed=1234 + worker)
-    ds = (Dataset.from_tfrecords(paths)
-          # interleave BEFORE shard so BOTH shard paths see mixed files:
-          # file-granular sharding copies the interleave spec (each worker
-          # round-robins its own files), and record-granular sharding
-          # (more workers than files) strides the already-interleaved
-          # stream — either way the reservoir shuffle mixes across the
-          # whole slice instead of a buffer-sized window of one file
-          .interleave(cycle_length=4)
-          .shard(num_workers, worker)
-          # shuffle compressed examples (KBs each), then decode in threads
-          .shuffle(args.shuffle_buffer, seed=worker)
-          .map(tf_fn, num_parallel=args.reader_threads)
-          .repeat(None if args.steps > 0 else args.epochs)
-          .batch(args.batch_size))
-
     model = ResNet50(num_classes=args.num_classes, norm=args.norm)
     rng = jax.random.key(worker)
     init_img = jnp.zeros((1, args.image_size, args.image_size, 3),
@@ -150,25 +134,88 @@ def main_fun(args, ctx):
     state = train_mod.create_train_state(params, opt)
     step = train_mod.make_train_step(loss_fn, opt, donate=True)
 
+    # full-state resume (params + optimizer moments + step); the input
+    # pipeline then skips the records already consumed — mid-epoch resume
+    # the reference's epoch-boundary TF callbacks could not do
+    resume_step = 0
+    if args.model_dir:
+        restored, found = ckpt_mod.restore_checkpoint(args.model_dir, state)
+        if restored is not None:
+            state, resume_step = restored, int(found or 0)
+            print(f"[worker {worker}] resumed at step {resume_step}",
+                  flush=True)
+
+    tf_fn = image.train_transform(args.image_size, seed=1234 + worker)
+    ds = (Dataset.from_tfrecords(paths)
+          # interleave BEFORE shard so BOTH shard paths see mixed files:
+          # file-granular sharding copies the interleave spec (each worker
+          # round-robins its own files), and record-granular sharding
+          # (more workers than files) strides the already-interleaved
+          # stream — either way the reservoir shuffle mixes across the
+          # whole slice instead of a buffer-sized window of one file
+          .interleave(cycle_length=4)
+          .shard(num_workers, worker)
+          # shuffle compressed examples (KBs each), then decode in threads
+          .shuffle(args.shuffle_buffer, seed=worker)
+          .repeat(None if args.steps > 0 else args.epochs))
+    if resume_step:
+        # deterministic pipeline: skip the records consumed so far —
+        # BEFORE the decode map, so skipping discards KB-scale compressed
+        # examples instead of JPEG-decoding millions just to drop them
+        ds = ds.skip(resume_step * args.batch_size)
+    ds = (ds.map(tf_fn, num_parallel=args.reader_threads)
+            .batch(args.batch_size))
+
+    # preemption safety: SIGTERM (TPU preemption / executor decommission)
+    # commits a final checkpoint before the process dies
+    holder = {"state": state}
+    handler = None
+    if args.model_dir and (ctx is None or ctx.is_chief):
+        handler = ckpt_mod.install_preemption_handler(
+            lambda: ckpt_mod.save_checkpoint(
+                args.model_dir, holder["state"],
+                int(np.asarray(holder["state"].step))))
+
+    import contextlib
+    guard = (handler.guard if handler is not None
+             else contextlib.nullcontext)
+
     losses = []
     metrics = None
-    for i, batch in enumerate(ds.prefetch_to_device()):
-        if args.steps > 0 and i >= args.steps:
-            break
-        state, metrics = step(state, batch, rng)
-        if i % 10 == 0:
-            losses.append(float(np.asarray(metrics["loss"])))
-            print(f"[worker {worker}] step {i} loss={losses[-1]:.4f}",
+    already_done = args.steps > 0 and resume_step >= args.steps
+    if not already_done:
+        for i, batch in enumerate(ds.prefetch_to_device()):
+            if args.steps > 0 and resume_step + i >= args.steps:
+                break
+            # guard: the donated input state is deleted at dispatch, so a
+            # SIGTERM inside the step would catch holder["state"] mid-
+            # donation — block it until the fresh state is published
+            with guard():
+                state, metrics = step(state, batch, rng)
+                holder["state"] = state
+            if i % 10 == 0:
+                losses.append(float(np.asarray(metrics["loss"])))
+                print(f"[worker {worker}] step {resume_step + i} "
+                      f"loss={losses[-1]:.4f}", flush=True)
+        if metrics is None and resume_step == 0:
+            raise RuntimeError(
+                f"worker {worker}: shard slice produced no full batches "
+                f"(batch_size={args.batch_size}, {len(paths)} shards, "
+                f"{num_workers} workers) — lower --batch_size or use fewer "
+                "workers than shard files")
+        if metrics is None:   # resumed past the remaining data: benign
+            final = float("nan")
+            print(f"[worker {worker}] resumed at step {resume_step}: no "
+                  "batches left to train; continuing to eval/save",
                   flush=True)
-    if metrics is None:
-        raise RuntimeError(
-            f"worker {worker}: shard slice produced no full batches "
-            f"(batch_size={args.batch_size}, {len(paths)} shards, "
-            f"{num_workers} workers) — lower --batch_size or use fewer "
-            "workers than shard files")
-    final = float(np.asarray(metrics["loss"]))
-    print(f"[worker {worker}] done: first={losses[0]:.4f} final={final:.4f}",
-          flush=True)
+        else:
+            final = float(np.asarray(metrics["loss"]))
+            print(f"[worker {worker}] done: first={losses[0]:.4f} "
+                  f"final={final:.4f}", flush=True)
+    else:
+        final = float("nan")
+        print(f"[worker {worker}] checkpoint already at step {resume_step} "
+              f">= --steps {args.steps}; skipping training", flush=True)
 
     # validation pass (chief only): validation-* shards through the
     # deterministic center-crop transform, top-1 accuracy on device
@@ -209,6 +256,8 @@ def main_fun(args, ctx):
     if args.model_dir and (ctx is None or ctx.is_chief):
         ckpt_mod.save_checkpoint(args.model_dir, state, step=int(
             np.asarray(state.step)))
+    if handler is not None:
+        handler.uninstall()  # clean shutdown: a late SIGTERM must not re-save
     return final
 
 

@@ -236,6 +236,24 @@ class Dataset:
         """Keep records where `pred(record)` is true."""
         return self._chain(lambda it: (r for r in it if pred(r)))
 
+    def skip(self, n):
+        """Skip the first `n` records — the resume-from-position primitive:
+        the pipeline is deterministic for a fixed seed, so a restart that
+        knows how many records it consumed (steps x batch_size) skips to
+        exactly where training stopped instead of re-seeing data
+        (mid-epoch resume; the reference's TF-callback checkpoints could
+        only resume on epoch boundaries).
+
+        Placement matters with `repeat()`: upstream of repeat the skip
+        re-applies EVERY epoch; for resume, call it on the repeated
+        stream — ``ds.repeat(E).skip(total_consumed)`` — so it skips the
+        total once.
+        """
+        if n < 0:
+            raise ValueError("skip count must be >= 0")
+        import itertools
+        return self._chain(lambda it: itertools.islice(it, n, None))
+
     def shuffle(self, buffer_size, seed=0):
         """Windowed shuffle with an O(buffer_size) reservoir, like
         ``tf.data.Dataset.shuffle``: deterministic for a fixed seed, and

@@ -75,6 +75,90 @@ def wait_for_saves():
         _async_ckptr.wait_until_finished()
 
 
+class PreemptionHandler:
+    """Handle of an installed preemption hook: ``uninstall()`` restores
+    the previous signal handlers; ``guard()`` is a context manager that
+    BLOCKS the signals for its body — wrap any region where the state the
+    save_fn reads is transiently invalid.  The canonical case is a
+    donated train step: the input state's buffers are deleted at dispatch
+    and the fresh state only becomes publishable after the call returns,
+    so a signal landing inside that window would save garbage (or
+    nothing).  A signal received while blocked is delivered on unblock.
+    """
+
+    def __init__(self, previous):
+        self._previous = previous
+
+    def uninstall(self):
+        import signal as signal_mod
+        for sig, prev in self._previous.items():
+            try:
+                signal_mod.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+
+    # kept callable for the uninstall-style usage
+    __call__ = uninstall
+
+    def guard(self):
+        import contextlib
+        import signal as signal_mod
+
+        sigs = set(self._previous)
+
+        @contextlib.contextmanager
+        def _guard():
+            signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK, sigs)
+            try:
+                yield
+            finally:
+                signal_mod.pthread_sigmask(signal_mod.SIG_UNBLOCK, sigs)
+        return _guard()
+
+
+def install_preemption_handler(save_fn, signals=None):
+    """Save a final checkpoint when the process is told to die.
+
+    TPU-VM preemptions and Spark executor decommissions deliver SIGTERM
+    with a grace window before the hard kill; the reference had no
+    equivalent (its checkpointing lived in TF callbacks that only fire on
+    epoch boundaries).  ``save_fn()`` runs at most once, from the signal
+    handler in the main thread — keep it to a synchronous
+    ``save_checkpoint`` + ``wait_for_saves``.  After it returns, the
+    process exits 128+signum (the conventional killed-by-signal code) so
+    the scheduler still sees a signal death, not a success.
+
+    Returns a `PreemptionHandler`; call its ``uninstall()`` after clean
+    shutdown so a late SIGTERM in teardown does not re-save, and wrap
+    donated train steps in ``handler.guard()`` so the signal cannot fire
+    while the checkpointable state is mid-donation.  Must be called from
+    the main thread (CPython restricts ``signal.signal`` to it).
+    """
+    import signal as signal_mod
+    import sys
+
+    signals = signals or (signal_mod.SIGTERM,)
+    fired = []
+    previous = {}
+
+    def handler(signum, frame):
+        if not fired:
+            fired.append(signum)
+            try:
+                logger.warning("signal %d: saving preemption checkpoint",
+                               signum)
+                save_fn()
+                wait_for_saves()
+                logger.warning("preemption checkpoint committed")
+            except Exception:
+                logger.exception("preemption save failed")
+        sys.exit(128 + signum)
+
+    for sig in signals:
+        previous[sig] = signal_mod.signal(sig, handler)
+    return PreemptionHandler(previous)
+
+
 def restore_checkpoint(ckpt_dir, target, step=None):
     """Restore the pytree saved at `step` (default: latest).
 

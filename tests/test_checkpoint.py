@@ -83,3 +83,80 @@ def test_async_save_keep_retention(tmp_path):
     steps = sorted(int(d.name.split("_")[1]) for d in tmp_path.iterdir()
                    if d.name.startswith("step_"))
     assert steps == [4, 5]  # same steady state as the sync path
+
+
+def test_preemption_handler_saves_then_dies(tmp_path):
+    # a SIGTERM'd training process must commit a final checkpoint and
+    # still exit with the killed-by-signal code (TPU preemptions / Spark
+    # decommissions deliver SIGTERM with a grace window)
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    prog = textwrap.dedent(f"""
+        import os, signal, sys, time
+        sys.path.insert(0, {repr(os.getcwd())})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax.numpy as jnp
+        from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+        state = {{"w": jnp.arange(4.0), "step": jnp.asarray(7)}}
+        ckpt.install_preemption_handler(
+            lambda: ckpt.save_checkpoint({repr(str(tmp_path))}, state, 7))
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(30)            # never reached
+        print("NOT PREEMPTED")
+    """)
+    proc = subprocess.run([sys.executable, "-c", prog],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 128 + signal.SIGTERM, proc.stderr[-2000:]
+    assert "NOT PREEMPTED" not in proc.stdout
+    restored, step = ckpt.restore_checkpoint(
+        str(tmp_path), {"w": jnp.zeros(4), "step": jnp.asarray(0)})
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(4.0))
+
+
+def test_preemption_handler_uninstall(tmp_path):
+    import signal
+
+    from tensorflowonspark_tpu.utils import checkpoint as ckpt
+    prev = signal.getsignal(signal.SIGTERM)
+    uninstall = ckpt.install_preemption_handler(lambda: None)
+    assert signal.getsignal(signal.SIGTERM) is not prev
+    uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preemption_guard_defers_signal(tmp_path):
+    # a signal raised INSIDE guard() must be delivered only after the
+    # guarded region publishes consistent state (the donated-step window)
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    marker = tmp_path / "saved.txt"
+    prog = textwrap.dedent(f"""
+        import os, signal, sys
+        sys.path.insert(0, {repr(os.getcwd())})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+        holder = {{"v": "stale"}}
+        h = ckpt.install_preemption_handler(
+            lambda: open({repr(str(marker))}, "w").write(holder["v"]))
+        with h.guard():
+            os.kill(os.getpid(), signal.SIGTERM)   # pending while blocked
+            holder["v"] = "published"
+        print("UNREACHABLE")                        # handler fires first
+    """)
+    proc = subprocess.run([sys.executable, "-c", prog],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 128 + signal.SIGTERM, proc.stderr[-2000:]
+    assert "UNREACHABLE" not in proc.stdout
+    assert marker.read_text() == "published"

@@ -71,6 +71,22 @@ def test_interleave_rejects_non_root(tfr_dir):
         ds.interleave()
 
 
+def test_skip_resumes_mid_epoch():
+    ds = data.Dataset.from_records(list(range(20))).shuffle(8, seed=3)
+    full = list(ds)
+    assert list(ds.skip(7)) == full[7:]        # deterministic resume
+    with pytest.raises(ValueError):
+        ds.skip(-1)
+
+
+def test_skip_after_repeat_skips_total_once():
+    ds = data.Dataset.from_records([0, 1, 2]).repeat(3)
+    assert list(ds.skip(4)) == [1, 2, 0, 1, 2]
+    # upstream of repeat: re-applies per epoch
+    ds2 = data.Dataset.from_records([0, 1, 2]).skip(1).repeat(2)
+    assert list(ds2) == [1, 2, 1, 2]
+
+
 def test_record_granular_shard_after_map():
     ds = data.Dataset.from_records(list(range(10))).map(lambda x: x * 2)
     assert ds.shard(3, 0).take(99) == [0, 6, 12, 18]
