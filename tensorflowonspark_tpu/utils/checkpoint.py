@@ -108,11 +108,14 @@ class PreemptionHandler:
 
         @contextlib.contextmanager
         def _guard():
-            signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK, sigs)
+            # restore the PREVIOUS mask, not a blanket unblock: nested
+            # guards (or a caller that blocked these signals itself) must
+            # stay protected when an inner guard exits
+            old = signal_mod.pthread_sigmask(signal_mod.SIG_BLOCK, sigs)
             try:
                 yield
             finally:
-                signal_mod.pthread_sigmask(signal_mod.SIG_UNBLOCK, sigs)
+                signal_mod.pthread_sigmask(signal_mod.SIG_SETMASK, old)
         return _guard()
 
 
@@ -142,16 +145,22 @@ def install_preemption_handler(save_fn, signals=None):
     previous = {}
 
     def handler(signum, frame):
-        if not fired:
-            fired.append(signum)
-            try:
-                logger.warning("signal %d: saving preemption checkpoint",
-                               signum)
-                save_fn()
-                wait_for_saves()
-                logger.warning("preemption checkpoint committed")
-            except Exception:
-                logger.exception("preemption save failed")
+        if fired:
+            # re-delivered signal while the first invocation is still
+            # saving (schedulers commonly TERM the process group twice):
+            # returning lets the in-progress save finish and exit —
+            # sys.exit here would raise SystemExit INSIDE save_fn and
+            # abort the very checkpoint this handler exists to write
+            return
+        fired.append(signum)
+        try:
+            logger.warning("signal %d: saving preemption checkpoint",
+                           signum)
+            save_fn()
+            wait_for_saves()
+            logger.warning("preemption checkpoint committed")
+        except Exception:
+            logger.exception("preemption save failed")
         sys.exit(128 + signum)
 
     for sig in signals:

@@ -160,3 +160,34 @@ def test_preemption_guard_defers_signal(tmp_path):
     assert proc.returncode == 128 + signal.SIGTERM, proc.stderr[-2000:]
     assert "UNREACHABLE" not in proc.stdout
     assert marker.read_text() == "published"
+
+
+def test_preemption_guard_nests(tmp_path):
+    # exiting an INNER guard must not unblock the signal for the still-
+    # guarded outer region (mask restore, not blanket unblock)
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    marker = tmp_path / "saved.txt"
+    prog = textwrap.dedent(f"""
+        import os, signal, sys
+        sys.path.insert(0, {repr(os.getcwd())})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from tensorflowonspark_tpu.utils import checkpoint as ckpt
+
+        holder = {{"v": "stale"}}
+        h = ckpt.install_preemption_handler(
+            lambda: open({repr(str(marker))}, "w").write(holder["v"]))
+        with h.guard():
+            with h.guard():
+                os.kill(os.getpid(), signal.SIGTERM)
+            holder["v"] = "outer-still-guarded"   # must run before handler
+        print("UNREACHABLE")
+    """)
+    proc = subprocess.run([sys.executable, "-c", prog],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 128 + signal.SIGTERM, proc.stderr[-2000:]
+    assert marker.read_text() == "outer-still-guarded"

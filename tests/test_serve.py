@@ -114,3 +114,92 @@ def test_non_object_bodies_get_400(server):
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(base + "/v1/models/default:predict", payload)
         assert e.value.code == 400
+
+
+@pytest.fixture(scope="module")
+def batched_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve_batched")
+    from tensorflowonspark_tpu.models.linear import Linear
+
+    params = Linear(features=1).init(
+        jax.random.key(0), np.zeros((1, 2), "float32"))["params"]
+    export.export_saved_model(
+        str(tmp / "m"), params,
+        builder="tensorflowonspark_tpu.models.linear:Linear",
+        builder_kwargs={"features": 1},
+        signatures={"serving_default": {
+            "inputs": {"x": {"shape": [2], "dtype": "float32"}},
+            "outputs": ["y"]}})
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp / "m"), "--port", "0",
+         "--batch_wait_ms", "50"])
+    srv, service = serve.make_server(args)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    host, port = srv.server_address[:2]
+    yield f"http://{host}:{port}", params, service
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_micro_batching_coalesces_concurrent_requests(batched_server):
+    # N concurrent requests inside one batching window must each get
+    # exactly their own rows back, from FEWER device executions than
+    # requests (the whole point of the batcher)
+    base, params, service = batched_server
+    w = np.asarray(params["dense"]["kernel"]).reshape(-1)
+    b = float(np.asarray(params["dense"]["bias"]).reshape(-1)[0])
+    results = {}
+    errors = []
+
+    def call(i):
+        try:
+            x = [float(i), float(i + 1)]
+            out = _post(f"{base}/v1/models/default:predict",
+                        {"instances": [{"x": x}]})
+            results[i] = (out["predictions"][0]["y"], x)
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(12)]
+    before = service._batcher.executions
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(results) == 12
+    for i, (got, x) in results.items():
+        want = float(np.dot(w, np.asarray(x, "float32")) + b)
+        got_v = got[0] if isinstance(got, list) else got
+        assert abs(got_v - want) < 1e-4, (i, got_v, want)
+    executed = service._batcher.executions - before
+    assert executed < 12, f"no coalescing happened ({executed} executions)"
+
+
+def test_micro_batching_isolates_malformed_request(batched_server):
+    # a bad request coalesced into the same window must fail ALONE;
+    # the valid neighbors still get their rows
+    base, params, service = batched_server
+    results, errors = {}, {}
+
+    def good(i):
+        out = _post(f"{base}/v1/models/default:predict",
+                    {"instances": [{"x": [1.0, 2.0]}]})
+        results[i] = out["predictions"][0]["y"]
+
+    def bad():
+        try:
+            _post(f"{base}/v1/models/default:predict",
+                  {"instances": [{"z": [1.0, 2.0]}]})
+        except urllib.error.HTTPError as e:
+            errors["bad"] = e.code
+
+    threads = ([threading.Thread(target=good, args=(i,)) for i in range(4)]
+               + [threading.Thread(target=bad)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4          # every valid request served
+    assert errors.get("bad") in (400, 500)
