@@ -34,12 +34,14 @@ The transform is a drop-in `optax.GradientTransformation`; compose decay
     opt = optim8bit.adamw8bit(3e-4, weight_decay=0.1)
     # or via the factory: optim.make_optimizer("adamw8bit", ...)
 
-Sharding note: quantized payloads are flat [n_blocks, block] views whose
-element order does not follow the parameter's sharded axes, so under
-explicit ``param_shardings`` the train-step helpers REPLICATE this state
-(with a loud warning — parallel/train._map_state).  Use adamw8bit for
-single-chip / pure-dp memory wins; fsdp-sharding it needs per-shard
-quantization, which is future work.
+Sharding note: quantized payloads are flat [n_blocks, block] views.  For
+a param sharded on dim 0 only (fsdp-style), each shard owns a contiguous
+flat range, so passing ``example_params`` to
+``parallel.train.make_train_step`` shards q/scale along their block axis
+with the same mesh axis — the int8 state then scales down per chip
+exactly like f32 moments would.  Without shapes (or for non-dim-0
+layouts) the train-step helpers REPLICATE this state with a loud warning
+(parallel/train._map_state).
 """
 from typing import NamedTuple
 

@@ -214,10 +214,13 @@ def local_mesh_spec(num_devices=None, tp=1, pp=1, fsdp=1):
 
 
 def batch_sharding(mesh):
-    """NamedSharding for a [batch, ...] input: batch split over dp+fsdp."""
+    """NamedSharding for a [batch, ...] input: batch split over whichever
+    of dp/fsdp the mesh actually has (a partial mesh — e.g. fsdp-only in
+    tests or tp-only serving meshes — must not name absent axes)."""
     import jax
     P = jax.sharding.PartitionSpec
-    return jax.sharding.NamedSharding(mesh, P(BATCH_AXES))
+    axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    return jax.sharding.NamedSharding(mesh, P(axes if axes else None))
 
 
 def replicated_sharding(mesh):

@@ -37,13 +37,20 @@ def create_train_state(params, optimizer, mesh=None, param_shardings=None):
 
 
 def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
-                    grad_accum=1, compute_dtype=None, donate=True):
+                    grad_accum=1, compute_dtype=None, donate=True,
+                    example_params=None):
     """Build the jitted train step.
 
     `loss_fn(params, batch, rng) -> scalar loss` — the mean over the LOCAL
     shard; with the batch sharded over dp/fsdp and params replicated (or
     sharded), jit's sharding propagation makes XLA emit the gradient
     allreduce automatically.
+
+    ``example_params`` (arrays or ShapeDtypeStructs matching the real
+    parameters) is only needed with `param_shardings` AND an optimizer
+    whose state the shardings alone cannot place — optim8bit's quantized
+    moments, which then shard along their block axis instead of
+    replicating (see _quantized_shardings).
 
     Returns `train_step(state, batch, rng) -> (state, metrics)`.
     """
@@ -86,6 +93,11 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
                    "grad_norm": optax.global_norm(grads)}
         return new_state, metrics
 
+    if mesh is None and param_shardings is not None:
+        # derive the mesh from the shardings rather than silently
+        # compiling an unsharded step
+        leaves = jax.tree_util.tree_leaves(param_shardings)
+        mesh = next((s.mesh for s in leaves if hasattr(s, "mesh")), None)
     if mesh is None:
         return jax.jit(_step, donate_argnums=(0,) if donate else ())
 
@@ -99,7 +111,8 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
     else:
         state_shardings = TrainState(
             step=repl, params=param_shardings,
-            opt_state=_opt_state_shardings(optimizer, param_shardings, repl))
+            opt_state=_opt_state_shardings(optimizer, param_shardings, repl,
+                                           example_params))
         in_shardings = (state_shardings, batch_shard, repl)
         out_shardings = (state_shardings, repl)
 
@@ -108,44 +121,126 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
                    donate_argnums=(0,) if donate else ())
 
 
-def _opt_state_shardings(optimizer, param_shardings, repl):
+def _opt_state_shardings(optimizer, param_shardings, repl,
+                         example_params=None):
     """Mirror param shardings onto optimizer slots (mu/nu mirror the param
-    tree and inherit its shardings; scalar slots like counts replicate)."""
+    tree and inherit its shardings; scalar slots like counts replicate).
+
+    ``example_params`` (a pytree of arrays or ShapeDtypeStructs matching
+    the real parameters) enables shape-aware placement for state the
+    shardings alone cannot describe — today that is optim8bit's
+    blockwise-quantized moments, which shard along their flat block axis
+    when the divisibility works out (see _quantized_shardings)."""
     import jax
     import jax.numpy as jnp
 
+    if example_params is not None:
+        shapes = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            example_params)
+        state_shapes = jax.eval_shape(optimizer.init, shapes)
+        return _map_state(state_shapes, param_shardings, repl,
+                          with_shapes=True)
     dummy = jax.tree_util.tree_map(lambda s: jnp.zeros(()), param_shardings)
     state = optimizer.init(dummy)
     return _map_state(state, param_shardings, repl)
 
 
-def _map_state(state, param_shardings, repl):
+def _map_state(state, param_shardings, repl, with_shapes=False):
     import jax
 
     params_struct = jax.tree_util.tree_structure(param_shardings)
     if jax.tree_util.tree_structure(state) == params_struct:
         return param_shardings
-    if hasattr(state, "_fields"):  # NamedTuple (ScaleByAdamState etc.)
-        return type(state)(*(_map_state(getattr(state, f), param_shardings, repl)
-                             for f in state._fields))
-    if isinstance(state, (tuple, list)):
-        return type(state)(_map_state(s, param_shardings, repl) for s in state)
-    if _has_quantized(state):
-        # optim8bit state (checked AFTER container recursion so only the
-        # subtrees that actually hold Quantized replicate — a chained f32
-        # ema/accumulator state still gets param shardings): blockwise-
-        # quantized payloads are flat [n_blocks, block] views whose
-        # element order does not follow the parameter's sharded axes, so
-        # they are REPLICATED (loudly — full-size int8 state per chip;
-        # still 4x smaller than replicated f32, but NOT sharded like f32
-        # moments would be under fsdp).  Sharding quantized state needs
-        # per-shard quantization, which is future work — see optim8bit
-        # module doc.
+    if _is_params_shaped_quantized(state, params_struct):
+        # a quantized-moments tree mirroring the params (ANY container
+        # type — dict, NamedTuple, list); checked BEFORE the NamedTuple
+        # recursion because Quantized is itself a NamedTuple and naive
+        # descent would walk into its q/scale fields and lose the
+        # params pairing
+        if with_shapes:
+            return _quantized_shardings(state, param_shardings, repl)
         logger.warning(
             "8-bit optimizer state is replicated under explicit param "
-            "shardings (not fsdp-sharded); per-chip optimizer memory is "
-            "the full quantized state")
+            "shardings; pass example_params to make_train_step to shard "
+            "it along the block axis")
+        return jax.tree_util.tree_map(lambda _: repl, state)
+    if hasattr(state, "_fields"):  # NamedTuple (ScaleByAdamState etc.)
+        return type(state)(*(_map_state(getattr(state, f), param_shardings,
+                                        repl, with_shapes)
+                             for f in state._fields))
+    if isinstance(state, (tuple, list)):
+        return type(state)(_map_state(s, param_shardings, repl, with_shapes)
+                           for s in state)
+    if _has_quantized(state):
+        if with_shapes:
+            # shape-aware path (make_train_step(..., example_params=...)):
+            # each param's quantized moments shard along their flat block
+            # axis when each mesh shard owns a whole number of blocks
+            return _quantized_shardings(state, param_shardings, repl)
+        # optim8bit state without shape info (checked AFTER container
+        # recursion so only the subtrees that actually hold Quantized
+        # replicate — a chained f32 ema/accumulator state still gets
+        # param shardings): blockwise-quantized payloads are flat
+        # [n_blocks, block] views, and without the parameter shapes the
+        # divisibility cannot be checked, so they are REPLICATED (loudly
+        # — full-size int8 state per chip; still 4x smaller than
+        # replicated f32, but NOT sharded like f32 moments would be
+        # under fsdp).  Pass example_params to make_train_step for the
+        # sharded placement.
+        logger.warning(
+            "8-bit optimizer state is replicated under explicit param "
+            "shardings; pass example_params to make_train_step to shard "
+            "it along the block axis")
     return jax.tree_util.tree_map(lambda _: repl, state)
+
+
+def _quantized_shardings(q_state_shapes, param_shardings, repl):
+    """Shardings for a params-shaped tree of Quantized shape-structs.
+
+    A Quantized payload is the param flattened row-major into
+    ``[n_blocks, block]``.  When the param is sharded on dim 0 ONLY
+    (fsdp-style row sharding) each shard owns a contiguous flat range;
+    if that range is a whole number of blocks, sharding q and scale on
+    THEIR dim 0 over the same axis places every block exactly with its
+    rows — zero extra communication.  Any other layout (non-dim-0
+    sharding, non-divisible blocks) replicates that param's state: GSPMD
+    would otherwise reshard every step.
+
+    The gate checks block-count divisibility; if the param's true element
+    count is not itself a multiple of shards x block (a padded tail
+    crossing a shard boundary), GSPMD still computes correctly but
+    inserts a gather — typical power-of-two layer shapes with the
+    default block (256) are exactly aligned.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from tensorflowonspark_tpu.optim8bit import Quantized
+
+    def per_param(sharding, qt):
+        spec = tuple(getattr(sharding, "spec", ()) or ())
+        mesh = getattr(sharding, "mesh", None)
+        n_blocks = qt.q.shape[0]
+        if (mesh is not None and spec and spec[0] is not None
+                and all(a is None for a in spec[1:])):
+            axis = spec[0]
+            n_shards = mesh.shape[axis] if not isinstance(axis, tuple) else 0
+            if n_shards and n_blocks % n_shards == 0:
+                s = NamedSharding(mesh, PartitionSpec(axis, None))
+                return Quantized(q=s, scale=s)
+        if any(a is not None for a in spec):
+            # the documented loud fallback: a sharded param whose
+            # quantized state cannot ride the block axis (non-dim-0
+            # layout or indivisible block count) replicates
+            logger.warning(
+                "quantized optimizer state for a param sharded %s "
+                "(%d blocks) cannot shard along its block axis; "
+                "replicating that param's int8 state", spec, n_blocks)
+        return Quantized(q=repl, scale=repl)
+
+    return jax.tree_util.tree_map(
+        per_param, param_shardings, q_state_shapes,
+        is_leaf=lambda x: isinstance(x, Quantized))
 
 
 def _has_quantized(state):
@@ -159,6 +254,20 @@ def _has_quantized(state):
         lambda x: found.append(True) if isinstance(x, Quantized) else None,
         state, is_leaf=lambda x: isinstance(x, Quantized))
     return bool(found)
+
+
+def _is_params_shaped_quantized(state, params_struct):
+    """True when `state` mirrors the params tree with a Quantized subtree
+    at every leaf position — the shape of optim8bit's mu/nu_sqrt."""
+    try:
+        from tensorflowonspark_tpu.optim8bit import Quantized
+    except Exception:
+        return False
+    try:
+        flat = params_struct.flatten_up_to(state)
+    except (ValueError, TypeError):
+        return False
+    return bool(flat) and all(isinstance(x, Quantized) for x in flat)
 
 
 def make_eval_step(forward_fn, mesh=None):
