@@ -236,6 +236,33 @@ class Dataset:
         """Keep records where `pred(record)` is true."""
         return self._chain(lambda it: (r for r in it if pred(r)))
 
+    def cache(self):
+        """Materialize upstream records in memory during the first FULL
+        pass; later iterations — including `repeat` epochs — replay from
+        memory instead of re-reading/re-parsing files (tf.data
+        ``.cache()``).  Place before `shuffle` so per-epoch reshuffling
+        still applies.  A partial iteration (early break) does not mark
+        the cache complete.
+
+        Replay yields the SAME objects each pass (no defensive copy —
+        the same trade tf.data makes): a downstream `map` fn that
+        mutates records in place (e.g. ``arr -= mean`` on a cached
+        numpy array) would corrupt the cache cumulatively across
+        epochs.  Map fns over cached data must return new values —
+        the bundled image transforms already do."""
+        state = {"filled": False, "records": None}
+
+        def op(it):
+            if state["filled"]:
+                yield from state["records"]
+                return
+            buf = []
+            for r in it:
+                buf.append(r)
+                yield r
+            state["records"], state["filled"] = buf, True
+        return self._chain(op)
+
     def skip(self, n):
         """Skip the first `n` records — the resume-from-position primitive:
         the pipeline is deterministic for a fixed seed, so a restart that

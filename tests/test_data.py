@@ -71,6 +71,50 @@ def test_interleave_rejects_non_root(tfr_dir):
         ds.interleave()
 
 
+def test_cache_consumes_source_once():
+    # counts records PULLED from the source (the chain still constructs
+    # upstream iterators per pass; the property is that a filled cache
+    # never CONSUMES them again)
+    pulled = {"n": 0}
+
+    def gen():
+        def inner():
+            for i in range(10):
+                pulled["n"] += 1
+                yield i
+        return inner()
+
+    ds = data.Dataset.from_generator(gen).cache()
+    assert list(ds) == list(range(10))
+    assert list(ds) == list(range(10))
+    assert pulled["n"] == 10                  # second pass replays memory
+    # repeat epochs also replay; shuffle AFTER cache still reshuffles —
+    # assert the ORDER differs between epochs (sorted() equality could
+    # not detect a broken per-epoch reseed)
+    ds2 = data.Dataset.from_generator(gen).cache().shuffle(10, seed=1)
+    both = list(ds2.repeat(2))
+    e1, e2 = both[:10], both[10:]
+    assert sorted(e1) == sorted(e2) == list(range(10))
+    assert e1 != e2                           # epoch reseed reaches shuffle
+    assert pulled["n"] == 20                  # one more fill, then cached
+
+
+def test_cache_partial_iteration_not_marked_complete():
+    pulled = {"n": 0}
+
+    def gen():
+        def inner():
+            for i in range(100):
+                pulled["n"] += 1
+                yield i
+        return inner()
+
+    ds = data.Dataset.from_generator(gen).cache()
+    assert ds.take(3) == [0, 1, 2]            # early break
+    assert list(ds) == list(range(100))       # re-reads: cache not filled
+    assert pulled["n"] >= 103
+
+
 def test_skip_resumes_mid_epoch():
     ds = data.Dataset.from_records(list(range(20))).shuffle(8, seed=3)
     full = list(ds)
