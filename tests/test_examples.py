@@ -105,6 +105,19 @@ def test_resnet_imagenet_shards_pipeline(tmp_path):
     assert "validation top-1" in out
 
 
+def test_resnet_imagenet_cluster(tmp_path):
+    # the same program on the 2-process cluster backend: per-worker shard
+    # slices, both workers train, chief runs validation
+    out = _run("resnet/resnet_imagenet.py", "--synth", "--steps", "2",
+               "--batch_size", "4", "--image_size", "32",
+               "--synth_examples", "64", "--num_classes", "8",
+               "--reader_threads", "2", "--shuffle_buffer", "16",
+               "--cluster_size", "2", cwd=tmp_path)
+    assert "[worker 0] done: first=" in out
+    assert "[worker 1] done: first=" in out
+    assert "validation top-1" in out
+
+
 def test_segmentation_single_and_cluster(tmp_path):
     _run("segmentation/segmentation.py", "--steps", "2", "--batch_size", "4",
          "--image_size", "32", "--num_examples", "16", cwd=tmp_path)
