@@ -203,3 +203,24 @@ def test_micro_batching_isolates_malformed_request(batched_server):
         t.join()
     assert len(results) == 4          # every valid request served
     assert errors.get("bad") in (400, 500)
+
+
+def test_micro_batcher_survives_predictor_failure(batched_server):
+    # a predictor exception fails that batch's requests but must NOT
+    # kill the batcher thread: later requests still get served
+    base, params, service = batched_server
+    real = service._batcher._predict
+
+    def boom(cols, n):
+        raise RuntimeError("injected predictor failure")
+
+    service._batcher._predict = boom
+    try:
+        with pytest.raises(urllib.error.HTTPError):
+            _post(f"{base}/v1/models/default:predict",
+                  {"instances": [{"x": [1.0, 2.0]}]})
+    finally:
+        service._batcher._predict = real
+    out = _post(f"{base}/v1/models/default:predict",
+                {"instances": [{"x": [1.0, 2.0]}]})
+    assert "predictions" in out                 # batcher thread alive
