@@ -22,6 +22,9 @@ def build_argparser():
     p.add_argument("--batch_size", type=int, default=16)
     p.add_argument("--image_size", type=int, default=64)
     p.add_argument("--num_examples", type=int, default=512)
+    p.add_argument("--model", choices=["unet", "deeplabv3"], default="unet",
+                   help="deeplabv3 runs a demo-scale config here; the "
+                        "full-size model is models.get_model('deeplabv3')")
     p.add_argument("--model_dir", default=None)
     p.add_argument("--platform", choices=["cpu", "tpu"], default="cpu")
     p.add_argument("--cluster_size", type=int, default=1)
@@ -70,7 +73,15 @@ def train(args, ctx=None):
     images, masks = synthetic_shapes(args.num_examples, args.image_size,
                                      seed=task)
 
-    model = UNet(num_classes=3)
+    if getattr(args, "model", "unet") == "deeplabv3":
+        # the BASELINE config's other segmentation model (DeepLabV3/UNet),
+        # via the registry at a demo scale
+        from tensorflowonspark_tpu.models import get_model
+        model = get_model("deeplabv3", num_classes=3,
+                          stage_sizes=(1, 1, 1, 1), num_filters=16,
+                          aspp_features=32, dtype="float32")
+    else:
+        model = UNet(num_classes=3)
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, args.image_size, args.image_size, 3)))["params"]
 

@@ -9,6 +9,7 @@ _REGISTRY = {
     "mnist_cnn": ("tensorflowonspark_tpu.models.cnn", "MnistCNN"),
     "resnet": ("tensorflowonspark_tpu.models.resnet", "ResNet"),
     "unet": ("tensorflowonspark_tpu.models.unet", "UNet"),
+    "deeplabv3": ("tensorflowonspark_tpu.models.deeplab", "DeepLabV3"),
     "transformer": ("tensorflowonspark_tpu.models.transformer", "Transformer"),
     "bert": ("tensorflowonspark_tpu.models.bert", "BertForPreTraining"),
 }

@@ -1,0 +1,115 @@
+"""DeepLabV3 semantic segmentation — the second model of the BASELINE
+segmentation config ("DeepLabV3 / UNet", BASELINE.json "configs"; the
+reference's segmentation example ships UNet and defers DeepLab to the
+upstream model zoo).
+
+TPU-first construction:
+- ResNet-bottleneck backbone with the last stage DILATED instead of
+  strided (output stride 16): atrous convs keep the static NHWC shapes
+  XLA tiles onto the MXU — no deconv/unpooling dynamic shapes.
+- ASPP: parallel 1x1 + three dilated 3x3 branches + image-level pooling,
+  concatenated and projected.  All branches are batched convs over one
+  feature map — they fuse into a handful of MXU matmuls.
+- Bilinear upsample back to input resolution via jax.image.resize
+  (static target shape, compiles to a single gather/convolution program).
+- GroupNorm by default for the same SPMD reasons as models.resnet
+  (stateless, no cross-replica batch statistics).
+"""
+import functools
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models.common import ChannelGroupNorm
+from tensorflowonspark_tpu.models.resnet import BottleneckBlock
+
+
+class ASPP(nn.Module):
+    """Atrous spatial pyramid pooling over [B, H, W, C]."""
+    features: int = 256
+    rates: Sequence[int] = (6, 12, 18)
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = jnp.dtype(self.dtype)
+        norm = ChannelGroupNorm
+        act = nn.relu
+        branches = []
+        conv1 = nn.Conv(self.features, (1, 1), use_bias=False, dtype=dtype,
+                        name="branch_1x1")
+        branches.append(act(norm(name="norm_1x1")(conv1(x))))
+        for r in self.rates:
+            conv = nn.Conv(self.features, (3, 3), kernel_dilation=(r, r),
+                           padding="SAME", use_bias=False, dtype=dtype,
+                           name=f"branch_rate{r}")
+            branches.append(act(norm(name=f"norm_rate{r}")(conv(x))))
+        # image-level pooling: global context broadcast back over H, W.
+        # No norm on this branch: over a [B,1,1,C] tensor GroupNorm
+        # degenerates to per-element (x-mean)=0 whenever group size hits
+        # 1, silently zeroing the branch (bias stays so the conv is
+        # affine like the normed branches' beta)
+        pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
+        pooled = nn.Conv(self.features, (1, 1), use_bias=True, dtype=dtype,
+                         name="branch_pool")(pooled)
+        pooled = act(pooled)
+        pooled = jnp.broadcast_to(
+            pooled, x.shape[:3] + (self.features,)).astype(dtype)
+        branches.append(pooled)
+        y = jnp.concatenate(branches, axis=-1)
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=dtype,
+                    name="project")(y)
+        return act(norm(name="norm_project")(y))
+
+
+class DeepLabV3(nn.Module):
+    """DeepLabV3 over NHWC images: dilated-ResNet backbone -> ASPP ->
+    classifier -> bilinear upsample to input resolution.
+
+    `stage_sizes` counts bottleneck blocks per stage (default the
+    ResNet-50 layout); the final stage uses dilation 2 instead of
+    stride 2, giving output stride 16.
+    """
+    num_classes: int = 21
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_filters: int = 64
+    aspp_features: int = 256
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        dtype = jnp.dtype(self.dtype)
+        H, W = x.shape[1], x.shape[2]
+        conv = functools.partial(nn.Conv, use_bias=False, padding="SAME",
+                                 dtype=dtype)
+        norm = ChannelGroupNorm
+        act = nn.relu
+
+        x = x.astype(dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = act(norm(name="norm_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            last = i == len(self.stage_sizes) - 1
+            # the last stage trades its stride for dilation: same
+            # receptive field, 2x the spatial resolution into ASPP
+            block_conv = (functools.partial(conv, kernel_dilation=(2, 2))
+                          if last else conv)
+            for j in range(block_count):
+                strides = 2 if (0 < i < len(self.stage_sizes) - 1
+                                and j == 0) else 1
+                x = BottleneckBlock(self.num_filters * 2 ** i,
+                                    conv=block_conv, norm=norm, act=act,
+                                    strides=strides,
+                                    name=f"stage{i}_block{j}")(x)
+        x = ASPP(features=self.aspp_features, dtype=self.dtype,
+                 name="aspp")(x)
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,
+                         name="classifier")(x)
+        # static-shape bilinear upsample back to the input resolution
+        logits = jax.image.resize(
+            logits.astype(jnp.float32),
+            (logits.shape[0], H, W, self.num_classes), method="bilinear")
+        return logits
