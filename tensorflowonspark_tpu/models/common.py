@@ -46,6 +46,7 @@ class WSConv(nn.Module):
     padding: str = "SAME"
     dtype: str = "bfloat16"
     use_bias: bool = False
+    kernel_dilation: Sequence[int] = (1, 1)  # atrous (DeepLab backbones)
 
     @nn.compact
     def __call__(self, x):
@@ -65,6 +66,7 @@ class WSConv(nn.Module):
             x.astype(jnp.dtype(self.dtype)),
             kernel.astype(jnp.dtype(self.dtype)),
             window_strides=tuple(self.strides), padding=self.padding,
+            rhs_dilation=tuple(self.kernel_dilation),
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.use_bias:
             y = y + self.param("bias", nn.initializers.zeros,

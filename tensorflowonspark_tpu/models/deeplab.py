@@ -4,18 +4,17 @@ reference's segmentation example ships UNet and defers DeepLab to the
 upstream model zoo).
 
 TPU-first construction:
-- ResNet-bottleneck backbone with the last stage DILATED instead of
-  strided (output stride 16): atrous convs keep the static NHWC shapes
-  XLA tiles onto the MXU — no deconv/unpooling dynamic shapes.
+- ONE backbone: `models.resnet.ResNet(features_only=True, output_stride=
+  16)` — the last stage dilated instead of strided, so the atrous convs
+  keep the static NHWC shapes XLA tiles onto the MXU, and every ResNet
+  option (GroupNorm/BatchNorm, the norm-free WSConv variant, the s2d
+  stem) reaches dense prediction too.
 - ASPP: parallel 1x1 + three dilated 3x3 branches + image-level pooling,
   concatenated and projected.  All branches are batched convs over one
   feature map — they fuse into a handful of MXU matmuls.
 - Bilinear upsample back to input resolution via jax.image.resize
   (static target shape, compiles to a single gather/convolution program).
-- GroupNorm by default for the same SPMD reasons as models.resnet
-  (stateless, no cross-replica batch statistics).
 """
-import functools
 from typing import Sequence
 
 import flax.linen as nn
@@ -23,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from tensorflowonspark_tpu.models.common import ChannelGroupNorm
-from tensorflowonspark_tpu.models.resnet import BottleneckBlock
+from tensorflowonspark_tpu.models.resnet import ResNet
 
 
 class ASPP(nn.Module):
@@ -69,41 +68,25 @@ class DeepLabV3(nn.Module):
     classifier -> bilinear upsample to input resolution.
 
     `stage_sizes` counts bottleneck blocks per stage (default the
-    ResNet-50 layout); the final stage uses dilation 2 instead of
-    stride 2, giving output stride 16.
+    ResNet-50 layout); `norm`/`stem` pass straight to the shared ResNet
+    backbone ("group" | "batch" | "none", "conv" | "s2d").
     """
     num_classes: int = 21
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     num_filters: int = 64
     aspp_features: int = 256
+    norm: str = "group"
+    stem: str = "conv"
     dtype: str = "bfloat16"
 
     @nn.compact
     def __call__(self, x, train=False):
-        dtype = jnp.dtype(self.dtype)
         H, W = x.shape[1], x.shape[2]
-        conv = functools.partial(nn.Conv, use_bias=False, padding="SAME",
-                                 dtype=dtype)
-        norm = ChannelGroupNorm
-        act = nn.relu
-
-        x = x.astype(dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
-        x = act(norm(name="norm_init")(x))
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        for i, block_count in enumerate(self.stage_sizes):
-            last = i == len(self.stage_sizes) - 1
-            # the last stage trades its stride for dilation: same
-            # receptive field, 2x the spatial resolution into ASPP
-            block_conv = (functools.partial(conv, kernel_dilation=(2, 2))
-                          if last else conv)
-            for j in range(block_count):
-                strides = 2 if (0 < i < len(self.stage_sizes) - 1
-                                and j == 0) else 1
-                x = BottleneckBlock(self.num_filters * 2 ** i,
-                                    conv=block_conv, norm=norm, act=act,
-                                    strides=strides,
-                                    name=f"stage{i}_block{j}")(x)
+        x = ResNet(stage_sizes=tuple(self.stage_sizes),
+                   num_filters=self.num_filters, bottleneck=True,
+                   norm=self.norm, stem=self.stem, dtype=self.dtype,
+                   output_stride=16, features_only=True,
+                   name="backbone")(x, train=train)
         x = ASPP(features=self.aspp_features, dtype=self.dtype,
                  name="aspp")(x)
         logits = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,

@@ -13,7 +13,7 @@ TPU-first choices:
   collection via ``mutable=["batch_stats"]``).
 """
 import functools
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -100,6 +100,15 @@ class ResNet(nn.Module):
     # receptive field) is the standard TPU ResNet stem optimization
     # (MLPerf space-to-depth trick).
     stem: str = "conv"
+    # None = classifier head over the classic stride-32 backbone.
+    # 16 (or 8) trades the last one (two) stage strides for dilation —
+    # the DeepLab-style dense-prediction backbone: same receptive field,
+    # higher-resolution features, still static NHWC shapes for the MXU.
+    output_stride: Optional[int] = None
+    # True: return the final feature map instead of pooled class logits
+    # (the feature-extractor seam models.deeplab consumes — one backbone,
+    # so norm="none"/WSConv and the s2d stem reach every consumer).
+    features_only: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -135,12 +144,25 @@ class ResNet(nn.Module):
         x = act(x)
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # with output_stride, the last N stages trade their stride-2 for
+        # dilation: stride 32 -> 16 dilates the last stage, -> 8 the last
+        # two (the striding stages are 1..len-1; the stem contributes /4)
+        n_dilated = 0
+        if self.output_stride is not None:
+            if self.output_stride not in (8, 16):
+                raise ValueError("output_stride must be 8, 16, or None")
+            n_dilated = {16: 1, 8: 2}[self.output_stride]
         for i, block_count in enumerate(self.stage_sizes):
+            dilated = i >= len(self.stage_sizes) - n_dilated
+            stage_conv = (functools.partial(conv, kernel_dilation=(2, 2))
+                          if dilated else conv)
             for j in range(block_count):
-                strides = 2 if i > 0 and j == 0 else 1
-                x = block_cls(self.num_filters * 2 ** i, conv=conv, norm=norm,
-                              act=act, strides=strides,
+                strides = 2 if (i > 0 and j == 0 and not dilated) else 1
+                x = block_cls(self.num_filters * 2 ** i, conv=stage_conv,
+                              norm=norm, act=act, strides=strides,
                               name=f"stage{i}_block{j}")(x)
+        if self.features_only:
+            return x
         x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
 

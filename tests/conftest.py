@@ -39,10 +39,10 @@ def mp_ctx():
 # bound (>20 min on a 1-core box), so the core-runtime tier must stay
 # runnable in one sitting.  Inclusion rule: a file is slow if it measured
 # >=20 s standalone (timing sweep recorded 2026-07-31) OR is non-core
-# (models/parallelism/optimizer features) and the fast tier would
-# otherwise exceed its <90 s budget — that covers the two sub-20 s
-# entries (hybrid_mesh 11 s, optim8bit 14 s).  Everything else forms the
-# fast tier:
+# (models/parallelism/optimizer features, peripheral utils) and the fast
+# tier would otherwise exceed its <90 s budget — that covers the sub-20 s
+# entries (hybrid_mesh 11 s, optim8bit 14 s, summary 9 s).  Everything
+# else forms the fast tier:
 #     pytest -m "not slow"        (also: scripts/run_tests.sh --fast)
 SLOW_FILES = {
     "test_aot.py",              # 70 s — native lib + mock PJRT round trips
@@ -64,6 +64,8 @@ SLOW_FILES = {
     "test_ring_attention.py",   # 31 s
     "test_spark_integration.py",  # 110 s — end-to-end Spark surface
     "test_streaming.py",        # 41 s
+    "test_summary.py",          # 9 s — non-core (tfevents writer), keeps
+    # the tier under its 90 s budget as fast files accrete
     "test_transformer.py",      # 47 s
     "test_ulysses.py",          # 35 s
     "test_xent.py",             # 20 s

@@ -85,6 +85,7 @@ def test_async_save_keep_retention(tmp_path):
     assert steps == [4, 5]  # same steady state as the sync path
 
 
+@pytest.mark.slow  # subprocess + orbax round trip
 def test_preemption_handler_saves_then_dies(tmp_path):
     # a SIGTERM'd training process must commit a final checkpoint and
     # still exit with the killed-by-signal code (TPU preemptions / Spark
@@ -131,6 +132,7 @@ def test_preemption_handler_uninstall(tmp_path):
     assert signal.getsignal(signal.SIGTERM) is prev
 
 
+@pytest.mark.slow  # subprocess + orbax round trip
 def test_preemption_guard_defers_signal(tmp_path):
     # a signal raised INSIDE guard() must be delivered only after the
     # guarded region publishes consistent state (the donated-step window)
@@ -162,6 +164,7 @@ def test_preemption_guard_defers_signal(tmp_path):
     assert marker.read_text() == "published"
 
 
+@pytest.mark.slow  # subprocess + orbax round trip
 def test_preemption_guard_nests(tmp_path):
     # exiting an INNER guard must not unblock the signal for the still-
     # guarded outer region (mask restore, not blanket unblock)

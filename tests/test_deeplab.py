@@ -66,13 +66,41 @@ def test_aspp_pool_branch_is_input_dependent():
     assert np.abs(a).max() > 0
 
 
+def test_backbone_options_reach_dense_prediction():
+    # ONE backbone: the norm-free WSConv variant and the s2d stem must
+    # compose with the dilated feature-extractor seam
+    model = DeepLabV3(num_classes=3, stage_sizes=(1, 1, 1, 1),
+                      num_filters=8, aspp_features=16, norm="none",
+                      stem="s2d", dtype="float32")
+    x = jnp.zeros((1, 32, 32, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    out = model.apply({"params": params}, x)
+    assert out.shape == (1, 32, 32, 3)
+    # WSConv kernels (not plain Conv) in the dilated backbone
+    block = params["backbone"]["stage3_block0"]
+    assert "WSConv_0" in block and "gain" in block["WSConv_0"]
+
+
+def test_resnet_output_stride_8():
+    from tensorflowonspark_tpu.models.resnet import ResNet
+
+    model = ResNet(stage_sizes=(1, 1, 1, 1), num_filters=8,
+                   bottleneck=True, output_stride=8, features_only=True,
+                   dtype="float32")
+    x = jnp.zeros((1, 64, 64, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    out = model.apply({"params": params}, x)
+    assert out.shape[1:3] == (8, 8)           # 64 / 8
+
+
 def test_trains_on_synthetic_masks():
     model = DeepLabV3(**SMALL)
     rng = np.random.RandomState(0)
-    X = jnp.asarray(rng.rand(8, 32, 32, 3), jnp.float32)
+    B, S, C = 8, 32, 3
+    X = jnp.asarray(rng.rand(B, S, S, 3), jnp.float32)
     # learnable mask: class = x-position band
-    y = jnp.asarray(np.tile(np.repeat(np.arange(32) * 3 // 32, 1)[None, None, :],
-                            (8, 32, 1)), jnp.int32)
+    bands = np.arange(S) * C // S                  # [S] in {0..C-1}
+    y = jnp.asarray(np.tile(bands[None, None, :], (B, S, 1)), jnp.int32)
     params = model.init(jax.random.key(0), X[:1])["params"]
 
     import optax
