@@ -57,6 +57,51 @@ def mean_squared_error(pred, target, mask=None):
                          - target.astype(jnp.float32)) ** 2, mask)[0]
 
 
+def confusion_matrix(preds, labels, num_classes, mask=None):
+    """[num_classes, num_classes] float32 counts, rows = true class.
+
+    One-hot matmul formulation: a [N, C] x [N, C] contraction the MXU
+    executes directly — no scatter, no sort, jit/SPMD-friendly (a
+    per-shard matrix psums cleanly across data-parallel shards).
+    """
+    preds = preds.reshape(-1)
+    labels = labels.reshape(-1)
+    t = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    p = jax.nn.one_hot(preds, num_classes, dtype=jnp.float32)
+    if mask is not None:
+        t = t * mask.astype(jnp.float32).reshape(-1, 1)
+    return t.T @ p
+
+
+def mean_iou(logits, labels, mask=None, num_classes=None):
+    """Mean intersection-over-union — the canonical segmentation metric
+    (pairs with models.unet / models.deeplab; the reference's
+    segmentation examples track only pixel accuracy).
+
+    IoU_c = TP_c / (TP_c + FP_c + FN_c), averaged over classes that
+    APPEAR (in labels or predictions — absent classes don't dilute the
+    mean).  ``mask`` excludes ignore pixels.  Returns a scalar; for
+    multi-batch eval accumulate `confusion_matrix` per batch and call
+    `iou_from_confusion` once.
+    """
+    num_classes = num_classes or logits.shape[-1]
+    cm = confusion_matrix(jnp.argmax(logits, axis=-1), labels,
+                          num_classes, mask)
+    return iou_from_confusion(cm)
+
+
+def iou_from_confusion(cm):
+    """Mean IoU from an accumulated confusion matrix (rows = true)."""
+    cm = cm.astype(jnp.float32)
+    tp = jnp.diagonal(cm)
+    fn = cm.sum(axis=1) - tp
+    fp = cm.sum(axis=0) - tp
+    denom = tp + fp + fn
+    present = denom > 0
+    iou = jnp.where(present, tp / jnp.maximum(denom, 1.0), 0.0)
+    return iou.sum() / jnp.maximum(present.sum(), 1)
+
+
 class MetricAccumulator:
     """Running weighted means kept on device until `result()`.
 

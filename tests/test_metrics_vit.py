@@ -155,3 +155,52 @@ def test_plain_tfrecord_with_gzip_magic_length(tmp_path):
         assert f.read(2) == b"\x1f\x8b"       # the collision is real
     got = list(tfrecord.read_records(path))
     assert got[0] == payload and got[1] == b"second"
+
+
+def test_confusion_matrix_counts():
+    preds = jnp.asarray([0, 1, 2, 2, 1, 0])
+    labels = jnp.asarray([0, 1, 1, 2, 1, 2])
+    cm = metrics.confusion_matrix(preds, labels, 3)
+    want = np.array([[1, 0, 0],     # true 0: pred 0
+                     [0, 2, 1],     # true 1: two pred 1, one pred 2
+                     [1, 0, 1]], np.float32)  # true 2: pred 0 and pred 2
+    np.testing.assert_array_equal(np.asarray(cm), want)
+    # mask drops the last two rows' pixels
+    cm2 = metrics.confusion_matrix(preds, labels, 3,
+                                   mask=jnp.asarray([1, 1, 1, 1, 0, 0]))
+    assert float(np.asarray(cm2).sum()) == 4.0
+
+
+def test_mean_iou_perfect_and_known():
+    # perfect prediction -> 1.0
+    labels = jnp.asarray(np.random.RandomState(0).randint(0, 3, (2, 8, 8)))
+    logits = jax.nn.one_hot(labels, 3) * 10.0
+    assert abs(float(metrics.mean_iou(logits, labels)) - 1.0) < 1e-6
+    # known case: 2 classes, half the pixels of class 1 mispredicted as 0
+    labels = jnp.asarray([0, 0, 1, 1])
+    preds_logits = jax.nn.one_hot(jnp.asarray([0, 0, 1, 0]), 2) * 10.0
+    # IoU_0 = 2/3 (tp=2, fp=1), IoU_1 = 1/2 (tp=1, fn=1) -> mean 7/12
+    got = float(metrics.mean_iou(preds_logits, labels))
+    assert abs(got - 7 / 12) < 1e-6
+
+
+def test_mean_iou_absent_class_not_diluting():
+    # class 2 never appears in labels or predictions -> mean over 2 classes
+    labels = jnp.asarray([0, 1, 0, 1])
+    logits = jax.nn.one_hot(labels, 3) * 10.0
+    assert abs(float(metrics.mean_iou(logits, labels)) - 1.0) < 1e-6
+
+
+def test_iou_accumulates_across_batches():
+    rng = np.random.RandomState(1)
+    labels = rng.randint(0, 4, (6, 10))
+    preds = rng.randint(0, 4, (6, 10))
+    cm = jnp.zeros((4, 4))
+    for i in range(6):
+        cm = cm + metrics.confusion_matrix(jnp.asarray(preds[i]),
+                                           jnp.asarray(labels[i]), 4)
+    one_shot = metrics.confusion_matrix(jnp.asarray(preds.reshape(-1)),
+                                        jnp.asarray(labels.reshape(-1)), 4)
+    np.testing.assert_array_equal(np.asarray(cm), np.asarray(one_shot))
+    v = float(metrics.iou_from_confusion(cm))
+    assert 0.0 <= v <= 1.0
