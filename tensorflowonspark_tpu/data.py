@@ -97,6 +97,98 @@ class Dataset:
 
         return cls.from_files(paths, reader)
 
+    @classmethod
+    def from_indexed_tfrecords(cls, paths, parse=None, global_shuffle=False,
+                               seed=0, shuffle_block=1, verify_crc=True):
+        """Root over indexed TFRecord shards with RANDOM access
+        (tfrecord.IndexedTFRecordFile; sidecar indexes are used when
+        present and built in memory otherwise).
+
+        This is the ArrayRecord-style input path (SURVEY.md §2.2).  Where
+        `from_tfrecords` reads shards sequentially (so `shuffle(buffer)`
+        only ever mixes records ~buffer apart and `shard()` is
+        file-granular), this root addresses every (file, record)
+        coordinate directly:
+
+        - ``global_shuffle=True`` draws a fresh uniform permutation of ALL
+          records each epoch (`seed` + epoch index, the `shuffle()` reseed
+          convention) — exact global shuffle, O(index) memory;
+        - ``shard(n, i)`` slices the (permuted) coordinate list, giving
+          every worker a disjoint, balanced 1/n of the records regardless
+          of file count or file sizes — record-granular, and each worker
+          reads ONLY its own records (no scan-and-discard);
+        - ``shuffle_block=k`` permutes blocks of k consecutive records
+          instead of single records: each block is fetched with one ranged
+          read, trading perfect uniformity for sequential IO (the
+          ArrayRecord shuffle-granularity tradeoff; k=1 is exact).
+        """
+        if shuffle_block < 1:
+            raise ValueError("shuffle_block must be >= 1")
+        cfg = {"parse": parse, "global_shuffle": bool(global_shuffle),
+               "seed": int(seed), "block": int(shuffle_block),
+               "verify": verify_crc}
+        return cls._indexed_root(_expand_paths(paths), cfg, None)
+
+    @classmethod
+    def _indexed_root(cls, files, cfg, shard_spec):
+        ds = cls(None)
+        ds._files = files
+        ds._indexed = cfg
+        ds._shard_spec = shard_spec
+        ds._epoch_source = ds._indexed_iter
+        return ds
+
+    # At most this many shard files keep an open fd during indexed
+    # iteration; the rest are release()d LRU and reopen on demand.
+    _MAX_OPEN_READERS = 128
+
+    def _indexed_readers(self):
+        from . import tfrecord
+
+        readers = getattr(self, "_idx_readers", None)
+        if readers is None:
+            readers = [tfrecord.IndexedTFRecordFile(
+                p, verify_crc=self._indexed["verify"]) for p in self._files]
+            self._idx_readers = readers
+        return readers
+
+    def _indexed_iter(self, epoch):
+        import collections
+
+        from . import tfrecord
+
+        if not self._files:
+            raise ValueError("dataset matched no input files")
+        cfg = self._indexed
+        readers = self._indexed_readers()
+        block = cfg["block"]
+        coords = []                      # (file_idx, start_record, n_records)
+        for fi, r in enumerate(readers):
+            n = len(r)
+            coords.extend((fi, s, min(block, n - s))
+                          for s in range(0, n, block))
+        if cfg["global_shuffle"]:
+            # same reseed scheme as shuffle(): deterministic per (seed,
+            # epoch), identical on every worker so shard slices stay
+            # disjoint across processes
+            rng = random.Random(cfg["seed"] * 1_000_003 + epoch)
+            rng.shuffle(coords)
+        if self._shard_spec:
+            n_shards, idx = self._shard_spec
+            coords = coords[idx::n_shards]
+        parse = cfg["parse"]
+        open_lru = collections.OrderedDict()     # file_idx -> None
+        for fi, start, count in coords:
+            payloads = readers[fi].read_range(start, count)
+            open_lru[fi] = None
+            open_lru.move_to_end(fi)
+            if len(open_lru) > self._MAX_OPEN_READERS:
+                oldest, _ = open_lru.popitem(last=False)
+                readers[oldest].release()
+            for payload in payloads:
+                ex = tfrecord.decode_example(payload)
+                yield parse(ex) if parse else ex
+
     def _file_source(self):
         files = self._my_files()
         if not files:
@@ -136,8 +228,11 @@ class Dataset:
     @property
     def file_rooted(self):
         """True when this dataset reads straight from a file list (so
-        `interleave()` applies and `shard()` is file-granular)."""
+        `interleave()` applies and `shard()` is file-granular).  Indexed
+        roots are excluded: they address records directly, so interleave
+        and file-granular sharding don't apply."""
         return (getattr(self, "_files", None) is not None
+                and getattr(self, "_indexed", None) is None
                 and self._parent is None)
 
     def interleave(self, cycle_length=4, block_length=1):
@@ -189,7 +284,16 @@ class Dataset:
         if not 0 <= index < num_shards:
             raise ValueError(f"shard index {index} not in [0, {num_shards})")
         if (self._parent is None
+                and getattr(self, "_indexed", None) is not None
+                and self._shard_spec is None):
+            # indexed root: record/block-granular slice of the (permuted)
+            # coordinate list — balanced shards regardless of file layout,
+            # and this worker reads only its own records
+            return Dataset._indexed_root(self._files, dict(self._indexed),
+                                         (num_shards, index))
+        if (self._parent is None
                 and getattr(self, "_files", None) is not None
+                and getattr(self, "_indexed", None) is None
                 and self._shard_spec is None
                 and len(self._files) >= num_shards):
             new = Dataset(None)
@@ -346,7 +450,10 @@ class Dataset:
         if getattr(self, "_repeat_epochs", _MISSING) is not _MISSING:
             return self._iter_repeated()
         if self._parent is None:
-            return iter(self._source())
+            # epoch-aware roots (indexed global shuffle) get the epoch index
+            # like shuffle ops do, so repeat() re-permutes per epoch
+            src = getattr(self, "_epoch_source", None)
+            return iter(src(epoch)) if src is not None else iter(self._source())
         upstream = self._parent._build(epoch)
         return iter(self._apply_op(upstream, epoch))
 

@@ -155,15 +155,25 @@ class TFRecordWriter:
     paths ending in ``.gz``) — the reader auto-detects it by magic bytes.
     """
 
-    def __init__(self, path_or_file, compression=None):
+    def __init__(self, path_or_file, compression=None, index=False):
+        # All argument validation happens BEFORE the 'wb' open: opening
+        # first would truncate an existing file on a call that then fails.
         if compression not in (None, "", "gzip"):
             raise ValueError(f"unsupported compression {compression!r}")
-        if hasattr(path_or_file, "write"):
+        is_file_like = hasattr(path_or_file, "write")
+        if not is_file_like and compression is None \
+                and str(path_or_file).endswith(".gz"):
+            compression = "gzip"
+        if index and is_file_like:
+            raise ValueError("index=True needs a path (the sidecar is "
+                             "written next to the data file)")
+        if index and compression == "gzip":
+            raise ValueError("gzip streams have no random access; "
+                             "index=True requires an uncompressed file")
+        if is_file_like:
             self._raw = path_or_file
             self._own = False
         else:
-            if compression is None and str(path_or_file).endswith(".gz"):
-                compression = "gzip"
             from . import fsio
             self._raw = fsio.fopen(path_or_file, "wb")
             self._own = True
@@ -174,9 +184,18 @@ class TFRecordWriter:
         else:
             self._f = self._raw
             self._gz = False
+        # Sidecar index accumulation: payload offsets/lengths tracked as
+        # frames are written (we own the framing, so counting is exact).
+        self._path = None if hasattr(path_or_file, "write") else path_or_file
+        self._index = ([], []) if index else None
+        self._pos = 0
 
     def write(self, record_bytes):
         data = bytes(record_bytes)
+        if self._index is not None:
+            self._index[0].append(self._pos + 12)   # payload offset
+            self._index[1].append(len(data))
+        self._pos += len(data) + 16
         if _native is not None:
             import ctypes
             out = ctypes.create_string_buffer(len(data) + 16)
@@ -199,6 +218,10 @@ class TFRecordWriter:
             self._f.close()         # writes the gzip trailer; leaves _raw open
         if self._own:
             self._raw.close()
+        if self._index is not None:
+            _write_index_sidecar(default_index_path(self._path), self._pos,
+                                 self._index[0], self._index[1])
+            self._index = None
 
     def __enter__(self):
         return self
@@ -485,11 +508,12 @@ def decode_example(data):
 # Convenience: dict-of-values <-> files
 # --------------------------------------------------------------------------
 
-def write_examples(path, dicts, compression=None):
+def write_examples(path, dicts, compression=None, index=False):
     """Write an iterable of {name: values} dicts as a TFRecord file
-    (gzip-compressed when `compression="gzip"` or the path ends in .gz)."""
+    (gzip-compressed when `compression="gzip"` or the path ends in .gz;
+    `index=True` also writes the random-access sidecar index)."""
     count = 0
-    with TFRecordWriter(path, compression=compression) as w:
+    with TFRecordWriter(path, compression=compression, index=index) as w:
         for d in dicts:
             w.write(encode_example(d))
             count += 1
@@ -500,3 +524,233 @@ def read_examples(path):
     """Yield decoded {name: (kind, values)} dicts from a TFRecord file."""
     for record in read_records(path):
         yield decode_example(record)
+
+
+# --------------------------------------------------------------------------
+# Indexed random access (the ArrayRecord-style capability, SURVEY.md §2.2:
+# the native data layer should own "TFRecord + ArrayRecord I/O").
+#
+# A TFRecord stream is sequential-only: record N is reachable only by
+# scanning records 0..N-1, so global shuffling and balanced record-granular
+# sharding require either a full pass per epoch or an index.  This section
+# adds the index as a SIDECAR file (`<data>.idx`) so the data file stays a
+# byte-for-byte standard TFRecord, readable by TF, Hadoop, and every other
+# TFRecord consumer — unlike a footer-based container, nothing about the
+# wire format changes.
+#
+# Sidecar format (little-endian):
+#   8B   magic  b"TFRIDX1\0"
+#   u64  data file size when indexed   (staleness check)
+#   u64  record count N
+#   N*u64  payload offsets
+#   N*u64  payload lengths
+#   u32  masked CRC32C over everything after the magic
+#
+# The index is rebuildable from the data alone (one native mmap+CRC pass
+# locally, one streaming pass remotely), so a missing or stale sidecar
+# degrades to a scan, never an error.
+# --------------------------------------------------------------------------
+
+INDEX_MAGIC = b"TFRIDX1\0"
+INDEX_SUFFIX = ".idx"
+
+
+def default_index_path(path):
+    """Sidecar index path for a TFRecord data file."""
+    return str(path) + INDEX_SUFFIX
+
+
+def index_records(path, verify_crc=True):
+    """Scan a TFRecord file and return (offsets, lengths) of every record
+    payload.  Local files use the native one-pass mmap indexer; remote
+    (fsspec) paths stream through the Python frame parser."""
+    from . import fsio
+
+    if _is_gzip(path):
+        raise ValueError(f"{path}: gzip TFRecord streams have no random "
+                         "access (no stable byte offsets); store shards "
+                         "uncompressed to index them")
+    if _native is not None and not fsio.is_remote(path):
+        local = fsio.local_path(path)
+        size = os.path.getsize(local)
+        if size == 0:
+            return [], []
+        offs, lens = _native_index_file(local, size, verify_crc)
+        return list(offs), list(lens)
+    offsets, lengths = [], []
+    with fsio.fopen(path, "rb") as f:
+        pos = 0
+        while True:
+            header = f.read(12)
+            if not header:
+                break
+            if len(header) < 12:
+                raise IOError("truncated TFRecord header")
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if verify_crc and masked_crc32c(header[:8]) != len_crc:
+                raise IOError("TFRecord length CRC mismatch (corrupt file)")
+            data = f.read(length)
+            crc_bytes = f.read(4)
+            if len(data) < length or len(crc_bytes) < 4:
+                raise IOError("truncated TFRecord payload")
+            if verify_crc and \
+                    masked_crc32c(data) != struct.unpack("<I", crc_bytes)[0]:
+                raise IOError("TFRecord payload CRC mismatch (corrupt file)")
+            offsets.append(pos + 12)
+            lengths.append(length)
+            pos += 12 + length + 4
+    return offsets, lengths
+
+
+def _write_index_sidecar(index_path, data_size, offsets, lengths):
+    from . import fsio
+
+    body = io.BytesIO()
+    body.write(struct.pack("<QQ", data_size, len(offsets)))
+    body.write(struct.pack(f"<{len(offsets)}Q", *offsets))
+    body.write(struct.pack(f"<{len(lengths)}Q", *lengths))
+    payload = body.getvalue()
+    with fsio.fopen(index_path, "wb") as f:
+        f.write(INDEX_MAGIC)
+        f.write(payload)
+        f.write(struct.pack("<I", masked_crc32c(payload)))
+
+
+def write_index(path, index_path=None, verify_crc=True):
+    """Build and persist the sidecar index for an existing TFRecord file.
+    Returns (offsets, lengths)."""
+    from . import fsio
+
+    offsets, lengths = index_records(path, verify_crc=verify_crc)
+    _write_index_sidecar(index_path or default_index_path(path),
+                         fsio.getsize(path), offsets, lengths)
+    return offsets, lengths
+
+
+def read_index(path, index_path=None):
+    """Load the sidecar index for `path`.  Returns (offsets, lengths), or
+    None when the sidecar is missing, corrupt, or stale (data file size
+    changed since it was written) — callers rebuild via index_records()."""
+    from . import fsio
+
+    idx = index_path or default_index_path(path)
+    if not fsio.exists(idx):
+        return None
+    try:
+        with fsio.fopen(idx, "rb") as f:
+            blob = f.read()
+        if len(blob) < len(INDEX_MAGIC) + 20 \
+                or blob[:len(INDEX_MAGIC)] != INDEX_MAGIC:
+            return None
+        payload, (crc,) = blob[8:-4], struct.unpack("<I", blob[-4:])
+        if masked_crc32c(payload) != crc:
+            logger.warning("ignoring corrupt index sidecar %s", idx)
+            return None
+        data_size, count = struct.unpack_from("<QQ", payload, 0)
+        if 16 + 16 * count != len(payload):
+            return None
+        if data_size != fsio.getsize(path):
+            logger.info("index sidecar %s is stale; reindexing", idx)
+            return None
+        offsets = list(struct.unpack_from(f"<{count}Q", payload, 16))
+        lengths = list(struct.unpack_from(f"<{count}Q", payload, 16 + 8 * count))
+        return offsets, lengths
+    except (OSError, struct.error):
+        return None
+
+
+class IndexedTFRecordFile:
+    """Random-access reader over one TFRecord shard.
+
+    Uses the sidecar index when present and fresh, else builds the index in
+    memory with one scan.  Works over any fsspec filesystem that supports
+    seek (local, gs://, hdfs://, s3://, memory:// ...): each `read(i)` is
+    one ranged read, and `read_range` fetches a contiguous run of records
+    with a single ranged read — the unit the global-shuffle Dataset root
+    reads by block.
+
+    This is the capability the ArrayRecord format exists for; here the data
+    file stays a standard TFRecord and random access lives in the sidecar.
+    """
+
+    def __init__(self, path, index_path=None, verify_crc=True):
+        self._path = path
+        self._verify = verify_crc
+        loaded = read_index(path, index_path)
+        if loaded is None:
+            loaded = index_records(path, verify_crc=verify_crc)
+        self._offsets, self._lengths = loaded
+        self._f = None                  # opened lazily on first read
+
+    def _file(self):
+        if self._f is None:
+            from . import fsio
+            self._f = fsio.fopen(self._path, "rb")
+        return self._f
+
+    def release(self):
+        """Close the underlying file handle, keeping the index; the next
+        read reopens transparently.  Lets callers iterate thousands of
+        shard files without holding thousands of fds (the Dataset root
+        keeps an LRU of open readers and releases the rest)."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __len__(self):
+        return len(self._offsets)
+
+    def read(self, i):
+        """Record payload `i` (one seek + one read)."""
+        off, ln = self._offsets[i], self._lengths[i]   # IndexError on bad i
+        f = self._file()
+        f.seek(off)
+        data = f.read(ln + 4)
+        if len(data) < ln + 4:
+            raise IOError(f"{self._path}: truncated record {i}")
+        payload, (crc,) = data[:ln], struct.unpack("<I", data[ln:])
+        if self._verify and masked_crc32c(payload) != crc:
+            raise IOError(f"{self._path}: payload CRC mismatch at record {i}")
+        return payload
+
+    __getitem__ = read
+
+    def read_range(self, start, count):
+        """Payloads of records [start, start+count) via ONE ranged read."""
+        if count <= 0:
+            return []
+        last = start + count - 1
+        span_start = self._offsets[start] - 12       # frame header start
+        span_end = self._offsets[last] + self._lengths[last] + 4
+        f = self._file()
+        f.seek(span_start)
+        blob = f.read(span_end - span_start)
+        if len(blob) < span_end - span_start:
+            raise IOError(f"{self._path}: truncated record range "
+                          f"[{start}, {start + count})")
+        out = []
+        for i in range(start, start + count):
+            lo = self._offsets[i] - span_start
+            payload = blob[lo:lo + self._lengths[i]]
+            if self._verify:
+                (crc,) = struct.unpack_from(
+                    "<I", blob, lo + self._lengths[i])
+                if masked_crc32c(payload) != crc:
+                    raise IOError(f"{self._path}: payload CRC mismatch at "
+                                  f"record {i}")
+            out.append(payload)
+        return out
+
+    def example(self, i):
+        """Decoded `{name: (kind, values)}` dict for record `i`."""
+        return decode_example(self.read(i))
+
+    def close(self):
+        self.release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,232 @@
+"""Indexed random-access TFRecord I/O + global-shuffle Dataset root.
+
+The reference delegated record I/O to the sequential-only tensorflow-hadoop
+jar (SURVEY.md §2.2); the SURVEY calls for the TPU framework to own
+"TFRecord + ArrayRecord I/O" natively.  These tests cover the ArrayRecord
+half: sidecar indexes, point/range random reads, and the exact global
+shuffle + balanced record-granular sharding they enable.
+"""
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu.data import Dataset
+
+
+def _write_shard(path, n, base=0, index=False):
+    return tfrecord.write_examples(
+        path, ({"x": base + i, "name": [f"r{base + i}".encode()]}
+               for i in range(n)), index=index)
+
+
+def _x(ex):
+    return int(ex["x"][1][0])
+
+
+def test_writer_sidecar_matches_scan(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 17, index=True)
+    sidecar = tfrecord.read_index(path)
+    assert sidecar is not None
+    offs, lens = tfrecord.index_records(path)
+    assert sidecar == (offs, lens)
+
+
+def test_point_reads_and_len(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 23, index=True)
+    with tfrecord.IndexedTFRecordFile(path) as r:
+        assert len(r) == 23
+        for i in (0, 7, 22, 3):
+            assert _x(r.example(i)) == i
+        # __getitem__ returns the raw payload
+        assert tfrecord.decode_example(r[5])["x"][1][0] == 5
+        with pytest.raises(IndexError):
+            r.read(23)
+
+
+def test_read_range_single_ranged_read(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 30, index=True)
+    with tfrecord.IndexedTFRecordFile(path) as r:
+        payloads = r.read_range(10, 5)
+        assert [tfrecord.decode_example(p)["x"][1][0]
+                for p in payloads] == [10, 11, 12, 13, 14]
+        assert r.read_range(29, 1)[0] == r.read(29)
+        assert r.read_range(0, 0) == []
+
+
+def test_missing_sidecar_builds_in_memory(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 9, index=False)
+    assert tfrecord.read_index(path) is None
+    with tfrecord.IndexedTFRecordFile(path) as r:
+        assert [_x(r.example(i)) for i in range(9)] == list(range(9))
+
+
+def test_write_index_then_reload(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 12)
+    offs, lens = tfrecord.write_index(path)
+    assert os.path.exists(tfrecord.default_index_path(path))
+    assert tfrecord.read_index(path) == (offs, lens)
+
+
+def test_stale_sidecar_rejected_and_rebuilt(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 5, index=True)
+    # append more records: data size changes, sidecar is now stale
+    with open(path, "ab") as f:
+        w = tfrecord.TFRecordWriter(f)
+        for i in range(5, 8):
+            w.write(tfrecord.encode_example({"x": i, "name": [b"r"]}))
+    assert tfrecord.read_index(path) is None
+    with tfrecord.IndexedTFRecordFile(path) as r:   # scan fallback
+        assert len(r) == 8
+        assert _x(r.example(7)) == 7
+
+
+def test_corrupt_sidecar_ignored(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 4, index=True)
+    idx = tfrecord.default_index_path(path)
+    blob = bytearray(open(idx, "rb").read())
+    blob[20] ^= 0xFF
+    open(idx, "wb").write(bytes(blob))
+    assert tfrecord.read_index(path) is None
+    with tfrecord.IndexedTFRecordFile(path) as r:
+        assert len(r) == 4
+
+
+def test_gzip_has_no_random_access(tmp_path):
+    path = str(tmp_path / "a.tfrecord.gz")
+    _write_shard(path, 3)
+    with pytest.raises(ValueError, match="random access"):
+        tfrecord.index_records(path)
+    with pytest.raises(ValueError, match="random access"):
+        tfrecord.TFRecordWriter(str(tmp_path / "b.gz"), index=True)
+
+
+def test_rejected_writer_does_not_truncate_existing_file(tmp_path):
+    # validation must run BEFORE the 'wb' open: a failing constructor call
+    # must not destroy an existing shard
+    path = str(tmp_path / "a.tfrecord.gz")
+    _write_shard(path, 3)
+    size = os.path.getsize(path)
+    with pytest.raises(ValueError):
+        tfrecord.TFRecordWriter(path, index=True)
+    assert os.path.getsize(path) == size
+    assert len(list(tfrecord.read_examples(path))) == 3
+
+
+def test_empty_glob_raises(tmp_path):
+    ds = Dataset.from_indexed_tfrecords(str(tmp_path / "nope-*.tfrecord"))
+    with pytest.raises(ValueError, match="matched no input files"):
+        next(iter(ds))
+
+
+def test_reader_release_reopens_transparently(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 6, index=True)
+    with tfrecord.IndexedTFRecordFile(path) as r:
+        assert _x(r.example(2)) == 2
+        r.release()
+        assert _x(r.example(5)) == 5       # reopened on demand
+
+
+def test_indexed_file_over_fsspec_memory():
+    pytest.importorskip("fsspec")
+    from tensorflowonspark_tpu import fsio
+    path = "memory://idx/a.tfrecord"
+    with tfrecord.TFRecordWriter(path) as w:
+        for i in range(6):
+            w.write(tfrecord.encode_example({"x": i}))
+    assert fsio.exists(path)
+    tfrecord.write_index(path)
+    with tfrecord.IndexedTFRecordFile(path) as r:
+        assert len(r) == 6
+        assert _x(r.example(4)) == 4
+        assert [_x(tfrecord.decode_example(p))
+                for p in r.read_range(2, 3)] == [2, 3, 4]
+
+
+# ------------------------------------------------------------ Dataset root
+
+def _shards(tmp_path, sizes, index=True):
+    paths, base = [], 0
+    for k, n in enumerate(sizes):
+        p = str(tmp_path / f"s{k}.tfrecord")
+        _write_shard(p, n, base=base, index=index)
+        paths.append(p)
+        base += n
+    return paths, base
+
+
+def test_dataset_sequential_order_without_shuffle(tmp_path):
+    paths, total = _shards(tmp_path, [4, 3, 5])
+    ds = Dataset.from_indexed_tfrecords(paths, parse=_x)
+    assert list(ds) == list(range(total))
+
+
+def test_dataset_global_shuffle_exact_epoch(tmp_path):
+    paths, total = _shards(tmp_path, [10, 7, 13])
+    ds = Dataset.from_indexed_tfrecords(paths, parse=_x,
+                                        global_shuffle=True, seed=3)
+    epoch = list(ds)
+    assert sorted(epoch) == list(range(total))     # every record exactly once
+    assert epoch != list(range(total))             # actually permuted
+    assert list(ds) == epoch                       # deterministic re-iteration
+
+
+def test_dataset_shuffle_reseeds_per_epoch(tmp_path):
+    paths, total = _shards(tmp_path, [16, 16])
+    ds = Dataset.from_indexed_tfrecords(paths, parse=_x,
+                                        global_shuffle=True).repeat(2)
+    out = list(ds)
+    first, second = out[:total], out[total:]
+    assert sorted(first) == sorted(second) == list(range(total))
+    assert first != second                         # re-permuted per epoch
+
+
+def test_dataset_shard_disjoint_balanced_union(tmp_path):
+    # file layout is deliberately lopsided: record-granular sharding must
+    # still produce balanced shards (file-granular would give 21 vs 2)
+    paths, total = _shards(tmp_path, [21, 2])
+    root = Dataset.from_indexed_tfrecords(paths, parse=_x,
+                                          global_shuffle=True, seed=9)
+    parts = [list(root.shard(3, i)) for i in range(3)]
+    assert max(len(p) for p in parts) - min(len(p) for p in parts) <= 1
+    merged = sorted(x for p in parts for x in p)
+    assert merged == list(range(total))
+
+
+def test_dataset_shuffle_block_reads_blocks(tmp_path):
+    paths, total = _shards(tmp_path, [12])
+    ds = Dataset.from_indexed_tfrecords(paths, parse=_x,
+                                        global_shuffle=True, seed=1,
+                                        shuffle_block=4)
+    out = list(ds)
+    assert sorted(out) == list(range(total))
+    # blocks of 4 stay contiguous
+    blocks = [out[i:i + 4] for i in range(0, total, 4)]
+    for b in blocks:
+        assert b == list(range(b[0], b[0] + 4))
+
+
+def test_dataset_composes_with_batch_and_repeat(tmp_path):
+    paths, total = _shards(tmp_path, [8, 8])
+    ds = (Dataset.from_indexed_tfrecords(paths, parse=lambda ex: (_x(ex),))
+          .shard(2, 0)
+          .repeat(2)
+          .batch(4))
+    batches = list(ds)
+    assert len(batches) == 4                       # 8 records x2 epochs / 4
+    assert all(b[0].shape == (4,) for b in batches)
+
+
+def test_interleave_rejected_on_indexed_root(tmp_path):
+    paths, _ = _shards(tmp_path, [4, 4])
+    ds = Dataset.from_indexed_tfrecords(paths, parse=_x)
+    with pytest.raises(ValueError, match="file-rooted"):
+        ds.interleave(2)
