@@ -49,6 +49,10 @@ def build_argparser():
                    choices=["none", "group", "batch"])
     p.add_argument("--reader_threads", type=int, default=4)
     p.add_argument("--shuffle_buffer", type=int, default=2048)
+    p.add_argument("--indexed", action="store_true",
+                   help="random-access shards via sidecar indexes: exact "
+                        "global shuffle + balanced record-granular "
+                        "sharding (data.Dataset.from_indexed_tfrecords)")
     p.add_argument("--learning_rate", type=float, default=0.1)
     p.add_argument("--model_dir", default=None)
     p.add_argument("--platform", choices=["cpu", "tpu"], default="cpu")
@@ -146,18 +150,30 @@ def main_fun(args, ctx):
                   flush=True)
 
     tf_fn = image.train_transform(args.image_size, seed=1234 + worker)
-    ds = (Dataset.from_tfrecords(paths)
-          # interleave BEFORE shard so BOTH shard paths see mixed files:
-          # file-granular sharding copies the interleave spec (each worker
-          # round-robins its own files), and record-granular sharding
-          # (more workers than files) strides the already-interleaved
-          # stream — either way the reservoir shuffle mixes across the
-          # whole slice instead of a buffer-sized window of one file
-          .interleave(cycle_length=4)
-          .shard(num_workers, worker)
-          # shuffle compressed examples (KBs each), then decode in threads
-          .shuffle(args.shuffle_buffer, seed=worker)
-          .repeat(None if args.steps > 0 else args.epochs))
+    if args.indexed:
+        # indexed root: sidecar indexes give an EXACT per-epoch global
+        # shuffle and balanced record-granular shards (no interleave or
+        # reservoir needed) — blocks of 16 compressed examples per ranged
+        # read keep the IO mostly sequential
+        ds = (Dataset.from_indexed_tfrecords(paths, global_shuffle=True,
+                                             seed=1234, shuffle_block=16)
+              .shard(num_workers, worker)
+              .repeat(None if args.steps > 0 else args.epochs))
+    else:
+        ds = (Dataset.from_tfrecords(paths)
+              # interleave BEFORE shard so BOTH shard paths see mixed
+              # files: file-granular sharding copies the interleave spec
+              # (each worker round-robins its own files), and
+              # record-granular sharding (more workers than files)
+              # strides the already-interleaved stream — either way the
+              # reservoir shuffle mixes across the whole slice instead of
+              # a buffer-sized window of one file
+              .interleave(cycle_length=4)
+              .shard(num_workers, worker)
+              # shuffle compressed examples (KBs each), then decode in
+              # threads
+              .shuffle(args.shuffle_buffer, seed=worker)
+              .repeat(None if args.steps > 0 else args.epochs))
     if resume_step:
         # deterministic pipeline: skip the records consumed so far —
         # BEFORE the decode map, so skipping discards KB-scale compressed

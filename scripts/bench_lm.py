@@ -37,6 +37,10 @@ def main():
                    help="optimizer first-moment dtype, e.g. bfloat16")
     p.add_argument("--attention", default="auto",
                    choices=["auto", "flash", "dense"])
+    p.add_argument("--norm_type", default="layernorm",
+                   choices=["layernorm", "rmsnorm"],
+                   help="rmsnorm = LLaMA-style scale-only norm (one "
+                        "statistics reduce instead of two)")
     args = p.parse_args()
 
     import numpy as np
@@ -61,7 +65,7 @@ def main():
             n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
             n_layers=args.n_layers, d_ff=args.d_ff,
             max_seq_len=args.seq_len, dtype="bfloat16", rope=True,
-            attention_impl=args.attention)
+            attention_impl=args.attention, norm_type=args.norm_type)
         model = Transformer(cfg)
         B, S = args.batch_size, args.seq_len
         attention = args.attention

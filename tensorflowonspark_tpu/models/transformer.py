@@ -53,6 +53,10 @@ class TransformerConfig:
     use_bias: bool = False        # bias terms on qkv/out/mlp denses
     # (True matches GPT-2-family checkpoints; see convert.py)
     ln_eps: float = 1e-6          # layernorm epsilon (GPT-2 ckpts: 1e-5)
+    norm_type: str = "layernorm"  # layernorm | rmsnorm (LLaMA-family:
+    # scale-only, no mean subtraction — one statistics reduce per norm
+    # instead of two, which is exactly the flagship profile's non-matmul
+    # tail; convergence-equivalent for pre-LN decoders)
     fused_ln: bool = False        # Pallas fused layernorm fwd (single
     # VMEM pass; falls back to the XLA reference under an active mesh —
     # pallas_call is a custom call GSPMD cannot partition)
@@ -548,6 +552,14 @@ class FusedLayerNorm(nn.Module):
 
 
 def _make_ln(cfg, name):
+    if cfg.norm_type not in ("layernorm", "rmsnorm"):
+        raise ValueError(
+            f"norm_type={cfg.norm_type!r} not in ('layernorm', 'rmsnorm')")
+    if cfg.norm_type == "rmsnorm":
+        if cfg.fused_ln:
+            raise ValueError("fused_ln applies to norm_type='layernorm' "
+                             "(the Pallas kernel computes mean+variance)")
+        return nn.RMSNorm(name=name, dtype=jnp.float32, epsilon=cfg.ln_eps)
     if cfg.fused_ln:
         return FusedLayerNorm(epsilon=cfg.ln_eps, name=name)
     return nn.LayerNorm(name=name, dtype=jnp.float32, epsilon=cfg.ln_eps)

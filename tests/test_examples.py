@@ -105,6 +105,17 @@ def test_resnet_imagenet_shards_pipeline(tmp_path):
     assert "validation top-1" in out
 
 
+def test_resnet_imagenet_indexed_pipeline(tmp_path):
+    # --indexed swaps the sequential root for random-access sidecar reads:
+    # exact global shuffle + balanced record-granular shards
+    out = _run("resnet/resnet_imagenet.py", "--synth", "--steps", "3",
+               "--batch_size", "8", "--image_size", "32",
+               "--synth_examples", "48", "--num_classes", "8",
+               "--reader_threads", "2", "--indexed", cwd=tmp_path)
+    assert "done: first=" in out
+    assert "validation top-1" in out
+
+
 def test_resnet_imagenet_cluster(tmp_path):
     # the same program on the 2-process cluster backend: per-worker shard
     # slices, both workers train, chief runs validation

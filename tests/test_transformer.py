@@ -256,3 +256,36 @@ def test_moe_router_validation(toy_batch):
                                  "moe_top_k": 9})
     with pytest.raises(ValueError, match="moe_top_k"):
         Transformer(bad_k).init(jax.random.key(0), toy_batch)
+
+
+def test_rmsnorm_variant(toy_batch):
+    cfg = TransformerConfig(**{**CFG.__dict__, "norm_type": "rmsnorm"})
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0), toy_batch)["params"]
+    # RMSNorm is scale-only: no bias/mean-subtraction params anywhere
+    ln1 = params["layer_0"]["ln1"]
+    assert set(ln1.keys()) == {"scale"}
+    logits = model.apply({"params": params}, toy_batch)
+    assert logits.shape == (4, 32, 128)
+
+    def loss(p):
+        return lm_loss(model.apply({"params": p}, toy_batch[:, :-1]),
+                       toy_batch[:, 1:])
+
+    g = jax.grad(loss)(params)
+    assert np.isfinite(float(optax.global_norm(g)))
+    # TP sharding rules still apply (scale vectors replicate)
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=2, tp=4))
+    sharding_mod.infer_param_shardings(params, mesh)
+
+
+def test_rmsnorm_validation():
+    with pytest.raises(ValueError, match="norm_type"):
+        Transformer(TransformerConfig(
+            **{**CFG.__dict__, "norm_type": "welch"})).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(ValueError, match="fused_ln"):
+        Transformer(TransformerConfig(
+            **{**CFG.__dict__, "norm_type": "rmsnorm",
+               "fused_ln": True})).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
