@@ -249,3 +249,102 @@ def from_hf_gpt2(model_or_path, dtype="float32", **config_overrides):
     # params are float32 master copies regardless of the compute dtype;
     # cfg.dtype controls activation precision inside the model
     return cfg, _finalize(params, "GPT-2", cfg.n_layers)
+
+
+def llama_config(hf_cfg, **overrides):
+    """TransformerConfig matching a ``transformers.LlamaConfig`` (the
+    LLaMA / Mistral-style decoder family: RMSNorm, RoPE, GQA, SwiGLU)."""
+    from .models.transformer import TransformerConfig
+
+    act = getattr(hf_cfg, "hidden_act", "silu")
+    act_map = {"silu": "silu", "gelu": "gelu_exact",
+               "gelu_pytorch_tanh": "gelu_tanh"}
+    if act not in act_map:
+        raise ValueError(f"unsupported hidden_act={act!r}")
+    if getattr(hf_cfg, "rope_scaling", None):
+        raise ValueError("rope_scaling is not supported (plain RoPE only)")
+    if getattr(hf_cfg, "attention_dropout", 0.0):
+        raise ValueError("attention_dropout != 0 is not supported")
+    head_dim = getattr(hf_cfg, "head_dim", None)
+    if head_dim and head_dim * hf_cfg.num_attention_heads != \
+            hf_cfg.hidden_size:
+        raise ValueError(f"head_dim={head_dim} * num_attention_heads != "
+                         "hidden_size (non-standard head widths would "
+                         "change the q/k/v projection shapes)")
+    if getattr(hf_cfg, "attention_bias", False):
+        # TransformerConfig.use_bias covers attention AND MLP denses;
+        # attention-only bias (Qwen-style) is not expressible
+        raise ValueError("attention_bias=True is not supported")
+    kw = dict(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=getattr(hf_cfg, "num_key_value_heads",
+                           hf_cfg.num_attention_heads),
+        n_layers=hf_cfg.num_hidden_layers,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        causal=True,
+        rope=True,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        use_bias=False,
+        ln_eps=hf_cfg.rms_norm_eps,
+        norm_type="rmsnorm",
+        mlp_style="gated",
+        activation=act_map[act],
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def from_hf_llama(model_or_path, dtype="float32", **config_overrides):
+    """Convert a LLaMA-family causal LM to (TransformerConfig, params).
+
+    `model_or_path`: a ``LlamaForCausalLM`` instance or a local directory
+    for ``LlamaForCausalLM.from_pretrained``.  The architecture maps 1:1
+    onto the flagship Transformer: RMSNorm -> norm_type='rmsnorm', SwiGLU
+    -> mlp_style='gated', GQA -> n_kv_heads, rotate-half RoPE ->
+    apply_rope (identical split-half convention).  Numerical parity is
+    checked against the torch forward pass in tests/test_convert.py.
+    """
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM
+        model = LlamaForCausalLM.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    sd = model.state_dict()
+    hf_cfg = model.config
+    cfg = llama_config(hf_cfg, dtype=dtype, **config_overrides)
+
+    # tied embeddings (tie_word_embeddings=True) omit lm_head.weight from
+    # the state dict — the unembedding IS the token table either way
+    lm_w = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    params = {
+        "token_embed": {"embedding": _t(sd["model.embed_tokens.weight"])},
+        "ln_f": {"scale": _t(sd["model.norm.weight"])},
+        "lm_head": {"kernel": _t(lm_w).T},
+    }
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+
+        def proj(name, pre=pre):
+            return {"kernel": _t(sd[pre + f"self_attn.{name}.weight"]).T}
+
+        params[f"layer_{i}"] = {
+            "ln1": {"scale": _t(sd[pre + "input_layernorm.weight"])},
+            "ln2": {"scale": _t(
+                sd[pre + "post_attention_layernorm.weight"])},
+            "attn": {
+                "query": proj("q_proj"),
+                "key": proj("k_proj"),
+                "value": proj("v_proj"),
+                "out": proj("o_proj"),
+            },
+            "mlp": {
+                "wi_gate": {"kernel": _t(
+                    sd[pre + "mlp.gate_proj.weight"]).T},
+                "wi_up": {"kernel": _t(sd[pre + "mlp.up_proj.weight"]).T},
+                "wo": {"kernel": _t(sd[pre + "mlp.down_proj.weight"]).T},
+            },
+        }
+    return cfg, _finalize(params, "LLaMA", cfg.n_layers)

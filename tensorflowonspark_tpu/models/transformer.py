@@ -62,6 +62,8 @@ class TransformerConfig:
     # pallas_call is a custom call GSPMD cannot partition)
     norm_style: str = "pre"       # pre-LN (GPT/LLaMA) | post-LN (BERT)
     activation: str = "gelu_tanh"  # gelu_tanh | gelu_exact | relu | silu
+    mlp_style: str = "plain"      # plain (wo(act(wi x))) | gated (LLaMA
+    # GLU: wo(act(wi_gate x) * (wi_up x)); SwiGLU with activation='silu')
     decode: bool = False          # autoregressive mode: kv cache of
     # max_seq_len (narrow n_kv_heads — the GQA HBM win), incremental steps
 
@@ -361,15 +363,32 @@ def _activation(x, name):
 
 
 class DenseMLP(nn.Module):
+    """Feed-forward block; ``cfg.mlp_style`` picks the form:
+    ``plain``  — wo(act(wi(x))), the GPT/BERT shape;
+    ``gated``  — wo(act(wi_gate(x)) * wi_up(x)), the LLaMA-family
+    GLU shape (SwiGLU when activation='silu').  The gate/up kernels keep
+    the ``wi`` name prefix so the Megatron column-parallel sharding rule
+    applies unchanged (parallel/sharding.py DEFAULT_RULES)."""
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x):
-        dtype = jnp.dtype(self.cfg.dtype)
-        h = nn.Dense(self.cfg.d_ff, use_bias=self.cfg.use_bias, name="wi",
-                     dtype=dtype)(x)
-        h = _activation(h, self.cfg.activation)
-        return nn.Dense(self.cfg.d_model, use_bias=self.cfg.use_bias,
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        if cfg.mlp_style not in ("plain", "gated"):
+            raise ValueError(
+                f"mlp_style={cfg.mlp_style!r} not in ('plain', 'gated')")
+        if cfg.mlp_style == "gated":
+            g = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, name="wi_gate",
+                         dtype=dtype)(x)
+            u = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, name="wi_up",
+                         dtype=dtype)(x)
+            h = _activation(g, cfg.activation) * u
+        else:
+            h = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, name="wi",
+                         dtype=dtype)(x)
+            h = _activation(h, cfg.activation)
+        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias,
                         name="wo", dtype=dtype)(h)
 
 

@@ -195,3 +195,91 @@ def test_gpt2_erf_gelu_maps_to_exact(tiny_gpt2):
     got = np.asarray(Transformer(c).apply({"params": params},
                                           jnp.asarray(tokens)))
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+# ------------------------------------------------------------- LLaMA family
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = transformers.LlamaConfig(
+        vocab_size=97, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        attention_bias=False, tie_word_embeddings=False,
+        attention_dropout=0.0)
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+def test_llama_logits_match_torch(tiny_llama):
+    cfg, params = convert.from_hf_llama(tiny_llama, attention_impl="dense")
+    assert cfg.norm_type == "rmsnorm" and cfg.mlp_style == "gated"
+    assert cfg.rope and cfg.n_kv_heads == 2 and not cfg.use_bias
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(0, 97, (2, 16))
+    with torch.no_grad():
+        ref = tiny_llama(torch.tensor(tokens)).logits.numpy()
+    model = Transformer(cfg)
+    got = np.asarray(jax.jit(
+        lambda p, t: model.apply({"params": p}, t))(params,
+                                                    jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_tied_embeddings(tiny_llama):
+    cfg = transformers.LlamaConfig(
+        vocab_size=53, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=1, max_position_embeddings=32,
+        tie_word_embeddings=True)
+    torch.manual_seed(1)
+    m = transformers.LlamaForCausalLM(cfg).eval()
+    ours, params = convert.from_hf_llama(m, attention_impl="dense")
+    # unembedding falls back to the token table when lm_head is tied
+    np.testing.assert_array_equal(
+        np.asarray(params["lm_head"]["kernel"]),
+        np.asarray(params["token_embed"]["embedding"]).T)
+    tokens = np.random.RandomState(2).randint(0, 53, (1, 8))
+    with torch.no_grad():
+        ref = m(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(Transformer(ours).apply({"params": params},
+                                             jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_unsupported_configs_rejected(tiny_llama):
+    bad = transformers.LlamaConfig(
+        vocab_size=53, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        attention_bias=True)
+    with pytest.raises(ValueError, match="attention_bias"):
+        convert.llama_config(bad)
+    bad2 = transformers.LlamaConfig(
+        vocab_size=53, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        rope_scaling={"rope_type": "linear", "factor": 2.0})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        convert.llama_config(bad2)
+
+
+def test_llama_converted_model_trains(tiny_llama):
+    import optax
+
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    cfg, params = convert.from_hf_llama(tiny_llama, attention_impl="dense")
+    model = Transformer(cfg)
+
+    def loss_fn(p, batch, rng):
+        return lm_loss(model.apply({"params": p}, batch[:, :-1]),
+                       batch[:, 1:])
+
+    opt = optax.adam(1e-3)
+    state = train_mod.create_train_state(params, opt)
+    step = train_mod.make_train_step(loss_fn, opt, donate=False)
+    batch = jnp.asarray(np.random.RandomState(1).randint(0, 97, (4, 17)))
+    losses = []
+    for i in range(5):
+        state, m = step(state, batch, jax.random.key(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
