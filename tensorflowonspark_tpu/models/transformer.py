@@ -421,11 +421,19 @@ class MoEMLP(nn.Module):
                         (E, D, cfg.d_ff)).astype(dtype)
         wo = self.param("experts_wo/kernel", nn.initializers.lecun_normal(),
                         (E, cfg.d_ff, D)).astype(dtype)
+        # gated experts (Mixtral-shape): wi routes through the activation,
+        # experts_up is the linear branch; both shard like experts_wi
+        # (the sharding rule matches the experts_(wi|up) prefix)
+        up = (self.param("experts_up/kernel", nn.initializers.lecun_normal(),
+                         (E, D, cfg.d_ff)).astype(dtype)
+              if cfg.mlp_style == "gated" else None)
 
         def expert_mlp(xe):
             """xe: [E, ..., D] -> [E, ..., D], batched over the expert dim."""
-            h = jnp.einsum("e...d,edf->e...f", xe, wi)
-            h = nn.gelu(h)
+            h = _activation(jnp.einsum("e...d,edf->e...f", xe, wi),
+                            cfg.activation)
+            if up is not None:
+                h = h * jnp.einsum("e...d,edf->e...f", xe, up)
             return jnp.einsum("e...f,efd->e...d", h, wo)
 
         if cfg.moe_router == "dense":

@@ -283,3 +283,21 @@ def test_llama_converted_model_trains(tiny_llama):
         state, m = step(state, batch, jax.random.key(i))
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_llama_converted_model_generates(tiny_llama):
+    # greedy KV-cache decode through rmsnorm + gated MLP + GQA + RoPE
+    # must match torch argmax stepping
+    from tensorflowonspark_tpu.models import decode
+
+    cfg, params = convert.from_hf_llama(tiny_llama, attention_impl="dense")
+    prompt = jnp.asarray(np.random.RandomState(3).randint(0, 97, (1, 4)))
+    out = decode.generate(Transformer(cfg), params, prompt,
+                          max_new_tokens=8, temperature=0.0)
+    assert out.shape == (1, 12)
+    with torch.no_grad():
+        t = torch.tensor(np.asarray(prompt))
+        for _ in range(8):
+            nxt = tiny_llama(t).logits[:, -1].argmax(-1, keepdim=True)
+            t = torch.cat([t, nxt], dim=1)
+    np.testing.assert_array_equal(np.asarray(out), t.numpy())

@@ -289,3 +289,32 @@ def test_rmsnorm_validation():
             **{**CFG.__dict__, "norm_type": "rmsnorm",
                "fused_ln": True})).init(
             jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_gated_moe_experts(toy_batch):
+    # Mixtral-shape: gated experts carry an experts_up branch that shards
+    # like experts_wi (ep + tp axes)
+    cfg = TransformerConfig(**{**CFG.__dict__, "num_experts": 4,
+                               "mlp_style": "gated", "activation": "silu",
+                               "moe_router": "topk", "moe_top_k": 2})
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0), toy_batch)["params"]
+    moe = params["layer_1"]["moe"] if "moe" in params["layer_1"] \
+        else params["layer_0"]["moe"]
+    assert "experts_up/kernel" in moe
+    assert moe["experts_up/kernel"].shape == moe["experts_wi/kernel"].shape
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=2, tp=4))
+    sh = sharding_mod.infer_param_shardings(params, mesh)
+    up_spec = (sh["layer_1"]["moe"] if "moe" in sh["layer_1"]
+               else sh["layer_0"]["moe"])["experts_up/kernel"].spec
+    assert up_spec[0] == "dp"          # ep rides the dp axis
+    logits = model.apply({"params": params}, toy_batch)
+    assert logits.shape == (4, 32, 128)
+
+    def loss(p):
+        return lm_loss(model.apply({"params": p}, toy_batch[:, :-1]),
+                       toy_batch[:, 1:])
+
+    g = jax.grad(loss)(params)
+    gn = float(optax.global_norm(g))
+    assert np.isfinite(gn) and gn > 0
