@@ -348,3 +348,95 @@ def from_hf_llama(model_or_path, dtype="float32", **config_overrides):
             },
         }
     return cfg, _finalize(params, "LLaMA", cfg.n_layers)
+
+
+def mixtral_config(hf_cfg, **overrides):
+    """TransformerConfig matching a ``transformers.MixtralConfig``
+    (LLaMA-style attention + a gated-expert MoE MLP in EVERY layer).
+
+    Router parity: Mixtral softmaxes the router logits, picks top-k, and
+    renormalizes over the chosen experts — exactly this framework's
+    ``moe_router='topk'`` convention (softmax is monotonic, so top-k over
+    probabilities equals top-k over logits).  Mixtral drops no tokens, so
+    the default capacity factor here is E/k (capacity == every token);
+    lower it explicitly to fine-tune with GShard capacity bounds.
+    """
+    E = hf_cfg.num_local_experts
+    k = hf_cfg.num_experts_per_tok
+    base = llama_config(hf_cfg)
+    # sliding-window attention is not implemented; HF only applies the
+    # window beyond `sliding_window` tokens, so sequences at or under it
+    # are numerics-identical — clamp max_seq_len to stay in that regime
+    window = getattr(hf_cfg, "sliding_window", None)
+    max_seq = base.max_seq_len if window is None \
+        else min(base.max_seq_len, int(window))
+    kw = dict(
+        base.__dict__,
+        max_seq_len=max_seq,
+        num_experts=E,
+        moe_every=1,
+        moe_router="topk",
+        moe_top_k=k,
+        moe_capacity_factor=float(E) / float(k),
+    )
+    kw.update(overrides)
+    from .models.transformer import TransformerConfig
+    return TransformerConfig(**kw)
+
+
+def from_hf_mixtral(model_or_path, dtype="float32", **config_overrides):
+    """Convert a Mixtral MoE causal LM to (TransformerConfig, params).
+
+    `model_or_path`: a ``MixtralForCausalLM`` instance or a local
+    directory.  Attention/norm weights map like LLaMA; each layer's
+    block-sparse MoE maps onto MoEMLP's stacked expert tensors
+    (w1 -> experts_wi gate, w3 -> experts_up, w2 -> experts_wo, the
+    router gate -> router/kernel).  Logit parity vs the torch forward
+    pass is checked in tests/test_convert.py.
+    """
+    if isinstance(model_or_path, str):
+        from transformers import MixtralForCausalLM
+        model = MixtralForCausalLM.from_pretrained(model_or_path)
+    else:
+        model = model_or_path
+    sd = model.state_dict()
+    hf_cfg = model.config
+    cfg = mixtral_config(hf_cfg, dtype=dtype, **config_overrides)
+    E = cfg.num_experts
+
+    lm_w = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    params = {
+        "token_embed": {"embedding": _t(sd["model.embed_tokens.weight"])},
+        "ln_f": {"scale": _t(sd["model.norm.weight"])},
+        "lm_head": {"kernel": _t(lm_w).T},
+    }
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        moe = pre + "block_sparse_moe."
+
+        def proj(name, pre=pre):
+            return {"kernel": _t(sd[pre + f"self_attn.{name}.weight"]).T}
+
+        def experts(w, moe=moe):
+            # HF Linear [out, in] -> stacked [E, in, out]
+            return np.stack([_t(sd[moe + f"experts.{e}.{w}.weight"]).T
+                             for e in range(E)])
+
+        params[f"layer_{i}"] = {
+            "ln1": {"scale": _t(sd[pre + "input_layernorm.weight"])},
+            "ln2": {"scale": _t(
+                sd[pre + "post_attention_layernorm.weight"])},
+            "attn": {
+                "query": proj("q_proj"),
+                "key": proj("k_proj"),
+                "value": proj("v_proj"),
+                "out": proj("o_proj"),
+            },
+            "moe": {
+                "router": {"kernel": _t(sd[moe + "gate.weight"]).T},
+                "experts_wi/kernel": experts("w1"),
+                "experts_up/kernel": experts("w3"),
+                "experts_wo/kernel": experts("w2"),
+            },
+        }
+    return cfg, _finalize(params, "Mixtral", cfg.n_layers)

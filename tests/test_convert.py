@@ -301,3 +301,46 @@ def test_llama_converted_model_generates(tiny_llama):
             nxt = tiny_llama(t).logits[:, -1].argmax(-1, keepdim=True)
             t = torch.cat([t, nxt], dim=1)
     np.testing.assert_array_equal(np.asarray(out), t.numpy())
+
+
+# ------------------------------------------------------------------ Mixtral
+
+@pytest.fixture(scope="module")
+def tiny_mixtral():
+    cfg = transformers.MixtralConfig(
+        vocab_size=97, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, attention_dropout=0.0,
+        sliding_window=None)
+    torch.manual_seed(0)
+    return transformers.MixtralForCausalLM(cfg).eval()
+
+
+def test_mixtral_logits_match_torch(tiny_mixtral):
+    cfg, params = convert.from_hf_mixtral(tiny_mixtral,
+                                          attention_impl="dense")
+    assert cfg.num_experts == 4 and cfg.moe_top_k == 2
+    assert cfg.moe_every == 1 and cfg.mlp_style == "gated"
+    # default capacity E/k admits every token: no drops, exact routing
+    assert cfg.moe_capacity_factor == 2.0
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(0, 97, (2, 16))
+    with torch.no_grad():
+        ref = tiny_mixtral(torch.tensor(tokens)).logits.numpy()
+    model = Transformer(cfg)
+    got = np.asarray(jax.jit(
+        lambda p, t: model.apply({"params": p}, t))(params,
+                                                    jnp.asarray(tokens)))
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
+def test_mixtral_sliding_window_clamps_seq(tiny_mixtral):
+    cfg = transformers.MixtralConfig(
+        vocab_size=53, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_local_experts=2,
+        num_experts_per_tok=1, max_position_embeddings=128,
+        sliding_window=32)
+    ours = convert.mixtral_config(cfg)
+    assert ours.max_seq_len == 32      # beyond the window HF numerics differ
