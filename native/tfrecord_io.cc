@@ -158,3 +158,294 @@ size_t tfr_frame_record(const uint8_t* data, size_t n, uint8_t* out) {
 }
 
 }  // extern "C"
+
+// -------------------------------------------------- columnar Example decode
+//
+// Bulk-decode ONE feature column of a TFRecord file of tf.train.Example
+// payloads straight into a caller-provided numeric buffer — the C++ analog
+// of the reference's JVM DFUtil record->row decoding, specialized for the
+// hot feed path (fixed-length numeric features).  Schema probing (feature
+// names, kinds, lengths) stays in Python on the first record; this pass
+// then decodes every record without constructing any Python objects.
+//
+// Wire schema walked here (public tf.train.Example field numbers):
+//   Example    { Features features = 1 }
+//   Features   { repeated map-entry feature = 1 }   each entry:
+//                { string key = 1; Feature value = 2 }
+//   Feature    { BytesList=1 | FloatList=2 | Int64List=3 }
+//   FloatList  { repeated float value = 1 }   (packed or unpacked)
+//   Int64List  { repeated int64 value = 1 }   (packed or unpacked)
+
+namespace {
+
+bool ReadVarint(const uint8_t* p, size_t n, size_t* pos, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*pos < n && shift < 64) {
+    uint8_t b = p[(*pos)++];
+    v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// Skip one field's payload given its wire type; returns false on malformed
+// input.  Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+bool SkipField(const uint8_t* p, size_t n, size_t* pos, uint32_t wire) {
+  uint64_t tmp;
+  switch (wire) {
+    case 0:
+      return ReadVarint(p, n, pos, &tmp);
+    case 1:
+      if (n - *pos < 8) return false;
+      *pos += 8;
+      return true;
+    case 2:
+      if (!ReadVarint(p, n, pos, &tmp) || tmp > n - *pos) return false;
+      *pos += tmp;
+      return true;
+    case 5:
+      if (n - *pos < 4) return false;
+      *pos += 4;
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Locate `field` (length-delimited) inside message [p, p+n); returns the
+// payload span.  First occurrence wins (proto3 maps repeat entries; for
+// scalar submessages TF writes one).
+bool FindLenDelim(const uint8_t* p, size_t n, uint32_t field,
+                  const uint8_t** out, size_t* out_len, size_t start = 0) {
+  size_t pos = start;
+  while (pos < n) {
+    uint64_t tag;
+    if (!ReadVarint(p, n, &pos, &tag)) return false;
+    uint32_t fnum = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if (fnum == field && wire == 2) {
+      uint64_t len;
+      if (!ReadVarint(p, n, &pos, &len) || len > n - pos) return false;
+      *out = p + pos;
+      *out_len = len;
+      return true;
+    }
+    if (!SkipField(p, n, &pos, wire)) return false;
+  }
+  return false;
+}
+
+// Find the Feature message for `name` inside an Example payload.
+// Returns 1 found, 0 not found, -1 malformed.
+int FindFeature(const uint8_t* ex, size_t n, const char* name,
+                size_t name_len, const uint8_t** feat, size_t* feat_len) {
+  const uint8_t* feats;
+  size_t feats_len;
+  if (!FindLenDelim(ex, n, 1, &feats, &feats_len)) return n ? -1 : 0;
+  // walk repeated map entries (field 1 of Features)
+  size_t pos = 0;
+  while (pos < feats_len) {
+    uint64_t tag;
+    if (!ReadVarint(feats, feats_len, &pos, &tag)) return -1;
+    uint32_t fnum = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if (fnum == 1 && wire == 2) {
+      uint64_t elen;
+      if (!ReadVarint(feats, feats_len, &pos, &elen) ||
+          elen > feats_len - pos)
+        return -1;
+      const uint8_t* entry = feats + pos;
+      pos += elen;
+      const uint8_t* key;
+      size_t key_len;
+      if (!FindLenDelim(entry, elen, 1, &key, &key_len)) continue;
+      if (key_len == name_len && std::memcmp(key, name, name_len) == 0) {
+        if (!FindLenDelim(entry, elen, 2, feat, feat_len)) return -1;
+        return 1;
+      }
+    } else if (!SkipField(feats, feats_len, &pos, wire)) {
+      return -1;
+    }
+  }
+  return 0;
+}
+
+// Decode the value list of a Feature into out[cap]; kind 2 = FloatList
+// (floats), 3 = Int64List (int64, zigzag-less two's-complement varints).
+// Returns the value count, or -1 malformed, -2 wrong kind, -3 overflow.
+long DecodeNumericList(const uint8_t* feat, size_t feat_len, int kind,
+                       void* out, size_t cap) {
+  const uint8_t* list;
+  size_t list_len;
+  if (!FindLenDelim(feat, feat_len, static_cast<uint32_t>(kind), &list,
+                    &list_len)) {
+    // empty Feature{} encodes "present with zero values" for any kind;
+    // a different populated kind is a schema error
+    const uint8_t* other;
+    size_t other_len;
+    for (uint32_t k = 1; k <= 3; ++k) {
+      if (static_cast<int>(k) != kind &&
+          FindLenDelim(feat, feat_len, k, &other, &other_len))
+        return -2;
+    }
+    return 0;
+  }
+  float* fo = static_cast<float*>(out);
+  int64_t* io = static_cast<int64_t*>(out);
+  size_t pos = 0;
+  long count = 0;
+  while (pos < list_len) {
+    uint64_t tag;
+    if (!ReadVarint(list, list_len, &pos, &tag)) return -1;
+    uint32_t fnum = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if (fnum != 1) {
+      if (!SkipField(list, list_len, &pos, wire)) return -1;
+      continue;
+    }
+    if (kind == 2) {
+      if (wire == 2) {  // packed floats
+        uint64_t blen;
+        if (!ReadVarint(list, list_len, &pos, &blen) || blen % 4 ||
+            blen > list_len - pos)
+          return -1;
+        size_t m = blen / 4;
+        if (count + static_cast<long>(m) > static_cast<long>(cap))
+          return -3;
+        std::memcpy(fo + count, list + pos, blen);
+        count += static_cast<long>(m);
+        pos += blen;
+      } else if (wire == 5) {  // unpacked float
+        if (list_len - pos < 4) return -1;
+        if (count + 1 > static_cast<long>(cap)) return -3;
+        std::memcpy(fo + count, list + pos, 4);
+        ++count;
+        pos += 4;
+      } else {
+        return -1;
+      }
+    } else {  // kind == 3, int64
+      if (wire == 2) {  // packed varints
+        uint64_t blen;
+        if (!ReadVarint(list, list_len, &pos, &blen) ||
+            blen > list_len - pos)
+          return -1;
+        size_t end = pos + blen;
+        while (pos < end) {
+          uint64_t v;
+          if (!ReadVarint(list, end, &pos, &v)) return -1;
+          if (count + 1 > static_cast<long>(cap)) return -3;
+          io[count++] = static_cast<int64_t>(v);
+        }
+      } else if (wire == 0) {  // unpacked varint
+        uint64_t v;
+        if (!ReadVarint(list, list_len, &pos, &v)) return -1;
+        if (count + 1 > static_cast<long>(cap)) return -3;
+        io[count++] = static_cast<int64_t>(v);
+      } else {
+        return -1;
+      }
+    }
+  }
+  return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode feature `name` of every record in a TFRecord file into `out`
+// (row-major [n_records, feat_len]).  kind: 2 = float32, 3 = int64.
+// Every record must yield exactly feat_len values.  Returns the record
+// count, or:
+//   -1/-2/-3/-5  framing errors (as tfr_index_file)
+//   -6  a record's value count != feat_len
+//   -7  feature missing from a record
+//   -8  feature holds a different kind
+//   -9  malformed Example payload
+long tfr_read_column(const char* path, const char* name, int kind,
+                     void* out, size_t feat_len, size_t max_records,
+                     int verify_crc) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -5;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return -5;
+  }
+  if (st.st_size == 0) {
+    ::close(fd);
+    return 0;
+  }
+  void* map = ::mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (map == MAP_FAILED) return -5;
+  const uint8_t* buf = static_cast<const uint8_t*>(map);
+  size_t n = st.st_size;
+  size_t name_len = std::strlen(name);
+  size_t elem = (kind == 2) ? 4 : 8;
+  size_t pos = 0;
+  long rec = 0;
+  long err = 0;
+  while (pos < n) {
+    if (n - pos < 12) {
+      err = -3;
+      break;
+    }
+    uint64_t len = LoadLE64(buf + pos);
+    if (verify_crc && MaskedCrc(buf + pos, 8) != LoadLE32(buf + pos + 8)) {
+      err = -1;
+      break;
+    }
+    size_t data_pos = pos + 12;
+    if (len > n - data_pos || n - data_pos - len < 4) {
+      err = -3;
+      break;
+    }
+    if (verify_crc &&
+        MaskedCrc(buf + data_pos, len) != LoadLE32(buf + data_pos + len)) {
+      err = -2;
+      break;
+    }
+    if (static_cast<size_t>(rec) >= max_records) {
+      err = -4;
+      break;
+    }
+    const uint8_t* feat;
+    size_t flen;
+    int found = FindFeature(buf + data_pos, len, name, name_len, &feat,
+                            &flen);
+    if (found < 0) {
+      err = -9;
+      break;
+    }
+    if (found == 0) {
+      err = -7;
+      break;
+    }
+    long cnt = DecodeNumericList(
+        feat, flen, kind,
+        static_cast<uint8_t*>(out) + static_cast<size_t>(rec) * feat_len *
+            elem,
+        feat_len);
+    if (cnt == -2) {
+      err = -8;
+      break;
+    }
+    if (cnt < 0 || static_cast<size_t>(cnt) != feat_len) {
+      err = (cnt < 0) ? -9 : -6;
+      break;
+    }
+    ++rec;
+    pos = data_pos + len + 4;
+  }
+  ::munmap(map, st.st_size);
+  return err ? err : rec;
+}
+
+}  // extern "C"

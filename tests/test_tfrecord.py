@@ -102,3 +102,96 @@ def test_we_read_tf_files(tmp_path, tf):
     np.testing.assert_allclose(back[0]["x"][1], [3.5, -1.25])
     assert back[0]["y"] == ("int64", [-9, 2**40])
     assert back[0]["s"] == ("bytes", [b"\x00\xffbin"])
+
+
+# ------------------------------------------------- columnar feature decode
+
+class TestReadColumn:
+    def _write(self, path, n=7, L=5):
+        tfrecord.write_examples(
+            path, ({"x": [float(i * L + j) for j in range(L)],
+                    "y": i, "s": [b"meta"]} for i in range(n)))
+
+    def test_float_column(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        self._write(p, n=7, L=5)
+        col = tfrecord.read_column(p, "x")
+        assert col.shape == (7, 5) and col.dtype == np.float32
+        np.testing.assert_array_equal(
+            col, np.arange(35, dtype=np.float32).reshape(7, 5))
+
+    def test_int64_column(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        self._write(p, n=7)
+        col = tfrecord.read_column(p, "y")
+        assert col.shape == (7, 1) and col.dtype == np.int64
+        np.testing.assert_array_equal(col[:, 0], np.arange(7))
+
+    def test_native_matches_python_fallback(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "a.tfrecord")
+        self._write(p, n=9, L=3)
+        native = tfrecord.read_column(p, "x")
+        monkeypatch.setattr(tfrecord, "_native", None)
+        python = tfrecord.read_column(p, "x")
+        np.testing.assert_array_equal(native, python)
+
+    def test_negative_int64_roundtrip(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        tfrecord.write_examples(p, ({"v": [-i, i]} for i in range(4)))
+        col = tfrecord.read_column(p, "v")
+        np.testing.assert_array_equal(
+            col, [[0, 0], [-1, 1], [-2, 2], [-3, 3]])
+
+    def test_missing_feature_raises(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        self._write(p)
+        with pytest.raises(IOError, match="missing"):
+            tfrecord.read_column(p, "nope")
+
+    def test_ragged_feature_raises(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        tfrecord.write_examples(p, [{"x": [1.0, 2.0]}, {"x": [3.0]}])
+        with pytest.raises(IOError, match="value count"):
+            tfrecord.read_column(p, "x")
+
+    def test_partially_missing_feature_raises(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        tfrecord.write_examples(p, [{"x": [1.0], "y": 1}, {"x": [2.0]}])
+        with pytest.raises(IOError, match="missing"):
+            tfrecord.read_column(p, "y")
+
+    def test_bytes_feature_rejected(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        self._write(p)
+        with pytest.raises(TypeError, match="BytesList"):
+            tfrecord.read_column(p, "s")
+
+    def test_kind_mismatch_raises(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord")
+        tfrecord.write_examples(p, [{"x": [1.0]}, {"x": 3}])
+        with pytest.raises(TypeError, match="different kind"):
+            tfrecord.read_column(p, "x")
+
+    def test_gzip_falls_back_to_python(self, tmp_path):
+        p = str(tmp_path / "a.tfrecord.gz")
+        self._write(p, n=4, L=2)
+        col = tfrecord.read_column(p, "x")
+        assert col.shape == (4, 2)
+
+    def test_tf_written_file_decodes(self, tmp_path):
+        # interop: a file written by TensorFlow itself (packed lists)
+        tf = pytest.importorskip("tensorflow")
+        p = str(tmp_path / "tf.tfrecord")
+        with tf.io.TFRecordWriter(p) as w:
+            for i in range(5):
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "f": tf.train.Feature(float_list=tf.train.FloatList(
+                        value=[i * 1.5, i * 2.5])),
+                    "l": tf.train.Feature(int64_list=tf.train.Int64List(
+                        value=[i]))}))
+                w.write(ex.SerializeToString())
+        col = tfrecord.read_column(p, "f")
+        np.testing.assert_allclose(
+            col, [[i * 1.5, i * 2.5] for i in range(5)], rtol=1e-6)
+        np.testing.assert_array_equal(
+            tfrecord.read_column(p, "l")[:, 0], np.arange(5))
