@@ -73,16 +73,17 @@ def map_fun(args, ctx):
     bs = max(args.batch_size - args.batch_size % mesh.devices.size,
              mesh.devices.size)
 
-    # the framework-owned input pipeline (tf.data analog): this process's
-    # shard files -> parse -> windowed shuffle (reseeded per epoch) ->
-    # endless epochs -> static-shape batches -> device prefetch
-    def parse(ex):
-        return (np.asarray(ex["image"][1], "float32")
-                .reshape(28, 28, 1) / 255.0,
-                np.int64(ex["label"][1][0]))
-
-    ds = (data.Dataset.from_tfrecords(shard, parse=parse)
-          .shuffle(8192, seed=ctx.process_id).repeat(None).batch(bs))
+    # the framework-owned input pipeline: this process's shard files decode
+    # COLUMNAR (one native C pass per feature, ~10x the record codec) ->
+    # per-shard shuffle (reseeded per epoch) -> static-shape batches ->
+    # device prefetch
+    ds = (data.Dataset.from_tfrecord_columns(
+              shard, ["image", "label"], batch_size=bs,
+              shuffle=True, seed=ctx.process_id)
+          .map(lambda b: (b["image"].astype(np.float32)
+                          .reshape(-1, 28, 28, 1) / 255.0,
+                          b["label"][:, 0]))
+          .repeat(None))
     batches = ds.prefetch_to_device(bsharding, depth=2)
     for i in range(args.steps):
         batch = next(batches)

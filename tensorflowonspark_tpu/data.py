@@ -130,6 +130,89 @@ class Dataset:
         return cls._indexed_root(_expand_paths(paths), cfg, None)
 
     @classmethod
+    def from_tfrecord_columns(cls, paths, features, batch_size,
+                              drop_remainder=True, shuffle=False, seed=0):
+        """Root of COLUMNAR batches over fixed-schema numeric TFRecord
+        shards — the native fast path for dense training data (MNIST-like:
+        a float feature + an int64 label).
+
+        Each shard is decoded with one native C pass per feature
+        (:func:`tensorflowonspark_tpu.tfrecord.read_column`, ~10x the
+        record codec) and batches are SLICES of the shard columns —
+        individual records never exist as Python objects.  Yields
+        ``{name: array[batch_size, feat_len]}`` dicts; remainders carry
+        across shard boundaries, so batch shapes are static everywhere
+        except an optional final partial batch (``drop_remainder=False``).
+
+        ``shuffle=True`` permutes records within each shard per epoch
+        (``seed`` + epoch, the shuffle() reseed convention).  ``shard()``
+        slices the file list (call before iteration); downstream
+        ``map``/``prefetch``/``prefetch_to_device`` compose per batch.
+        """
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if not features:
+            raise ValueError("features must name at least one column")
+        cfg = {"features": tuple(features), "batch": int(batch_size),
+               "drop": bool(drop_remainder), "shuffle": bool(shuffle),
+               "seed": int(seed)}
+        return cls._columnar_root(_expand_paths(paths), cfg, None)
+
+    @classmethod
+    def _columnar_root(cls, files, cfg, shard_spec):
+        ds = cls(None)
+        ds._files = files
+        ds._columnar = cfg
+        ds._shard_spec = shard_spec
+        ds._epoch_source = ds._columnar_iter
+        return ds
+
+    def _columnar_iter(self, epoch):
+        import numpy as np
+
+        from . import tfrecord
+
+        files = self._files
+        if self._shard_spec:
+            n, i = self._shard_spec
+            files = files[i::n]
+        if not files:
+            raise ValueError("dataset matched no input files")
+        cfg = self._columnar
+        B = cfg["batch"]
+        pending = None                   # {name: [rows...]} leftover columns
+
+        def _concat(a, b):
+            return b if a is None else {
+                k: np.concatenate([a[k], b[k]]) for k in b}
+
+        for fi, path in enumerate(files):
+            cols = {name: tfrecord.read_column(path, name)
+                    for name in cfg["features"]}
+            n_rec = len(next(iter(cols.values())))
+            for name, c in cols.items():
+                if len(c) != n_rec:
+                    raise IOError(f"{path}: feature {name!r} has "
+                                  f"{len(c)} records, expected {n_rec}")
+            if cfg["shuffle"]:
+                # stable per-(seed, epoch, file) stream — NOT hash(),
+                # which is salted per process
+                rng = np.random.default_rng(
+                    (cfg["seed"] * 1_000_003 + epoch
+                     + fi * 2_654_435_761) % (2 ** 63))
+                perm = rng.permutation(n_rec)
+                cols = {k: c[perm] for k, c in cols.items()}
+            cols = _concat(pending, cols)
+            n_rec = len(next(iter(cols.values())))
+            n_full = n_rec // B
+            for j in range(n_full):
+                yield {k: c[j * B:(j + 1) * B] for k, c in cols.items()}
+            pending = ({k: c[n_full * B:] for k, c in cols.items()}
+                       if n_rec % B else None)
+        if pending is not None and not cfg["drop"]:
+            yield pending
+
+    @classmethod
     def _indexed_root(cls, files, cfg, shard_spec):
         ds = cls(None)
         ds._files = files
@@ -233,6 +316,7 @@ class Dataset:
         and file-granular sharding don't apply."""
         return (getattr(self, "_files", None) is not None
                 and getattr(self, "_indexed", None) is None
+                and getattr(self, "_columnar", None) is None
                 and self._parent is None)
 
     def interleave(self, cycle_length=4, block_length=1):
@@ -283,6 +367,13 @@ class Dataset:
         """
         if not 0 <= index < num_shards:
             raise ValueError(f"shard index {index} not in [0, {num_shards})")
+        if (self._parent is None
+                and getattr(self, "_columnar", None) is not None
+                and self._shard_spec is None):
+            # columnar root: file-granular slice (each worker decodes only
+            # its own shard files)
+            return Dataset._columnar_root(self._files, dict(self._columnar),
+                                          (num_shards, index))
         if (self._parent is None
                 and getattr(self, "_indexed", None) is not None
                 and self._shard_spec is None):

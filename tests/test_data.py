@@ -254,3 +254,81 @@ def test_take_zero_and_dir_listing(tfr_dir, tmp_path):
     (mixed / ".hidden").write_text("x")
     ds = data.Dataset.from_tfrecords(str(mixed), parse=_parse)
     assert len(list(ds)) == 8
+
+
+# -------------------------------------------------- columnar-batch root
+
+Dataset = data.Dataset
+
+
+class TestFromTFRecordColumns:
+    def _shards(self, tmp_path, sizes):
+        paths, base = [], 0
+        for k, n in enumerate(sizes):
+            p = str(tmp_path / f"c{k}.tfrecord")
+            tfrecord.write_examples(
+                p, ({"x": [float(base + i), 0.5], "y": base + i}
+                    for i in range(n)))
+            paths.append(p)
+            base += n
+        return paths, base
+
+    def test_static_batches_across_shard_boundaries(self, tmp_path):
+        paths, total = self._shards(tmp_path, [5, 7, 4])   # 16 records
+        ds = Dataset.from_tfrecord_columns(paths, ["x", "y"], batch_size=4)
+        batches = list(ds)
+        assert len(batches) == 4
+        for b in batches:
+            assert b["x"].shape == (4, 2) and b["x"].dtype == np.float32
+            assert b["y"].shape == (4, 1) and b["y"].dtype == np.int64
+        ids = np.concatenate([b["y"][:, 0] for b in batches])
+        np.testing.assert_array_equal(ids, np.arange(total))
+
+    def test_tail_batch_kept_when_not_dropped(self, tmp_path):
+        paths, total = self._shards(tmp_path, [5, 5])
+        ds = Dataset.from_tfrecord_columns(paths, ["y"], batch_size=4,
+                                           drop_remainder=False)
+        batches = list(ds)
+        assert [len(b["y"]) for b in batches] == [4, 4, 2]
+
+    def test_shuffle_permutes_and_reseeds_per_epoch(self, tmp_path):
+        paths, total = self._shards(tmp_path, [16])
+        ds = Dataset.from_tfrecord_columns(paths, ["y"], batch_size=16,
+                                           shuffle=True, seed=5).repeat(2)
+        batches = list(ds)
+        e0, e1 = batches[0]["y"][:, 0], batches[1]["y"][:, 0]
+        assert sorted(e0) == sorted(e1) == list(range(total))
+        assert not np.array_equal(e0, e1)
+        assert not np.array_equal(e0, np.arange(total))
+        # deterministic: same seed, same order
+        again = list(Dataset.from_tfrecord_columns(
+            paths, ["y"], batch_size=16, shuffle=True, seed=5))
+        np.testing.assert_array_equal(again[0]["y"], batches[0]["y"])
+
+    def test_shard_is_file_granular(self, tmp_path):
+        paths, total = self._shards(tmp_path, [4, 4, 4, 4])
+        root = Dataset.from_tfrecord_columns(paths, ["y"], batch_size=4)
+        seen = []
+        for i in range(2):
+            for b in root.shard(2, i):
+                seen.extend(b["y"][:, 0])
+        assert sorted(seen) == list(range(total))
+
+    def test_composes_with_map_and_prefetch(self, tmp_path):
+        paths, _ = self._shards(tmp_path, [8])
+        ds = (Dataset.from_tfrecord_columns(paths, ["x", "y"], batch_size=4)
+              .map(lambda b: (b["x"] * 2, b["y"][:, 0]))
+              .prefetch(2))
+        out = list(ds)
+        assert len(out) == 2
+        np.testing.assert_allclose(out[0][0][:, 1], 1.0)
+
+    def test_validation_errors(self, tmp_path):
+        paths, _ = self._shards(tmp_path, [4])
+        with pytest.raises(ValueError, match="batch_size"):
+            Dataset.from_tfrecord_columns(paths, ["y"], batch_size=0)
+        with pytest.raises(ValueError, match="features"):
+            Dataset.from_tfrecord_columns(paths, [], batch_size=2)
+        with pytest.raises(ValueError, match="matched no input files"):
+            list(Dataset.from_tfrecord_columns(
+                str(tmp_path / "none-*"), ["y"], batch_size=2))
