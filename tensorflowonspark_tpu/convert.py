@@ -275,6 +275,9 @@ def llama_config(hf_cfg, **overrides):
         # TransformerConfig.use_bias covers attention AND MLP denses;
         # attention-only bias (Qwen-style) is not expressible
         raise ValueError("attention_bias=True is not supported")
+    if getattr(hf_cfg, "mlp_bias", False):
+        # silently dropping the bias tensors would convert to wrong logits
+        raise ValueError("mlp_bias=True is not supported")
     kw = dict(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,

@@ -187,6 +187,8 @@ class Dataset:
                 k: np.concatenate([a[k], b[k]]) for k in b}
 
         for fi, path in enumerate(files):
+            if next(tfrecord.read_examples(path), None) is None:
+                continue                     # valid zero-record shard
             cols = {name: tfrecord.read_column(path, name)
                     for name in cfg["features"]}
             n_rec = len(next(iter(cols.values())))
@@ -261,16 +263,22 @@ class Dataset:
             coords = coords[idx::n_shards]
         parse = cfg["parse"]
         open_lru = collections.OrderedDict()     # file_idx -> None
-        for fi, start, count in coords:
-            payloads = readers[fi].read_range(start, count)
-            open_lru[fi] = None
-            open_lru.move_to_end(fi)
-            if len(open_lru) > self._MAX_OPEN_READERS:
-                oldest, _ = open_lru.popitem(last=False)
-                readers[oldest].release()
-            for payload in payloads:
-                ex = tfrecord.decode_example(payload)
-                yield parse(ex) if parse else ex
+        try:
+            for fi, start, count in coords:
+                payloads = readers[fi].read_range(start, count)
+                open_lru[fi] = None
+                open_lru.move_to_end(fi)
+                if len(open_lru) > self._MAX_OPEN_READERS:
+                    oldest, _ = open_lru.popitem(last=False)
+                    readers[oldest].release()
+                for payload in payloads:
+                    ex = tfrecord.decode_example(payload)
+                    yield parse(ex) if parse else ex
+        finally:
+            # handles reopen on demand, so release everything at epoch end
+            # (incl. GeneratorExit) — a finite pass must not pin fds
+            for r in readers:
+                r.release()
 
     def _file_source(self):
         files = self._my_files()
@@ -371,7 +379,15 @@ class Dataset:
                 and getattr(self, "_columnar", None) is not None
                 and self._shard_spec is None):
             # columnar root: file-granular slice (each worker decodes only
-            # its own shard files)
+            # its own shard files).  Fail FAST when files can't cover the
+            # shards — an empty worker would otherwise crash mid-training
+            # (and deadlock SPMD collectives); write >= num_shards files
+            # or use from_indexed_tfrecords for record-granular sharding.
+            if len(self._files) < num_shards:
+                raise ValueError(
+                    f"shard({num_shards}): only {len(self._files)} shard "
+                    "files — the columnar root shards at file granularity; "
+                    "write more shard files or use from_indexed_tfrecords")
             return Dataset._columnar_root(self._files, dict(self._columnar),
                                           (num_shards, index))
         if (self._parent is None

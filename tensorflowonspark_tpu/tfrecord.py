@@ -525,9 +525,9 @@ def write_examples(path, dicts, compression=None, index=False):
     return count
 
 
-def read_examples(path):
+def read_examples(path, verify_crc=True):
     """Yield decoded {name: (kind, values)} dicts from a TFRecord file."""
-    for record in read_records(path):
+    for record in read_records(path, verify_crc=verify_crc):
         yield decode_example(record)
 
 
@@ -558,7 +558,7 @@ def read_column(path, name, verify_crc=True):
 
     from . import fsio
 
-    first = next(read_examples(path), None)
+    first = next(read_examples(path, verify_crc=verify_crc), None)
     if first is None:
         raise ValueError(f"{path}: empty TFRecord file")
     if name not in first:
@@ -576,7 +576,12 @@ def read_column(path, name, verify_crc=True):
         import ctypes
 
         local = fsio.local_path(path)
-        n_max = max(os.path.getsize(local) // 16, 1)
+        # row-count bound: every record costs >= 16 framing bytes plus at
+        # least one wire byte per value, so size//(16+feat_len) bounds the
+        # record count without tying the allocation to the 16-byte
+        # worst case (which would reserve feat_len*8 bytes PER FILE BYTE
+        # for wide columns)
+        n_max = max(os.path.getsize(local) // (16 + feat_len), 1)
         out = np.empty((n_max, feat_len), np_dtype)
         rc = _native.tfr_read_column(
             os.fsencode(local), name.encode(), proto_kind,
@@ -590,7 +595,7 @@ def read_column(path, name, verify_crc=True):
         return out[:rc].copy()
 
     rows = []
-    for ex in read_examples(path):
+    for ex in read_examples(path, verify_crc=verify_crc):
         if name not in ex:
             raise IOError(f"{path}: feature {name!r} missing from a record")
         k, v = ex[name]

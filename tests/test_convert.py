@@ -260,6 +260,11 @@ def test_llama_unsupported_configs_rejected(tiny_llama):
         rope_scaling={"rope_type": "linear", "factor": 2.0})
     with pytest.raises(ValueError, match="rope_scaling"):
         convert.llama_config(bad2)
+    bad3 = transformers.LlamaConfig(
+        vocab_size=53, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, mlp_bias=True)
+    with pytest.raises(ValueError, match="mlp_bias"):
+        convert.llama_config(bad3)
 
 
 def test_llama_converted_model_trains(tiny_llama):

@@ -332,3 +332,18 @@ class TestFromTFRecordColumns:
         with pytest.raises(ValueError, match="matched no input files"):
             list(Dataset.from_tfrecord_columns(
                 str(tmp_path / "none-*"), ["y"], batch_size=2))
+
+    def test_empty_shard_skipped(self, tmp_path):
+        paths, total = self._shards(tmp_path, [4, 4])
+        empty = str(tmp_path / "c_empty.tfrecord")
+        tfrecord.write_examples(empty, [])
+        ds = Dataset.from_tfrecord_columns([paths[0], empty, paths[1]],
+                                           ["y"], batch_size=4)
+        ids = np.concatenate([b["y"][:, 0] for b in ds])
+        np.testing.assert_array_equal(ids, np.arange(total))
+
+    def test_shard_requires_enough_files(self, tmp_path):
+        paths, _ = self._shards(tmp_path, [4, 4])
+        root = Dataset.from_tfrecord_columns(paths, ["y"], batch_size=2)
+        with pytest.raises(ValueError, match="file granularity"):
+            root.shard(3, 0)

@@ -8,6 +8,7 @@ shuffle + balanced record-granular sharding they enable.
 """
 import os
 
+import numpy as np
 import pytest
 
 from tensorflowonspark_tpu import tfrecord
@@ -230,3 +231,32 @@ def test_interleave_rejected_on_indexed_root(tmp_path):
     ds = Dataset.from_indexed_tfrecords(paths, parse=_x)
     with pytest.raises(ValueError, match="file-rooted"):
         ds.interleave(2)
+
+
+def test_epoch_end_releases_file_handles(tmp_path):
+    paths, _ = _shards(tmp_path, [4, 4])
+    ds = Dataset.from_indexed_tfrecords(paths, parse=_x)
+    list(ds)                                    # one finite pass
+    for r in ds._idx_readers:
+        assert r._f is None                     # no fd pinned after epoch
+    # partial iteration (GeneratorExit) releases too
+    it = iter(ds)
+    next(it)
+    it.close()
+    for r in ds._idx_readers:
+        assert r._f is None
+
+
+def test_read_column_verify_crc_false_tolerates_bad_crc(tmp_path):
+    import struct
+    path = str(tmp_path / "a.tfrecord")
+    tfrecord.write_examples(path, [{"v": [1.0, 2.0]}, {"v": [3.0, 4.0]}])
+    blob = bytearray(open(path, "rb").read())
+    # zero the first record's payload CRC (offset: 12-byte header + payload)
+    (ln,) = struct.unpack_from("<Q", blob, 0)
+    struct.pack_into("<I", blob, 12 + ln, 0)
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(IOError):
+        tfrecord.read_column(path, "v")
+    col = tfrecord.read_column(path, "v", verify_crc=False)
+    np.testing.assert_array_equal(col, [[1.0, 2.0], [3.0, 4.0]])
