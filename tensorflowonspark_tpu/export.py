@@ -145,6 +145,37 @@ def read_signature(export_dir, signature_def_key=None):
             f"{sorted(spec['signatures'])}") from None
 
 
+def load_model(export_dir):
+    """Rebuild ``(built, params, spec)`` from an export dir — the raw
+    builder object (flax Module or plain callable) plus deserialized
+    params, WITHOUT wrapping into a signature apply fn.
+
+    This is the entry for consumers that need the module itself rather
+    than a fixed forward — e.g. autoregressive generation, which re-enters
+    the model once per token through its kv cache.  int8-quantized exports
+    dequantize EAGERLY here (generation touches the params every decode
+    step; per-step dequant would re-pay the conversion thousands of
+    times).
+    """
+    from . import fsio
+
+    with fsio.fopen(fsio.join(export_dir, MODEL_SPEC), "r") as f:
+        spec = json.load(f)
+    if spec.get("format") != "tfos-tpu-saved-model":
+        raise ValueError(f"{export_dir} is not a tfos-tpu saved model")
+    built = _resolve_builder(spec["builder"])(**spec["builder_kwargs"])
+    import flax.serialization
+    with fsio.fopen(fsio.join(export_dir, PARAMS_FILE), "rb") as f:
+        params = flax.serialization.msgpack_restore(f.read())
+    if isinstance(params, dict) and set(params) == {"params"}:
+        params = params["params"]
+    if spec.get("quantized") == "int8":
+        from . import quantize as quantize_mod
+        params = quantize_mod.dequantize_tree(
+            params, dtype=spec.get("dequant_dtype"))
+    return built, params, spec
+
+
 def load_saved_model(export_dir, signature_def_key=None):
     """Load ``(apply_fn, params, signature)`` from an export dir.
 

@@ -677,3 +677,11 @@ def lm_loss(logits, targets, ignore_id=-1):
     gold = jnp.einsum("bsv,bsv->bs", logits, onehot)
     mask = (targets != ignore_id).astype(jnp.float32)
     return jnp.sum((logz - gold) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def build_transformer(**kwargs):
+    """Export-spec builder (``"module:callable"`` import path, see
+    export.export_saved_model): rebuilds ``Transformer`` from JSON-able
+    TransformerConfig fields, so exported decoder LMs can be rebuilt by
+    the serving layer — including ``serve``'s :generate endpoint."""
+    return Transformer(TransformerConfig(**kwargs))

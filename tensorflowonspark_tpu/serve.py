@@ -41,6 +41,8 @@ def build_argparser():
                         "requests within this window coalesce into one "
                         "device execution (up to --batch_size rows)")
     p.add_argument("--signature_def_key", default=None)
+    p.add_argument("--max_new_tokens_limit", type=int, default=512,
+                   help="upper bound a :generate request may ask for")
     p.add_argument("--input_mapping", default=None)
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
@@ -183,6 +185,9 @@ class ModelService:
         self.export_dir = args.export_dir
         self.model_name = getattr(args, "model_name", "default")
         self.requests = 0
+        self._gen = None                # lazy GenerateService (or False =
+        self._gen_lock = threading.Lock()   # probed and not a decoder LM)
+        self._max_new_limit = getattr(args, "max_new_tokens_limit", 512)
         self._batcher = None
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
@@ -203,6 +208,20 @@ class ModelService:
             self.requests += 1
         return _rows_from_outputs(outputs, n)
 
+    def generate_service(self):
+        """Lazily-built GenerateService, or None when the export's builder
+        does not rebuild a decoder LM (probed once)."""
+        with self._gen_lock:
+            if self._gen is None:
+                try:
+                    self._gen = GenerateService(
+                        self.export_dir,
+                        max_new_tokens_limit=self._max_new_limit)
+                except (TypeError, ValueError) as e:
+                    logger.info(":generate unavailable: %s", e)
+                    self._gen = False
+            return self._gen or None
+
     def metadata(self):
         out = {"model": {"export_dir": self.export_dir,
                          "engine": self.desc,
@@ -210,7 +229,91 @@ class ModelService:
                "status": "ok"}
         if self._batcher is not None:
             out["model"]["batched_executions"] = self._batcher.executions
+        if self._gen is not None:      # only report once probed (lazily)
+            out["model"]["generate"] = ("available" if self._gen
+                                        else "unavailable")
         return out
+
+
+class GenerateService:
+    """Autoregressive generation over an exported decoder LM.
+
+    Rebuilds the exported module (export.load_model) and serves
+    ``models.decode.generate`` — kv-cache greedy/sampled continuation.
+    Only exports whose builder rebuilds a ``Transformer`` qualify; the
+    endpoint reports 404 otherwise.  Constructed LAZILY on the first
+    :generate request so forward-only serving never pays a second param
+    load.
+
+    Prompts are grouped by length (static shapes per compiled decode
+    step); equal-length prompts in one request batch into one prefill +
+    scan.
+    """
+
+    def __init__(self, export_dir, max_new_tokens_limit=512):
+        from . import export as export_mod
+        from .models.transformer import Transformer
+
+        built, params, _ = export_mod.load_model(export_dir)
+        if not isinstance(built, Transformer):
+            raise TypeError(
+                f"export builder rebuilds {type(built).__name__}, not a "
+                "Transformer — :generate serves decoder LMs only")
+        self.model, self.params = built, params
+        self.limit = max_new_tokens_limit
+        self._lock = threading.Lock()
+        self.requests = 0
+
+    def generate(self, req):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from .models import decode
+
+        inputs = req.get("inputs")
+        if (not isinstance(inputs, list) or not inputs
+                or not all(isinstance(p, list) and p and
+                           all(isinstance(t, int) for t in p)
+                           for p in inputs)):
+            raise ValueError('"inputs" must be a non-empty list of '
+                             "non-empty token-id lists")
+        max_new = req.get("max_new_tokens", 16)
+        if not isinstance(max_new, int) or not 1 <= max_new <= self.limit:
+            raise ValueError(f'"max_new_tokens" must be an int in '
+                             f"[1, {self.limit}]")
+        temperature = float(req.get("temperature", 0.0))
+        if temperature < 0:
+            raise ValueError('"temperature" must be >= 0')
+        eos_id = req.get("eos_id")
+        if eos_id is not None and not isinstance(eos_id, int):
+            raise ValueError('"eos_id" must be an int')
+        rng = (jax.random.key(int(req.get("seed", 0)))
+               if temperature > 0 else None)
+
+        # group by prompt length: each group is one static-shape batch
+        groups = {}
+        for i, p in enumerate(inputs):
+            groups.setdefault(len(p), []).append(i)
+        outs = [None] * len(inputs)
+        with self._lock:
+            for length, idxs in sorted(groups.items()):
+                prompt = jnp.asarray(
+                    np.stack([inputs[i] for i in idxs]), jnp.int32)
+                seq = decode.generate(self.model, self.params, prompt,
+                                      max_new_tokens=max_new,
+                                      temperature=temperature, rng=rng,
+                                      eos_id=eos_id)
+                for row, i in zip(np.asarray(seq), idxs):
+                    toks = row.tolist()
+                    if eos_id is not None and eos_id in toks[length:]:
+                        # static shapes pad with eos; trim host-side
+                        end = length + toks[length:].index(eos_id) + 1
+                        toks = toks[:end]
+                    outs[i] = toks
+            self.requests += 1
+        return outs
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -233,18 +336,28 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
-        if self.path != f"/v1/models/{self.service.model_name}:predict":
+        name = self.service.model_name
+        is_predict = self.path == f"/v1/models/{name}:predict"
+        is_generate = self.path == f"/v1/models/{name}:generate"
+        if not (is_predict or is_generate):
             self._send(404, {"error": f"unknown path {self.path} (serving "
-                             f"model {self.service.model_name!r})"})
+                             f"model {name!r})"})
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
             req = json.loads(self.rfile.read(length) or b"{}")
             if not isinstance(req, dict):
-                raise ValueError("request body must be a JSON object with "
-                                 '"instances"')
-            preds = self.service.predict(req.get("instances"))
-            self._send(200, {"predictions": preds})
+                raise ValueError("request body must be a JSON object")
+            if is_generate:
+                gen = self.service.generate_service()
+                if gen is None:
+                    self._send(404, {"error": "this export is not a "
+                                     "decoder LM; :generate unavailable"})
+                    return
+                self._send(200, {"outputs": gen.generate(req)})
+            else:
+                preds = self.service.predict(req.get("instances"))
+                self._send(200, {"predictions": preds})
         except (ValueError, KeyError, TypeError, AttributeError) as e:
             # malformed client input in any shape -> 400
             self._send(400, {"error": str(e) or type(e).__name__})

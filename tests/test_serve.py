@@ -224,3 +224,109 @@ def test_micro_batcher_survives_predictor_failure(batched_server):
     out = _post(f"{base}/v1/models/default:predict",
                 {"instances": [{"x": [1.0, 2.0]}]})
     assert "predictions" in out                 # batcher thread alive
+
+
+# ----------------------------------------------------------- :generate
+
+@pytest.fixture(scope="module")
+def lm_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve_lm")
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=41, d_model=16, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=32, max_seq_len=32, dtype="float32",
+                  rope=True, norm_type="rmsnorm", mlp_style="gated",
+                  activation="silu", attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export.export_saved_model(
+        str(tmp / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp / "lm"), "--port", "0",
+         "--max_new_tokens_limit", "8"])
+    server, service = serve.make_server(args)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server, service, model, params
+    server.shutdown()
+
+
+def _post_gen(server, path, payload):
+    port = server.server_address[1]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_generate_greedy_matches_decode(lm_server):
+    server, service, model, params = lm_server
+    from tensorflowonspark_tpu.models import decode
+    import jax.numpy as jnp
+
+    prompts = [[1, 2, 3, 4], [7, 8, 9, 10]]
+    code, out = _post_gen(server, "/v1/models/default:generate",
+                      {"inputs": prompts, "max_new_tokens": 5})
+    assert code == 200
+    seqs = out["outputs"]
+    assert [len(s) for s in seqs] == [9, 9]
+    ref = decode.generate(model, params, jnp.asarray(prompts, jnp.int32),
+                          max_new_tokens=5, temperature=0.0)
+    assert seqs == np.asarray(ref).tolist()
+    # mixed prompt lengths group by length and come back in order
+    code, out = _post_gen(server, "/v1/models/default:generate",
+                      {"inputs": [[5, 6], [1, 2, 3], [9, 9]],
+                       "max_new_tokens": 2})
+    assert code == 200
+    assert [len(s) for s in out["outputs"]] == [4, 5, 4]
+    assert out["outputs"][0][:2] == [5, 6]
+    assert out["outputs"][1][:3] == [1, 2, 3]
+
+
+def test_generate_validation_400s(lm_server):
+    server = lm_server[0]
+    for bad in ({"inputs": []},
+                {"inputs": [[1, 2]], "max_new_tokens": 0},
+                {"inputs": [[1, 2]], "max_new_tokens": 99},   # over limit
+                {"inputs": [["a"]]},
+                {"inputs": [[1]], "temperature": -1},
+                {"inputs": [[1] * 40, ], "max_new_tokens": 8}):  # > max_seq
+        code, out = _post_gen(server, "/v1/models/default:generate", bad)
+        assert code == 400, (bad, out)
+    # server is still healthy afterwards
+    code, out = _post_gen(server, "/v1/models/default:generate",
+                      {"inputs": [[1, 2]], "max_new_tokens": 1})
+    assert code == 200
+
+
+def test_generate_metadata_reports_availability(lm_server):
+    server = lm_server[0]
+    port = server.server_address[1]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/models/default") as r:
+        meta = json.loads(r.read())
+    assert meta["model"]["generate"] == "available"
+
+
+def test_generate_404_on_non_lm_export(server):
+    # the Linear forward-only export must refuse :generate but keep serving
+    url, _ = server
+    req = urllib.request.Request(
+        url + "/v1/models/default:generate",
+        data=json.dumps({"inputs": [[1, 2]]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 404
+    assert "generate" in json.loads(e.value.read())["error"]
