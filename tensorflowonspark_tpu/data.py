@@ -658,15 +658,18 @@ _MISSING = object()
 
 
 def _expand_paths(paths):
+    from .tfrecord import INDEX_SUFFIX
     if isinstance(paths, str):
         import os
         if os.path.isdir(paths):
             out = sorted(
                 p for f in os.listdir(paths)
                 if not f.startswith(("_", "."))
+                and not f.endswith(INDEX_SUFFIX)   # sidecar indexes
                 and os.path.isfile(p := os.path.join(paths, f)))
         else:
-            out = sorted(glob_mod.glob(paths))
+            out = sorted(p for p in glob_mod.glob(paths)
+                         if not p.endswith(INDEX_SUFFIX))
         return out
     return sorted(str(p) for p in paths)
 

@@ -127,10 +127,11 @@ def from_example(example, schema):
 # Iterator-level API (no Spark required)
 # --------------------------------------------------------------------------
 
-def write_tfrecords(rows, path):
-    """Write an iterable of row dicts to one TFRecord file; returns count."""
+def write_tfrecords(rows, path, index=False):
+    """Write an iterable of row dicts to one TFRecord file (``index=True``
+    adds the random-access sidecar); returns count."""
     return tfrecord.write_examples(
-        path, (to_feature_dict(r) for r in rows))
+        path, (to_feature_dict(r) for r in rows), index=index)
 
 
 def read_tfrecords(path_or_dir, binary_features=(), schema=None):
@@ -146,6 +147,9 @@ def read_tfrecords(path_or_dir, binary_features=(), schema=None):
             paths = [p for p in fsio.glob(fsio.join(path_or_dir, "*"))
                      if fsio.isfile(p) and not
                      os.path.basename(p).startswith(("_", "."))]
+        # random-access sidecars live next to the data shards
+        paths = [p for p in paths
+                 if not p.endswith(tfrecord.INDEX_SUFFIX)]
     else:
         paths = [path_or_dir]
     rows = []
@@ -161,11 +165,15 @@ def read_tfrecords(path_or_dir, binary_features=(), schema=None):
 # Spark-level API (gated)
 # --------------------------------------------------------------------------
 
-def saveAsTFRecords(df, output_dir):
+def saveAsTFRecords(df, output_dir, index=False):
     """Save a Spark DataFrame as sharded TFRecord files (maps reference
     saveAsTFRecords, dfutil.py:29-41 — but writes natively per executor
-    instead of through the Hadoop output format)."""
+    instead of through the Hadoop output format).  ``index=True`` also
+    writes each shard's random-access sidecar index, so downstream
+    readers get Dataset.from_indexed_tfrecords' exact global shuffle
+    without a rebuild scan."""
     columns = df.columns
+    write_index = index
 
     def write_partition(index, iterator):
         # makedirs must run on the EXECUTOR, not the driver: on a multi-node
@@ -177,7 +185,8 @@ def saveAsTFRecords(df, output_dir):
         fsio.makedirs(output_dir)
         part = fsio.join(output_dir, f"part-r-{index:05d}")
         count = write_tfrecords(
-            (dict(zip(columns, row)) for row in iterator), part)
+            (dict(zip(columns, row)) for row in iterator), part,
+            index=write_index)
         yield (index, count)
 
     counts = df.rdd.mapPartitionsWithIndex(write_partition).collect()
@@ -196,7 +205,9 @@ def loadTFRecords(sc, input_dir, binary_features=(), schema_hint=None):
     from . import fsio
 
     spark = SparkSession.builder.getOrCreate()
-    paths = fsio.glob(fsio.join(input_dir, "part-*")) or [input_dir]
+    paths = [p for p in
+             (fsio.glob(fsio.join(input_dir, "part-*")) or [input_dir])
+             if not p.endswith(tfrecord.INDEX_SUFFIX)]
 
     # infer schema from the first record of the first shard
     schema = dict(schema_hint or {})

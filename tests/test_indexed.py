@@ -260,3 +260,18 @@ def test_read_column_verify_crc_false_tolerates_bad_crc(tmp_path):
         tfrecord.read_column(path, "v")
     col = tfrecord.read_column(path, "v", verify_crc=False)
     np.testing.assert_array_equal(col, [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_sidecars_invisible_to_directory_readers(tmp_path):
+    # .idx sidecars next to data shards must not be picked up as shards
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.data import _expand_paths
+    d = tmp_path / "shards"
+    d.mkdir()
+    for k in range(2):
+        _write_shard(str(d / f"part-r-{k:05d}"), 3, base=3 * k, index=True)
+    rows, _ = dfutil.read_tfrecords(str(d))
+    assert len(rows) == 6
+    assert all(not p.endswith(".idx") for p in _expand_paths(str(d)))
+    assert all(not p.endswith(".idx")
+               for p in _expand_paths(str(d / "part-*")))

@@ -174,6 +174,29 @@ def test_dfutil_dataframe_roundtrip(sc, tmp_path):
     np.testing.assert_allclose(r7["score"], 3.5)
 
 
+def test_dfutil_save_with_sidecar_indexes(sc, tmp_path):
+    from pyspark.sql import SparkSession
+
+    from tensorflowonspark_tpu import dfutil, tfrecord
+    from tensorflowonspark_tpu.data import Dataset
+
+    spark = SparkSession.builder.getOrCreate()
+    df = spark.createDataFrame(
+        sc.parallelize([(i, float(i)) for i in range(12)], 2),
+        ["id", "val"])
+    out = str(tmp_path / "tfr_idx")
+    assert dfutil.saveAsTFRecords(df, out, index=True) == 12
+    parts = sorted(p for p in os.listdir(out) if p.startswith("part-r-")
+                   and not p.endswith(tfrecord.INDEX_SUFFIX))
+    for p in parts:
+        assert tfrecord.read_index(os.path.join(out, p)) is not None
+    # the sidecars feed the indexed root directly (no rebuild scan)
+    ds = Dataset.from_indexed_tfrecords(
+        [os.path.join(out, p) for p in parts],
+        parse=lambda ex: int(ex["id"][1][0]), global_shuffle=True)
+    assert sorted(ds) == list(range(12))
+
+
 # --- Spark ML pipeline (reference tests/test_pipeline.py:89-172) ---------
 
 def test_ml_estimator_fit_transform_pipeline(sc, tmp_path):
