@@ -197,3 +197,13 @@ def test_gpt2_finetune_end_to_end(tmp_path):
     assert "sample:" in out
     assert "int8 artifact" in out
     assert (tmp_path / "ft_out" / "int8").exists()
+
+
+def test_llama_serve_end_to_end(tmp_path):
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    out = _run("lm/llama_serve.py", "--platform", "cpu",
+               "--new_tokens", "4", cwd=tmp_path)
+    assert "imported LLaMA" in out
+    assert "serving on http://" in out
+    assert "llama serving round trip complete" in out
