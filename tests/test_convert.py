@@ -349,3 +349,21 @@ def test_mixtral_sliding_window_clamps_seq(tiny_mixtral):
         sliding_window=32)
     ours = convert.mixtral_config(cfg)
     assert ours.max_seq_len == 32      # beyond the window HF numerics differ
+
+
+def test_mixtral_converted_model_generates(tiny_mixtral):
+    # greedy KV-cache decode through the MoE topk router must match torch
+    from tensorflowonspark_tpu.models import decode
+
+    cfg, params = convert.from_hf_mixtral(tiny_mixtral,
+                                          attention_impl="dense")
+    prompt = jnp.asarray(np.random.RandomState(4).randint(0, 97, (2, 4)))
+    out = decode.generate(Transformer(cfg), params, prompt,
+                          max_new_tokens=6, temperature=0.0)
+    assert out.shape == (2, 10)
+    with torch.no_grad():
+        t = torch.tensor(np.asarray(prompt))
+        for _ in range(6):
+            nxt = tiny_mixtral(t).logits[:, -1].argmax(-1, keepdim=True)
+            t = torch.cat([t, nxt], dim=1)
+    np.testing.assert_array_equal(np.asarray(out), t.numpy())
