@@ -27,6 +27,10 @@ def main():
     p.add_argument("--windows", type=int, default=3)
     p.add_argument("--norm_type", default="rmsnorm",
                    choices=["layernorm", "rmsnorm"])
+    p.add_argument("--loop", default="auto",
+                   choices=["auto", "scan", "host"],
+                   help="token-loop driver (host = one async dispatch per "
+                        "token; 10x on high-dispatch-overhead runtimes)")
     p.add_argument("--param_dtype", default="bfloat16",
                    help="serving weight width (bfloat16 = what serve's "
                         ":generate uses; float32 = training masters)")
@@ -69,7 +73,7 @@ def main():
     def run():
         out = decode.generate(model, params, prompt,
                               max_new_tokens=args.new_tokens,
-                              temperature=0.0)
+                              temperature=0.0, loop=args.loop)
         np.asarray(out[:, -1])            # host readback barrier
         return out
 
@@ -83,10 +87,10 @@ def main():
     # prefill-only timing: generate 1 token (scan body compiles separately
     # but its single step is negligible next to the prompt pass)
     decode.generate(model, params, prompt, max_new_tokens=1,
-                    temperature=0.0)[:, -1]
+                    temperature=0.0, loop=args.loop)[:, -1]
     t0 = time.perf_counter()
     out = decode.generate(model, params, prompt, max_new_tokens=1,
-                          temperature=0.0)
+                          temperature=0.0, loop=args.loop)
     np.asarray(out[:, -1])
     prefill = time.perf_counter() - t0
 
@@ -95,7 +99,7 @@ def main():
     kind = jax.devices()[0].device_kind
     print(f"device={kind} params={n_params / 1e6:.0f}M B={B} "
           f"prompt={args.prompt_len} new={args.new_tokens} "
-          f"norm={args.norm_type}")
+          f"norm={args.norm_type} loop={args.loop}")
     print(f"end-to-end={best * 1000:.0f} ms  prefill~{prefill * 1000:.0f} ms  "
           f"decode={per_tok * 1000:.2f} ms/tok  "
           f"throughput={B / per_tok:,.0f} tok/s")

@@ -58,17 +58,193 @@ def _jitted_step(decode_model):
     return step
 
 
+@functools.lru_cache(maxsize=32)
+def _jitted_step_all(decode_model):
+    """Like _jitted_step but returns logits at EVERY fed position — the
+    verify pass of speculative decoding needs the target's next-token
+    distribution after each proposed token, not just the last."""
+
+    @jax.jit
+    def step(params, tokens, cache):
+        logits, mut = decode_model.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+        return logits, mut["cache"]
+
+    return step
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_decode_body(decode_model, greedy, with_eos):
+    """One fused host-loop decode step: model apply + token pick + eos
+    masking in a single dispatch.  `greedy`/`with_eos` are static (part of
+    the cache key); params/temperature/eos_id are arguments so parameter
+    trees and sampling knobs don't trigger retraces."""
+
+    # the cache (argnum 2) is donated: each step's dynamic_update_slice
+    # then writes in place instead of copying hundreds of MB of kv per
+    # token; the host loop rebinds the returned cache and never touches
+    # the donated one again
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def body(params, tok, cache, done, rng_t, temperature, eos_id):
+        logits, mut = decode_model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            mutable=["cache"])
+        logits = logits[:, -1]
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            nxt = jax.random.categorical(rng_t, logits / temperature,
+                                         axis=-1)
+        if with_eos:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return nxt, mut["cache"], done
+
+    return body
+
+
+def _set_cache_index(cache, value):
+    """Rewind/commit: set every layer's cache_index to `value`.  Entries
+    past the index are invisible (decode attention masks keys at
+    j > index + s) and get overwritten by later writes, so rewinding the
+    index alone discards rejected speculative tokens."""
+    value = jnp.asarray(value, jnp.int32)
+
+    def set_leaf(path, leaf):
+        last = path[-1]
+        name = getattr(last, "key", getattr(last, "name", None))
+        return value if name == "cache_index" else leaf
+
+    return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+
+def speculative_generate(model, params, draft_model, draft_params, prompt,
+                         max_new_tokens, k=4):
+    """Greedy generation with draft-model speculation — EXACTLY the tokens
+    `generate(model, params, prompt, ..., temperature=0)` produces, faster
+    when the draft agrees with the target often.
+
+    Each round: the draft proposes `k` tokens autoregressively, then ONE
+    target forward over the proposed block verifies all of them (the
+    kv-cache decode step already handles multi-token blocks — it is the
+    prefill path).  The longest matching prefix is committed plus the
+    target's own next token (which equals the draft token wherever they
+    agreed), so every committed token is the target's greedy choice.
+    Rounds advance all rows by the same amount (the batch-min acceptance);
+    rejected cache entries are discarded by rewinding cache_index alone.
+
+    Why this exists: decode throughput is launch-overhead-bound (one
+    small-kernel pass per token — BASELINE.md round 3); a verified block
+    amortizes the target's per-token pass over ~acceptance+1 tokens.
+
+    `model`/`draft_model` are Transformers (or configs) sharing a vocab;
+    the draft is typically a few-layer model.  Greedy only — sampling
+    needs rejection sampling, which changes the acceptance rule.
+    """
+    import numpy as np
+
+    if k < 1:
+        raise ValueError(f"k={k} must be >= 1")
+    if max_new_tokens <= 0:
+        return prompt
+    B, T0 = prompt.shape
+    t_model, t_cache = init_cache(model, B)
+    d_model, d_cache = init_cache(draft_model, B)
+    if t_model.cfg.vocab_size != d_model.cfg.vocab_size:
+        raise ValueError(
+            f"target vocab {t_model.cfg.vocab_size} != draft vocab "
+            f"{d_model.cfg.vocab_size}")
+    # the verify block may write up to k tokens past the committed prefix
+    # before rewinding, so leave k slots of headroom in BOTH caches
+    for cfg in (t_model.cfg, d_model.cfg):
+        if T0 + max_new_tokens + k > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {T0} + max_new_tokens {max_new_tokens} + k {k} "
+                f"exceeds max_seq_len {cfg.max_seq_len}")
+
+    t_step = _jitted_step(t_model)          # [B, S] -> last-position logits
+    t_verify = _jitted_step_all(t_model)    # [B, S] -> all-position logits
+    d_step = _jitted_step(d_model)
+
+    # prefill both caches over the prompt; first token comes from the target
+    t_logits, t_cache = t_step(params, prompt, t_cache)
+    _, d_cache = d_step(draft_params, prompt, d_cache)
+    last = jnp.argmax(t_logits, axis=-1)    # [B], committed, not yet fed
+    committed = [np.asarray(last)]
+    base = T0                               # tokens IN both caches
+
+    while len(committed) < max_new_tokens:
+        m = min(k, max_new_tokens - len(committed))
+        if m == 0:
+            break
+        # --- draft proposes m tokens after `last` -----------------------
+        props = []
+        d_tok = last
+        for _ in range(m):
+            d_logits, d_cache = d_step(draft_params, d_tok[:, None], d_cache)
+            d_tok = jnp.argmax(d_logits, axis=-1)
+            props.append(d_tok)
+        props = jnp.stack(props, axis=1)                     # [B, m]
+        # --- one target pass verifies the whole block -------------------
+        block = jnp.concatenate([last[:, None], props[:, :-1]], axis=1)
+        t_logits_all, t_cache = t_verify(params, block, t_cache)
+        t_next = jnp.argmax(t_logits_all, axis=-1)           # [B, m]
+        # row-wise longest matching prefix; advance by the batch minimum
+        # (rows that matched further agree with t_next there anyway)
+        matches_np = np.asarray(props == t_next)             # [B, m]
+        n_acc = np.where(matches_np.all(axis=1), m,
+                         matches_np.argmin(axis=1))          # [B]
+        a = int(n_acc.min())
+        a = min(a, m - 1)  # cap: committing a+1 <= m tokens this round
+        props_np, t_next_np = np.asarray(props), np.asarray(t_next)
+        for j in range(a):
+            committed.append(props_np[:, j])
+        committed.append(t_next_np[:, a])
+        last = jnp.asarray(t_next_np[:, a])
+        # --- commit/rewind: prefix + block head (last) + accepted -------
+        base = base + 1 + a
+        t_cache = _set_cache_index(t_cache, base)
+        # draft cache holds [.., last(fed), p1..p_{m-1}(fed)] — same rewind
+        d_cache = _set_cache_index(d_cache, base)
+
+    new = jnp.asarray(np.stack(committed[:max_new_tokens], axis=1))
+    return jnp.concatenate([prompt, new], axis=1)
+
+
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None, eos_id=None):
+             rng=None, eos_id=None, loop="auto"):
     """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
 
     temperature=0 is greedy argmax; >0 samples from softmax(logits/T).
     With `eos_id`, sequences that emit it keep emitting eos_id (shapes stay
     static; trim host-side).  Runs as prefill (one call over the prompt)
-    + lax.scan of single-token steps.
+    + the token loop.
+
+    ``loop`` picks the token-loop driver:
+
+    - ``"scan"`` — one ``lax.scan`` over all steps: a single dispatch for
+      the whole generation, the idiomatic choice on directly-attached
+      TPUs.
+    - ``"host"`` — a Python loop dispatching one jitted step per token,
+      fully async (no per-token sync; one readback at the end).  On
+      runtimes where XLA while-loop iterations are expensive (the
+      tunneled device plugin this repo benches through runs the SAME
+      per-token program 10x faster host-driven: 11 vs 112 ms/tok,
+      BASELINE.md round 3), this is the fast path.
+    - ``"auto"`` (default) — the ``TFOS_TPU_DECODE_LOOP`` env var when
+      set (``scan``/``host``), else ``scan``.
     """
+    import os
+
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires `rng`")
+    if loop not in ("auto", "scan", "host"):
+        raise ValueError(f"loop={loop!r} not in ('auto', 'scan', 'host')")
+    if loop == "auto":
+        loop = os.environ.get("TFOS_TPU_DECODE_LOOP", "scan")
+        if loop not in ("scan", "host"):
+            raise ValueError(
+                f"TFOS_TPU_DECODE_LOOP={loop!r} not in ('scan', 'host')")
     if max_new_tokens <= 0:
         return prompt
     decode_model, cache = init_cache(model, prompt.shape[0])
@@ -107,6 +283,22 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         return (nxt, cache, done), nxt
 
     rngs = jax.random.split(rng, max(max_new_tokens - 1, 0))
-    (_, _, _), rest = jax.lax.scan(scan_body, (tok, cache, done), rngs)
-    new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
+    if loop == "host":
+        # same per-token program, host-dispatched: ONE jitted call per
+        # token (step + pick + eos fused), every call queued async (no
+        # per-token readback) — steady-state cost is max(device step,
+        # dispatch) instead of the while-loop's per-iteration overhead
+        body = _jitted_decode_body(decode_model, temperature == 0,
+                                   eos_id is not None)
+        temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
+        eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
+        toks = [tok]
+        for t in range(max_new_tokens - 1):
+            tok, cache, done = body(params, tok, cache, done, rngs[t],
+                                    temp, eos)
+            toks.append(tok)
+        new_tokens = jnp.stack(toks, axis=1)
+    else:
+        (_, _, _), rest = jax.lax.scan(scan_body, (tok, cache, done), rngs)
+        new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, new_tokens], axis=1)

@@ -145,3 +145,96 @@ def test_generate_with_tp_sharded_params():
     with jax.set_mesh(mesh):
         got = generate(model, sharded, prompt, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ------------------------------------------------------- speculative decode
+
+def _mk(seed, n_layers=2, vocab=64):
+    cfg = TransformerConfig(vocab_size=vocab, d_model=32, n_heads=4,
+                            n_layers=n_layers, d_ff=64, max_seq_len=48,
+                            dtype="float32", rope=True, n_kv_heads=2)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_speculative_matches_greedy_disagreeing_draft(k):
+    # an unrelated random draft: near-zero acceptance, output still EXACT
+    from tensorflowonspark_tpu.models.decode import speculative_generate
+
+    target, t_params = _mk(0)
+    draft, d_params = _mk(1, n_layers=1)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 6)), jnp.int32)
+    ref = generate(target, t_params, prompt, max_new_tokens=10,
+                   temperature=0.0)
+    out = speculative_generate(target, t_params, draft, d_params, prompt,
+                               max_new_tokens=10, k=k)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_full_acceptance_self_draft():
+    # draft == target: every proposal accepted, output still exact
+    from tensorflowonspark_tpu.models.decode import speculative_generate
+
+    target, t_params = _mk(0)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, 64, (1, 4)), jnp.int32)
+    ref = generate(target, t_params, prompt, max_new_tokens=12,
+                   temperature=0.0)
+    out = speculative_generate(target, t_params, target, t_params, prompt,
+                               max_new_tokens=12, k=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_validation():
+    from tensorflowonspark_tpu.models.decode import speculative_generate
+
+    target, t_params = _mk(0)
+    draft, d_params = _mk(1, n_layers=1)
+    small_vocab, sv_params = _mk(2, vocab=32)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="k="):
+        speculative_generate(target, t_params, draft, d_params, prompt,
+                             max_new_tokens=4, k=0)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(target, t_params, small_vocab, sv_params,
+                             prompt, max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_generate(target, t_params, draft, d_params, prompt,
+                             max_new_tokens=44, k=4)
+    np.testing.assert_array_equal(
+        np.asarray(speculative_generate(target, t_params, draft, d_params,
+                                        prompt, max_new_tokens=0)),
+        np.asarray(prompt))
+
+
+def test_host_loop_matches_scan(model_and_params):
+    # the loop driver is an execution detail: identical outputs for
+    # greedy, sampling (same rng), and eos-forcing paths
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.RandomState(5).randint(0, 64, (2, 4)), jnp.int32)
+    for kw in ({"temperature": 0.0},
+               {"temperature": 0.7, "rng": jax.random.key(3)},
+               {"temperature": 0.0, "eos_id": 7}):
+        ref = generate(model, params, prompt, max_new_tokens=9,
+                       loop="scan", **kw)
+        got = generate(model, params, prompt, max_new_tokens=9,
+                       loop="host", **kw)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref), kw)
+
+
+def test_loop_env_var_and_validation(model_and_params, monkeypatch):
+    model, params = model_and_params
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="loop="):
+        generate(model, params, prompt, 2, loop="while")
+    monkeypatch.setenv("TFOS_TPU_DECODE_LOOP", "turbo")
+    with pytest.raises(ValueError, match="TFOS_TPU_DECODE_LOOP"):
+        generate(model, params, prompt, 2)
+    monkeypatch.setenv("TFOS_TPU_DECODE_LOOP", "host")
+    out = generate(model, params, prompt, 2)
+    assert out.shape == (1, 6)
