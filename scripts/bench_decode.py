@@ -85,9 +85,11 @@ def main():
         best = min(best, time.perf_counter() - t0)
 
     # prefill-only timing: generate 1 token (scan body compiles separately
-    # but its single step is negligible next to the prompt pass)
-    decode.generate(model, params, prompt, max_new_tokens=1,
-                    temperature=0.0, loop=args.loop)[:, -1]
+    # but its single step is negligible next to the prompt pass).  The
+    # warmup must SYNC before the timer starts or its queued execution
+    # lands inside the timed window.
+    np.asarray(decode.generate(model, params, prompt, max_new_tokens=1,
+                               temperature=0.0, loop=args.loop)[:, -1])
     t0 = time.perf_counter()
     out = decode.generate(model, params, prompt, max_new_tokens=1,
                           temperature=0.0, loop=args.loop)

@@ -127,15 +127,21 @@ def export_saved_model(export_dir, params, builder, builder_kwargs=None,
     return export_dir
 
 
-def read_signature(export_dir, signature_def_key=None):
-    """Read ``(spec, signature)`` from an export dir without loading
-    params — the cheap metadata half of `load_saved_model` (format check
-    and signature lookup included)."""
+def _read_spec(export_dir):
+    """Read + format-check ``tfos_model.json``."""
     from . import fsio
     with fsio.fopen(fsio.join(export_dir, MODEL_SPEC), "r") as f:
         spec = json.load(f)
     if spec.get("format") != "tfos-tpu-saved-model":
         raise ValueError(f"{export_dir} is not a tfos-tpu saved model")
+    return spec
+
+
+def read_signature(export_dir, signature_def_key=None):
+    """Read ``(spec, signature)`` from an export dir without loading
+    params — the cheap metadata half of `load_saved_model` (format check
+    and signature lookup included)."""
+    spec = _read_spec(export_dir)
     sig_key = signature_def_key or DEFAULT_SIGNATURE
     try:
         return spec, spec["signatures"][sig_key]
@@ -143,6 +149,20 @@ def read_signature(export_dir, signature_def_key=None):
         raise ValueError(
             f"signature {sig_key!r} not found; available: "
             f"{sorted(spec['signatures'])}") from None
+
+
+def _restore_params(export_dir):
+    """Deserialize the params tree from an export dir (msgpack; unwraps a
+    sole {'params': ...} envelope).  Quantized trees come back AS STORED —
+    dequantization policy belongs to the caller."""
+    from . import fsio
+    import flax.serialization
+
+    with fsio.fopen(fsio.join(export_dir, PARAMS_FILE), "rb") as f:
+        params = flax.serialization.msgpack_restore(f.read())
+    if isinstance(params, dict) and set(params) == {"params"}:
+        params = params["params"]
+    return params
 
 
 def load_model(export_dir):
@@ -157,18 +177,9 @@ def load_model(export_dir):
     step; per-step dequant would re-pay the conversion thousands of
     times).
     """
-    from . import fsio
-
-    with fsio.fopen(fsio.join(export_dir, MODEL_SPEC), "r") as f:
-        spec = json.load(f)
-    if spec.get("format") != "tfos-tpu-saved-model":
-        raise ValueError(f"{export_dir} is not a tfos-tpu saved model")
+    spec = _read_spec(export_dir)
     built = _resolve_builder(spec["builder"])(**spec["builder_kwargs"])
-    import flax.serialization
-    with fsio.fopen(fsio.join(export_dir, PARAMS_FILE), "rb") as f:
-        params = flax.serialization.msgpack_restore(f.read())
-    if isinstance(params, dict) and set(params) == {"params"}:
-        params = params["params"]
+    params = _restore_params(export_dir)
     if spec.get("quantized") == "int8":
         from . import quantize as quantize_mod
         params = quantize_mod.dequantize_tree(
@@ -183,7 +194,6 @@ def load_saved_model(export_dir, signature_def_key=None):
     the reference's ``tf.saved_model.load`` + signature lookup
     (pipeline.py:596-613).
     """
-    from . import fsio
     spec, signature = read_signature(export_dir, signature_def_key)
 
     built = _resolve_builder(spec["builder"])(**spec["builder_kwargs"])
@@ -195,13 +205,7 @@ def load_saved_model(export_dir, signature_def_key=None):
     else:
         apply_fn = built
 
-    import flax.serialization
-    with fsio.fopen(fsio.join(export_dir, PARAMS_FILE), "rb") as f:
-        raw = f.read()
-    # msgpack restore needs no target template for plain dict pytrees
-    params = flax.serialization.msgpack_restore(raw)
-    if isinstance(params, dict) and set(params) == {"params"}:
-        params = params["params"]
+    params = _restore_params(export_dir)
     if spec.get("quantized") == "int8":
         from . import quantize as quantize_mod
         inner_apply = apply_fn

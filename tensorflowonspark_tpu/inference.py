@@ -47,12 +47,14 @@ def build_argparser():
 
 
 def _input_files(pattern):
-    from . import fsio
+    from . import fsio, tfrecord
     if fsio.isdir(pattern):
         files = fsio.glob(fsio.join(pattern, "*.tfrecord")) or \
             fsio.glob(fsio.join(pattern, "part-*"))
     else:
         files = fsio.glob(pattern)
+    # random-access sidecars (saveAsTFRecords(index=True)) are not shards
+    files = [f for f in files if not f.endswith(tfrecord.INDEX_SUFFIX)]
     if not files:
         raise FileNotFoundError(f"no input files match {pattern!r}")
     return files

@@ -102,3 +102,51 @@ def test_quantized_export_round_trip(tmp_path):
     qapply, qp, _ = export.load_saved_model(str(tmp_path / "int8"))
     got = np.asarray(jax.jit(qapply)(qp, x))
     assert np.max(np.abs(got - ref)) < 0.05 * (np.max(np.abs(ref)) + 1e-6)
+
+
+def test_inference_input_files_skip_sidecars(tmp_path):
+    from tensorflowonspark_tpu import inference, tfrecord
+    d = tmp_path / "shards"
+    d.mkdir()
+    for k in range(2):
+        tfrecord.write_examples(str(d / f"part-r-{k:05d}"),
+                                [{"x": [1.0]}], index=True)
+    files = inference._input_files(str(d))
+    assert len(files) == 2
+    assert all(not f.endswith(".idx") for f in files)
+    # glob patterns filter too
+    files = inference._input_files(str(d / "part-*"))
+    assert all(not f.endswith(".idx") for f in files)
+
+
+def test_load_model_int8_export_generates(tmp_path):
+    # the eager-dequant path of load_model: an int8-quantized decoder LM
+    # export must still rebuild and generate
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import export as export_mod
+    from tensorflowonspark_tpu.models import decode
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+                  d_ff=64, max_seq_len=32, dtype="float32", rope=True,
+                  attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    out_dir = str(tmp_path / "q")
+    export_mod.export_saved_model(
+        out_dir, params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw, quantize_int8=True,
+        quantize_kwargs={"min_elements": 256})
+    built, loaded, spec = export_mod.load_model(out_dir)
+    assert spec.get("quantized") == "int8"
+    # dequantized eagerly: plain float leaves, no quantize containers
+    assert all(jnp.issubdtype(x.dtype, jnp.floating)
+               for x in jax.tree_util.tree_leaves(loaded))
+    seq = decode.generate(built, loaded, jnp.zeros((1, 4), jnp.int32),
+                          max_new_tokens=4, temperature=0.0)
+    assert seq.shape == (1, 8)
