@@ -144,7 +144,10 @@ def test_generate_with_tp_sharded_params():
     sharded = sharding_mod.shard_params(params, sh)
     with jax.set_mesh(mesh):
         got = generate(model, sharded, prompt, max_new_tokens=6)
+        host = generate(model, sharded, prompt, max_new_tokens=6,
+                        loop="host")
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(host), np.asarray(want))
 
 
 # ------------------------------------------------------- speculative decode
