@@ -118,6 +118,59 @@ def _set_cache_index(cache, value):
     return jax.tree_util.tree_map_with_path(set_leaf, cache)
 
 
+def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
+                    rng=None, eos_id=None):
+    """Yield each new token as a host numpy [B] array as soon as it is
+    decoded — the streaming form of `generate` (host-loop only: a
+    per-token readback is inherent to streaming).
+
+    Token-for-token identical to ``generate(...)`` with the same
+    arguments: the rng split order matches, so a streamed sampling run
+    reproduces the batch call.  The serving layer forwards these as
+    server-sent events (`serve`'s ``:generate`` with ``"stream": true``).
+    """
+    import numpy as np
+
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires `rng`")
+    if max_new_tokens <= 0:
+        return
+    decode_model, cache = init_cache(model, prompt.shape[0])
+    cfg = decode_model.cfg
+    if prompt.shape[1] + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq_len {cfg.max_seq_len}")
+
+    _step = _jitted_step(decode_model)
+
+    def pick(logits, rng_t):
+        if temperature > 0:
+            return jax.random.categorical(rng_t, logits / temperature,
+                                          axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    rng = rng if rng is not None else jax.random.key(0)
+    last_logits, cache = _step(params, prompt, cache)         # prefill
+    rng, sub = jax.random.split(rng)
+    tok = pick(last_logits, sub)
+    done = jnp.zeros(tok.shape, bool)
+    if eos_id is not None:
+        done = done | (tok == eos_id)
+        tok = jnp.where(done, eos_id, tok)
+    yield np.asarray(tok)
+
+    body = _jitted_decode_body(decode_model, temperature == 0,
+                               eos_id is not None)
+    temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
+    eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
+    rngs = jax.random.split(rng, max(max_new_tokens - 1, 0))
+    for t in range(max_new_tokens - 1):
+        tok, cache, done = body(params, tok, cache, done, rngs[t],
+                                temp, eos)
+        yield np.asarray(tok)
+
+
 def speculative_generate(model, params, draft_model, draft_params, prompt,
                          max_new_tokens, k=4):
     """Greedy generation with draft-model speculation — EXACTLY the tokens

@@ -276,13 +276,8 @@ class GenerateService:
         self._lock = threading.Lock()
         self.requests = 0
 
-    def generate(self, req):
-        import numpy as np
-
+    def _validate(self, req):
         import jax
-        import jax.numpy as jnp
-
-        from .models import decode
 
         inputs = req.get("inputs")
         if (not isinstance(inputs, list) or not inputs
@@ -303,7 +298,51 @@ class GenerateService:
             raise ValueError('"eos_id" must be an int')
         rng = (jax.random.key(int(req.get("seed", 0)))
                if temperature > 0 else None)
+        return inputs, max_new, temperature, eos_id, rng
 
+    def stream(self, req):
+        """Yield JSON-able events for a single-prompt generation:
+        ``{"token": t}`` per decoded token (eos-trimmed), then
+        ``{"done": true, "output": [...full sequence...]}``."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from .models import decode
+
+        # validate EAGERLY (before any response bytes): a malformed
+        # request must 400, not die mid-stream after a 200 header
+        inputs, max_new, temperature, eos_id, rng = self._validate(req)
+        if len(inputs) != 1:
+            raise ValueError('"stream": true serves exactly one prompt '
+                             "per request")
+        prompt = jnp.asarray(np.asarray(inputs, np.int32))
+        seq = list(inputs[0])
+
+        def events():
+            with self._lock:
+                for tok_arr in decode.generate_stream(
+                        self.model, self.params, prompt, max_new,
+                        temperature=temperature, rng=rng, eos_id=eos_id):
+                    tok = int(tok_arr[0])
+                    seq.append(tok)
+                    yield {"token": tok}
+                    if eos_id is not None and tok == eos_id:
+                        break           # stream ends at eos; shapes stay
+                        # static device-side, the generator is dropped
+                self.requests += 1
+            yield {"done": True, "output": seq}
+
+        return events()
+
+    def generate(self, req):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from .models import decode
+
+        inputs, max_new, temperature, eos_id, rng = self._validate(req)
         # group by prompt length: each group is one static-shape batch
         groups = {}
         for i, p in enumerate(inputs):
@@ -330,6 +369,9 @@ class GenerateService:
 
 class _Handler(BaseHTTPRequestHandler):
     service = None   # injected by make_server
+    # chunked transfer (the streaming :generate path) requires HTTP/1.1;
+    # every non-stream response sets Content-Length, so keep-alive is safe
+    protocol_version = "HTTP/1.1"
 
     def _send(self, code, payload):
         body = json.dumps(payload).encode()
@@ -366,7 +408,10 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(404, {"error": "this export is not a "
                                      "decoder LM; :generate unavailable"})
                     return
-                self._send(200, {"outputs": gen.generate(req)})
+                if req.get("stream"):
+                    self._stream_events(gen.stream(req))
+                else:
+                    self._send(200, {"outputs": gen.generate(req)})
             else:
                 preds = self.service.predict(req.get("instances"))
                 self._send(200, {"predictions": preds})
@@ -376,6 +421,33 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:   # keep the server alive on model errors
             logger.exception("predict failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _stream_events(self, events):
+        """Write newline-delimited JSON events with chunked framing, one
+        chunk per event, so clients see tokens as they decode."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data):
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for ev in events:
+                chunk(json.dumps(ev).encode() + b"\n")
+        except Exception as e:   # mid-stream: emit an error event, end clean
+            logger.exception("stream failed")
+            try:
+                chunk(json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode() + b"\n")
+            except OSError:
+                pass
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
 
     def log_message(self, fmt, *args):
         logger.debug("http: " + fmt, *args)

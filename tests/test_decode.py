@@ -241,3 +241,20 @@ def test_loop_env_var_and_validation(model_and_params, monkeypatch):
     monkeypatch.setenv("TFOS_TPU_DECODE_LOOP", "host")
     out = generate(model, params, prompt, 2)
     assert out.shape == (1, 6)
+
+
+def test_generate_stream_matches_generate(model_and_params):
+    from tensorflowonspark_tpu.models.decode import generate_stream
+
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.RandomState(6).randint(0, 64, (2, 4)), jnp.int32)
+    for kw in ({"temperature": 0.0},
+               {"temperature": 0.6, "rng": jax.random.key(9)},
+               {"temperature": 0.0, "eos_id": 5}):
+        ref = np.asarray(generate(model, params, prompt,
+                                  max_new_tokens=7, **kw))
+        toks = list(generate_stream(model, params, prompt,
+                                    max_new_tokens=7, **kw))
+        assert len(toks) == 7
+        np.testing.assert_array_equal(np.stack(toks, axis=1), ref[:, 4:])

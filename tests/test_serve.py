@@ -330,3 +330,54 @@ def test_generate_404_on_non_lm_export(server):
         urllib.request.urlopen(req)
     assert e.value.code == 404
     assert "generate" in json.loads(e.value.read())["error"]
+
+
+def test_generate_stream_matches_batch(lm_server):
+    server, service, model, params = lm_server
+    port = server.server_address[1]
+    prompts = [[1, 2, 3, 4]]
+    code, batch = _post_gen(server, "/v1/models/default:generate",
+                            {"inputs": prompts, "max_new_tokens": 6})
+    assert code == 200
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/default:generate",
+        data=json.dumps({"inputs": prompts, "max_new_tokens": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers.get("Content-Type") == "application/x-ndjson"
+        for line in r:                      # events arrive incrementally
+            events.append(json.loads(line))
+    toks = [e["token"] for e in events if "token" in e]
+    final = events[-1]
+    assert final["done"] is True
+    assert final["output"] == batch["outputs"][0]
+    assert prompts[0] + toks == final["output"]
+
+
+def test_generate_stream_sampling_reproduces_batch(lm_server):
+    server = lm_server[0]
+    port = server.server_address[1]
+    body = {"inputs": [[3, 1, 4]], "max_new_tokens": 5,
+            "temperature": 0.8, "seed": 7}
+    code, batch = _post_gen(server, "/v1/models/default:generate", body)
+    assert code == 200
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/default:generate",
+        data=json.dumps({**body, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        events = [json.loads(line) for line in r]
+    assert events[-1]["output"] == batch["outputs"][0]
+
+
+def test_generate_stream_validation_400s_before_headers(lm_server):
+    server = lm_server[0]
+    # multi-prompt and malformed streams must 400 as normal JSON errors
+    for bad in ({"inputs": [[1], [2]], "stream": True},
+                {"inputs": [], "stream": True},
+                {"inputs": [[1]], "stream": True, "max_new_tokens": 99}):
+        code, out = _post_gen(server, "/v1/models/default:generate", bad)
+        assert code == 400, (bad, out)
+        assert "error" in out
