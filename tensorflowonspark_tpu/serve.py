@@ -43,6 +43,12 @@ def build_argparser():
     p.add_argument("--signature_def_key", default=None)
     p.add_argument("--max_new_tokens_limit", type=int, default=512,
                    help="upper bound a :generate request may ask for")
+    p.add_argument("--draft_export_dir", default=None,
+                   help="a smaller decoder-LM export used as the "
+                        "speculative draft for greedy :generate requests "
+                        "(identical outputs, faster when the draft agrees)")
+    p.add_argument("--draft_k", type=int, default=4,
+                   help="draft tokens proposed per verification pass")
     p.add_argument("--input_mapping", default=None)
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
@@ -188,6 +194,8 @@ class ModelService:
         self._gen = None                # lazy GenerateService (or False =
         self._gen_lock = threading.Lock()   # probed and not a decoder LM)
         self._max_new_limit = getattr(args, "max_new_tokens_limit", 512)
+        self._draft_dir = getattr(args, "draft_export_dir", None)
+        self._draft_k = getattr(args, "draft_k", 4)
         self._batcher = None
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
@@ -216,7 +224,9 @@ class ModelService:
                 try:
                     self._gen = GenerateService(
                         self.export_dir,
-                        max_new_tokens_limit=self._max_new_limit)
+                        max_new_tokens_limit=self._max_new_limit,
+                        draft_export_dir=self._draft_dir,
+                        draft_k=self._draft_k)
                 except (TypeError, ValueError) as e:
                     logger.info(":generate unavailable: %s", e)
                     self._gen = False
@@ -250,7 +260,8 @@ class GenerateService:
     scan.
     """
 
-    def __init__(self, export_dir, max_new_tokens_limit=512):
+    @staticmethod
+    def _load_lm(export_dir):
         from . import export as export_mod
         from .models.transformer import Transformer
 
@@ -271,7 +282,19 @@ class GenerateService:
             params = jax.tree_util.tree_map(
                 lambda x: x.astype(compute)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
-        self.model, self.params = built, params
+        return built, params
+
+    def __init__(self, export_dir, max_new_tokens_limit=512,
+                 draft_export_dir=None, draft_k=4):
+        self.model, self.params = self._load_lm(export_dir)
+        self.draft_model = self.draft_params = None
+        self.draft_k = draft_k
+        if draft_export_dir:
+            # speculative decoding: greedy requests verify k draft tokens
+            # per target pass — EXACTLY the same tokens (the draft only
+            # changes speed), so no request-level opt-in is needed
+            self.draft_model, self.draft_params = \
+                self._load_lm(draft_export_dir)
         self.limit = max_new_tokens_limit
         self._lock = threading.Lock()
         self.requests = 0
@@ -348,14 +371,28 @@ class GenerateService:
         for i, p in enumerate(inputs):
             groups.setdefault(len(p), []).append(i)
         outs = [None] * len(inputs)
+        use_draft = (self.draft_model is not None and temperature == 0
+                     and eos_id is None)
         with self._lock:
             for length, idxs in sorted(groups.items()):
                 prompt = jnp.asarray(
                     np.stack([inputs[i] for i in idxs]), jnp.int32)
-                seq = decode.generate(self.model, self.params, prompt,
-                                      max_new_tokens=max_new,
-                                      temperature=temperature, rng=rng,
-                                      eos_id=eos_id)
+                if use_draft and length + max_new + self.draft_k > min(
+                        self.model.cfg.max_seq_len,
+                        self.draft_model.cfg.max_seq_len):
+                    # speculation needs k cache slots of headroom; fall
+                    # back to vanilla decode near the length limit
+                    use_draft = False
+                if use_draft:
+                    seq = decode.speculative_generate(
+                        self.model, self.params, self.draft_model,
+                        self.draft_params, prompt,
+                        max_new_tokens=max_new, k=self.draft_k)
+                else:
+                    seq = decode.generate(self.model, self.params, prompt,
+                                          max_new_tokens=max_new,
+                                          temperature=temperature, rng=rng,
+                                          eos_id=eos_id)
                 for row, i in zip(np.asarray(seq), idxs):
                     toks = row.tolist()
                     if eos_id is not None and eos_id in toks[length:]:

@@ -381,3 +381,50 @@ def test_generate_stream_validation_400s_before_headers(lm_server):
         code, out = _post_gen(server, "/v1/models/default:generate", bad)
         assert code == 400, (bad, out)
         assert "error" in out
+
+
+def test_generate_with_speculative_draft(tmp_path):
+    # a draft export changes SPEED, never tokens: greedy outputs with an
+    # unrelated draft must equal the draft-free server's
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    def export_lm(d, seed, n_layers):
+        cfg_kw = dict(vocab_size=41, d_model=16, n_heads=2, n_kv_heads=1,
+                      n_layers=n_layers, d_ff=32, max_seq_len=32,
+                      dtype="float32", rope=True, attention_impl="dense")
+        model = Transformer(TransformerConfig(**cfg_kw))
+        params = model.init(jax.random.key(seed),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        export.export_saved_model(
+            str(d), params,
+            builder="tensorflowonspark_tpu.models.transformer:"
+                    "build_transformer",
+            builder_kwargs=cfg_kw)
+        return str(d)
+
+    target = export_lm(tmp_path / "t", seed=0, n_layers=2)
+    draft = export_lm(tmp_path / "d", seed=1, n_layers=1)
+
+    def serve_and_generate(extra):
+        args = serve.build_argparser().parse_args(
+            ["--export_dir", target, "--port", "0"] + extra)
+        srv, _ = serve.make_server(args)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            code, out = _post_gen(srv, "/v1/models/default:generate",
+                                  {"inputs": [[1, 2, 3], [4, 5, 6]],
+                                   "max_new_tokens": 6})
+            assert code == 200
+            return out["outputs"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    plain = serve_and_generate([])
+    drafted = serve_and_generate(["--draft_export_dir", draft,
+                                  "--draft_k", "3"])
+    assert drafted == plain
