@@ -219,25 +219,27 @@ bool SkipField(const uint8_t* p, size_t n, size_t* pos, uint32_t wire) {
 
 // Locate `field` (length-delimited) inside message [p, p+n); returns the
 // payload span.  First occurrence wins (proto3 maps repeat entries; for
-// scalar submessages TF writes one).
-bool FindLenDelim(const uint8_t* p, size_t n, uint32_t field,
-                  const uint8_t** out, size_t* out_len, size_t start = 0) {
+// scalar submessages TF writes one).  Returns 1 found, 0 walked to the
+// end of a well-formed message without the field, -1 malformed — callers
+// must not conflate "absent" with "corrupt".
+int FindLenDelim(const uint8_t* p, size_t n, uint32_t field,
+                 const uint8_t** out, size_t* out_len, size_t start = 0) {
   size_t pos = start;
   while (pos < n) {
     uint64_t tag;
-    if (!ReadVarint(p, n, &pos, &tag)) return false;
+    if (!ReadVarint(p, n, &pos, &tag)) return -1;
     uint32_t fnum = static_cast<uint32_t>(tag >> 3);
     uint32_t wire = static_cast<uint32_t>(tag & 7);
     if (fnum == field && wire == 2) {
       uint64_t len;
-      if (!ReadVarint(p, n, &pos, &len) || len > n - pos) return false;
+      if (!ReadVarint(p, n, &pos, &len) || len > n - pos) return -1;
       *out = p + pos;
       *out_len = len;
-      return true;
+      return 1;
     }
-    if (!SkipField(p, n, &pos, wire)) return false;
+    if (!SkipField(p, n, &pos, wire)) return -1;
   }
-  return false;
+  return 0;
 }
 
 // Find the Feature message for `name` inside an Example payload.
@@ -246,7 +248,11 @@ int FindFeature(const uint8_t* ex, size_t n, const char* name,
                 size_t name_len, const uint8_t** feat, size_t* feat_len) {
   const uint8_t* feats;
   size_t feats_len;
-  if (!FindLenDelim(ex, n, 1, &feats, &feats_len)) return n ? -1 : 0;
+  int r = FindLenDelim(ex, n, 1, &feats, &feats_len);
+  if (r < 0) return -1;
+  // a well-formed Example with no `features` submessage simply has no
+  // features: "not found", not "malformed"
+  if (r == 0) return 0;
   // walk repeated map entries (field 1 of Features)
   size_t pos = 0;
   while (pos < feats_len) {
@@ -263,9 +269,18 @@ int FindFeature(const uint8_t* ex, size_t n, const char* name,
       pos += elen;
       const uint8_t* key;
       size_t key_len;
-      if (!FindLenDelim(entry, elen, 1, &key, &key_len)) continue;
+      int kr = FindLenDelim(entry, elen, 1, &key, &key_len);
+      if (kr < 0) return -1;
+      if (kr == 0) continue;  // keyless map entry: skip it
       if (key_len == name_len && std::memcmp(key, name, name_len) == 0) {
-        if (!FindLenDelim(entry, elen, 2, feat, feat_len)) return -1;
+        int vr = FindLenDelim(entry, elen, 2, feat, feat_len);
+        if (vr < 0) return -1;
+        if (vr == 0) {
+          // entry with key but no value field is proto-legal and means
+          // an empty Feature{} (present, zero values)
+          *feat = entry;
+          *feat_len = 0;
+        }
         return 1;
       }
     } else if (!SkipField(feats, feats_len, &pos, wire)) {
@@ -282,15 +297,17 @@ long DecodeNumericList(const uint8_t* feat, size_t feat_len, int kind,
                        void* out, size_t cap) {
   const uint8_t* list;
   size_t list_len;
-  if (!FindLenDelim(feat, feat_len, static_cast<uint32_t>(kind), &list,
-                    &list_len)) {
+  int lr = FindLenDelim(feat, feat_len, static_cast<uint32_t>(kind), &list,
+                        &list_len);
+  if (lr < 0) return -1;
+  if (lr == 0) {
     // empty Feature{} encodes "present with zero values" for any kind;
     // a different populated kind is a schema error
     const uint8_t* other;
     size_t other_len;
     for (uint32_t k = 1; k <= 3; ++k) {
       if (static_cast<int>(k) != kind &&
-          FindLenDelim(feat, feat_len, k, &other, &other_len))
+          FindLenDelim(feat, feat_len, k, &other, &other_len) > 0)
         return -2;
     }
     return 0;

@@ -327,6 +327,8 @@ class GenerateService:
         """Yield JSON-able events for a single-prompt generation:
         ``{"token": t}`` per decoded token (eos-trimmed), then
         ``{"done": true, "output": [...full sequence...]}``."""
+        import queue as queue_mod
+
         import numpy as np
 
         import jax.numpy as jnp
@@ -341,26 +343,59 @@ class GenerateService:
                              "per request")
         prompt = jnp.asarray(np.asarray(inputs, np.int32))
         seq = list(inputs[0])
+        # Decode runs in its own thread; the handler thread drains this
+        # queue and writes the socket OUTSIDE self._lock.  Sized to hold
+        # the entire stream (tokens + done + sentinel) so the decode loop
+        # can always run to completion and release the lock even when the
+        # client stops reading — a stalled socket wedges only its own
+        # handler thread, never other :generate requests.
+        q = queue_mod.Queue(maxsize=max_new + 2)
+        cancelled = threading.Event()
+
+        def produce():
+            try:
+                with self._lock:
+                    for tok_arr in decode.generate_stream(
+                            self.model, self.params, prompt, max_new,
+                            temperature=temperature, rng=rng, eos_id=eos_id):
+                        tok = int(tok_arr[0])
+                        seq.append(tok)
+                        q.put({"token": tok})
+                        if cancelled.is_set():
+                            # client gone: stop burning device time; shapes
+                            # stay static device-side, the loop just ends
+                            q.put(None)
+                            return
+                        if eos_id is not None and tok == eos_id:
+                            break       # stream ends at eos
+                    self.requests += 1
+                q.put({"done": True, "output": seq})
+            except Exception as e:      # surfaced as a stream error event
+                q.put(e)
+            q.put(None)                 # end-of-stream sentinel
+
+        threading.Thread(target=produce, name="generate-stream",
+                         daemon=True).start()
 
         def events():
-            with self._lock:
-                for tok_arr in decode.generate_stream(
-                        self.model, self.params, prompt, max_new,
-                        temperature=temperature, rng=rng, eos_id=eos_id):
-                    tok = int(tok_arr[0])
-                    seq.append(tok)
-                    yield {"token": tok}
-                    if eos_id is not None and tok == eos_id:
-                        break           # stream ends at eos; shapes stay
-                        # static device-side, the generator is dropped
-                self.requests += 1
-            yield {"done": True, "output": seq}
+            try:
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    if isinstance(item, Exception):
+                        raise item
+                    yield item
+            finally:
+                cancelled.set()   # consumer died/finished: tell the
+                # producer to stop decoding for a client nobody serves
 
         return events()
 
     def generate(self, req):
         import numpy as np
 
+        import jax
         import jax.numpy as jnp
 
         from .models import decode
@@ -374,7 +409,7 @@ class GenerateService:
         use_draft = (self.draft_model is not None and temperature == 0
                      and eos_id is None)
         with self._lock:
-            for length, idxs in sorted(groups.items()):
+            for g, (length, idxs) in enumerate(sorted(groups.items())):
                 prompt = jnp.asarray(
                     np.stack([inputs[i] for i in idxs]), jnp.int32)
                 if use_draft and length + max_new + self.draft_k > min(
@@ -389,9 +424,15 @@ class GenerateService:
                         self.draft_params, prompt,
                         max_new_tokens=max_new, k=self.draft_k)
                 else:
+                    # fresh key per length group (otherwise every group in
+                    # one request samples identical noise); group 0 keeps
+                    # the request key so single-group requests match the
+                    # streaming path token-for-token
+                    sub = (rng if rng is None or g == 0
+                           else jax.random.fold_in(rng, g))
                     seq = decode.generate(self.model, self.params, prompt,
                                           max_new_tokens=max_new,
-                                          temperature=temperature, rng=rng,
+                                          temperature=temperature, rng=sub,
                                           eos_id=eos_id)
                 for row, i in zip(np.asarray(seq), idxs):
                     toks = row.tolist()

@@ -224,8 +224,8 @@ class TFRecordWriter:
         if self._own:
             self._raw.close()
         if self._index is not None:
-            _write_index_sidecar(default_index_path(self._path), self._pos,
-                                 self._index[0], self._index[1])
+            _write_index_sidecar(default_index_path(self._path), self._path,
+                                 self._pos, self._index[0], self._index[1])
             self._index = None
 
     def __enter__(self):
@@ -620,9 +620,11 @@ def read_column(path, name, verify_crc=True):
 # wire format changes.
 #
 # Sidecar format (little-endian):
-#   8B   magic  b"TFRIDX1\0"
+#   8B   magic  b"TFRIDX2\0"
 #   u64  data file size when indexed   (staleness check)
 #   u64  record count N
+#   u32  data fingerprint: masked CRC32C over the data file's first and
+#        last min(64, size) bytes  (catches same-size rewrites)
 #   N*u64  payload offsets
 #   N*u64  payload lengths
 #   u32  masked CRC32C over everything after the magic
@@ -632,7 +634,7 @@ def read_column(path, name, verify_crc=True):
 # degrades to a scan, never an error.
 # --------------------------------------------------------------------------
 
-INDEX_MAGIC = b"TFRIDX1\0"
+INDEX_MAGIC = b"TFRIDX2\0"
 INDEX_SUFFIX = ".idx"
 
 
@@ -684,11 +686,29 @@ def index_records(path, verify_crc=True):
     return offsets, lengths
 
 
-def _write_index_sidecar(index_path, data_size, offsets, lengths):
+def _data_fingerprint(path, size):
+    """CRC over the data file's head+tail bytes.  Catches the rewrite the
+    size check alone cannot: a data file replaced by one of the SAME byte
+    size, which would otherwise serve wrong payloads silently under
+    verify_crc=False (two ranged reads; cheap even on remote FS)."""
+    from . import fsio
+
+    if size <= 0:
+        return 0
+    n = min(64, size)
+    with fsio.fopen(path, "rb") as f:
+        head = f.read(n)
+        f.seek(max(0, size - n))
+        tail = f.read(n)
+    return masked_crc32c(head + tail)
+
+
+def _write_index_sidecar(index_path, data_path, data_size, offsets, lengths):
     from . import fsio
 
     body = io.BytesIO()
-    body.write(struct.pack("<QQ", data_size, len(offsets)))
+    body.write(struct.pack("<QQI", data_size, len(offsets),
+                           _data_fingerprint(data_path, data_size)))
     body.write(struct.pack(f"<{len(offsets)}Q", *offsets))
     body.write(struct.pack(f"<{len(lengths)}Q", *lengths))
     payload = body.getvalue()
@@ -704,15 +724,16 @@ def write_index(path, index_path=None, verify_crc=True):
     from . import fsio
 
     offsets, lengths = index_records(path, verify_crc=verify_crc)
-    _write_index_sidecar(index_path or default_index_path(path),
+    _write_index_sidecar(index_path or default_index_path(path), path,
                          fsio.getsize(path), offsets, lengths)
     return offsets, lengths
 
 
 def read_index(path, index_path=None):
     """Load the sidecar index for `path`.  Returns (offsets, lengths), or
-    None when the sidecar is missing, corrupt, or stale (data file size
-    changed since it was written) — callers rebuild via index_records()."""
+    None when the sidecar is missing, corrupt, or stale (data file size OR
+    head/tail content fingerprint changed since it was written) — callers
+    rebuild via index_records()."""
     from . import fsio
 
     idx = index_path or default_index_path(path)
@@ -721,21 +742,23 @@ def read_index(path, index_path=None):
     try:
         with fsio.fopen(idx, "rb") as f:
             blob = f.read()
-        if len(blob) < len(INDEX_MAGIC) + 20 \
+        if len(blob) < len(INDEX_MAGIC) + 24 \
                 or blob[:len(INDEX_MAGIC)] != INDEX_MAGIC:
             return None
         payload, (crc,) = blob[8:-4], struct.unpack("<I", blob[-4:])
         if masked_crc32c(payload) != crc:
             logger.warning("ignoring corrupt index sidecar %s", idx)
             return None
-        data_size, count = struct.unpack_from("<QQ", payload, 0)
-        if 16 + 16 * count != len(payload):
+        data_size, count, fingerprint = struct.unpack_from("<QQI", payload, 0)
+        if 20 + 16 * count != len(payload):
             return None
-        if data_size != fsio.getsize(path):
+        if data_size != fsio.getsize(path) \
+                or fingerprint != _data_fingerprint(path, data_size):
             logger.info("index sidecar %s is stale; reindexing", idx)
             return None
-        offsets = list(struct.unpack_from(f"<{count}Q", payload, 16))
-        lengths = list(struct.unpack_from(f"<{count}Q", payload, 16 + 8 * count))
+        offsets = list(struct.unpack_from(f"<{count}Q", payload, 20))
+        lengths = list(
+            struct.unpack_from(f"<{count}Q", payload, 20 + 8 * count))
         return offsets, lengths
     except (OSError, struct.error):
         return None

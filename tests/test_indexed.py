@@ -88,6 +88,20 @@ def test_stale_sidecar_rejected_and_rebuilt(tmp_path):
         assert _x(r.example(7)) == 7
 
 
+def test_same_size_rewrite_detected_as_stale(tmp_path):
+    # the size check alone passes when the data file is rewritten to the
+    # SAME byte size; the content fingerprint must catch it (otherwise a
+    # verify_crc=False reader serves wrong payloads silently)
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 5, base=0, index=True)
+    size = os.path.getsize(path)
+    _write_shard(path, 5, base=5, index=False)   # keep the old sidecar
+    assert os.path.getsize(path) == size         # same size by construction
+    assert tfrecord.read_index(path) is None     # fingerprint says stale
+    with tfrecord.IndexedTFRecordFile(path, verify_crc=False) as r:
+        assert [_x(r.example(i)) for i in range(5)] == [5, 6, 7, 8, 9]
+
+
 def test_corrupt_sidecar_ignored(tmp_path):
     path = str(tmp_path / "a.tfrecord")
     _write_shard(path, 4, index=True)

@@ -372,6 +372,46 @@ def test_generate_stream_sampling_reproduces_batch(lm_server):
     assert events[-1]["output"] == batch["outputs"][0]
 
 
+def test_abandoned_stream_does_not_hold_the_decode_lock(lm_server):
+    # an events() consumer that stops reading (stalled/dead client) must
+    # not pin GenerateService._lock: decoding runs in its own thread into
+    # a queue sized for the whole stream, so the lock frees regardless
+    _, service, model, params = lm_server
+    gen = service.generate_service()
+    ev = gen.stream({"inputs": [[1, 2, 3]], "max_new_tokens": 4})
+    assert "token" in next(ev)          # stream started, then abandoned
+    res = {}
+    t = threading.Thread(
+        target=lambda: res.update(
+            out=gen.generate({"inputs": [[4, 5]], "max_new_tokens": 2})),
+        daemon=True)
+    t.start()
+    t.join(timeout=90)
+    assert "out" in res, "generate blocked behind an abandoned stream"
+    assert len(res["out"][0]) == 4
+
+
+def test_generate_groups_sample_independent_noise(lm_server):
+    # two length groups in one sampled request must not start from the
+    # identical key (duplicated noise); group 0 keeps the request key so
+    # solo requests and streams stay reproducible
+    server = lm_server[0]
+    body = {"inputs": [[5, 6], [1, 2, 3]], "max_new_tokens": 6,
+            "temperature": 1.5, "seed": 11}
+    code, both = _post_gen(server, "/v1/models/default:generate", body)
+    assert code == 200
+    code, solo0 = _post_gen(server, "/v1/models/default:generate",
+                            {"inputs": [[5, 6]], "max_new_tokens": 6,
+                             "temperature": 1.5, "seed": 11})
+    assert code == 200
+    assert both["outputs"][0] == solo0["outputs"][0]
+    code, solo1 = _post_gen(server, "/v1/models/default:generate",
+                            {"inputs": [[1, 2, 3]], "max_new_tokens": 6,
+                             "temperature": 1.5, "seed": 11})
+    assert code == 200
+    assert both["outputs"][1] != solo1["outputs"][0]
+
+
 def test_generate_stream_validation_400s_before_headers(lm_server):
     server = lm_server[0]
     # multi-prompt and malformed streams must 400 as normal JSON errors

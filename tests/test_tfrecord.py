@@ -160,6 +160,17 @@ class TestReadColumn:
         with pytest.raises(IOError, match="missing"):
             tfrecord.read_column(p, "y")
 
+    def test_record_without_features_field_reports_missing(self, tmp_path):
+        # a well-formed Example whose `features` (field 1) submessage is
+        # simply absent is a MISSING feature (-7), not a malformed
+        # payload (-9) — proto presence is optional
+        p = str(tmp_path / "a.tfrecord")
+        with tfrecord.TFRecordWriter(p) as w:
+            w.write(tfrecord.encode_example({"x": [1.0]}))
+            w.write(b"\x12\x00")   # only an unknown field 2; no features
+        with pytest.raises(IOError, match="missing"):
+            tfrecord.read_column(p, "x")
+
     def test_bytes_feature_rejected(self, tmp_path):
         p = str(tmp_path / "a.tfrecord")
         self._write(p)
