@@ -298,6 +298,14 @@ class GenerateService:
         self.limit = max_new_tokens_limit
         self._lock = threading.Lock()
         self.requests = 0
+        # warm the loop-driver probe at LOAD time (service construction is
+        # already the slow path): the first :generate request must not pay
+        # two probe compiles while holding self._lock
+        import os
+
+        from .models import decode
+        if os.environ.get("TFOS_TPU_DECODE_LOOP") is None:
+            decode.probe_loop_driver()
 
     def _validate(self, req):
         import jax

@@ -635,6 +635,7 @@ def read_column(path, name, verify_crc=True):
 # --------------------------------------------------------------------------
 
 INDEX_MAGIC = b"TFRIDX2\0"
+_INDEX_MAGIC_V1 = b"TFRIDX1\0"   # still readable: size-only staleness
 INDEX_SUFFIX = ".idx"
 
 
@@ -742,23 +743,34 @@ def read_index(path, index_path=None):
     try:
         with fsio.fopen(idx, "rb") as f:
             blob = f.read()
-        if len(blob) < len(INDEX_MAGIC) + 24 \
-                or blob[:len(INDEX_MAGIC)] != INDEX_MAGIC:
+        magic = blob[:len(INDEX_MAGIC)]
+        v1 = magic == _INDEX_MAGIC_V1   # pre-fingerprint sidecars stay
+        # readable with their original (size-only) staleness semantics —
+        # a format bump must not degrade existing datasets to full scans
+        if len(blob) < len(INDEX_MAGIC) + (20 if v1 else 24) \
+                or (magic != INDEX_MAGIC and not v1):
             return None
         payload, (crc,) = blob[8:-4], struct.unpack("<I", blob[-4:])
         if masked_crc32c(payload) != crc:
             logger.warning("ignoring corrupt index sidecar %s", idx)
             return None
-        data_size, count, fingerprint = struct.unpack_from("<QQI", payload, 0)
-        if 20 + 16 * count != len(payload):
+        header = 16 if v1 else 20
+        if v1:
+            data_size, count = struct.unpack_from("<QQ", payload, 0)
+            fingerprint = None
+        else:
+            data_size, count, fingerprint = struct.unpack_from(
+                "<QQI", payload, 0)
+        if header + 16 * count != len(payload):
             return None
-        if data_size != fsio.getsize(path) \
-                or fingerprint != _data_fingerprint(path, data_size):
+        if data_size != fsio.getsize(path) or (
+                fingerprint is not None
+                and fingerprint != _data_fingerprint(path, data_size)):
             logger.info("index sidecar %s is stale; reindexing", idx)
             return None
-        offsets = list(struct.unpack_from(f"<{count}Q", payload, 20))
+        offsets = list(struct.unpack_from(f"<{count}Q", payload, header))
         lengths = list(
-            struct.unpack_from(f"<{count}Q", payload, 20 + 8 * count))
+            struct.unpack_from(f"<{count}Q", payload, header + 8 * count))
         return offsets, lengths
     except (OSError, struct.error):
         return None

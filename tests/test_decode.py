@@ -243,6 +243,54 @@ def test_loop_env_var_and_validation(model_and_params, monkeypatch):
     assert out.shape == (1, 6)
 
 
+def test_auto_probe_measures_and_caches(monkeypatch):
+    from tensorflowonspark_tpu.models import decode
+
+    monkeypatch.setattr(decode, "_LOOP_PROBE", {})
+    verdict = decode.probe_loop_driver()
+    assert verdict in ("scan", "host")
+    platform = jax.devices()[0].platform
+    assert decode._LOOP_PROBE[platform] == verdict
+    # cached: a second call must not re-measure (poison the timer)
+    import time
+
+    def boom():
+        raise AssertionError("re-measured a cached platform")
+    monkeypatch.setattr(time, "perf_counter", boom)
+    assert decode.probe_loop_driver() == verdict
+
+
+def test_auto_uses_probe_verdict_both_ways(model_and_params, monkeypatch):
+    from tensorflowonspark_tpu.models import decode
+
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.RandomState(8).randint(0, 64, (1, 3)), jnp.int32)
+    monkeypatch.delenv("TFOS_TPU_DECODE_LOOP", raising=False)
+    ref = np.asarray(generate(model, params, prompt, 4, loop="scan"))
+    platform = jax.devices()[0].platform
+    for forced in ("scan", "host"):
+        monkeypatch.setattr(decode, "_LOOP_PROBE", {platform: forced})
+        got = generate(model, params, prompt, 4)   # loop="auto" default
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+def test_env_var_overrides_probe(model_and_params, monkeypatch):
+    from tensorflowonspark_tpu.models import decode
+
+    model, params = model_and_params
+
+    def boom(platform=None):
+        raise AssertionError("probe must not run when the env var is set")
+    monkeypatch.setattr(decode, "probe_loop_driver", boom)
+    monkeypatch.setenv("TFOS_TPU_DECODE_LOOP", "scan")
+    out = generate(model, params, jnp.zeros((1, 4), jnp.int32), 2)
+    assert out.shape == (1, 6)
+    monkeypatch.delenv("TFOS_TPU_DECODE_LOOP")
+    with pytest.raises(AssertionError, match="probe must not run"):
+        generate(model, params, jnp.zeros((1, 4), jnp.int32), 2)
+
+
 def test_generate_stream_matches_generate(model_and_params):
     from tensorflowonspark_tpu.models.decode import generate_stream
 

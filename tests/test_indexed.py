@@ -88,6 +88,31 @@ def test_stale_sidecar_rejected_and_rebuilt(tmp_path):
         assert _x(r.example(7)) == 7
 
 
+def test_v1_sidecar_still_readable(tmp_path):
+    # pre-fingerprint (TFRIDX1) sidecars written by earlier releases must
+    # keep loading with their original size-only staleness semantics — a
+    # format bump must not degrade existing datasets to full scans
+    import io
+    import struct
+    path = str(tmp_path / "a.tfrecord")
+    _write_shard(path, 6, index=False)
+    offs, lens = tfrecord.index_records(path)
+    body = io.BytesIO()
+    body.write(struct.pack("<QQ", os.path.getsize(path), len(offs)))
+    body.write(struct.pack(f"<{len(offs)}Q", *offs))
+    body.write(struct.pack(f"<{len(lens)}Q", *lens))
+    payload = body.getvalue()
+    with open(tfrecord.default_index_path(path), "wb") as f:
+        f.write(b"TFRIDX1\0" + payload +
+                struct.pack("<I", tfrecord.masked_crc32c(payload)))
+    assert tfrecord.read_index(path) == (offs, lens)
+    # v1 staleness check still applies (size change -> rebuild)
+    with open(path, "ab") as f:
+        tfrecord.TFRecordWriter(f).write(
+            tfrecord.encode_example({"x": 6, "name": [b"r6"]}))
+    assert tfrecord.read_index(path) is None
+
+
 def test_same_size_rewrite_detected_as_stale(tmp_path):
     # the size check alone passes when the data file is rewritten to the
     # SAME byte size; the content fingerprint must catch it (otherwise a
