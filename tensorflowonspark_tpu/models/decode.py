@@ -366,6 +366,9 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     - ``"auto"`` (default) — the ``TFOS_TPU_DECODE_LOOP`` env var when
       set (``scan``/``host``); otherwise a one-time measured probe of
       this runtime picks the faster driver (`probe_loop_driver`).
+      Generations shorter than 16 tokens never trigger the probe (they
+      cost less than the measurement); they use the cached verdict when
+      one exists, else ``scan``.
     """
     import os
 
@@ -376,7 +379,16 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     if loop == "auto":
         loop = os.environ.get("TFOS_TPU_DECODE_LOOP")
         if loop is None:
-            loop = probe_loop_driver()
+            cached = _LOOP_PROBE.get(jax.devices()[0].platform)
+            if cached is not None:
+                loop = cached
+            elif max_new_tokens >= 16:
+                loop = probe_loop_driver()
+            else:
+                # a short generation costs less than the probe itself;
+                # take the idiomatic default until someone pays for a
+                # long run (or warms the probe explicitly, as serve does)
+                loop = "scan"
         elif loop not in ("scan", "host"):
             raise ValueError(
                 f"TFOS_TPU_DECODE_LOOP={loop!r} not in ('scan', 'host')")

@@ -280,15 +280,20 @@ def test_env_var_overrides_probe(model_and_params, monkeypatch):
 
     model, params = model_and_params
 
-    def boom(platform=None):
-        raise AssertionError("probe must not run when the env var is set")
+    def boom():
+        raise AssertionError("probe must not run here")
     monkeypatch.setattr(decode, "probe_loop_driver", boom)
+    monkeypatch.setattr(decode, "_LOOP_PROBE", {})
     monkeypatch.setenv("TFOS_TPU_DECODE_LOOP", "scan")
+    out = generate(model, params, jnp.zeros((1, 4), jnp.int32), 16)
+    assert out.shape == (1, 20)
+    monkeypatch.delenv("TFOS_TPU_DECODE_LOOP")
+    # short generations skip the probe too (cheaper than measuring)
     out = generate(model, params, jnp.zeros((1, 4), jnp.int32), 2)
     assert out.shape == (1, 6)
-    monkeypatch.delenv("TFOS_TPU_DECODE_LOOP")
+    # long ones with no env var and no cached verdict DO probe
     with pytest.raises(AssertionError, match="probe must not run"):
-        generate(model, params, jnp.zeros((1, 4), jnp.int32), 2)
+        generate(model, params, jnp.zeros((1, 4), jnp.int32), 16)
 
 
 def test_generate_stream_matches_generate(model_and_params):
