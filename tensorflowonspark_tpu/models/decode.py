@@ -104,6 +104,114 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
     return body
 
 
+# --------------------------------------------------------------- slots ----
+# Continuous-batching primitives: a `decode_slots=True` model keeps a
+# PER-ROW cache_index, so every batch row is an independent serving slot.
+# New requests prefill into a free row while the other rows keep decoding;
+# finished rows retire at token boundaries (serve.ContinuousBatcher drives
+# these).  Net-new beyond the reference (its serving is batch forward
+# only, TFModel.scala:245-292).
+
+def init_slot_cache(model_or_cfg, n_slots):
+    """Build the slot-decode model + empty cache with `n_slots` rows."""
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg = (model_or_cfg.cfg if isinstance(model_or_cfg, Transformer)
+           else model_or_cfg)
+    if not isinstance(cfg, TransformerConfig):
+        raise TypeError(f"expected Transformer or TransformerConfig, "
+                        f"got {type(model_or_cfg)}")
+    slot_model = Transformer(
+        dataclasses.replace(cfg, decode=True, decode_slots=True))
+    shapes = jax.eval_shape(
+        lambda: slot_model.init(jax.random.key(0),
+                                jnp.zeros((n_slots, 1), jnp.int32)))
+    cache = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), shapes["cache"])
+    return slot_model, cache
+
+
+def _reset_row_indices(row_cache, value):
+    """Set every per-row index leaf (cache_index / pos_index) of a sliced
+    single-row cache to `value`."""
+    value = jnp.asarray(value, jnp.int32)
+
+    def set_leaf(path, leaf):
+        last = path[-1]
+        name = getattr(last, "key", getattr(last, "name", None))
+        if name in ("cache_index", "pos_index"):
+            return jnp.full(leaf.shape, value, jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(set_leaf, row_cache)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_prefill(slot_model):
+    """Prefill ONE slot row: slice row `row` out of the batch cache, run
+    the prompt block through it from position 0, write the row back.
+    `prompt` is bucket-padded to a static length; `true_len` (traced) is
+    the real prompt length — the returned logits are the position
+    `true_len - 1` distribution and the row index rewinds to `true_len`,
+    so the pad tail is never visible to later steps."""
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, cache, prompt, row, true_len):
+        row_cache = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_slice_in_dim(a, row, 1, 0), cache)
+        row_cache = _reset_row_indices(row_cache, 0)
+        logits, mut = slot_model.apply(
+            {"params": params, "cache": row_cache}, prompt,
+            mutable=["cache"])
+        new_row = _reset_row_indices(mut["cache"], true_len)
+        cache = jax.tree_util.tree_map(
+            lambda full, upd: jax.lax.dynamic_update_slice_in_dim(
+                full, upd, row, 0), cache, new_row)
+        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, 1)
+        return last[:, 0], cache          # [1, V], updated batch cache
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_step(slot_model):
+    """One decode step over ALL slots: feed each row its current token,
+    per-row greedy/sampled pick (`temps[b] == 0` = greedy).
+
+    The rng is CARRIED device-side (split inside the step and returned)
+    so the serving loop issues exactly ONE dispatch per token — on
+    tunneled runtimes every extra per-step device op (a host fold_in, an
+    h2d of tokens) costs a full round trip (measured ~200 ms/step with
+    naive per-step host traffic vs ~20 ms with the resident chain)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(params, cache, toks, temps, rng):
+        rng_out, rng_use = jax.random.split(rng)
+        logits, mut = slot_model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            mutable=["cache"])
+        logits = logits[:, -1]
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(
+            rng_use, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+        return jnp.where(temps > 0, sampled, greedy), mut["cache"], rng_out
+
+    return step
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_set_row(slot_model):
+    """Tiny device update used at slot joins: place the joining request's
+    first token / temperature into row `row` of the resident arrays."""
+
+    @jax.jit
+    def set_row(toks, temps, row, tok, temp):
+        return toks.at[row].set(tok), temps.at[row].set(temp)
+
+    return set_row
+
+
 _LOOP_PROBE = {}    # platform name -> measured "scan" | "host" verdict
 _LOOP_PROBE_LOCK = threading.Lock()   # one measurement at a time: racing
 # probes would contend on the device and could cache a skewed verdict

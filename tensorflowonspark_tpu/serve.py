@@ -49,6 +49,11 @@ def build_argparser():
                         "(identical outputs, faster when the draft agrees)")
     p.add_argument("--draft_k", type=int, default=4,
                    help="draft tokens proposed per verification pass")
+    p.add_argument("--generate_slots", type=int, default=0,
+                   help=">0 enables continuous batching for :generate — "
+                        "this many decode slots; concurrent requests join "
+                        "the in-flight batch at token boundaries "
+                        "(mutually exclusive with --draft_export_dir)")
     p.add_argument("--input_mapping", default=None)
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
@@ -196,6 +201,7 @@ class ModelService:
         self._max_new_limit = getattr(args, "max_new_tokens_limit", 512)
         self._draft_dir = getattr(args, "draft_export_dir", None)
         self._draft_k = getattr(args, "draft_k", 4)
+        self._gen_slots = getattr(args, "generate_slots", 0) or 0
         self._batcher = None
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
@@ -226,7 +232,7 @@ class ModelService:
                         self.export_dir,
                         max_new_tokens_limit=self._max_new_limit,
                         draft_export_dir=self._draft_dir,
-                        draft_k=self._draft_k)
+                        draft_k=self._draft_k, slots=self._gen_slots)
                 except (TypeError, ValueError) as e:
                     logger.info(":generate unavailable: %s", e)
                     self._gen = False
@@ -242,7 +248,261 @@ class ModelService:
         if self._gen is not None:      # only report once probed (lazily)
             out["model"]["generate"] = ("available" if self._gen
                                         else "unavailable")
+            if self._gen and self._gen.batcher is not None:
+                out["model"]["generate_slots"] = self._gen.batcher.n_slots
         return out
+
+
+class SlotHandle:
+    """One in-flight generation in the continuous batcher: tokens stream
+    into `.tokens` as they decode; `.result()` blocks for the full
+    sequence."""
+
+    def __init__(self, prompt):
+        import queue as queue_mod
+
+        self.prompt = list(prompt)
+        self.tokens = queue_mod.Queue()   # ints, then None sentinel
+        self.cancelled = threading.Event()
+        self._done = threading.Event()
+        self._seq = None
+        self._err = None
+
+    def cancel(self):
+        """Stop decoding for this request (client gone): the batcher
+        retires its slot at the next readback boundary."""
+        self.cancelled.set()
+
+    def _finish(self, seq):
+        self._seq = seq
+        self._done.set()
+        self.tokens.put(None)
+
+    def _fail(self, err):
+        self._err = err
+        self._done.set()
+        self.tokens.put(None)
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation did not complete in time")
+        if self._err is not None:
+            raise self._err
+        return self._seq
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over the per-row kv cache
+    (models.decode `decode_slots`): new requests PREFILL into a free slot
+    at a token boundary while the other slots keep decoding; finished
+    slots retire immediately.  The device runs one fused step per token
+    for the whole slot batch, so N concurrent streams cost ~one stream's
+    step rate (batching is near-free: BASELINE.md round 3 measured B8 at
+    ~1.3x the B1 step cost) instead of running back-to-back.
+
+    Greedy decoding is token-identical to `decode.generate`; sampled
+    requests draw per-row from a per-step key (a different noise schedule
+    than a solo run — documented serving semantics).  Net-new beyond the
+    reference (no generation serving there at all).
+    """
+
+    def __init__(self, model, params, n_slots=8, max_pending=1024,
+                 read_chunk=8, seed=0):
+        import queue as queue_mod
+
+        import jax
+        import jax.numpy as jnp
+
+        from .models import decode as decode_mod
+
+        self.model, self.params = model, params
+        self.slot_model, self._cache = decode_mod.init_slot_cache(model,
+                                                                  n_slots)
+        self._prefill = decode_mod._jitted_slot_prefill(self.slot_model)
+        self._step = decode_mod._jitted_slot_step(self.slot_model)
+        self._set_row = decode_mod._jitted_set_row(self.slot_model)
+        self.n_slots = n_slots
+        self.max_seq = self.slot_model.cfg.max_seq_len
+        self.read_chunk = max(1, read_chunk)
+        self._pending = queue_mod.Queue(max_pending)
+        self._slots = [None] * n_slots
+        self._gen = [0] * n_slots      # occupant generation per row: tokens
+        # decoded for a previous occupant must never reach a new one
+        # device-resident chains: ONE dispatch per decoded token
+        self._toks = jnp.zeros((n_slots,), jnp.int32)
+        self._temps = jnp.zeros((n_slots,), jnp.float32)
+        self._rng = jax.random.key(seed)
+        self._steps = 0
+        self._dead = None     # set to the fatal exception if the loop dies
+        self.requests = 0
+        threading.Thread(target=self._loop, name="slot-batcher",
+                         daemon=True).start()
+
+    def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0):
+        if self._dead is not None:
+            raise RuntimeError(f"batcher died: {self._dead}")
+        if len(prompt) + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new} exceeds "
+                f"max_seq_len {self.max_seq}")
+        h = SlotHandle(prompt)
+        self._pending.put((h, list(prompt), max_new, float(temperature),
+                           eos_id, int(seed)))
+        if self._dead is not None:
+            # the loop may have died between the check above and the put
+            # (its death-drain already ran): fail whatever is queued,
+            # including our own item, so no handler blocks forever
+            self._drain_pending(RuntimeError(f"batcher died: {self._dead}"))
+        return h
+
+    def _drain_pending(self, err):
+        import queue as queue_mod
+
+        while True:
+            try:
+                item = self._pending.get_nowait()
+            except queue_mod.Empty:
+                return
+            item[0]._fail(err)
+
+    # ---- device loop (single driver thread owns the cache) --------------
+
+    def _pick_first(self, logits_row, temperature, seed):
+        import jax
+        import jax.numpy as jnp
+
+        if temperature > 0:
+            return int(jax.random.categorical(
+                jax.random.fold_in(jax.random.key(seed), 0),
+                logits_row / temperature))
+        return int(jnp.argmax(logits_row))
+
+    def _do_prefill(self, row, item):
+        import jax.numpy as jnp
+
+        h, prompt, max_new, temp, eos_id, seed = item
+        if h.cancelled.is_set():        # client gone before admission
+            h._finish(list(prompt))
+            return
+        L = len(prompt)
+        bucket = min(max(8, 1 << (L - 1).bit_length()), self.max_seq)
+        padded = prompt + [0] * (bucket - L)
+        logits, self._cache = self._prefill(
+            self.params, self._cache, jnp.asarray([padded], jnp.int32),
+            jnp.asarray(row, jnp.int32), jnp.asarray(L, jnp.int32))
+        tok = self._pick_first(logits[0], temp, seed)
+        h.tokens.put(tok)
+        seq = prompt + [tok]
+        if max_new <= 1 or (eos_id is not None and tok == eos_id):
+            h._finish(seq)
+            self.requests += 1
+            return
+        self._gen[row] += 1
+        self._toks, self._temps = self._set_row(
+            self._toks, self._temps, jnp.asarray(row, jnp.int32),
+            jnp.asarray(tok, jnp.int32), jnp.asarray(temp, jnp.float32))
+        self._slots[row] = {"handle": h, "seq": seq,
+                            "remaining": max_new - 1, "temp": temp,
+                            "eos": eos_id}
+
+    def _admit(self, block=False):
+        import queue as queue_mod
+
+        for row in range(self.n_slots):
+            if self._slots[row] is not None:
+                continue
+            try:
+                item = self._pending.get(timeout=0.05 if block else 0)
+            except queue_mod.Empty:
+                return
+            self._do_prefill(row, item)
+            block = False    # only the first admit may block (idle wake)
+
+    def _process_batch(self, batch):
+        """One arrived [k, n_slots] token block -> emissions/retires, in
+        dispatch order.  `batch` is (stacked_dev, [gen_snapshot per step])
+        whose host copy was started earlier (copy_to_host_async), so the
+        np.asarray here is usually free."""
+        import numpy as np
+
+        stacked, gens_list = batch
+        block = np.asarray(stacked)
+        for gens, row_toks in zip(gens_list, block):
+            for r, s in enumerate(self._slots):
+                if s is None or self._gen[r] != gens[r]:
+                    continue      # freed or re-occupied since dispatch
+                if s["handle"].cancelled.is_set():
+                    # client gone: stop burning device time on this slot
+                    s["handle"]._finish(s["seq"])
+                    self.requests += 1
+                    self._slots[r] = None
+                    continue
+                tok = int(row_toks[r])
+                s["seq"].append(tok)
+                s["remaining"] -= 1
+                s["handle"].tokens.put(tok)
+                if s["remaining"] <= 0 or (s["eos"] is not None
+                                           and tok == s["eos"]):
+                    s["handle"]._finish(s["seq"])
+                    self.requests += 1
+                    self._slots[r] = None   # row frees; steps already in
+                    # flight for it decode garbage that _gen filters out
+
+    def _loop(self):
+        import jax.numpy as jnp
+
+        try:
+            reads = []       # dispatched this chunk: [(nxt_dev, gens)]
+            inflight = None  # previous chunk, host copy in progress
+            while True:
+                idle = (all(s is None for s in self._slots)
+                        and not reads and inflight is None)
+                self._admit(block=idle)
+                active = any(s is not None for s in self._slots)
+                if active:
+                    # ONE dispatch: token/rng/temp chains stay on device
+                    nxt, self._cache, self._rng = self._step(
+                        self.params, self._cache, self._toks, self._temps,
+                        self._rng)
+                    self._toks = nxt
+                    self._steps += 1
+                    reads.append((nxt, tuple(self._gen)))
+                # Readback protocol (measured on the tunneled runtime:
+                # per-token sync d2h ~200 ms regardless of size): stack a
+                # chunk, START its host copy asynchronously, and process
+                # the PREVIOUS chunk — whose copy has been riding under
+                # this chunk's compute and is now free to read.  Steps
+                # may overshoot a retiring slot by up to ~2 chunks; the
+                # generation filter drops those tokens and the masked
+                # cache write makes out-of-range positions no-ops.
+                flush = reads and (
+                    len(reads) >= self.read_chunk
+                    or not active
+                    or min((s["remaining"] for s in self._slots
+                            if s is not None), default=0) <= len(reads))
+                if flush:
+                    stacked = jnp.stack([r[0] for r in reads])
+                    gens = [r[1] for r in reads]
+                    try:
+                        stacked.copy_to_host_async()
+                    except Exception:
+                        pass             # not all backends support it
+                    prev, inflight = inflight, (stacked, gens)
+                    reads = []
+                    if prev is not None:
+                        self._process_batch(prev)
+                elif inflight is not None and not active and not reads:
+                    # nothing more to dispatch: drain the in-flight chunk
+                    self._process_batch(inflight)
+                    inflight = None
+        except BaseException as e:     # device failure: fail everything
+            logger.exception("continuous batcher died")
+            self._dead = e
+            for s in self._slots:
+                if s is not None:
+                    s["handle"]._fail(e)
+            self._slots = [None] * self.n_slots
+            self._drain_pending(e)
 
 
 class GenerateService:
@@ -285,16 +545,22 @@ class GenerateService:
         return built, params
 
     def __init__(self, export_dir, max_new_tokens_limit=512,
-                 draft_export_dir=None, draft_k=4):
+                 draft_export_dir=None, draft_k=4, slots=0):
         self.model, self.params = self._load_lm(export_dir)
         self.draft_model = self.draft_params = None
         self.draft_k = draft_k
+        if slots and draft_export_dir:
+            raise ValueError("--generate_slots and --draft_export_dir are "
+                             "mutually exclusive (speculation verifies "
+                             "whole blocks; slots retire per token)")
         if draft_export_dir:
             # speculative decoding: greedy requests verify k draft tokens
             # per target pass — EXACTLY the same tokens (the draft only
             # changes speed), so no request-level opt-in is needed
             self.draft_model, self.draft_params = \
                 self._load_lm(draft_export_dir)
+        self.batcher = (ContinuousBatcher(self.model, self.params,
+                                          n_slots=slots) if slots else None)
         self.limit = max_new_tokens_limit
         self._lock = threading.Lock()
         self.requests = 0
@@ -349,6 +615,25 @@ class GenerateService:
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
+        if self.batcher is not None:
+            h = self.batcher.submit(inputs[0], max_new,
+                                    temperature=temperature, eos_id=eos_id,
+                                    seed=int(req.get("seed", 0)))
+
+            def slot_events():
+                try:
+                    while True:
+                        tok = h.tokens.get()
+                        if tok is None:
+                            break
+                        yield {"token": tok}
+                    yield {"done": True, "output": h.result()}
+                finally:
+                    # consumer died/finished: free the slot instead of
+                    # decoding to max_new for a client nobody serves
+                    h.cancel()
+
+            return slot_events()
         prompt = jnp.asarray(np.asarray(inputs, np.int32))
         seq = list(inputs[0])
         # Decode runs in its own thread; the handler thread drains this
@@ -409,6 +694,19 @@ class GenerateService:
         from .models import decode
 
         inputs, max_new, temperature, eos_id, rng = self._validate(req)
+        if self.batcher is not None:
+            # continuous batching: every prompt becomes a slot request;
+            # they decode concurrently with each other AND with other
+            # HTTP requests' prompts (no service lock on this path — the
+            # batcher's driver thread owns the device)
+            seed = int(req.get("seed", 0))
+            handles = [self.batcher.submit(p, max_new,
+                                           temperature=temperature,
+                                           eos_id=eos_id, seed=seed + i)
+                       for i, p in enumerate(inputs)]
+            outs = [h.result(timeout=600) for h in handles]
+            self.requests += 1
+            return outs
         # group by prompt length: each group is one static-shape batch
         groups = {}
         for i, p in enumerate(inputs):
@@ -541,6 +839,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(args):
     """Build (server, service); caller runs serve_forever()."""
+    # fail FAST on invalid combinations: GenerateService is constructed
+    # lazily on the first :generate request, where a config error would
+    # otherwise be swallowed by the is-this-a-decoder-LM probe and turn
+    # into a misleading 404
+    if getattr(args, "generate_slots", 0) and \
+            getattr(args, "draft_export_dir", None):
+        raise ValueError("--generate_slots and --draft_export_dir are "
+                         "mutually exclusive (speculation verifies whole "
+                         "blocks; slots retire per token)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
     server = ThreadingHTTPServer((args.host, args.port), handler)

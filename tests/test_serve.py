@@ -468,3 +468,135 @@ def test_generate_with_speculative_draft(tmp_path):
     drafted = serve_and_generate(["--draft_export_dir", draft,
                                   "--draft_k", "3"])
     assert drafted == plain
+
+
+# ------------------------------------------------- continuous batching
+
+@pytest.fixture(scope="module")
+def slot_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve_slots")
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=41, d_model=16, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=32, max_seq_len=64, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export.export_saved_model(
+        str(tmp / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp / "lm"), "--port", "0",
+         "--max_new_tokens_limit", "16", "--generate_slots", "4"])
+    server, service = serve.make_server(args)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server, service, model, params
+    server.shutdown()
+
+
+def test_slots_greedy_matches_decode(slot_server):
+    server, service, model, params = slot_server
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import decode
+
+    prompts = [[1, 2, 3, 4], [9, 8], [5, 5, 5]]
+    code, out = _post_gen(server, "/v1/models/default:generate",
+                          {"inputs": prompts, "max_new_tokens": 6})
+    assert code == 200
+    meta = service.metadata()
+    assert meta["model"]["generate_slots"] == 4
+    for p, got in zip(prompts, out["outputs"]):
+        ref = decode.generate(model, params,
+                              jnp.asarray([p], jnp.int32),
+                              max_new_tokens=6, loop="host")
+        assert got == np.asarray(ref)[0].tolist()
+
+
+def test_slots_concurrent_requests_interleave(slot_server):
+    # more concurrent requests than one request's prompts: they join the
+    # SAME in-flight batch; every result must still be exact
+    import concurrent.futures as cf
+
+    server, service, model, params = slot_server
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import decode
+
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+
+    def one(p):
+        code, out = _post_gen(server, "/v1/models/default:generate",
+                              {"inputs": [p], "max_new_tokens": 8})
+        assert code == 200
+        return out["outputs"][0]
+
+    with cf.ThreadPoolExecutor(6) as ex:
+        results = list(ex.map(one, prompts))
+    for p, got in zip(prompts, results):
+        ref = decode.generate(model, params, jnp.asarray([p], jnp.int32),
+                              max_new_tokens=8, loop="host")
+        assert got == np.asarray(ref)[0].tolist()
+
+
+def test_slots_eos_and_stream(slot_server):
+    server, service, model, params = slot_server
+    port = server.server_address[1]
+    # find the greedy token after [7, 7] so we can use it as eos
+    code, out = _post_gen(server, "/v1/models/default:generate",
+                          {"inputs": [[7, 7]], "max_new_tokens": 4})
+    assert code == 200
+    eos = out["outputs"][0][2]
+    code, out2 = _post_gen(server, "/v1/models/default:generate",
+                           {"inputs": [[7, 7]], "max_new_tokens": 8,
+                            "eos_id": eos})
+    assert code == 200
+    assert out2["outputs"][0] == [7, 7, eos]    # retires at eos
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/default:generate",
+        data=json.dumps({"inputs": [[1, 2, 3]], "max_new_tokens": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        events = [json.loads(line) for line in r]
+    toks = [e["token"] for e in events if "token" in e]
+    assert len(toks) == 6
+    assert events[-1]["output"] == [1, 2, 3] + toks
+
+
+def test_slots_reject_draft_combo(monkeypatch):
+    # speculation verifies whole blocks; slots retire per token — the two
+    # must refuse to combine rather than silently ignore one
+    monkeypatch.setattr(serve.GenerateService, "_load_lm",
+                        staticmethod(lambda d: (None, None)))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        serve.GenerateService("x", draft_export_dir="y", slots=4)
+    # and the server must fail at STARTUP, not turn the error into a
+    # lazy-probe 404 on the first :generate request
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", "x", "--port", "0", "--generate_slots", "4",
+         "--draft_export_dir", "y"])
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        serve.make_server(args)
+
+
+def test_slots_cancel_frees_slot(slot_server):
+    # an abandoned stream must retire its slot at the next readback
+    # boundary instead of decoding to max_new for a dead client
+    _, service, model, params = slot_server
+    gen = service.generate_service()
+    h = gen.batcher.submit([1, 2, 3], 16)
+    assert h.tokens.get(timeout=60) is not None   # decoding started
+    h.cancel()
+    seq = h.result(timeout=60)                    # finishes early
+    assert len(seq) < 3 + 16
+    # the batcher keeps serving new requests afterwards
+    out = gen.batcher.submit([4, 5], 4).result(timeout=120)
+    assert len(out) == 6
