@@ -1,0 +1,378 @@
+"""Overlapped cluster-fed training: the integrated data-plane proof.
+
+Round-3 verdict: every stage was measured separately (ring ~220-320 MB/s,
+norm-free ResNet 3,082 img/s) but no single run showed minispark executors
+-> shm ring -> DataFeed.next_numpy_batch -> device_prefetch -> jitted
+donated train step all CONCURRENT, with the bottleneck attributed.  This
+script is that run (reference: the path that IS the product,
+/root/reference/tensorflowonspark/TFSparkNode.py:460-515):
+
+  - a minispark SparkContext (real separated executor processes) runs an
+    image-generating RDD through `cluster.train` (InputMode.SPARK);
+  - the training node (background process, its own TPU/CPU device) pulls
+    batches off the shm ring via DataFeed, keeps `depth` host->HBM
+    transfers in flight (device_prefetch), and drives a donated jitted
+    ResNet train step;
+  - the SAME process then re-times the step feed-free (one resident
+    device batch) and reports fed/feed-free throughput, the host loop's
+    measured feed-wait, and an optional JAX profiler trace.
+
+Done-criterion: feed-wait ~ 0 and fed throughput within ~10% of the
+feed-free number — then the step, not the feed, is the bottleneck.
+
+    python scripts/bench_overlap.py                      # real chip
+    python scripts/bench_overlap.py --platform cpu --smoke  # CI shape
+
+Sizing note (the honest scaling argument): ResNet feed demand in MB/s is
+resolution-independent (~0.15 MB/image at 224px; throughput scales with
+1/pixels while bytes/image scales with pixels), so plain ResNet-50 at
+3,082 img/s needs ~465 MB/s — above this 1-core box's measured ring
+ceiling, but well inside a real multi-core Spark executor host's.  The
+default config therefore uses the width-2 variant (ResNet-50-W2,
+4x FLOPs/image => ~1/4 the MB/s demand) so that the STEP is the
+bottleneck on one core, which is the regime the overlap claim is about;
+--width 1 reproduces the feed-bound regime for comparison.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workload", choices=["resnet", "lm"], default="resnet",
+                   help="resnet: uint8 image feed (stresses MB/s — on the "
+                        "tunneled bench box the ~10 MB/s h2d link, not the "
+                        "ring, is the ceiling); lm: decoder LM + a fat "
+                        "synthetic feature column sized to fit under the "
+                        "h2d link while the step dominates")
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--width", type=int, default=2,
+                   help="ResNet width multiplier (2 => ResNet-50-W2)")
+    p.add_argument("--norm", default="none",
+                   choices=["none", "group", "batch"])
+    p.add_argument("--warmup", type=int, default=4)
+    p.add_argument("--measure", type=int, default=24)
+    p.add_argument("--prefetch", type=int, default=2)
+    p.add_argument("--platform", choices=["cpu", "tpu"], default="tpu")
+    p.add_argument("--num_partitions", type=int, default=8)
+    p.add_argument("--pool", type=int, default=64,
+                   help="distinct images generated per feeder partition "
+                        "(the pool repeats; generation must not throttle "
+                        "the feeder)")
+    p.add_argument("--seq_len", type=int, default=1024,
+                   help="lm workload: tokens per record")
+    p.add_argument("--fat", type=int, default=8192,
+                   help="lm workload: f32 features per record in the fat "
+                        "synthetic column (rides ring AND h2d)")
+    p.add_argument("--d_model", type=int, default=1024)
+    p.add_argument("--n_layers", type=int, default=8)
+    p.add_argument("--trace_dir", default=None,
+                   help="write a JAX profiler trace of a fed-step slice")
+    p.add_argument("--out", default=None, help="result JSON path")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for CI: 64px, batch 16, few steps")
+    return p
+
+
+def _feeder(index, n_records, image_size, pool, seed):
+    """Runs INSIDE a minispark executor: generate a pool of synthetic
+    images once, then yield (image_u8[H,W,3], label) records.  Generation
+    is amortized so the feeder's cost is the transport itself."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed + index)
+    images = rng.randint(0, 255, (pool, image_size, image_size, 3),
+                         dtype=np.uint8)
+    for i in range(n_records):
+        yield images[i % pool], (index * n_records + i) % 1000
+
+
+def _lm_feeder(index, n_records, seq_len, fat, vocab, pool, seed):
+    """LM records: (tokens[S+1] i32, fat_features[F] f32).  The fat column
+    is the VERDICT's 'fat synthetic feature column': it makes the feed
+    carry real bytes through ring + h2d while the LM step dominates."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed + index)
+    toks = rng.randint(1, vocab, (pool, seq_len + 1)).astype(np.int32)
+    fats = rng.standard_normal((pool, fat)).astype(np.float32)
+    for i in range(n_records):
+        yield toks[i % pool], fats[i % pool]
+
+
+def bench_fun(args, ctx):
+    """The training node: consume the cluster feed, then self-compare
+    against the feed-free step."""
+    from tensorflowonspark_tpu import util as fw_util
+
+    if args.platform == "cpu":
+        fw_util.pin_platform("cpu")
+    import time
+
+    import numpy as np
+
+    import jax
+
+    # persistent compile cache: the flagship init+step compile is ~4 min
+    # through the tunnel; re-runs of the bench must not re-pay it
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("TFOS_TPU_JAX_CACHE",
+                                         "/tmp/tfos_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception as e:
+        print(f"[bench] no persistent compile cache: {e}", flush=True)
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import feed as feed_mod
+    from tensorflowonspark_tpu import image
+    from tensorflowonspark_tpu.models.resnet import ResNet50
+    from tensorflowonspark_tpu.optim import make_optimizer
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    H = args.image_size
+    B = args.batch_size
+
+    if args.workload == "lm":
+        from tensorflowonspark_tpu.models.transformer import (
+            Transformer, TransformerConfig, lm_loss)
+
+        S, F = args.seq_len, args.fat
+        cfg = TransformerConfig(
+            vocab_size=32000, d_model=args.d_model, n_heads=8,
+            n_kv_heads=8, n_layers=args.n_layers, d_ff=4 * args.d_model,
+            max_seq_len=S, dtype="bfloat16", rope=True,
+            norm_type="rmsnorm")
+        model = Transformer(cfg)
+        params = model.init(jax.random.key(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+
+        def loss_fn(p, batch, _rng):
+            toks, fat = batch
+            logits = model.apply({"params": p}, toks[:, :-1])
+            # touch the fat column so its transfer is real (not DCE'd)
+            return (lm_loss(logits, toks[:, 1:])
+                    + 1e-6 * jnp.mean(fat.astype(jnp.float32) ** 2))
+
+        def cols_to_batch(cols):
+            toks, fat = cols
+            return (np.ascontiguousarray(toks, dtype=np.int32),
+                    np.ascontiguousarray(fat, dtype=np.float32))
+
+        resident_np = (np.ones((B, S + 1), np.int32),
+                       np.zeros((B, F), np.float32))
+        rec_bytes = (S + 1) * 4 + F * 4
+    else:
+        model = ResNet50(num_classes=1000, norm=args.norm,
+                         num_filters=64 * args.width)
+        params = model.init(
+            jax.random.key(0),
+            image.normalize_batch(
+                jnp.zeros((1, H, H, 3), jnp.uint8)))["params"]
+
+        def loss_fn(p, batch, _rng):
+            imgs_u8, labels = batch
+            x = image.normalize_batch(imgs_u8)    # fuses into conv_init
+            logits = model.apply({"params": p}, x)
+            onehot = jax.nn.one_hot(labels, 1000, dtype=jnp.float32)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits.astype(jnp.float32)) * onehot,
+                -1))
+
+        def cols_to_batch(cols):
+            imgs, labels = cols
+            return (np.ascontiguousarray(imgs, dtype=np.uint8),
+                    np.asarray(labels, np.int64))
+
+        resident_np = (np.zeros((B, H, H, 3), np.uint8),
+                       np.arange(B) % 1000)
+        rec_bytes = H * H * 3 + 8
+
+    opt, _ = make_optimizer("sgd", learning_rate=0.1, momentum=0.9)
+    state = train_mod.create_train_state(params, opt)
+    step = train_mod.make_train_step(loss_fn, opt, donate=True)
+    rng = jax.random.key(1)
+
+    # ---- feed-free reference FIRST: compile + one resident batch --------
+    # (ordering matters on a 1-core host: the feeder processes contend
+    # with XLA's host-side compile, so compile before touching the feed)
+    resident = tuple(jax.device_put(a) for a in resident_np)
+    t0 = time.perf_counter()
+    state, metrics = step(state, resident, rng)
+    float(np.asarray(metrics["loss"]))
+    print(f"[bench] compile+first step: {time.perf_counter() - t0:.0f}s",
+          flush=True)
+    t0 = time.perf_counter()
+    for _ in range(args.measure):
+        state, metrics = step(state, resident, rng)
+    float(np.asarray(metrics["loss"]))   # readback barrier (tunnel-safe)
+    free_dt = time.perf_counter() - t0
+    print(f"[bench] feed-free: {args.measure * B / free_dt:.0f} img/s",
+          flush=True)
+
+    df = ctx.get_data_feed(train_mode=True)
+    wait = {"feed": 0.0, "batches": 0}
+
+    def host_batches():
+        """DataFeed -> workload batch arrays, measuring the time this
+        loop spends BLOCKED waiting for host data."""
+        while not df.should_stop():
+            t0 = time.perf_counter()
+            cols = df.next_numpy_batch(B, timeout=300)
+            wait["feed"] += time.perf_counter() - t0
+            if cols is None or len(cols[1]) == 0:
+                continue
+            if len(cols[1]) < B:
+                cols = feed_mod.pad_batch(tuple(cols), B)
+            wait["batches"] += 1
+            yield cols_to_batch(cols)
+
+    dev_batches = feed_mod.device_prefetch(host_batches(),
+                                           depth=args.prefetch)
+
+    # ---- warmup (steady-state the prefetch pipeline); the profiler
+    # trace captures fed-overlapped warmup steps so its own overhead
+    # stays OUT of the measured window ------------------------------------
+    metrics = None
+    trace_written = False
+    if args.trace_dir:
+        try:
+            jax.profiler.start_trace(args.trace_dir)
+            trace_written = True
+        except Exception as e:         # profiling support varies by plugin
+            print(f"[bench] profiler unavailable: {e}", flush=True)
+    for _ in range(max(args.warmup, 3 if trace_written else 0)):
+        state, metrics = step(state, next(dev_batches), rng)
+    float(np.asarray(metrics["loss"]))   # readback barrier: block_until_ready
+    # can return early under tunneled plugins (BASELINE.md methodology)
+    if trace_written:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+    # ---- fed, overlapped, measured window -------------------------------
+    wait["feed"] = 0.0
+    wait["batches"] = 0
+    t0 = time.perf_counter()
+    for i in range(args.measure):
+        state, metrics = step(state, next(dev_batches), rng)
+    float(np.asarray(metrics["loss"]))   # readback barrier: block_until_ready
+    # can return early under tunneled plugins (BASELINE.md methodology)
+    fed_dt = time.perf_counter() - t0
+    fed_wait = wait["feed"]
+
+    # drain the remaining feed so feeders can finish, then stop the feed
+    df.terminate()
+
+    n_recs = args.measure * B
+    result = {
+        "workload": args.workload, "batch_size": B,
+        "steps": args.measure,
+        "platform": jax.devices()[0].platform,
+        "fed_rec_s": n_recs / fed_dt,
+        "feed_free_rec_s": n_recs / free_dt,
+        "overlap_ratio": free_dt / fed_dt,
+        "feed_wait_s": fed_wait,
+        "feed_wait_frac": fed_wait / fed_dt,
+        "feed_mb_s": n_recs * rec_bytes / fed_dt / (1 << 20),
+        "trace_written": trace_written,
+        "loss": float(np.asarray(metrics["loss"])),
+    }
+    if args.workload == "resnet":
+        result.update(image_size=H, width=args.width, norm=args.norm)
+    else:
+        result.update(seq_len=args.seq_len, fat=args.fat,
+                      d_model=args.d_model, n_layers=args.n_layers)
+    print("[bench_overlap] " + json.dumps(result), flush=True)
+    if args.out:
+        tmp = args.out + ".tmp"     # atomic: the driver polls for args.out
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+        os.rename(tmp, args.out)
+
+
+def main(argv=None):
+    # single-host bench: loopback rendezvous (the routable-IP default is
+    # for real clusters; sandboxes without egress can't reach it)
+    os.environ.setdefault("TFOS_TPU_SERVER_HOST", "127.0.0.1")
+    args = build_argparser().parse_args(argv)
+    if args.smoke:
+        args.image_size, args.batch_size = 64, 16
+        args.warmup, args.measure = 2, 6
+        args.platform = "cpu"
+        if args.workload == "lm":
+            args.seq_len, args.fat, args.d_model, args.n_layers = 64, 256, 64, 2
+            args.batch_size = 4
+    elif args.workload == "lm" and args.batch_size == 64:
+        args.batch_size = 8              # the LM bench shape (B8 S1024)
+    args.out = args.out or os.path.join(tempfile.mkdtemp(prefix="overlap-"),
+                                        "result.json")
+
+    from tensorflowonspark_tpu import cluster, minispark, pipeline
+
+    assert minispark.install(), "real pyspark present; use it directly"
+    import pyspark
+
+    workdir = tempfile.mkdtemp(prefix="overlap-spark-")
+    sc = pyspark.SparkContext(num_executors=1, workdir=workdir)
+    try:
+        c = cluster.run(sc, bench_fun, pipeline.Namespace(vars(args)),
+                        num_executors=1,
+                        input_mode=cluster.InputMode.SPARK)
+        total = (args.warmup + args.measure + 2 * args.prefetch
+                 + 4) * args.batch_size
+        per_part = -(-total // args.num_partitions)
+        H, pool = args.image_size, args.pool
+        S, F = args.seq_len, args.fat
+        rdd = sc.parallelize(range(args.num_partitions),
+                             args.num_partitions)
+        if args.workload == "lm":
+            rdd = rdd.mapPartitionsWithIndex(
+                lambda idx, _it, _n=per_part, _s=S, _f=F, _p=pool:
+                _lm_feeder(idx, _n, _s, _f, 32000, _p, seed=7))
+        else:
+            rdd = rdd.mapPartitionsWithIndex(
+                lambda idx, _it, _n=per_part, _h=H, _p=pool:
+                _feeder(idx, _n, _h, _p, seed=7))
+        c.train(rdd, feed_timeout=600)
+        # the node is still finishing its measured window + drain when the
+        # feed completes; give it the grace window before manager teardown
+        c.shutdown(grace_secs=60)
+    finally:
+        sc.stop()
+
+    # the node finishes its feed-free reference window in the background
+    # after the feed closes (shutdown only grants a grace period; it does
+    # not wait for trainer exit) — wait for the result artifact
+    import time
+    deadline = time.time() + 900
+    while not os.path.exists(args.out):
+        if time.time() > deadline:
+            raise TimeoutError(f"no result at {args.out}")
+        time.sleep(2)
+    with open(args.out) as f:
+        result = json.load(f)
+    print(json.dumps(result, indent=2))
+    # the criterion is the RATIO: fed within ~10% of feed-free means the
+    # step, not the feed, bounds throughput.  feed_wait_frac is a
+    # diagnostic, not a gate — with device_prefetch the host loop
+    # legitimately blocks on the next batch WHILE the device computes
+    # (that hidden latency is exactly what the prefetch exists to hide);
+    # only the ratio says whether any of it delayed the step.
+    ok = result["overlap_ratio"] >= 0.9
+    print(f"step-bound: {ok} (overlap_ratio="
+          f"{result['overlap_ratio']:.3f}, feed_wait_frac="
+          f"{result['feed_wait_frac']:.3f} [hidden by prefetch])")
+    # smoke is a plumbing check: toy shapes are legitimately feed-bound
+    return 0 if (ok or args.smoke) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -438,4 +438,10 @@ class DataFeed:
                     self.done_feeding = True
             except queue_mod.Empty:
                 done = True
+            except (OSError, EOFError, BrokenPipeError) as e:
+                # the manager is already gone (cluster shutdown won the
+                # race): nothing left to drain, feeders are dead too
+                logger.info("terminate(): manager closed mid-drain (%s)", e)
+                self.done_feeding = True
+                done = True
         logger.info("terminate() drained %d in-flight items", count)

@@ -166,6 +166,8 @@ def _wrapper_fn_background(map_fun, tf_args, ctx, error_q_addr, authkey,
     is the liveness principal for the node, so it also owns the heartbeat:
     a silent death here (OOM, SIGKILL) is what the coordinator's monitor
     exists to catch."""
+    from . import backend as backend_mod
+    map_fun = backend_mod._loads_fn(map_fun)
     hb_client = None
     if server_addr is not None:
         # connect=False: the beat thread makes its own connections and
@@ -376,9 +378,16 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
                     cluster_info=cluster_info,
                     default_fs=cluster_meta.get("default_fs", "file://"),
                     working_dir=os.getcwd(), mgr=None)
+                # map_fun crosses as a cloudpickle blob: a fn defined in a
+                # __main__ script arrives here (executor) as a by-value
+                # cloudpickle clone, which the standard pickler spawn uses
+                # for Process args would refuse ("not the same object as
+                # __main__.<fn>")
+                from . import backend as backend_mod
                 p = mp.Process(
                     target=_wrapper_fn_background,
-                    args=(map_fun, tf_args, ctx_bg, mgr._tfos_addr, authkey,
+                    args=(backend_mod._dumps_fn(map_fun), tf_args, ctx_bg,
+                          mgr._tfos_addr, authkey,
                           cluster_meta.get("server_addr"),
                           _heartbeat_interval(cluster_meta)),
                     name=f"node-{job_name}-{task_index}")
