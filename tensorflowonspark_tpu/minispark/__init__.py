@@ -196,6 +196,21 @@ class BarrierTaskContext:
         raise RuntimeError("minispark does not run barrier stages")
 
 
+def has_real_pyspark():
+    """True when a REAL pyspark distribution is importable — regardless
+    of whether the minispark shim currently occupies sys.modules.  The
+    path finders are consulted directly (find_spec would short-circuit
+    on the sys.modules entry and report the shim).  The conformance
+    tiers key on this: minispark tests skip when it is True, the
+    real-Spark tier skips when it is False."""
+    try:
+        import importlib.machinery
+        spec = importlib.machinery.PathFinder.find_spec("pyspark")
+    except (ImportError, ValueError):
+        return False
+    return spec is not None and "minispark" not in str(spec.origin or "")
+
+
 def install(force=False):
     """Register minispark as `pyspark` in sys.modules.
 

@@ -192,20 +192,22 @@ class TFModel(TFParams):
         super().__init__()
         self.args = Namespace(tf_args if tf_args is not None else {})
 
-    def transform(self, dataset, backend=None):
+    def transform(self, dataset, backend=None, box=None):
         """Run batch inference over ``dataset``; returns rows in input order.
 
-        Output rows are numpy values — scalar outputs yield ``np.ndarray``
-        row views / numpy scalars, multi-output models yield tuples of
-        them — NOT boxed Python floats/lists (per-element ``.tolist()``
-        dominated serving cost; see BASELINE.md serving round 2).  Sinks
-        that need Python-native types (``createDataFrame``, JSON
-        serialization) must box at their own boundary the way
-        `pipeline_ml.TFModelML` does before building its DataFrame.
-        """
-        return self._transform(dataset, backend)
+        ``box`` controls the row value types:
 
-    def _transform(self, dataset, backend=None):
+        - ``None`` (default) — auto: rows from a Spark DataFrame/RDD input
+          are boxed to Python-native floats/lists ON THE EXECUTORS (real
+          Spark sinks — ``createDataFrame``, JSON — choke on numpy types,
+          and those rows pay Spark serialization anyway); plain local
+          partitions keep numpy row views (per-element ``.tolist()``
+          dominated serving cost; see BASELINE.md serving round 2).
+        - ``True`` / ``False`` — force either behavior.
+        """
+        return self._transform(dataset, backend, box=box)
+
+    def _transform(self, dataset, backend=None, box=None):
         import os
 
         args = self.merge_args_params()
@@ -227,10 +229,34 @@ class TFModel(TFParams):
             batch_size=args.batch_size,
             input_mapping=args.input_mapping,
             output_mapping=args.output_mapping)
+        is_spark = hasattr(dataset, "rdd") or hasattr(dataset, "mapPartitions")
+        if box is None:
+            box = is_spark
+        if box:
+            run_fn = _boxed(run_fn)
         partitions, bk = _as_partitions(dataset, args, backend)
         if bk is None:  # plain local data, no executor pool: run inline
             return [row for part in partitions for row in run_fn(iter(part))]
         return bk.map_partitions(partitions, run_fn)
+
+
+def _boxed(run_fn):
+    """Wrap a partition fn so its rows come back as Python-native values
+    (floats/ints/lists), boxed on the executor."""
+
+    def box_value(v):
+        if hasattr(v, "tolist"):        # ndarray or numpy scalar
+            return v.tolist()
+        return v
+
+    def boxed_fn(it, _run=run_fn):
+        for row in _run(it):
+            if isinstance(row, tuple):
+                yield tuple(box_value(v) for v in row)
+            else:
+                yield box_value(row)
+
+    return boxed_fn
 
 
 def _as_partitions(dataset, args, backend):

@@ -74,25 +74,22 @@ class TFModel(Model, base.TFParams):
         args = self.merge_args_params()
         inner = base.TFModel(self.args)
         inner._paramMap = dict(self._paramMap)
-        preds = inner._transform(dataset)
+        # box=True: the base transform converts numpy values to
+        # Python-native ones ON THE EXECUTORS (pipeline._boxed — the one
+        # boxing implementation); real pyspark's createDataFrame type
+        # inference rejects numpy scalars
+        preds = inner._transform(dataset, box=True)
         columns = self._output_columns(args)
         if hasattr(preds, "mapPartitions"):     # RDD of prediction rows
             n_cols = len(columns)
 
             def _as_row(r):
-                import numpy as np
-
                 row = tuple(r) if isinstance(r, (tuple, list)) else (r,)
                 if len(row) != n_cols:
                     raise ValueError(
                         f"model emitted {len(row)} outputs but the schema "
                         f"has {n_cols} columns {columns}")
-                # serving emits numpy scalars/row views (the columnar fast
-                # path); real pyspark's type inference needs python values
-                # — box only here, at the DataFrame boundary
-                return tuple(v.item() if isinstance(v, np.generic)
-                             else v.tolist() if isinstance(v, np.ndarray)
-                             else v for v in row)
+                return row
 
             spark = SparkSession.builder.getOrCreate()
             return spark.createDataFrame(preds.map(_as_row), list(columns))

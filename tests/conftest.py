@@ -63,6 +63,8 @@ SLOW_FILES = {
     "test_pipelined_lm.py",     # 25 s
     "test_ring_attention.py",   # 31 s
     "test_spark_integration.py",  # 110 s — end-to-end Spark surface
+    "test_spark_real.py",       # same bodies over real pyspark (skips
+    # in seconds when pyspark is absent, but runs minutes when present)
     "test_streaming.py",        # 41 s
     "test_summary.py",          # 9 s — non-core (tfevents writer), keeps
     # the tier under its 90 s budget as fast files accrete

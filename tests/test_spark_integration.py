@@ -1,31 +1,21 @@
-"""End-to-end Spark-surface tests over the minispark local cluster.
+"""Minispark tier of the Spark-surface conformance tests.
 
-Mirrors the reference's distributed test tier, which REQUIRED a real
-process-separated cluster (2-worker standalone; reference:
-tests/README.md:10, tox.ini:29-34) — here the pyspark-API-compatible
-`minispark` double provides the same process shape (persistent executor
-processes, stable workdirs, partition routing), so the whole
-Spark-facing half executes: SparkBackend bootstrap, SPARK-mode feeding,
-the inference round trip (reference: tests/test_TFCluster.py:29-48),
-DataFrame⇄TFRecord (reference: tests/test_dfutil.py), the Spark-ML
-fit→transform pipeline (reference: tests/test_pipeline.py:89-172), and
-queue-stream feeding (the DStream path).
+The bodies live in ``spark_surface.py`` (shared verbatim with the
+real-pyspark tier, ``test_spark_real.py``); this front-end supplies the
+minispark SparkContext — a pyspark-API double with real separated
+executor processes — and skips itself whenever real pyspark is
+importable, so the double never shadows the real thing.
 """
-import os
-
-import numpy as np
 import pytest
 
-from tensorflowonspark_tpu import backend, cluster, minispark
+from tensorflowonspark_tpu import minispark
 
 pytestmark = pytest.mark.skipif(
-    not minispark.install(), reason="real pyspark present; the minispark "
-    "suite would shadow it (run the real-Spark tier instead)")
+    not minispark.install(), reason="real pyspark present; run the "
+    "real-Spark tier (test_spark_real.py) instead")
 
-NUM_EXECUTORS = 2
-
-W_TRUE = np.array([2.0, -3.0], "float32")
-B_TRUE = 1.5
+from spark_surface import *      # noqa: E402,F401,F403  (the test bodies)
+from spark_surface import NUM_EXECUTORS  # noqa: E402
 
 
 @pytest.fixture
@@ -36,224 +26,3 @@ def sc(tmp_path):
                                    workdir=str(tmp_path / "spark"))
     yield context
     context.stop()
-
-
-# --- map functions (module-level: they cross process boundaries) ---------
-
-def fn_square(args, ctx):
-    df = ctx.get_data_feed(train_mode=False)
-    while not df.should_stop():
-        batch = df.next_batch(10)
-        if batch:
-            df.batch_results([x * x for x in batch])
-
-
-def fn_count_to_file(args, ctx):
-    df = ctx.get_data_feed()
-    total = 0
-    while not df.should_stop():
-        total += len(df.next_batch(32))
-    with open(os.path.join(ctx.working_dir, "count.txt"), "w") as f:
-        f.write(str(total))
-
-
-def train_fn_linear(args, ctx):
-    import numpy as np
-
-    from tensorflowonspark_tpu import export
-
-    df = ctx.get_data_feed()
-    X, Y = [], []
-    while not df.should_stop():
-        for rec in df.next_batch(args.batch_size):
-            X.append(rec[0])
-            Y.append(rec[1])
-    assert X, "feed delivered no records"
-    if ctx.is_chief:
-        X, Y = np.asarray(X, "float32"), np.asarray(Y, "float32")
-        sol, *_ = np.linalg.lstsq(np.c_[X, np.ones(len(X))], Y, rcond=None)
-        params = {"dense": {
-            "kernel": sol[:-1].reshape(2, 1).astype("float32"),
-            "bias": sol[-1:].astype("float32")}}
-        export.export_saved_model(
-            args.export_dir, params,
-            builder="tensorflowonspark_tpu.models.linear:Linear",
-            builder_kwargs={"features": 1},
-            signatures={"serving_default": {
-                "inputs": {"x": {"shape": [2], "dtype": "float32"}},
-                "outputs": ["y"]}})
-
-
-# --- SparkBackend cluster lifecycle --------------------------------------
-
-def test_spark_backend_inference_roundtrip(sc):
-    """reference tests/test_TFCluster.py:29-48: squares of 0..999 through a
-    SPARK-mode cluster, returned as a LAZY RDD, summed on the driver."""
-    c = cluster.run(sc, fn_square, tf_args={}, num_executors=NUM_EXECUTORS,
-                    input_mode=cluster.InputMode.SPARK)
-    data = list(range(1000))
-    rdd = sc.parallelize(data, 4)
-    result_rdd = c.inference(rdd)
-    assert hasattr(result_rdd, "collect"), "Spark inference must stay lazy"
-    total = sum(result_rdd.collect())
-    assert total == sum(x * x for x in data)
-    c.shutdown()
-
-
-def test_spark_train_epochs_via_union(sc):
-    """cluster.train over an RDD with num_epochs>1 rides RDD.union (the
-    reference's sc.union([rdd]*epochs), TFCluster.py:86-94); every record
-    is delivered epochs times."""
-    c = cluster.run(sc, fn_count_to_file, tf_args={},
-                    num_executors=NUM_EXECUTORS,
-                    input_mode=cluster.InputMode.SPARK)
-    rdd = sc.parallelize(range(100), 2)
-    c.train(rdd, num_epochs=3, feed_timeout=60)
-    c.shutdown(grace_secs=1)
-    counts = []
-    for i in range(NUM_EXECUTORS):
-        path = os.path.join(sc.executor_root, f"executor-{i}", "count.txt")
-        with open(path) as f:
-            counts.append(int(f.read()))
-    assert sum(counts) == 300, counts
-
-
-def test_spark_stream_feeding_queue_dstream(sc):
-    """train_stream over a queue-backed DStream (the reference's streaming
-    path, TFCluster.py:83-85 + mnist_spark_streaming example)."""
-    from pyspark.streaming import StreamingContext
-
-    c = cluster.run(sc, fn_count_to_file, tf_args={},
-                    num_executors=NUM_EXECUTORS,
-                    input_mode=cluster.InputMode.SPARK)
-    ssc = StreamingContext(sc, 0.1)
-    batches = [sc.parallelize(range(50), 2) for _ in range(4)]
-    stream = ssc.queueStream(batches)
-    c.train_stream(stream, feed_timeout=60)
-    ssc.start()
-    c.shutdown(ssc=ssc, grace_secs=1)   # graceful: drains the queue first
-    counts = []
-    for i in range(NUM_EXECUTORS):
-        path = os.path.join(sc.executor_root, f"executor-{i}", "count.txt")
-        with open(path) as f:
-            counts.append(int(f.read()))
-    assert sum(counts) == 200, counts
-
-
-# --- DataFrame <-> TFRecord (reference tests/test_dfutil.py) -------------
-
-def test_dfutil_dataframe_roundtrip(sc, tmp_path):
-    from pyspark.sql import SparkSession
-    from pyspark.sql import types as T
-
-    from tensorflowonspark_tpu import dfutil
-
-    spark = SparkSession.builder.getOrCreate()
-    rows = [(i, float(i) / 2, f"name-{i}", [float(i), float(i + 1)])
-            for i in range(20)]
-    schema = T.StructType([
-        T.StructField("id", T.LongType()),
-        T.StructField("score", T.FloatType()),
-        T.StructField("name", T.StringType()),
-        T.StructField("vec", T.ArrayType(T.FloatType()))])
-    df = spark.createDataFrame(sc.parallelize(rows, 3), schema)
-
-    out = str(tmp_path / "tfr")
-    total = dfutil.saveAsTFRecords(df, out)
-    assert total == 20
-    parts = [p for p in os.listdir(out) if p.startswith("part-r-")]
-    assert len(parts) == 3   # one shard per partition
-
-    loaded = dfutil.loadTFRecords(sc, out)
-    assert dfutil.isLoadedDF(loaded)
-    back = {r["id"]: r for r in loaded.collect()}
-    assert len(back) == 20
-    r7 = back[7]
-    assert r7["name"] == "name-7"
-    np.testing.assert_allclose(r7["vec"], [7.0, 8.0])
-    np.testing.assert_allclose(r7["score"], 3.5)
-
-
-def test_dfutil_save_with_sidecar_indexes(sc, tmp_path):
-    from pyspark.sql import SparkSession
-
-    from tensorflowonspark_tpu import dfutil, tfrecord
-    from tensorflowonspark_tpu.data import Dataset
-
-    spark = SparkSession.builder.getOrCreate()
-    df = spark.createDataFrame(
-        sc.parallelize([(i, float(i)) for i in range(12)], 2),
-        ["id", "val"])
-    out = str(tmp_path / "tfr_idx")
-    assert dfutil.saveAsTFRecords(df, out, index=True) == 12
-    parts = sorted(p for p in os.listdir(out) if p.startswith("part-r-")
-                   and not p.endswith(tfrecord.INDEX_SUFFIX))
-    for p in parts:
-        assert tfrecord.read_index(os.path.join(out, p)) is not None
-    # the sidecars feed the indexed root directly (no rebuild scan)
-    ds = Dataset.from_indexed_tfrecords(
-        [os.path.join(out, p) for p in parts],
-        parse=lambda ex: int(ex["id"][1][0]), global_shuffle=True)
-    assert sorted(ds) == list(range(12))
-
-
-# --- Spark ML pipeline (reference tests/test_pipeline.py:89-172) ---------
-
-def test_ml_estimator_fit_transform_pipeline(sc, tmp_path):
-    from pyspark.ml import Pipeline
-    from pyspark.sql import SparkSession
-    from pyspark.sql import types as T
-
-    from tensorflowonspark_tpu import pipeline_ml
-
-    rng = np.random.RandomState(1234)
-    X = rng.rand(256, 2).astype("float32")
-    y = X @ W_TRUE + B_TRUE
-    spark = SparkSession.builder.getOrCreate()
-    schema = T.StructType([
-        T.StructField("features", T.ArrayType(T.FloatType())),
-        T.StructField("label", T.FloatType())])
-    df = spark.createDataFrame(
-        sc.parallelize(list(zip(X.tolist(), y.tolist())), 2), schema)
-
-    export_dir = str(tmp_path / "export")
-    est = (pipeline_ml.TFEstimator(train_fn_linear,
-                                   {"export_dir": export_dir})
-           .setClusterSize(NUM_EXECUTORS).setBatchSize(32).setGraceSecs(5)
-           .setEpochs(1))
-    # compose as a real Spark ML Pipeline stage
-    pipeline_model = Pipeline(stages=[est]).fit(df)
-    model = pipeline_model.stages[0]
-    assert isinstance(model, pipeline_ml.TFModel)
-    assert model.getBatchSize() == 32      # params persisted onto the model
-
-    preds_df = model.transform(df.select("features"))
-    assert preds_df.columns == ["y"]
-    got = np.array([r[0] for r in preds_df.collect()]).reshape(-1)
-    np.testing.assert_allclose(got, y, rtol=1e-3, atol=1e-3)
-
-
-def test_ml_output_mapping_renames_column(sc, tmp_path):
-    from pyspark.sql import SparkSession
-    from pyspark.sql import types as T
-
-    from tensorflowonspark_tpu import pipeline_ml
-
-    rng = np.random.RandomState(7)
-    X = rng.rand(64, 2).astype("float32")
-    y = X @ W_TRUE + B_TRUE
-    spark = SparkSession.builder.getOrCreate()
-    schema = T.StructType([
-        T.StructField("features", T.ArrayType(T.FloatType())),
-        T.StructField("label", T.FloatType())])
-    df = spark.createDataFrame(
-        sc.parallelize(list(zip(X.tolist(), y.tolist())), 2), schema)
-    export_dir = str(tmp_path / "export")
-    est = (pipeline_ml.TFEstimator(train_fn_linear,
-                                   {"export_dir": export_dir})
-           .setClusterSize(NUM_EXECUTORS).setGraceSecs(5))
-    model = est.fit(df)
-    model.setOutputMapping({"y": "prediction"})
-    out = model.transform(df.select("features"))
-    assert out.columns == ["prediction"]
-    assert out.count() == 64
