@@ -82,9 +82,22 @@ def main():
     p.add_argument("--batch_size", type=int, default=256)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--windows", type=int, default=3)
+    p.add_argument("--trace", action="store_true",
+                   help="capture a 3-step profiler trace and print the "
+                        "device time / bytes / actual-HLO-FLOPs breakdown "
+                        "by hlo_category (the roofline evidence)")
     args = p.parse_args()
 
     import jax
+
+    try:   # persistent compile cache: --trace's second build, and reruns,
+        # skip the multi-minute tunnel compile
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("TFOS_TPU_JAX_CACHE",
+                                         "/tmp/tfos_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
 
     ips, dt = bench_step(norm=args.norm, batch_size=args.batch_size,
                          steps=args.steps, windows=args.windows,
@@ -95,6 +108,72 @@ def main():
     print(f"device={kind} norm={args.norm} stem={args.stem} "
           f"batch={args.batch_size}")
     print(f"step={dt * 1000:.1f} ms  images/sec={ips:,.0f}  MFU~{mfu:.1f}%")
+    if args.trace:
+        profile_step(norm=args.norm, batch_size=args.batch_size,
+                     stem=args.stem, peak=peak)
+
+
+def profile_step(norm="none", batch_size=256, stem="conv", peak=None,
+                 trace_dir="/tmp/resnet_trace", built=None):
+    """3-step trace -> per-hlo_category device time / bytes / FLOPs table.
+
+    This is the evidence behind the BASELINE.md round-4 ResNet roofline
+    entry: with norm='none' the convolution fusions (elementwise already
+    fused into their epilogues) carry ~90% of device time, so the naive
+    3*4.1GF/img MFU is bounded by conv HBM traffic, not by an unfused
+    elementwise tail.
+    """
+    import collections
+    import glob
+    import gzip
+    import json
+
+    import numpy as np
+
+    import jax
+
+    if built is None:       # standalone call; main() could pass bench's
+        built = build_step(norm=norm, batch_size=batch_size, stem=stem)[:3]
+    step, state, batch = built
+    state, m = step(state, batch, jax.random.key(1))
+    _ = np.asarray(m["loss"])                       # compile + sync
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(3):
+        state, m = step(state, batch, jax.random.key(1))
+    _ = np.asarray(m["loss"])
+    jax.profiler.stop_trace()
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz")))
+    with gzip.open(paths[-1]) as f:
+        trace = json.load(f)
+    pids = {e["pid"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "TPU" in e["args"].get("name", "")}
+    cat = collections.Counter()
+    byt = collections.Counter()
+    flops = collections.Counter()
+    for e in trace["traceEvents"]:
+        a = e.get("args") or {}
+        if (e.get("ph") == "X" and e.get("pid") in pids
+                and "hlo_category" in a):
+            c = a["hlo_category"]
+            cat[c] += e["dur"]
+            byt[c] += int(a.get("raw_bytes_accessed", 0))
+            flops[c] += int(a.get("model_flops", 0) or 0)
+    tot = sum(cat.values())
+    print(f"\ndevice op time {tot / 3e3:.1f} ms/step "
+          f"({sum(flops.values()) / 3e9:,.0f} actual GFLOP/step):")
+    for c, us in cat.most_common():
+        ms = us / 3e3
+        gib = byt[c] / 3 / (1 << 30)
+        gf = flops[c] / 3e9
+        line = f"  {ms:7.2f} ms  {gib:7.2f} GiB  {gf:8.1f} GF  {c}"
+        if us:
+            line += f"  ({byt[c] / (us * 1e-6) / 1e9:,.0f} GB/s)"
+        print(line)
+    if peak:
+        print(f"actual-HLO MXU utilization: "
+              f"{sum(flops.values()) / 3 / (tot / 3e6) / peak * 100:.0f}%")
 
 
 if __name__ == "__main__":

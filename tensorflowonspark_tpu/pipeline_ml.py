@@ -74,22 +74,30 @@ class TFModel(Model, base.TFParams):
         args = self.merge_args_params()
         inner = base.TFModel(self.args)
         inner._paramMap = dict(self._paramMap)
-        # box=True: the base transform converts numpy values to
-        # Python-native ones ON THE EXECUTORS (pipeline._boxed — the one
-        # boxing implementation); real pyspark's createDataFrame type
-        # inference rejects numpy scalars
-        preds = inner._transform(dataset, box=True)
+        # box=False: boxing happens below in _as_row, AFTER the column
+        # split — a single vector-valued output must stay ONE ArrayType
+        # column, which a pre-boxed list row would splat into columns
+        preds = inner._transform(dataset, box=False)
         columns = self._output_columns(args)
         if hasattr(preds, "mapPartitions"):     # RDD of prediction rows
             n_cols = len(columns)
 
             def _as_row(r):
-                row = tuple(r) if isinstance(r, (tuple, list)) else (r,)
+                import numpy as np
+
+                # a tuple = multi-output row; anything else (scalar OR
+                # per-row vector, ndarray or list) is one column's value
+                row = tuple(r) if isinstance(r, tuple) else (r,)
                 if len(row) != n_cols:
                     raise ValueError(
                         f"model emitted {len(row)} outputs but the schema "
                         f"has {n_cols} columns {columns}")
-                return row
+                # serving emits numpy scalars/row views (the columnar fast
+                # path); real pyspark's type inference needs python values
+                # — box here, at the DataFrame boundary, per column
+                return tuple(v.item() if isinstance(v, np.generic)
+                             else v.tolist() if isinstance(v, np.ndarray)
+                             else v for v in row)
 
             spark = SparkSession.builder.getOrCreate()
             return spark.createDataFrame(preds.map(_as_row), list(columns))

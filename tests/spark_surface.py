@@ -266,3 +266,35 @@ def test_ml_output_mapping_renames_column(sc, tmp_path):
     assert type(rows[0]).__module__ != "numpy", type(rows[0])
     local = base.transform([[ (X[0].tolist(),) ]])
     assert hasattr(local[0], "dtype"), type(local[0])  # numpy preserved
+
+
+def test_ml_vector_output_stays_one_column(sc, tmp_path):
+    """A single output column holding a VECTOR per row must come back as
+    one ArrayType column — not be splatted into columns (the mnist
+    example's {'logits': 'pred'} pattern)."""
+    from pyspark.sql import SparkSession
+    from pyspark.sql import types as T
+
+    from tensorflowonspark_tpu import export, pipeline_ml
+
+    export_dir = str(tmp_path / "vec_export")
+    params = {"dense": {"kernel": np.eye(3, dtype="float32") * 2.0,
+                        "bias": np.zeros(3, "float32")}}
+    export.export_saved_model(
+        export_dir, params,
+        builder="tensorflowonspark_tpu.models.linear:Linear",
+        builder_kwargs={"features": 3},
+        signatures={"serving_default": {
+            "inputs": {"x": {"shape": [3], "dtype": "float32"}},
+            "outputs": ["y"]}})
+    spark = SparkSession.builder.getOrCreate()
+    schema = T.StructType([
+        T.StructField("features", T.ArrayType(T.FloatType()))])
+    vecs = [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]
+    df = spark.createDataFrame(sc.parallelize([(v,) for v in vecs], 2),
+                               schema)
+    model = pipeline_ml.TFModel({"export_dir": export_dir})
+    out = model.transform(df)
+    assert out.columns == ["y"]
+    got = sorted(r[0] for r in out.collect())
+    np.testing.assert_allclose(got, [[2.0, 4.0, 6.0], [8.0, 10.0, 12.0]])
