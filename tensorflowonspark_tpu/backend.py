@@ -98,6 +98,25 @@ def _bootstrap_trampoline(fn, executor_id, workdir, status_q, manager_linger=600
     a linger timeout as a leak guard; then stop the manager and exit.
     """
     from tensorflowonspark_tpu import manager as manager_mod
+
+    # SIGTERM (cluster.abort / LocalBackend.terminate) must take the
+    # queue-manager SERVER down too: BaseManager.start forks it as a
+    # separate child process, which would otherwise outlive this executor
+    # as an orphan holding the manager port (and every inherited fd)
+    import signal as signal_mod
+
+    def _on_term(_signum, _frame):
+        for m in manager_mod._started_managers:
+            try:
+                m.shutdown()
+            except Exception:
+                pass
+        os._exit(143)
+
+    try:
+        signal_mod.signal(signal_mod.SIGTERM, _on_term)
+    except ValueError:
+        pass    # not the main thread (never the case here)
     try:
         fn = _loads_fn(fn)
         os.chdir(workdir)
@@ -213,6 +232,10 @@ class LocalBackend(Backend):
 
         live_procs = []
         cancelled = threading.Event()
+        # terminate() reaps in-flight tasks AND stops the serial runners
+        # from spawning the next queued one
+        self._live_task_procs = live_procs
+        self._tasks_cancelled = cancelled
         blob = self._ship_fn(fn)
 
         def _run_serial(eid, tasks):
@@ -229,7 +252,11 @@ class LocalBackend(Backend):
                 live_procs.append(p)
                 p.join()
 
-        threads = [threading.Thread(target=_run_serial, args=(eid, tasks))
+        # daemon: the normal path joins these explicitly below, but an
+        # abandoned run (cluster.abort mid-task, driver exception) must
+        # not leave a non-daemon runner blocking interpreter shutdown
+        threads = [threading.Thread(target=_run_serial, args=(eid, tasks),
+                                    daemon=True)
                    for eid, tasks in by_exec.items()]
         for t in threads:
             t.start()
@@ -282,7 +309,15 @@ class LocalBackend(Backend):
             p.join(timeout)
 
     def terminate(self):
-        for p in self._bootstrap_procs:
+        # bootstraps AND in-flight task processes: a forceful teardown
+        # (cluster.abort) must leave no children for multiprocessing's
+        # atexit to join forever.  Cancel FIRST so the serial runner
+        # threads don't spawn the next queued task after the kill.
+        ev = getattr(self, "_tasks_cancelled", None)
+        if ev is not None:
+            ev.set()
+        for p in list(self._bootstrap_procs) + list(
+                getattr(self, "_live_task_procs", [])):
             if p.is_alive():
                 p.terminate()
 

@@ -240,6 +240,31 @@ class TPUCluster:
                 return f"http://{n['host']}:{n['tb_port']}"
         return None
 
+    def abort(self):
+        """Forceful teardown after a node failure: kill executors, stop
+        the rendezvous server, best-effort-close every node manager.
+        Unlike `shutdown`, never raises — it exists so `run_elastic` can
+        clear the ground for a relaunch."""
+        logger.warning("aborting cluster (forceful teardown)")
+        from . import manager as manager_mod
+        for n in self.cluster_info or []:
+            try:
+                mgr = manager_mod.connect(tuple(n["addr"]), n["authkey"])
+                mgr.set("state", "stopped")
+            except Exception:
+                pass                     # dead node: nothing to stop
+        try:
+            if hasattr(self._backend, "terminate"):
+                self._backend.terminate()
+            if hasattr(self._backend, "join"):
+                self._backend.join(timeout=10)   # bounded reap
+        except Exception:
+            pass
+        try:
+            self.server.stop()
+        except Exception:
+            pass
+
     def _check_driver_error(self):
         err = self._status.get("error")  # _StatusView folds in backend errors
         if err:
@@ -311,17 +336,32 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
     t = threading.Thread(target=_launch, name="cluster-launch", daemon=True)
     t.start()
 
-    cluster_info = server.await_reservations(
-        timeout=reservation_timeout, status=status)
+    try:
+        cluster_info = server.await_reservations(
+            timeout=reservation_timeout, status=status)
 
-    # Duplicate (host, executor_id) detection (maps TFCluster.py:355-370):
-    # a task retry that re-bootstrapped would corrupt feed routing.
-    seen = set()
-    for n in cluster_info:
-        key = (n["host"], n["executor_id"])
-        if key in seen:
-            raise RuntimeError(f"duplicate node registered for {key}")
-        seen.add(key)
+        # Duplicate (host, executor_id) detection (maps TFCluster.py:355-370):
+        # a task retry that re-bootstrapped would corrupt feed routing.
+        seen = set()
+        for n in cluster_info:
+            key = (n["host"], n["executor_id"])
+            if key in seen:
+                raise RuntimeError(f"duplicate node registered for {key}")
+            seen.add(key)
+    except BaseException:
+        # a failed LAUNCH must not leak the rendezvous server or live
+        # executor processes (run_elastic retries; the hung-at-exit
+        # alternative is multiprocessing's atexit joining orphans forever)
+        try:
+            server.stop()
+        except Exception:
+            pass
+        try:
+            if hasattr(backend, "terminate"):
+                backend.terminate()
+        except Exception:
+            pass
+        raise
 
     # Failure detection (net-new, SURVEY.md §5): nodes heartbeat to the
     # rendezvous server; the monitor turns silence into a cluster error the
@@ -342,3 +382,55 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
     cluster._status = status
     logger.info("cluster is running: %d nodes", len(cluster_info))
     return cluster
+
+
+def run_elastic(backend_factory, map_fun, tf_args=None, *, train_data=None,
+                num_epochs=1, feed_timeout=600, grace_secs=0,
+                max_restarts=2, restart_backoff=2.0, **run_kwargs):
+    """Run a cluster end-to-end (launch -> feed -> shutdown) with
+    automatic RELAUNCH on node failure — the elasticity the reference's
+    fixed-size cluster never had (SURVEY.md §5 "no elasticity"), built
+    from the parts that already exist: the heartbeat monitor turns a
+    SIGKILLed/preempted node into a driver-visible error, and the
+    checkpoint layer (utils.checkpoint + a resume-capable ``map_fun``)
+    turns a relaunch into a continuation instead of a restart.
+
+    ``backend_factory`` — a zero-arg callable returning a FRESH backend
+    per attempt (LocalBackend executor pools do not survive terminate()),
+    or a live SparkContext / backend instance to reuse across attempts.
+
+    ``train_data`` — partitions/RDD fed via ``cluster.train`` each
+    attempt (InputMode.SPARK).  Delivery across restarts is
+    AT-LEAST-ONCE: a relaunch re-feeds the interrupted call's data; the
+    training fn must resume model state from its checkpoint (step
+    counters and loss continue; duplicate records within the interrupted
+    epoch are the documented cost — exactly-once feed offsets are a
+    non-goal here).  ``train_data=None`` runs NATIVE mode: nodes read
+    their own (resumable) input.
+
+    Raises after ``max_restarts`` failed relaunches.
+    """
+    input_mode = run_kwargs.pop(
+        "input_mode",
+        InputMode.SPARK if train_data is not None else InputMode.NATIVE)
+    attempt = 0
+    while True:
+        backend = backend_factory() if callable(backend_factory) \
+            else backend_factory
+        c = None
+        try:
+            c = run(backend, map_fun, tf_args, input_mode=input_mode,
+                    **run_kwargs)
+            if train_data is not None:
+                c.train(train_data, num_epochs=num_epochs,
+                        feed_timeout=feed_timeout)
+            c.shutdown(grace_secs=grace_secs)
+            return
+        except Exception as e:
+            attempt += 1
+            logger.warning("cluster attempt %d failed: %s", attempt, e)
+            if c is not None:
+                c.abort()
+            if attempt > max_restarts:
+                raise
+            time.sleep(restart_backoff)
