@@ -106,6 +106,15 @@ def _bootstrap_trampoline(fn, executor_id, workdir, status_q, manager_linger=600
     import signal as signal_mod
 
     def _on_term(_signum, _frame):
+        # children FIRST (the background node process — a grandchild
+        # nothing else tracks; left alive it would keep training and
+        # writing checkpoints into a relaunched attempt's resume), then
+        # the manager server, then exit
+        for child in mp.active_children():
+            try:
+                child.terminate()
+            except Exception:
+                pass
         for m in manager_mod._started_managers:
             try:
                 m.shutdown()
@@ -250,6 +259,11 @@ class LocalBackend(Backend):
                 )
                 p.start()
                 live_procs.append(p)
+                if cancelled.is_set():
+                    # closes the cancel/start race: terminate() set the
+                    # event and swept live_procs while we were between
+                    # the loop check and p.start()
+                    p.terminate()
                 p.join()
 
         # daemon: the normal path joins these explicitly below, but an

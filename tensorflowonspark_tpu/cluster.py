@@ -247,12 +247,25 @@ class TPUCluster:
         clear the ground for a relaunch."""
         logger.warning("aborting cluster (forceful teardown)")
         from . import manager as manager_mod
-        for n in self.cluster_info or []:
+
+        def _stop_manager(n):
             try:
                 mgr = manager_mod.connect(tuple(n["addr"]), n["authkey"])
                 mgr.set("state", "stopped")
             except Exception:
                 pass                     # dead node: nothing to stop
+
+        # bounded per node via daemon threads: a preempted host
+        # blackholing SYNs must not stall the relaunch for the kernel's
+        # ~130 s connect timeout (times N hosts, serially)
+        stoppers = []
+        for n in self.cluster_info or []:
+            t = threading.Thread(target=_stop_manager, args=(n,),
+                                 daemon=True)
+            t.start()
+            stoppers.append(t)
+        for t in stoppers:
+            t.join(timeout=5)
         try:
             if hasattr(self._backend, "terminate"):
                 self._backend.terminate()

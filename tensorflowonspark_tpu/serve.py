@@ -54,6 +54,11 @@ def build_argparser():
                         "this many decode slots; concurrent requests join "
                         "the in-flight batch at token boundaries "
                         "(mutually exclusive with --draft_export_dir)")
+    p.add_argument("--generate_read_chunk", type=int, default=8,
+                   help="slot batcher readback granularity: tokens reach "
+                        "clients in bursts of this size (larger = higher "
+                        "throughput on high-latency runtimes, burstier "
+                        "streams; 1 = per-token)")
     p.add_argument("--input_mapping", default=None)
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
@@ -202,6 +207,7 @@ class ModelService:
         self._draft_dir = getattr(args, "draft_export_dir", None)
         self._draft_k = getattr(args, "draft_k", 4)
         self._gen_slots = getattr(args, "generate_slots", 0) or 0
+        self._gen_read_chunk = getattr(args, "generate_read_chunk", 8) or 8
         self._batcher = None
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
@@ -232,7 +238,8 @@ class ModelService:
                         self.export_dir,
                         max_new_tokens_limit=self._max_new_limit,
                         draft_export_dir=self._draft_dir,
-                        draft_k=self._draft_k, slots=self._gen_slots)
+                        draft_k=self._draft_k, slots=self._gen_slots,
+                        read_chunk=self._gen_read_chunk)
                 except (TypeError, ValueError) as e:
                     logger.info(":generate unavailable: %s", e)
                     self._gen = False
@@ -545,7 +552,7 @@ class GenerateService:
         return built, params
 
     def __init__(self, export_dir, max_new_tokens_limit=512,
-                 draft_export_dir=None, draft_k=4, slots=0):
+                 draft_export_dir=None, draft_k=4, slots=0, read_chunk=8):
         self.model, self.params = self._load_lm(export_dir)
         self.draft_model = self.draft_params = None
         self.draft_k = draft_k
@@ -560,7 +567,9 @@ class GenerateService:
             self.draft_model, self.draft_params = \
                 self._load_lm(draft_export_dir)
         self.batcher = (ContinuousBatcher(self.model, self.params,
-                                          n_slots=slots) if slots else None)
+                                          n_slots=slots,
+                                          read_chunk=read_chunk)
+                        if slots else None)
         self.limit = max_new_tokens_limit
         self._lock = threading.Lock()
         self.requests = 0

@@ -17,23 +17,16 @@ from tensorflowonspark_tpu import backend, cluster
 
 
 def smoke_train_fn(args, ctx):
-    """Tiny jitted linear-regression step fed from the cluster: asserts
-    the shm ring transport actually engaged, then records what it saw."""
-    import jax
-    import jax.numpy as jnp
-
-    from tensorflowonspark_tpu import feed as feed_mod
+    """Tiny numpy sgd fed from the cluster: asserts the shm ring
+    transport actually engaged, then records what it saw.  Deliberately
+    NO jax in this fn: the node process is forked (transitively) from
+    the jax-threaded pytest process, and jit inside such a fork can
+    deadlock — the jitted-step variants live in the slow tier
+    (test_elastic, test_examples), where executors spawn fresh."""
+    import numpy as np
 
     df = ctx.get_data_feed(train_mode=True)
-
-    @jax.jit
-    def sgd_step(w, X, y):
-        def loss(w):
-            return jnp.mean((X @ w - y) ** 2)
-        g = jax.grad(loss)(w)
-        return w - 0.1 * g
-
-    w = jnp.zeros((2,), jnp.float32)
+    w = np.zeros(2)
     rows = 0
     batches = 0
     while not df.should_stop():
@@ -41,15 +34,16 @@ def smoke_train_fn(args, ctx):
         if cols is None or len(cols[0]) == 0:
             continue
         X = np.stack([np.asarray(cols[0]), np.asarray(cols[1])], axis=1)
-        y = np.asarray(cols[2], np.float32)
-        w = sgd_step(w, jnp.asarray(X, jnp.float32), jnp.asarray(y))
+        y = np.asarray(cols[2], np.float64)
+        g = 2.0 * X.T @ (X @ w - y) / len(y)   # d/dw mean((Xw-y)^2)
+        w -= 0.1 * g
         rows += len(y)
         batches += 1
     out = {
         "rows": rows,
         "batches": batches,
         "ring_attached": df._ring is not None,
-        "w": np.asarray(w).tolist(),
+        "w": w.tolist(),
     }
     with open(os.path.join(ctx.working_dir, "smoke.json"), "w") as f:
         json.dump(out, f)
@@ -75,5 +69,5 @@ def test_cluster_data_plane_smoke(tmp_path):
     assert out["rows"] == 256
     assert out["batches"] >= 8
     assert out["ring_attached"], "feed did not ride the shm ring"
-    # the jitted steps actually learned the line (direction, not parity)
+    # the sgd steps actually learned the line (direction, not parity)
     assert abs(out["w"][0] - 2.0) < 1.0 and abs(out["w"][1] + 3.0) < 1.0
