@@ -411,6 +411,11 @@ def run_elastic(backend_factory, map_fun, tf_args=None, *, train_data=None,
     ``backend_factory`` — a zero-arg callable returning a FRESH backend
     per attempt (LocalBackend executor pools do not survive terminate()),
     or a live SparkContext / backend instance to reuse across attempts.
+    Teardown strength differs by backend: LocalBackend attempts are
+    killed outright; a Spark backend has no executor-kill hook, so a
+    surviving node on an aborted attempt exits when its manager is
+    marked stopped (abort broadcasts that, bounded at 5 s/node) or at
+    its next feed timeout — size ``feed_timeout`` accordingly.
 
     ``train_data`` — partitions/RDD fed via ``cluster.train`` each
     attempt (InputMode.SPARK).  Delivery across restarts is

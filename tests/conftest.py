@@ -63,6 +63,10 @@ SLOW_FILES = {
     "test_pipeline.py",         # 45 s
     "test_pipelined_lm.py",     # 25 s
     "test_ring_attention.py",   # 31 s
+    "test_serve.py",            # 68 s — HTTP servers + decode compiles
+    "test_slots.py",            # 31 s — slot-decode parity compiles
+    # (both grew past the fast budget with the round-4 continuous-
+    # batching work; the fast tier keeps the cluster data-plane smoke)
     "test_spark_integration.py",  # 110 s — end-to-end Spark surface
     "test_spark_real.py",       # same bodies over real pyspark (skips
     # in seconds when pyspark is absent, but runs minutes when present)
