@@ -51,7 +51,7 @@ SLOW_FILES = {
     "test_convert.py",          # 31 s — HF checkpoint parity
     "test_decode.py",           # 62 s — KV-cache generation compiles
     "test_deeplab.py",          # 53 s — dilated-conv compiles
-    "test_elastic.py",          # 41 s — SIGKILL + relaunch integration
+    "test_elastic.py",          # ~80 s — SIGKILL + relaunch integration (LocalBackend + minispark paths)
     "test_examples.py",         # >10 min — example subprocesses
     "test_hybrid_mesh.py",      # 11 s — multi-slice mesh compiles
     "test_lora.py",             # 25 s

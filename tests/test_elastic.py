@@ -127,3 +127,32 @@ def test_exhausted_restarts_raise(tmp_path):
             feed_timeout=10, max_restarts=1, restart_backoff=0.2,
             grace_secs=0, heartbeat_timeout=6)
     assert attempt[0] == 2      # initial + one restart, then raise
+
+
+def test_elastic_over_minispark_reuses_executors(tmp_path):
+    """The Spark-shaped path: run_elastic reuses the SAME SparkContext
+    (and thus the same executor processes) across attempts — the relaunch
+    must re-bootstrap nodes in executor workdirs that still hold the
+    previous attempt's manager advertisement."""
+    from tensorflowonspark_tpu import minispark
+    if not minispark.install():
+        pytest.skip("real pyspark present")
+    import pyspark
+
+    sc = pyspark.SparkContext(num_executors=1,
+                              workdir=str(tmp_path / "spark"))
+    try:
+        model_dir = str(tmp_path / "model")
+        os.makedirs(model_dir)
+        xs = [3.0 * i / 200.0 for i in range(200)]
+        rdd = sc.parallelize([(x, 2.0 * x) for x in xs], 2)
+        cluster.run_elastic(
+            sc, elastic_train_fn, {"model_dir": model_dir},
+            train_data=rdd, feed_timeout=20, max_restarts=1,
+            restart_backoff=0.5, grace_secs=1, heartbeat_timeout=6)
+        with open(os.path.join(model_dir, "result.json")) as f:
+            result = json.load(f)
+        assert result["start_step"] == 6, result
+        assert result["final_step"] >= 12, result
+    finally:
+        sc.stop()
