@@ -53,7 +53,8 @@ def make_schedule(learning_rate, schedule="constant", warmup_steps=0,
 def make_optimizer(name="adamw", learning_rate=1e-3, schedule="constant",
                    warmup_steps=0, total_steps=None, end_value=0.0,
                    weight_decay=0.0, clip_norm=None, b1=None, b2=None,
-                   momentum=0.9, decay_mask=None, mu_dtype=None):
+                   momentum=0.9, decay_mask=None, mu_dtype=None,
+                   layouts=None):
     """Build `(optax_optimizer, schedule_fn)` from plain config values.
 
     `decay_mask` (a pytree-of-bools fn or tree) routes weight decay away
@@ -79,6 +80,10 @@ def make_optimizer(name="adamw", learning_rate=1e-3, schedule="constant",
         mu_dtype = jnp.dtype(mu_dtype)
     if mu_dtype is not None and name not in ("adam", "adamw", "lion"):
         raise ValueError(f"optimizer={name!r} has no mu_dtype knob")
+    if layouts is not None and name != "adamw8bit":
+        raise ValueError(
+            f"optimizer={name!r} has no quantized-state layouts knob "
+            "(layouts= is adamw8bit-only; see optim8bit.layouts_for_shardings)")
 
     if name not in OPTIMIZERS:
         raise ValueError(f"optimizer={name!r} not in {OPTIMIZERS}")
@@ -103,7 +108,7 @@ def make_optimizer(name="adamw", learning_rate=1e-3, schedule="constant",
         from tensorflowonspark_tpu import optim8bit
         core = optim8bit.adamw8bit(sched, b1=b1 or 0.9, b2=b2 or 0.999,
                                    weight_decay=weight_decay,
-                                   mask=decay_mask)
+                                   mask=decay_mask, layouts=layouts)
     elif name == "sgd":
         core = optax.sgd(sched, momentum=momentum)
     elif name == "lion":

@@ -38,7 +38,7 @@ def create_train_state(params, optimizer, mesh=None, param_shardings=None):
 
 def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
                     grad_accum=1, compute_dtype=None, donate=True,
-                    example_params=None):
+                    example_params=None, layouts=None):
     """Build the jitted train step.
 
     `loss_fn(params, batch, rng) -> scalar loss` — the mean over the LOCAL
@@ -51,6 +51,13 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
     whose state the shardings alone cannot place — optim8bit's quantized
     moments, which then shard along their block axis instead of
     replicating (see _quantized_shardings).
+
+    ``layouts`` — the SAME pytree the 8-bit optimizer was built with
+    (``optim8bit.layouts_for_shardings(params, shardings)``); declares
+    that each param's quantized state uses the shard-aligned block
+    layout, so it shards by the param's FULL spec (fsdp and tp axes).
+    Explicit on purpose: the aligned payload's shape coincides with the
+    row-major one in the common case, so it cannot be detected.
 
     Returns `train_step(state, batch, rng) -> (state, metrics)`.
     """
@@ -112,7 +119,7 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
         state_shardings = TrainState(
             step=repl, params=param_shardings,
             opt_state=_opt_state_shardings(optimizer, param_shardings, repl,
-                                           example_params))
+                                           example_params, layouts))
         in_shardings = (state_shardings, batch_shard, repl)
         out_shardings = (state_shardings, repl)
 
@@ -122,7 +129,7 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
 
 
 def _opt_state_shardings(optimizer, param_shardings, repl,
-                         example_params=None):
+                         example_params=None, layouts=None):
     """Mirror param shardings onto optimizer slots (mu/nu mirror the param
     tree and inherit its shardings; scalar slots like counts replicate).
 
@@ -140,13 +147,23 @@ def _opt_state_shardings(optimizer, param_shardings, repl,
             example_params)
         state_shapes = jax.eval_shape(optimizer.init, shapes)
         return _map_state(state_shapes, param_shardings, repl,
-                          with_shapes=True)
+                          param_shapes=shapes, layouts=layouts)
     dummy = jax.tree_util.tree_map(lambda s: jnp.zeros(()), param_shardings)
-    state = optimizer.init(dummy)
+    try:
+        state = optimizer.init(dummy)
+    except ValueError as e:
+        # e.g. adamw8bit built with layouts=: its init is shape-dependent
+        # and cannot run on placeholder scalars
+        raise ValueError(
+            "deriving optimizer-state shardings from placeholder scalar "
+            "params failed — an optimizer with shape-dependent state "
+            "(e.g. adamw8bit with layouts=) needs example_params passed "
+            "to make_train_step") from e
     return _map_state(state, param_shardings, repl)
 
 
-def _map_state(state, param_shardings, repl, with_shapes=False):
+def _map_state(state, param_shardings, repl, param_shapes=None,
+               layouts=None):
     import jax
 
     params_struct = jax.tree_util.tree_structure(param_shardings)
@@ -158,8 +175,9 @@ def _map_state(state, param_shardings, repl, with_shapes=False):
         # recursion because Quantized is itself a NamedTuple and naive
         # descent would walk into its q/scale fields and lose the
         # params pairing
-        if with_shapes:
-            return _quantized_shardings(state, param_shardings, repl)
+        if param_shapes is not None:
+            return _quantized_shardings(state, param_shardings, repl,
+                                        param_shapes, layouts)
         logger.warning(
             "8-bit optimizer state is replicated under explicit param "
             "shardings; pass example_params to make_train_step to shard "
@@ -167,17 +185,19 @@ def _map_state(state, param_shardings, repl, with_shapes=False):
         return jax.tree_util.tree_map(lambda _: repl, state)
     if hasattr(state, "_fields"):  # NamedTuple (ScaleByAdamState etc.)
         return type(state)(*(_map_state(getattr(state, f), param_shardings,
-                                        repl, with_shapes)
+                                        repl, param_shapes, layouts)
                              for f in state._fields))
     if isinstance(state, (tuple, list)):
-        return type(state)(_map_state(s, param_shardings, repl, with_shapes)
+        return type(state)(_map_state(s, param_shardings, repl, param_shapes,
+                                      layouts)
                            for s in state)
     if _has_quantized(state):
-        if with_shapes:
+        if param_shapes is not None:
             # shape-aware path (make_train_step(..., example_params=...)):
             # each param's quantized moments shard along their flat block
             # axis when each mesh shard owns a whole number of blocks
-            return _quantized_shardings(state, param_shardings, repl)
+            return _quantized_shardings(state, param_shardings, repl,
+                                        param_shapes, layouts)
         # optim8bit state without shape info (checked AFTER container
         # recursion so only the subtrees that actually hold Quantized
         # replicate — a chained f32 ema/accumulator state still gets
@@ -195,32 +215,58 @@ def _map_state(state, param_shardings, repl, with_shapes=False):
     return jax.tree_util.tree_map(lambda _: repl, state)
 
 
-def _quantized_shardings(q_state_shapes, param_shardings, repl):
+def _quantized_shardings(q_state_shapes, param_shardings, repl,
+                         param_shapes, layouts=None):
     """Shardings for a params-shaped tree of Quantized shape-structs.
 
-    A Quantized payload is the param flattened row-major into
-    ``[n_blocks, block]``.  When the param is sharded on dim 0 ONLY
-    (fsdp-style row sharding) each shard owns a contiguous flat range;
-    if that range is a whole number of blocks, sharding q and scale on
-    THEIR dim 0 over the same axis places every block exactly with its
-    rows — zero extra communication.  Any other layout (non-dim-0
-    sharding, non-divisible blocks) replicates that param's state: GSPMD
-    would otherwise reshard every step.
+    Preferred route — shard-aligned layout, declared via ``layouts``
+    (the same tree the optimizer was built with): each param's blocks
+    were computed over its logical shards (shard-major flatten), so
+    q/scale shard on dim 0 by the param's FULL spec (fsdp AND tp axes)
+    with zero extra communication.  The layout is NEVER guessed from
+    shapes: an aligned payload's shape coincides with the row-major one
+    whenever each shard's elements are a block multiple (the common
+    production case), and sharding a row-major payload by a multi-dim
+    spec would make GSPMD reshard the int8 state every step.  A layout
+    that doesn't match the declared sharding or the payload shape is an
+    error, not a silent fallback.
 
-    The gate checks block-count divisibility; if the param's true element
-    count is not itself a multiple of shards x block (a padded tail
-    crossing a shard boundary), GSPMD still computes correctly but
-    inserts a gather — typical power-of-two layer shapes with the
-    default block (256) are exactly aligned.
+    Fallback — dim-0-only: a layout-less payload under fsdp-style row
+    sharding still shards on its block axis when each shard owns a whole
+    number of blocks (row-major flatten IS shard-major there).  Anything
+    else (a TP axis in the spec without a declared layout, indivisible
+    blocks) replicates that param's state, loudly.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
-    from tensorflowonspark_tpu.optim8bit import Quantized
+    from tensorflowonspark_tpu.optim8bit import (
+        Quantized, expected_blocks, shard_layout)
 
-    def per_param(sharding, qt):
+    def per_param(sharding, qt, pshape, layout):
         spec = tuple(getattr(sharding, "spec", ()) or ())
         mesh = getattr(sharding, "mesh", None)
-        n_blocks = qt.q.shape[0]
+        n_blocks, block = qt.q.shape
+        shape = tuple(pshape.shape)
+        if layout is not None and any(n > 1 for n in layout):
+            if layout != shard_layout(shape, sharding):
+                raise ValueError(
+                    f"declared quantized-state layout {layout} does not "
+                    f"match sharding {spec} for param shape {shape} "
+                    "(build both from optim8bit.layouts_for_shardings "
+                    "with the same shardings)")
+            if n_blocks != expected_blocks(shape, layout, block):
+                raise ValueError(
+                    f"quantized payload {tuple(qt.q.shape)} for param "
+                    f"shape {shape} was not built with layout {layout} "
+                    "(pass the same layouts= to the optimizer and "
+                    "make_train_step)")
+            axes = []
+            for entry in spec:
+                names = (() if entry is None else entry
+                         if isinstance(entry, tuple) else (entry,))
+                axes.extend(a for a in names if mesh.shape.get(a, 1) > 1)
+            s = NamedSharding(mesh, PartitionSpec(tuple(axes), None))
+            return Quantized(q=s, scale=s)
         if (mesh is not None and spec and spec[0] is not None
                 and all(a is None for a in spec[1:])):
             axis = spec[0]
@@ -230,16 +276,22 @@ def _quantized_shardings(q_state_shapes, param_shardings, repl):
                 return Quantized(q=s, scale=s)
         if any(a is not None for a in spec):
             # the documented loud fallback: a sharded param whose
-            # quantized state cannot ride the block axis (non-dim-0
-            # layout or indivisible block count) replicates
+            # quantized state cannot ride the block axis (layout-less
+            # TP sharding or indivisible block count) replicates —
+            # build the optimizer with optim8bit.layouts_for_shardings
+            # and pass layouts= to make_train_step to shard it
             logger.warning(
                 "quantized optimizer state for a param sharded %s "
                 "(%d blocks) cannot shard along its block axis; "
-                "replicating that param's int8 state", spec, n_blocks)
+                "replicating that param's int8 state (build the "
+                "optimizer with layouts=optim8bit.layouts_for_shardings "
+                "and pass layouts= to make_train_step)", spec, n_blocks)
         return Quantized(q=repl, scale=repl)
 
+    if layouts is None:
+        layouts = jax.tree_util.tree_map(lambda _: None, param_shardings)
     return jax.tree_util.tree_map(
-        per_param, param_shardings, q_state_shapes,
+        per_param, param_shardings, q_state_shapes, param_shapes, layouts,
         is_leaf=lambda x: isinstance(x, Quantized))
 
 

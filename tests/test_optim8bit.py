@@ -241,6 +241,208 @@ def test_fsdp_quantized_state_replicates_when_indivisible():
     assert q.sharding.is_fully_replicated
 
 
+def test_shard_major_layout_round_trip():
+    # layout quantization: blocks are computed per logical shard; the
+    # round trip hits the same error bound as the row-major layout, and
+    # row k of the shard-major flatten is exactly shard k's elements
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(12, 10) * 3.0, jnp.float32)
+    qt = optim8bit.quantize(x, block=8, layout=(2, 2))
+    # 4 shards x ceil(30/8)=4 blocks each
+    assert qt.q.shape == (16, 8)
+    out = optim8bit.dequantize(qt, (12, 10), layout=(2, 2))
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    assert err.max() <= np.abs(np.asarray(x)).max() / 127 + 1e-6
+
+    sm = np.asarray(optim8bit._shard_major(x, (2, 2)))
+    xs = np.asarray(x)
+    manual = np.stack([xs[i * 6:(i + 1) * 6, j * 5:(j + 1) * 5].reshape(-1)
+                       for i in range(2) for j in range(2)])
+    np.testing.assert_array_equal(sm, manual)
+
+
+def test_layout_one_matches_no_layout():
+    x = jnp.asarray(np.random.RandomState(1).randn(12, 10), jnp.float32)
+    qa = optim8bit.quantize(x, block=8)
+    qb = optim8bit.quantize(x, block=8, layout=(1, 1))
+    np.testing.assert_array_equal(np.asarray(qa.q), np.asarray(qb.q))
+    np.testing.assert_array_equal(np.asarray(qa.scale),
+                                  np.asarray(qb.scale))
+
+
+def test_layouts_for_shardings():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+    params = {"w": jnp.ones((8, 4)), "b": jnp.ones((4,)),
+              "odd": jnp.ones((7, 4)), "s": jnp.ones(())}
+    shardings = {"w": NamedSharding(mesh, P("fsdp", "tp")),
+                 "b": NamedSharding(mesh, P()),
+                 "odd": NamedSharding(mesh, P("fsdp", None)),
+                 "s": NamedSharding(mesh, P())}
+    lts = optim8bit.layouts_for_shardings(params, shardings)
+    assert lts["w"] == (2, 2)
+    assert lts["b"] is None          # replicated -> no layout
+    assert lts["odd"] is None        # 7 % 2 != 0 -> no aligned layout
+    assert lts["s"] is None          # scalar
+
+
+def test_fsdp_tp_sharded_quantized_state_with_layouts():
+    # the round-5 fix: a param sharded on BOTH dims (fsdp x tp — every
+    # Megatron matrix) gets SHARDED int8 state when the optimizer is
+    # built with layouts_for_shardings, and the sharded step matches a
+    # single-device run of the same optimizer exactly (layout is pure
+    # math; sharding cannot change values)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(16, 8), jnp.float32)}
+    shardings = {"w": NamedSharding(mesh, P("fsdp", "tp"))}
+    X = jnp.asarray(np.random.RandomState(1).randn(8, 16), jnp.float32)
+
+    def loss_fn(p, batch, rng):
+        return jnp.mean((batch @ p["w"]) ** 2)
+
+    layouts = optim8bit.layouts_for_shardings(params, shardings)
+    assert layouts["w"] == (2, 2)
+    opt = optim8bit.adamw8bit(1e-2, block_size=8, layouts=layouts)
+
+    ref_state = train_mod.create_train_state(
+        jax.tree_util.tree_map(jnp.copy, params), opt)
+    ref_step = train_mod.make_train_step(loss_fn, opt, donate=False)
+    state = train_mod.create_train_state(
+        jax.tree_util.tree_map(jnp.copy, params), opt)
+    step = train_mod.make_train_step(
+        loss_fn, opt, param_shardings=shardings, example_params=params,
+        layouts=layouts, donate=False)
+
+    for _ in range(5):
+        ref_state, ref_m = ref_step(ref_state, X, jax.random.key(0))
+        state, m = step(state, X, jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(m["loss"]),
+                               np.asarray(ref_m["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(state.params["w"]),
+                               np.asarray(ref_state.params["w"]),
+                               rtol=1e-5, atol=1e-7)
+    q = state.opt_state[0].mu["w"].q
+    assert q.sharding.spec == P(("fsdp", "tp"), None), q.sharding
+    assert not q.sharding.is_fully_replicated
+
+
+def test_layoutless_multidim_payload_replicates_not_missharded():
+    # review regression: a layout-less (row-major) payload under fsdp x tp
+    # sharding must REPLICATE (loudly), never be sharded by the multi-dim
+    # spec — its shape coincides with the aligned layout whenever
+    # per_shard is a block multiple, so detection must not guess
+    import logging as logging_mod
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+    params = {"w": jnp.ones((16, 8), jnp.float32)}
+    shardings = {"w": NamedSharding(mesh, P("fsdp", "tp"))}
+    opt = optim8bit.adamw8bit(1e-2, block_size=8)   # NO layouts
+    repl = NamedSharding(mesh, P())
+    mapped = train_mod._opt_state_shardings(opt, shardings, repl,
+                                            example_params=params)
+    assert mapped[0].mu["w"].q == repl, mapped[0].mu["w"]
+
+
+def test_layout_mismatch_raises():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+    params = {"w": jnp.ones((16, 8), jnp.float32)}
+    shardings = {"w": NamedSharding(mesh, P("fsdp", None))}
+    # declared layout says fsdp x tp, sharding says fsdp-only -> error
+    opt = optim8bit.adamw8bit(1e-2, block_size=8, layouts={"w": (2, 2)})
+    repl = NamedSharding(mesh, P())
+    with pytest.raises(ValueError, match="does not match sharding"):
+        train_mod._opt_state_shardings(opt, shardings, repl,
+                                       example_params=params,
+                                       layouts={"w": (2, 2)})
+
+
+def test_dequantize_validates_layout():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 4), jnp.float32)
+    # block 3: shard sizes aren't block multiples, so mismatched layouts
+    # disagree on the padded row count and the check can fire
+    qt = optim8bit.quantize(x, block=3, layout=(2, 2))
+    with pytest.raises(ValueError, match="not quantized with layout"):
+        optim8bit.dequantize(qt, (4, 4), layout=(2, 1))
+    with pytest.raises(ValueError, match="does not tile"):
+        optim8bit.dequantize(qt, (5, 4), layout=(2, 2))
+    # wrong-rank layouts must raise even when all-ones
+    with pytest.raises(ValueError, match="does not tile"):
+        optim8bit.quantize(x, block=3, layout=(1,))
+    with pytest.raises(ValueError, match="does not tile"):
+        optim8bit.dequantize(qt, (4, 4), layout=(1,))
+
+
+def test_layouts_optimizer_needs_example_params():
+    # an optimizer whose init is shape-dependent cannot derive state
+    # shardings from placeholder scalars; the error must say what to do
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel import train as train_mod
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+    shardings = {"w": NamedSharding(mesh, P("fsdp", "tp"))}
+    opt = optim8bit.adamw8bit(1e-2, block_size=8, layouts={"w": (2, 2)})
+
+    def loss_fn(p, batch, rng):
+        return jnp.mean(p["w"] ** 2)
+
+    with pytest.raises(ValueError, match="example_params"):
+        train_mod.make_train_step(loss_fn, opt, param_shardings=shardings,
+                                  donate=False)
+
+
+def test_layouts_convergence_parity():
+    # block boundaries move under a layout but optimizer quality must not
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+    params = {"w": jnp.zeros((8, 3), jnp.float32)}
+    # 8x3 doesn't tile 2x2 on dim 1 -> helper declines; force a dim-0
+    # layout to exercise the layouts= code path in _train's tree
+    layouts = optim8bit.layouts_for_shardings(
+        params, {"w": NamedSharding(mesh, P("fsdp", None))})
+    assert layouts["w"] == (2, 1)
+    ref = _train(optim8bit.adamw8bit(1e-2))
+    got = _train(optim8bit.adamw8bit(1e-2, layouts=layouts))
+    assert got < ref * 1.15 + 1e-6, (got, ref)
+
+
+def test_factory_layouts_passthrough_and_rejection():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("fsdp", "tp"))
+    layouts = {"w": (2, 2)}
+    opt, _ = optim.make_optimizer("adamw8bit", learning_rate=1e-2,
+                                  layouts=layouts)
+    params = {"w": jnp.ones((8, 4), jnp.float32)}
+    state = opt.init(params)
+    # 4 shards x ceil(8/256)=1 block each
+    assert state[0].mu["w"].q.shape == (4, 256)
+    with pytest.raises(ValueError, match="layouts"):
+        optim.make_optimizer("adamw", learning_rate=1e-2, layouts=layouts)
+
+
 def test_fsdp_sharded_quantized_state_namedtuple_params():
     # params in a NamedTuple container must shard the same as a dict:
     # Quantized is itself a NamedTuple, so naive recursion would descend
