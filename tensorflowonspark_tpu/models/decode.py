@@ -149,26 +149,29 @@ def _reset_row_indices(row_cache, value):
 
 @functools.lru_cache(maxsize=32)
 def _jitted_slot_prefill(slot_model):
-    """Prefill ONE slot row: slice row `row` out of the batch cache, run
-    the prompt block through it from position 0, write the row back.
-    `prompt` is bucket-padded to a static length; `true_len` (traced) is
-    the real prompt length — the returned logits are the position
-    `true_len - 1` distribution and the row index rewinds to `true_len`,
-    so the pad tail is never visible to later steps."""
+    """Prefill ONE slot row with one prompt CHUNK: slice row `row` out of
+    the batch cache, run the chunk through it starting at position
+    `start`, write the row back.  `chunk` is bucket-padded to a static
+    length; `n_valid` (traced) is the number of real tokens in it — the
+    row index lands at ``start + n_valid`` so the pad tail is never
+    visible to later steps.  The returned logits are the LAST valid
+    position's distribution (only meaningful on the final chunk of a
+    prompt).  Whole-prompt prefill is the single-chunk case
+    (start=0, n_valid=true_len)."""
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def prefill(params, cache, prompt, row, true_len):
+    def prefill(params, cache, chunk, row, start, n_valid):
         row_cache = jax.tree_util.tree_map(
             lambda a: jax.lax.dynamic_slice_in_dim(a, row, 1, 0), cache)
-        row_cache = _reset_row_indices(row_cache, 0)
+        row_cache = _reset_row_indices(row_cache, start)
         logits, mut = slot_model.apply(
-            {"params": params, "cache": row_cache}, prompt,
+            {"params": params, "cache": row_cache}, chunk,
             mutable=["cache"])
-        new_row = _reset_row_indices(mut["cache"], true_len)
+        new_row = _reset_row_indices(mut["cache"], start + n_valid)
         cache = jax.tree_util.tree_map(
             lambda full, upd: jax.lax.dynamic_update_slice_in_dim(
                 full, upd, row, 0), cache, new_row)
-        last = jax.lax.dynamic_slice_in_dim(logits, true_len - 1, 1, 1)
+        last = jax.lax.dynamic_slice_in_dim(logits, n_valid - 1, 1, 1)
         return last[:, 0], cache          # [1, V], updated batch cache
 
     return prefill
@@ -179,23 +182,31 @@ def _jitted_slot_step(slot_model):
     """One decode step over ALL slots: feed each row its current token,
     per-row greedy/sampled pick (`temps[b] == 0` = greedy).
 
-    The rng is CARRIED device-side (split inside the step and returned)
-    so the serving loop issues exactly ONE dispatch per token — on
-    tunneled runtimes every extra per-step device op (a host fold_in, an
-    h2d of tokens) costs a full round trip (measured ~200 ms/step with
-    naive per-step host traffic vs ~20 ms with the resident chain)."""
+    Sampling keys follow the SHARED schedule (`step_keys`): row b's noise
+    for its new-token ordinal ``ords[b]`` is ``fold_in(key(seeds[b]),
+    ords[b])`` — a pure function of the request seed and position, so a
+    slot run reproduces a solo `generate(rng=key(seed))` token-for-token
+    (same dtype/program caveats aside).  All chains live device-side so
+    the serving loop issues exactly ONE dispatch per token — on tunneled
+    runtimes every extra per-step device op (a host fold_in, an h2d of
+    tokens) costs a full round trip (measured ~200 ms/step with naive
+    per-step host traffic vs ~20 ms with resident chains)."""
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def step(params, cache, toks, temps, rng):
-        rng_out, rng_use = jax.random.split(rng)
+    def step(params, cache, toks, temps, seeds, ords):
         logits, mut = slot_model.apply(
             {"params": params, "cache": cache}, toks[:, None],
             mutable=["cache"])
         logits = logits[:, -1]
         greedy = jnp.argmax(logits, axis=-1)
-        sampled = jax.random.categorical(
-            rng_use, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
-        return jnp.where(temps > 0, sampled, greedy), mut["cache"], rng_out
+        keys = jax.vmap(
+            lambda s, t: jax.random.fold_in(jax.random.key(s), t))(
+                seeds, ords)
+        sampled = jax.vmap(
+            lambda k, lg, T: jax.random.categorical(k, lg / T))(
+                keys, logits, jnp.maximum(temps, 1e-6))
+        return (jnp.where(temps > 0, sampled, greedy), mut["cache"],
+                ords + 1)
 
     return step
 
@@ -203,13 +214,93 @@ def _jitted_slot_step(slot_model):
 @functools.lru_cache(maxsize=32)
 def _jitted_set_row(slot_model):
     """Tiny device update used at slot joins: place the joining request's
-    first token / temperature into row `row` of the resident arrays."""
+    first token / temperature / sampling chain into row `row` of the
+    resident arrays."""
 
     @jax.jit
-    def set_row(toks, temps, row, tok, temp):
-        return toks.at[row].set(tok), temps.at[row].set(temp)
+    def set_row(toks, temps, seeds, ords, row, tok, temp, seed, ordinal):
+        return (toks.at[row].set(tok), temps.at[row].set(temp),
+                seeds.at[row].set(seed), ords.at[row].set(ordinal))
 
     return set_row
+
+
+def _set_row_indices_vec(cache, values):
+    """Set every per-row index leaf (cache_index / pos_index) of the full
+    slot cache to the per-row `values` [n_slots] (speculative rewind)."""
+    values = jnp.asarray(values, jnp.int32)
+
+    def set_leaf(path, leaf):
+        last = path[-1]
+        name = getattr(last, "key", getattr(last, "name", None))
+        if name in ("cache_index", "pos_index"):
+            return jnp.broadcast_to(values, leaf.shape).astype(jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_spec_round(t_model, d_model, k):
+    """One fused speculative round over ALL slots (greedy rows only):
+    k unrolled draft slot-steps propose, ONE target pass over the [n, k]
+    block verifies, per-row longest-prefix acceptance commits 1..k tokens,
+    and BOTH caches rewind per row — a single dispatch per round.
+
+    Returns ``(new_toks, t_next [n, k], commit [n], t_cache, d_cache)``:
+    row r committed ``commit[r]`` tokens this round, which are
+    ``t_next[r, :commit[r]]`` (every committed token is the target's own
+    greedy choice — speculation changes speed, never tokens).  Unlike the
+    grouped `speculative_generate` (batch-min acceptance), acceptance is
+    PER ROW: each slot advances at its own agreement rate.  Inactive rows
+    decode garbage the serving loop's generation filter drops; their
+    cache writes land beyond any live region and rewind with everyone
+    else."""
+
+    def _first_index_leaf(cache):
+        found = []
+
+        def look(path, leaf):
+            name = getattr(path[-1], "key", getattr(path[-1], "name", None))
+            if name == "cache_index":
+                found.append(leaf)
+            return leaf
+
+        jax.tree_util.tree_map_with_path(look, cache)
+        return found[0]
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def spec_round(t_params, d_params, t_cache, d_cache, toks):
+        # per-row committed length = cache_index before this round (all
+        # layers agree; read one leaf)
+        idx = _first_index_leaf(t_cache)
+        props = []
+        d_tok = toks
+        for _ in range(k):                      # unrolled: k static
+            d_logits, mut = d_model.apply(
+                {"params": d_params, "cache": d_cache}, d_tok[:, None],
+                mutable=["cache"])
+            d_cache = mut["cache"]
+            d_tok = jnp.argmax(d_logits[:, -1], axis=-1)
+            props.append(d_tok)
+        props = jnp.stack(props, axis=1)                     # [n, k]
+        block = jnp.concatenate([toks[:, None], props[:, :-1]], axis=1)
+        t_logits, mut = t_model.apply(
+            {"params": t_params, "cache": t_cache}, block,
+            mutable=["cache"])
+        t_cache = mut["cache"]
+        t_next = jnp.argmax(t_logits, axis=-1)               # [n, k]
+        matches = props == t_next
+        a = jnp.where(matches.all(axis=1), k - 1,
+                      jnp.argmin(matches, axis=1))           # [n], <= k-1
+        commit = a + 1                                       # 1..k tokens
+        new_toks = jnp.take_along_axis(t_next, a[:, None], axis=1)[:, 0]
+        new_idx = idx + commit
+        t_cache = _set_row_indices_vec(t_cache, new_idx)
+        d_cache = _set_row_indices_vec(d_cache, new_idx)
+        return new_toks, t_next, commit, t_cache, d_cache
+
+    return spec_round
 
 
 _LOOP_PROBE = {}    # platform name -> measured "scan" | "host" verdict
@@ -305,6 +396,17 @@ def _set_cache_index(cache, value):
     return jax.tree_util.tree_map_with_path(set_leaf, cache)
 
 
+def step_keys(rng, n):
+    """The sampling key schedule shared by EVERY decode path: the key for
+    new-token ordinal ``t`` is ``fold_in(rng, t)``.  A pure function of
+    (request key, position), so a solo `generate`, a `generate_stream`,
+    and a serving slot (serve.ContinuousBatcher keeps per-row (seed,
+    ordinal) and derives the same keys on device) all sample IDENTICAL
+    noise for the same request — cross-path parity is by construction,
+    not by luck (tests/test_slots.py pins it)."""
+    return jax.vmap(lambda t: jax.random.fold_in(rng, t))(jnp.arange(n))
+
+
 def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
                     rng=None, eos_id=None):
     """Yield each new token as a host numpy [B] array as soon as it is
@@ -312,9 +414,10 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     per-token readback is inherent to streaming).
 
     Token-for-token identical to ``generate(...)`` with the same
-    arguments: the rng split order matches, so a streamed sampling run
-    reproduces the batch call.  The serving layer forwards these as
-    server-sent events (`serve`'s ``:generate`` with ``"stream": true``).
+    arguments: both draw token ``t``'s noise from ``fold_in(rng, t)``
+    (see `step_keys`), so a streamed sampling run reproduces the batch
+    call.  The serving layer forwards these as server-sent events
+    (`serve`'s ``:generate`` with ``"stream": true``).
     """
     import numpy as np
 
@@ -338,9 +441,9 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
         return jnp.argmax(logits, axis=-1)
 
     rng = rng if rng is not None else jax.random.key(0)
+    keys = step_keys(rng, max_new_tokens)
     last_logits, cache = _step(params, prompt, cache)         # prefill
-    rng, sub = jax.random.split(rng)
-    tok = pick(last_logits, sub)
+    tok = pick(last_logits, keys[0])
     done = jnp.zeros(tok.shape, bool)
     if eos_id is not None:
         done = done | (tok == eos_id)
@@ -351,9 +454,8 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
                                eos_id is not None)
     temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
     eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
-    rngs = jax.random.split(rng, max(max_new_tokens - 1, 0))
     for t in range(max_new_tokens - 1):
-        tok, cache, done = body(params, tok, cache, done, rngs[t],
+        tok, cache, done = body(params, tok, cache, done, keys[t + 1],
                                 temp, eos)
         yield np.asarray(tok)
 
@@ -520,9 +622,9 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         return jnp.argmax(logits, axis=-1)
 
     rng = rng if rng is not None else jax.random.key(0)
+    keys = step_keys(rng, max_new_tokens)
     last_logits, cache = step(prompt, cache)                  # prefill
-    rng, sub = jax.random.split(rng)
-    tok = pick(last_logits, sub)                              # [B]
+    tok = pick(last_logits, keys[0])                          # [B]
     done = jnp.zeros(tok.shape, bool)
     if eos_id is not None:
         done = done | (tok == eos_id)
@@ -537,7 +639,6 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
             done = done | (nxt == eos_id)
         return (nxt, cache, done), nxt
 
-    rngs = jax.random.split(rng, max(max_new_tokens - 1, 0))
     if loop == "host":
         # same per-token program, host-dispatched: ONE jitted call per
         # token (step + pick + eos fused), every call queued async (no
@@ -549,11 +650,12 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
         toks = [tok]
         for t in range(max_new_tokens - 1):
-            tok, cache, done = body(params, tok, cache, done, rngs[t],
+            tok, cache, done = body(params, tok, cache, done, keys[t + 1],
                                     temp, eos)
             toks.append(tok)
         new_tokens = jnp.stack(toks, axis=1)
     else:
-        (_, _, _), rest = jax.lax.scan(scan_body, (tok, cache, done), rngs)
+        (_, _, _), rest = jax.lax.scan(scan_body, (tok, cache, done),
+                                       keys[1:])
         new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, new_tokens], axis=1)

@@ -46,19 +46,27 @@ def build_argparser():
     p.add_argument("--draft_export_dir", default=None,
                    help="a smaller decoder-LM export used as the "
                         "speculative draft for greedy :generate requests "
-                        "(identical outputs, faster when the draft agrees)")
+                        "(identical outputs, faster when the draft agrees); "
+                        "speculation runs inside the decode slots")
     p.add_argument("--draft_k", type=int, default=4,
                    help="draft tokens proposed per verification pass")
-    p.add_argument("--generate_slots", type=int, default=0,
-                   help=">0 enables continuous batching for :generate — "
-                        "this many decode slots; concurrent requests join "
-                        "the in-flight batch at token boundaries "
-                        "(mutually exclusive with --draft_export_dir)")
+    p.add_argument("--generate_slots", type=int, default=8,
+                   help="decode slots of the :generate engine (continuous "
+                        "batching: concurrent requests join the in-flight "
+                        "batch at token boundaries); every request decodes "
+                        "through slots")
     p.add_argument("--generate_read_chunk", type=int, default=8,
                    help="slot batcher readback granularity: tokens reach "
                         "clients in bursts of this size (larger = higher "
                         "throughput on high-latency runtimes, burstier "
                         "streams; 1 = per-token)")
+    p.add_argument("--generate_prefill_chunk", type=int, default=512,
+                   help="admission prefill chunk (tokens): long prompts "
+                        "prefill in chunks interleaved with decode steps "
+                        "so in-flight streams stall at most one chunk")
+    p.add_argument("--generate_timeout_s", type=float, default=None,
+                   help="wall-time bound on one :generate request "
+                        "(default: max(600, 2*max_new_tokens_limit))")
     p.add_argument("--input_mapping", default=None)
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
@@ -206,8 +214,11 @@ class ModelService:
         self._max_new_limit = getattr(args, "max_new_tokens_limit", 512)
         self._draft_dir = getattr(args, "draft_export_dir", None)
         self._draft_k = getattr(args, "draft_k", 4)
-        self._gen_slots = getattr(args, "generate_slots", 0) or 0
+        self._gen_slots = getattr(args, "generate_slots", 8) or 8
         self._gen_read_chunk = getattr(args, "generate_read_chunk", 8) or 8
+        self._gen_prefill_chunk = getattr(args, "generate_prefill_chunk",
+                                          512) or 512
+        self._gen_timeout_s = getattr(args, "generate_timeout_s", None)
         self._batcher = None
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
@@ -239,7 +250,9 @@ class ModelService:
                         max_new_tokens_limit=self._max_new_limit,
                         draft_export_dir=self._draft_dir,
                         draft_k=self._draft_k, slots=self._gen_slots,
-                        read_chunk=self._gen_read_chunk)
+                        read_chunk=self._gen_read_chunk,
+                        prefill_chunk=self._gen_prefill_chunk,
+                        request_timeout_s=self._gen_timeout_s)
                 except (TypeError, ValueError) as e:
                     logger.info(":generate unavailable: %s", e)
                     self._gen = False
@@ -299,25 +312,32 @@ class SlotHandle:
 
 
 class ContinuousBatcher:
-    """Slot-based continuous batching over the per-row kv cache
-    (models.decode `decode_slots`): new requests PREFILL into a free slot
-    at a token boundary while the other slots keep decoding; finished
-    slots retire immediately.  The device runs one fused step per token
-    for the whole slot batch, so N concurrent streams cost ~one stream's
-    step rate (batching is near-free: BASELINE.md round 3 measured B8 at
-    ~1.3x the B1 step cost) instead of running back-to-back.
+    """THE serving decode engine: slot-based continuous batching over the
+    per-row kv cache (models.decode `decode_slots`).  New requests
+    PREFILL into a free slot in chunks interleaved with decode steps (a
+    long prompt admission never stalls in-flight streams for more than
+    one chunk); finished slots retire immediately.  The device runs one
+    fused step per token for the whole slot batch, so N concurrent
+    streams cost ~one stream's step rate (batching is near-free:
+    BASELINE.md round 3 measured B8 at ~1.3x the B1 step cost).
 
-    Greedy decoding is token-identical to `decode.generate`; sampled
-    requests draw per-row from a per-step key (a different noise schedule
-    than a solo run — documented serving semantics).  Net-new beyond the
-    reference (no generation serving there at all).
+    Every :generate request routes here (round 5 unified the grouped and
+    slot paths), so identical requests produce identical tokens by
+    construction at ANY dtype.  Greedy decoding is token-identical to a
+    solo `decode.generate` in f32; sampled rows draw from the SHARED
+    schedule ``fold_in(key(seed), ordinal)`` (decode.step_keys), so a
+    sampled slot run reproduces the solo call too.  With a draft model,
+    greedy slots advance by fused speculative rounds (k draft steps + one
+    verify dispatch, per-row acceptance) — tokens unchanged, speed up
+    where the draft agrees.  Net-new beyond the reference (no generation
+    serving there at all).
     """
 
     def __init__(self, model, params, n_slots=8, max_pending=1024,
-                 read_chunk=8, seed=0):
+                 read_chunk=8, prefill_chunk=512, draft_model=None,
+                 draft_params=None, draft_k=4):
         import queue as queue_mod
 
-        import jax
         import jax.numpy as jnp
 
         from .models import decode as decode_mod
@@ -328,22 +348,62 @@ class ContinuousBatcher:
         self._prefill = decode_mod._jitted_slot_prefill(self.slot_model)
         self._step = decode_mod._jitted_slot_step(self.slot_model)
         self._set_row = decode_mod._jitted_set_row(self.slot_model)
+        self.draft_model = self.draft_params = None
+        self.draft_k = draft_k
+        if draft_model is not None:
+            if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.cfg.vocab_size} != target "
+                    f"vocab {model.cfg.vocab_size}")
+            self.draft_model, self.draft_params = draft_model, draft_params
+            self.d_slot_model, self._d_cache = decode_mod.init_slot_cache(
+                draft_model, n_slots)
+            self._d_prefill = decode_mod._jitted_slot_prefill(
+                self.d_slot_model)
+            self._spec_round = decode_mod._jitted_slot_spec_round(
+                self.slot_model, self.d_slot_model, draft_k)
         self.n_slots = n_slots
         self.max_seq = self.slot_model.cfg.max_seq_len
+        if draft_model is not None:
+            self.max_seq = min(self.max_seq,
+                               draft_model.cfg.max_seq_len) - draft_k
         self.read_chunk = max(1, read_chunk)
+        self.prefill_chunk = max(8, prefill_chunk)
         self._pending = queue_mod.Queue(max_pending)
         self._slots = [None] * n_slots
         self._gen = [0] * n_slots      # occupant generation per row: tokens
         # decoded for a previous occupant must never reach a new one
+        self._admitting = None         # chunked-prefill state machine
         # device-resident chains: ONE dispatch per decoded token
         self._toks = jnp.zeros((n_slots,), jnp.int32)
         self._temps = jnp.zeros((n_slots,), jnp.float32)
-        self._rng = jax.random.key(seed)
+        self._seeds = jnp.zeros((n_slots,), jnp.int32)
+        self._ords = jnp.zeros((n_slots,), jnp.int32)
         self._steps = 0
+        self._spec_rounds = 0
         self._dead = None     # set to the fatal exception if the loop dies
+        self._stop = threading.Event()
         self.requests = 0
-        threading.Thread(target=self._loop, name="slot-batcher",
-                         daemon=True).start()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="slot-batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout=30):
+        """Shut the driver loop down cleanly (benches/tests teardown): the
+        loop exits at its next iteration boundary; queued, in-flight, AND
+        mid-admission requests fail with RuntimeError."""
+        self._stop.set()
+        self._thread.join(timeout)
+        err = RuntimeError("batcher stopped")
+        self._dead = self._dead or err
+        adm, self._admitting = self._admitting, None
+        if adm is not None:
+            adm["item"][0]._fail(err)
+        for s in self._slots:
+            if s is not None:
+                s["handle"]._fail(err)
+        self._slots = [None] * self.n_slots
+        self._drain_pending(err)
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0):
         if self._dead is not None:
@@ -379,24 +439,65 @@ class ContinuousBatcher:
         import jax.numpy as jnp
 
         if temperature > 0:
+            # ordinal 0 of the shared schedule (decode.step_keys): the
+            # first sampled token matches a solo generate(rng=key(seed))
             return int(jax.random.categorical(
                 jax.random.fold_in(jax.random.key(seed), 0),
                 logits_row / temperature))
         return int(jnp.argmax(logits_row))
 
-    def _do_prefill(self, row, item):
-        import jax.numpy as jnp
+    def _prefill_chunk_sizes(self, length):
+        """Split a prompt into chunk lengths: full `prefill_chunk` pieces
+        with a bucket-padded tail (power-of-2 buckets bound compile
+        variants)."""
+        sizes = []
+        rest = length
+        while rest > self.prefill_chunk:
+            sizes.append(self.prefill_chunk)
+            rest -= self.prefill_chunk
+        sizes.append(rest)
+        return sizes
 
+    def _start_admission(self, row, item):
         h, prompt, max_new, temp, eos_id, seed = item
         if h.cancelled.is_set():        # client gone before admission
             h._finish(list(prompt))
             return
-        L = len(prompt)
-        bucket = min(max(8, 1 << (L - 1).bit_length()), self.max_seq)
-        padded = prompt + [0] * (bucket - L)
-        logits, self._cache = self._prefill(
-            self.params, self._cache, jnp.asarray([padded], jnp.int32),
-            jnp.asarray(row, jnp.int32), jnp.asarray(L, jnp.int32))
+        self._admitting = {"row": row, "item": item, "offset": 0,
+                           "sizes": self._prefill_chunk_sizes(len(prompt))}
+        self._continue_admission()
+
+    def _continue_admission(self):
+        """Run ONE prefill chunk of the admitting prompt (target + draft
+        caches); on the final chunk, pick the first token and occupy the
+        slot.  Between calls the loop keeps stepping in-flight slots, so
+        a long prompt stalls them by at most one chunk's latency."""
+        import jax.numpy as jnp
+
+        adm = self._admitting
+        h, prompt, max_new, temp, eos_id, seed = adm["item"]
+        row, off = adm["row"], adm["offset"]
+        if h.cancelled.is_set():
+            self._admitting = None
+            h._finish(list(prompt))
+            return
+        size = adm["sizes"][adm.get("i", 0)]
+        chunk = prompt[off:off + size]
+        bucket = min(max(8, 1 << (len(chunk) - 1).bit_length()),
+                     self.prefill_chunk)
+        padded = chunk + [0] * (bucket - len(chunk))
+        args = (jnp.asarray([padded], jnp.int32),
+                jnp.asarray(row, jnp.int32), jnp.asarray(off, jnp.int32),
+                jnp.asarray(len(chunk), jnp.int32))
+        logits, self._cache = self._prefill(self.params, self._cache, *args)
+        if self.draft_model is not None:
+            _, self._d_cache = self._d_prefill(self.draft_params,
+                                               self._d_cache, *args)
+        adm["offset"] = off + len(chunk)
+        adm["i"] = adm.get("i", 0) + 1
+        if adm["offset"] < len(prompt):
+            return                       # more chunks to go
+        self._admitting = None
         tok = self._pick_first(logits[0], temp, seed)
         h.tokens.put(tok)
         seq = prompt + [tok]
@@ -405,9 +506,11 @@ class ContinuousBatcher:
             self.requests += 1
             return
         self._gen[row] += 1
-        self._toks, self._temps = self._set_row(
-            self._toks, self._temps, jnp.asarray(row, jnp.int32),
-            jnp.asarray(tok, jnp.int32), jnp.asarray(temp, jnp.float32))
+        self._toks, self._temps, self._seeds, self._ords = self._set_row(
+            self._toks, self._temps, self._seeds, self._ords,
+            jnp.asarray(row, jnp.int32), jnp.asarray(tok, jnp.int32),
+            jnp.asarray(temp, jnp.float32), jnp.asarray(seed, jnp.int32),
+            jnp.asarray(1, jnp.int32))
         self._slots[row] = {"handle": h, "seq": seq,
                             "remaining": max_new - 1, "temp": temp,
                             "eos": eos_id}
@@ -415,6 +518,9 @@ class ContinuousBatcher:
     def _admit(self, block=False):
         import queue as queue_mod
 
+        if self._admitting is not None:
+            self._continue_admission()
+            return
         for row in range(self.n_slots):
             if self._slots[row] is not None:
                 continue
@@ -422,19 +528,24 @@ class ContinuousBatcher:
                 item = self._pending.get(timeout=0.05 if block else 0)
             except queue_mod.Empty:
                 return
-            self._do_prefill(row, item)
+            self._start_admission(row, item)
+            if self._admitting is not None:
+                return    # chunked admission in progress: one at a time
             block = False    # only the first admit may block (idle wake)
 
     def _process_batch(self, batch):
-        """One arrived [k, n_slots] token block -> emissions/retires, in
-        dispatch order.  `batch` is (stacked_dev, [gen_snapshot per step])
-        whose host copy was started earlier (copy_to_host_async), so the
-        np.asarray here is usually free."""
+        """One arrived token block -> emissions/retires, in dispatch
+        order.  `batch` is (toks_dev [k, n], counts [k, n] or None,
+        [gen_snapshot per entry]); counts (speculative rounds) say how
+        many of each row's k tokens were committed.  The host copy was
+        started earlier (copy_to_host_async), so the np.asarray here is
+        usually free."""
         import numpy as np
 
-        stacked, gens_list = batch
+        stacked, counts, gens_list = batch
         block = np.asarray(stacked)
-        for gens, row_toks in zip(gens_list, block):
+        counts = None if counts is None else np.asarray(counts)
+        for i, (gens, row_toks) in enumerate(zip(gens_list, block)):
             for r, s in enumerate(self._slots):
                 if s is None or self._gen[r] != gens[r]:
                     continue      # freed or re-occupied since dispatch
@@ -444,36 +555,79 @@ class ContinuousBatcher:
                     self.requests += 1
                     self._slots[r] = None
                     continue
-                tok = int(row_toks[r])
-                s["seq"].append(tok)
-                s["remaining"] -= 1
-                s["handle"].tokens.put(tok)
-                if s["remaining"] <= 0 or (s["eos"] is not None
-                                           and tok == s["eos"]):
-                    s["handle"]._finish(s["seq"])
-                    self.requests += 1
-                    self._slots[r] = None   # row frees; steps already in
-                    # flight for it decode garbage that _gen filters out
+                if counts is None:
+                    toks = [int(row_toks[r])]
+                else:             # speculative round: commit[r] tokens
+                    toks = [int(t) for t in
+                            np.atleast_1d(row_toks[r])[:counts[i][r]]]
+                for tok in toks:
+                    s["seq"].append(tok)
+                    s["remaining"] -= 1
+                    s["handle"].tokens.put(tok)
+                    if s["remaining"] <= 0 or (s["eos"] is not None
+                                               and tok == s["eos"]):
+                        s["handle"]._finish(s["seq"])
+                        self.requests += 1
+                        self._slots[r] = None   # row frees; in-flight
+                        # steps decode garbage that _gen filters out
+                        break
 
-    def _loop(self):
+    def _dispatch(self):
+        """One decode advance for all active slots: a fused speculative
+        round when a draft is loaded and every active row is greedy, else
+        one plain step.  Returns the readback entry."""
+        use_spec = (self.draft_model is not None
+                    and all(s is None or s["temp"] == 0
+                            for s in self._slots))
+        if use_spec:
+            (nxt, t_next, commit, self._cache,
+             self._d_cache) = self._spec_round(
+                self.params, self.draft_params, self._cache, self._d_cache,
+                self._toks)
+            self._toks = nxt
+            self._spec_rounds += 1
+            return (t_next, commit, tuple(self._gen))
+        nxt, self._cache, self._ords = self._step(
+            self.params, self._cache, self._toks, self._temps,
+            self._seeds, self._ords)
+        self._toks = nxt
+        self._steps += 1
+        return (nxt, None, tuple(self._gen))
+
+    def _flush_entries(self, reads):
+        """Stack this chunk's entries for one async host copy.  Plain
+        steps stack to [k, n]; speculative rounds to [k, n, draft_k] with
+        a [k, n] counts plane.  Mixed chunks pad plain entries to width
+        draft_k with count 1."""
         import jax.numpy as jnp
 
+        if all(e[1] is None for e in reads):
+            return jnp.stack([e[0] for e in reads]), None
+        k = self.draft_k
+
+        def widen(e):
+            toks, counts, _ = e
+            if counts is None:
+                return (jnp.pad(toks[:, None], ((0, 0), (0, k - 1))),
+                        jnp.ones(toks.shape[0], jnp.int32))
+            return toks, counts
+
+        wide = [widen(e) for e in reads]
+        return (jnp.stack([w[0] for w in wide]),
+                jnp.stack([w[1] for w in wide]))
+
+    def _loop(self):
         try:
-            reads = []       # dispatched this chunk: [(nxt_dev, gens)]
+            reads = []       # dispatched this chunk: [(toks, counts, gens)]
             inflight = None  # previous chunk, host copy in progress
-            while True:
+            while not self._stop.is_set():
                 idle = (all(s is None for s in self._slots)
+                        and self._admitting is None
                         and not reads and inflight is None)
                 self._admit(block=idle)
                 active = any(s is not None for s in self._slots)
                 if active:
-                    # ONE dispatch: token/rng/temp chains stay on device
-                    nxt, self._cache, self._rng = self._step(
-                        self.params, self._cache, self._toks, self._temps,
-                        self._rng)
-                    self._toks = nxt
-                    self._steps += 1
-                    reads.append((nxt, tuple(self._gen)))
+                    reads.append(self._dispatch())
                 # Readback protocol (measured on the tunneled runtime:
                 # per-token sync d2h ~200 ms regardless of size): stack a
                 # chunk, START its host copy asynchronously, and process
@@ -488,13 +642,13 @@ class ContinuousBatcher:
                     or min((s["remaining"] for s in self._slots
                             if s is not None), default=0) <= len(reads))
                 if flush:
-                    stacked = jnp.stack([r[0] for r in reads])
-                    gens = [r[1] for r in reads]
+                    stacked, counts = self._flush_entries(reads)
+                    gens = [r[2] for r in reads]
                     try:
                         stacked.copy_to_host_async()
                     except Exception:
                         pass             # not all backends support it
-                    prev, inflight = inflight, (stacked, gens)
+                    prev, inflight = inflight, (stacked, counts, gens)
                     reads = []
                     if prev is not None:
                         self._process_batch(prev)
@@ -505,6 +659,9 @@ class ContinuousBatcher:
         except BaseException as e:     # device failure: fail everything
             logger.exception("continuous batcher died")
             self._dead = e
+            adm, self._admitting = self._admitting, None
+            if adm is not None:
+                adm["item"][0]._fail(e)
             for s in self._slots:
                 if s is not None:
                     s["handle"]._fail(e)
@@ -515,16 +672,18 @@ class ContinuousBatcher:
 class GenerateService:
     """Autoregressive generation over an exported decoder LM.
 
-    Rebuilds the exported module (export.load_model) and serves
-    ``models.decode.generate`` — kv-cache greedy/sampled continuation.
-    Only exports whose builder rebuilds a ``Transformer`` qualify; the
-    endpoint reports 404 otherwise.  Constructed LAZILY on the first
-    :generate request so forward-only serving never pays a second param
-    load.
+    Rebuilds the exported module (export.load_model) and serves every
+    request through ONE decode engine — the ContinuousBatcher (round 5
+    unified the former grouped path onto slots: a request's tokens no
+    longer depend on server flags, and concurrent requests always share
+    the in-flight batch).  Only exports whose builder rebuilds a
+    ``Transformer`` qualify; the endpoint reports 404 otherwise.
+    Constructed LAZILY on the first :generate request so forward-only
+    serving never pays a second param load.
 
-    Prompts are grouped by length (static shapes per compiled decode
-    step); equal-length prompts in one request batch into one prefill +
-    scan.
+    With ``draft_export_dir``, greedy decoding speculates inside the
+    slots (fused per-round draft+verify; tokens unchanged by
+    construction — see decode._jitted_slot_spec_round).
     """
 
     @staticmethod
@@ -552,39 +711,35 @@ class GenerateService:
         return built, params
 
     def __init__(self, export_dir, max_new_tokens_limit=512,
-                 draft_export_dir=None, draft_k=4, slots=0, read_chunk=8):
+                 draft_export_dir=None, draft_k=4, slots=8, read_chunk=8,
+                 prefill_chunk=512, request_timeout_s=None):
+        import itertools
+
         self.model, self.params = self._load_lm(export_dir)
-        self.draft_model = self.draft_params = None
-        self.draft_k = draft_k
-        if slots and draft_export_dir:
-            raise ValueError("--generate_slots and --draft_export_dir are "
-                             "mutually exclusive (speculation verifies "
-                             "whole blocks; slots retire per token)")
+        draft_model = draft_params = None
         if draft_export_dir:
             # speculative decoding: greedy requests verify k draft tokens
             # per target pass — EXACTLY the same tokens (the draft only
             # changes speed), so no request-level opt-in is needed
-            self.draft_model, self.draft_params = \
-                self._load_lm(draft_export_dir)
-        self.batcher = (ContinuousBatcher(self.model, self.params,
-                                          n_slots=slots,
-                                          read_chunk=read_chunk)
-                        if slots else None)
+            draft_model, draft_params = self._load_lm(draft_export_dir)
+        self.batcher = ContinuousBatcher(
+            self.model, self.params, n_slots=slots or 8,
+            read_chunk=read_chunk, prefill_chunk=prefill_chunk,
+            draft_model=draft_model, draft_params=draft_params,
+            draft_k=draft_k)
         self.limit = max_new_tokens_limit
-        self._lock = threading.Lock()
+        # bound on a single request's wall time: decoding its own tokens
+        # plus waiting behind a full house of equally-long requests, with
+        # a generous floor for compiles (the first request pays them)
+        self.timeout_s = request_timeout_s or max(
+            600.0, 2.0 * max_new_tokens_limit)
+        # requests that sample WITHOUT an explicit seed each get a fresh
+        # one (identical unseeded prompts must not replay identical
+        # noise); pass "seed" for reproducibility
+        self._auto_seed = itertools.count(1 << 20)
         self.requests = 0
-        # warm the loop-driver probe at LOAD time (service construction is
-        # already the slow path): the first :generate request must not pay
-        # two probe compiles while holding self._lock
-        import os
-
-        from .models import decode
-        if os.environ.get("TFOS_TPU_DECODE_LOOP") is None:
-            decode.probe_loop_driver()
 
     def _validate(self, req):
-        import jax
-
         inputs = req.get("inputs")
         if (not isinstance(inputs, list) or not inputs
                 or not all(isinstance(p, list) and p and
@@ -602,161 +757,74 @@ class GenerateService:
         eos_id = req.get("eos_id")
         if eos_id is not None and not isinstance(eos_id, int):
             raise ValueError('"eos_id" must be an int')
-        rng = (jax.random.key(int(req.get("seed", 0)))
-               if temperature > 0 else None)
-        return inputs, max_new, temperature, eos_id, rng
+        seed = req.get("seed")
+        if seed is not None:
+            seed = int(seed)
+        return inputs, max_new, temperature, eos_id, seed
+
+    def _prompt_seeds(self, n, seed, temperature):
+        """Per-prompt seeds: explicit seed s -> s, s+1, ... (documented
+        reproducible); unseeded sampling -> a FRESH auto-seed per prompt
+        (identical unseeded prompts must not replay identical noise, and
+        consecutive requests must not overlap the way seed+i would);
+        greedy keeps 0 so deterministic requests stay byte-stable."""
+        if seed is not None:
+            return [seed + i for i in range(n)]
+        if temperature > 0:
+            return [next(self._auto_seed) for _ in range(n)]
+        return [0] * n
 
     def stream(self, req):
         """Yield JSON-able events for a single-prompt generation:
         ``{"token": t}`` per decoded token (eos-trimmed), then
         ``{"done": true, "output": [...full sequence...]}``."""
-        import queue as queue_mod
-
-        import numpy as np
-
-        import jax.numpy as jnp
-
-        from .models import decode
-
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
-        inputs, max_new, temperature, eos_id, rng = self._validate(req)
+        inputs, max_new, temperature, eos_id, seed = self._validate(req)
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
-        if self.batcher is not None:
-            h = self.batcher.submit(inputs[0], max_new,
-                                    temperature=temperature, eos_id=eos_id,
-                                    seed=int(req.get("seed", 0)))
+        seed = self._prompt_seeds(1, seed, temperature)[0]
+        h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
+                                eos_id=eos_id, seed=seed)
+        self.requests += 1
 
-            def slot_events():
-                try:
-                    while True:
-                        tok = h.tokens.get()
-                        if tok is None:
-                            break
-                        yield {"token": tok}
-                    yield {"done": True, "output": h.result()}
-                finally:
-                    # consumer died/finished: free the slot instead of
-                    # decoding to max_new for a client nobody serves
-                    h.cancel()
-
-            return slot_events()
-        prompt = jnp.asarray(np.asarray(inputs, np.int32))
-        seq = list(inputs[0])
-        # Decode runs in its own thread; the handler thread drains this
-        # queue and writes the socket OUTSIDE self._lock.  Sized to hold
-        # the entire stream (tokens + done + sentinel) so the decode loop
-        # can always run to completion and release the lock even when the
-        # client stops reading — a stalled socket wedges only its own
-        # handler thread, never other :generate requests.
-        q = queue_mod.Queue(maxsize=max_new + 2)
-        cancelled = threading.Event()
-
-        def produce():
-            try:
-                with self._lock:
-                    for tok_arr in decode.generate_stream(
-                            self.model, self.params, prompt, max_new,
-                            temperature=temperature, rng=rng, eos_id=eos_id):
-                        tok = int(tok_arr[0])
-                        seq.append(tok)
-                        q.put({"token": tok})
-                        if cancelled.is_set():
-                            # client gone: stop burning device time; shapes
-                            # stay static device-side, the loop just ends
-                            q.put(None)
-                            return
-                        if eos_id is not None and tok == eos_id:
-                            break       # stream ends at eos
-                    self.requests += 1
-                q.put({"done": True, "output": seq})
-            except Exception as e:      # surfaced as a stream error event
-                q.put(e)
-            q.put(None)                 # end-of-stream sentinel
-
-        threading.Thread(target=produce, name="generate-stream",
-                         daemon=True).start()
-
-        def events():
+        def slot_events():
             try:
                 while True:
-                    item = q.get()
-                    if item is None:
-                        return
-                    if isinstance(item, Exception):
-                        raise item
-                    yield item
+                    tok = h.tokens.get()
+                    if tok is None:
+                        break
+                    yield {"token": tok}
+                yield {"done": True, "output": h.result()}
             finally:
-                cancelled.set()   # consumer died/finished: tell the
-                # producer to stop decoding for a client nobody serves
+                # consumer died/finished: free the slot instead of
+                # decoding to max_new for a client nobody serves
+                h.cancel()
 
-        return events()
+        return slot_events()
 
     def generate(self, req):
-        import numpy as np
-
-        import jax
-        import jax.numpy as jnp
-
-        from .models import decode
-
-        inputs, max_new, temperature, eos_id, rng = self._validate(req)
-        if self.batcher is not None:
-            # continuous batching: every prompt becomes a slot request;
-            # they decode concurrently with each other AND with other
-            # HTTP requests' prompts (no service lock on this path — the
-            # batcher's driver thread owns the device)
-            seed = int(req.get("seed", 0))
-            handles = [self.batcher.submit(p, max_new,
-                                           temperature=temperature,
-                                           eos_id=eos_id, seed=seed + i)
-                       for i, p in enumerate(inputs)]
-            outs = [h.result(timeout=600) for h in handles]
-            self.requests += 1
-            return outs
-        # group by prompt length: each group is one static-shape batch
-        groups = {}
-        for i, p in enumerate(inputs):
-            groups.setdefault(len(p), []).append(i)
-        outs = [None] * len(inputs)
-        use_draft = (self.draft_model is not None and temperature == 0
-                     and eos_id is None)
-        with self._lock:
-            for g, (length, idxs) in enumerate(sorted(groups.items())):
-                prompt = jnp.asarray(
-                    np.stack([inputs[i] for i in idxs]), jnp.int32)
-                if use_draft and length + max_new + self.draft_k > min(
-                        self.model.cfg.max_seq_len,
-                        self.draft_model.cfg.max_seq_len):
-                    # speculation needs k cache slots of headroom; fall
-                    # back to vanilla decode near the length limit
-                    use_draft = False
-                if use_draft:
-                    seq = decode.speculative_generate(
-                        self.model, self.params, self.draft_model,
-                        self.draft_params, prompt,
-                        max_new_tokens=max_new, k=self.draft_k)
-                else:
-                    # fresh key per length group (otherwise every group in
-                    # one request samples identical noise); group 0 keeps
-                    # the request key so single-group requests match the
-                    # streaming path token-for-token
-                    sub = (rng if rng is None or g == 0
-                           else jax.random.fold_in(rng, g))
-                    seq = decode.generate(self.model, self.params, prompt,
-                                          max_new_tokens=max_new,
-                                          temperature=temperature, rng=sub,
-                                          eos_id=eos_id)
-                for row, i in zip(np.asarray(seq), idxs):
-                    toks = row.tolist()
-                    if eos_id is not None and eos_id in toks[length:]:
-                        # static shapes pad with eos; trim host-side
-                        end = length + toks[length:].index(eos_id) + 1
-                        toks = toks[:end]
-                    outs[i] = toks
-            self.requests += 1
+        inputs, max_new, temperature, eos_id, seed = self._validate(req)
+        seeds = self._prompt_seeds(len(inputs), seed, temperature)
+        # every prompt becomes a slot request; they decode concurrently
+        # with each other AND with other HTTP requests' prompts (no
+        # service lock -- the batcher's driver thread owns the device)
+        handles = []
+        try:
+            for p, s in zip(inputs, seeds):
+                handles.append(self.batcher.submit(
+                    p, max_new, temperature=temperature, eos_id=eos_id,
+                    seed=s))
+            outs = [h.result(timeout=self.timeout_s) for h in handles]
+        except Exception:
+            # a failed request (one prompt too long, a timeout) must not
+            # leave its other prompts decoding for a client that already
+            # got an error
+            for h in handles:
+                h.cancel()
+            raise
+        self.requests += 1
         return outs
 
 
@@ -852,11 +920,10 @@ def make_server(args):
     # lazily on the first :generate request, where a config error would
     # otherwise be swallowed by the is-this-a-decoder-LM probe and turn
     # into a misleading 404
-    if getattr(args, "generate_slots", 0) and \
-            getattr(args, "draft_export_dir", None):
-        raise ValueError("--generate_slots and --draft_export_dir are "
-                         "mutually exclusive (speculation verifies whole "
-                         "blocks; slots retire per token)")
+    if getattr(args, "generate_slots", 8) < 1:
+        raise ValueError("--generate_slots must be >= 1: slots are the "
+                         ":generate decode engine (round 5 unified the "
+                         "grouped path onto them)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
     server = ThreadingHTTPServer((args.host, args.port), handler)

@@ -571,19 +571,62 @@ def test_slots_eos_and_stream(slot_server):
     assert events[-1]["output"] == [1, 2, 3] + toks
 
 
-def test_slots_reject_draft_combo(monkeypatch):
-    # speculation verifies whole blocks; slots retire per token — the two
-    # must refuse to combine rather than silently ignore one
-    monkeypatch.setattr(serve.GenerateService, "_load_lm",
-                        staticmethod(lambda d: (None, None)))
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        serve.GenerateService("x", draft_export_dir="y", slots=4)
-    # and the server must fail at STARTUP, not turn the error into a
-    # lazy-probe 404 on the first :generate request
+def test_slots_compose_with_draft(tmp_path):
+    # round 5: speculation runs INSIDE the slots (fused per-round
+    # draft+verify, per-row acceptance) — a draft-equipped slot server
+    # returns exactly the draft-free tokens
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    def export_lm(d, seed, n_layers):
+        cfg_kw = dict(vocab_size=41, d_model=16, n_heads=2, n_kv_heads=1,
+                      n_layers=n_layers, d_ff=32, max_seq_len=64,
+                      dtype="float32", rope=True, attention_impl="dense")
+        model = Transformer(TransformerConfig(**cfg_kw))
+        params = model.init(jax.random.key(seed),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        export.export_saved_model(
+            str(d), params,
+            builder="tensorflowonspark_tpu.models.transformer:"
+                    "build_transformer",
+            builder_kwargs=cfg_kw)
+        return str(d)
+
+    target = export_lm(tmp_path / "t", seed=0, n_layers=2)
+    draft = export_lm(tmp_path / "d", seed=1, n_layers=1)
+
+    def serve_and_generate(extra):
+        args = serve.build_argparser().parse_args(
+            ["--export_dir", target, "--port", "0",
+             "--generate_slots", "3"] + extra)
+        srv, svc = serve.make_server(args)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            code, out = _post_gen(srv, "/v1/models/default:generate",
+                                  {"inputs": [[1, 2, 3], [4, 5, 6, 7]],
+                                   "max_new_tokens": 6})
+            assert code == 200
+            return out["outputs"], svc
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    plain, _ = serve_and_generate([])
+    drafted, svc = serve_and_generate(["--draft_export_dir", draft,
+                                       "--draft_k", "3"])
+    assert drafted == plain
+    assert svc.generate_service().batcher._spec_rounds > 0
+
+
+def test_make_server_rejects_zero_slots():
+    # slots ARE the decode engine now: a slot-less server is an error at
+    # startup, not a lazy surprise
     args = serve.build_argparser().parse_args(
-        ["--export_dir", "x", "--port", "0", "--generate_slots", "4",
-         "--draft_export_dir", "y"])
-    with pytest.raises(ValueError, match="mutually exclusive"):
+        ["--export_dir", "x", "--port", "0", "--generate_slots", "0"])
+    with pytest.raises(ValueError, match="generate_slots"):
         serve.make_server(args)
 
 

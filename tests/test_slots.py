@@ -1,11 +1,12 @@
 """Continuous-batching decode primitives (decode_slots=True).
 
 Every batch row is an independent serving slot with its own cache_index:
-requests prefill into a free row while other rows keep decoding, and the
-sequences each slot produces must be IDENTICAL to a solo
-`decode.generate` run of the same prompt (greedy).  Net-new beyond the
-reference (its serving is batch feed-forward only,
-TFModel.scala:245-292).
+requests prefill into a free row (optionally in CHUNKS) while other rows
+keep decoding, and the sequences each slot produces must be IDENTICAL to
+a solo `decode.generate` run of the same prompt — greedy AND sampled
+(both draw token t's noise from ``fold_in(key(seed), t)``, the shared
+schedule in decode.step_keys).  Net-new beyond the reference (its
+serving is batch feed-forward only, TFModel.scala:245-292).
 """
 import numpy as np
 import pytest
@@ -31,39 +32,64 @@ def model_and_params(request):
     return model, params
 
 
-def _solo(model, params, prompt_list, n_new):
+def _solo(model, params, prompt_list, n_new, temperature=0.0, seed=0):
     out = decode.generate(model, params,
                           jnp.asarray([prompt_list], jnp.int32),
-                          max_new_tokens=n_new, loop="host")
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None))
     return np.asarray(out)[0].tolist()
 
 
-def _prefill(model, params, cache, prompt_list, row, bucket=8):
+def _prefill(model, params, cache, prompt_list, row, bucket=8,
+             chunk_size=None):
+    """Whole-prompt prefill, or chunked when chunk_size is given —
+    byte-identical results either way (test_chunked_prefill_matches)."""
     pre = decode._jitted_slot_prefill(model)
-    padded = prompt_list + [0] * (bucket - len(prompt_list))
-    logits, cache = pre(params, cache,
-                        jnp.asarray([padded], jnp.int32),
-                        jnp.asarray(row, jnp.int32),
-                        jnp.asarray(len(prompt_list), jnp.int32))
-    return int(jnp.argmax(logits[0])), cache
+    pieces = ([prompt_list] if chunk_size is None else
+              [prompt_list[i:i + chunk_size]
+               for i in range(0, len(prompt_list), chunk_size)])
+    off = 0
+    for piece in pieces:
+        padded = piece + [0] * (bucket - len(piece))
+        logits, cache = pre(params, cache,
+                            jnp.asarray([padded], jnp.int32),
+                            jnp.asarray(row, jnp.int32),
+                            jnp.asarray(off, jnp.int32),
+                            jnp.asarray(len(piece), jnp.int32))
+        off += len(piece)
+    return int(jnp.argmax(logits[0])), logits, cache
+
+
+def _step_fn(slot_model, params):
+    step = decode._jitted_slot_step(slot_model)
+
+    def run(cache, toks, temps, seeds, ords):
+        return step(params, cache, jnp.asarray(toks, jnp.int32),
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(seeds, jnp.int32),
+                    jnp.asarray(ords, jnp.int32))
+
+    return run
 
 
 def test_slots_match_solo_generate(model_and_params):
     model, params = model_and_params
     slot_model, cache = decode.init_slot_cache(model, 3)
-    step = decode._jitted_slot_step(slot_model)
+    step = _step_fn(slot_model, params)
     a = [1, 2, 3, 4]
     b = [9, 8, 7, 6, 5, 4]
     n_new = 6
-    tok_a, cache = _prefill(slot_model, params, cache, a, 0)
-    tok_b, cache = _prefill(slot_model, params, cache, b, 2)
+    tok_a, _, cache = _prefill(slot_model, params, cache, a, 0)
+    tok_b, _, cache = _prefill(slot_model, params, cache, b, 2)
     seq_a, seq_b = [tok_a], [tok_b]
     toks = np.zeros(3, np.int32)
-    temps = jnp.zeros((3,), jnp.float32)
-    for _ in range(n_new - 1):
+    zeros = np.zeros(3, np.int32)
+    ords = np.ones(3, np.int32)
+    for t in range(n_new - 1):
         toks[0], toks[2] = seq_a[-1], seq_b[-1]
-        nxt, cache, _ = step(params, cache, jnp.asarray(toks), temps,
-                             jax.random.key(0))
+        nxt, cache, _ = step(cache, toks, zeros, zeros, ords + t)
         nxt = np.asarray(nxt)
         seq_a.append(int(nxt[0]))
         seq_b.append(int(nxt[2]))
@@ -71,29 +97,87 @@ def test_slots_match_solo_generate(model_and_params):
     assert b + seq_b == _solo(model, params, b, n_new)
 
 
+def test_sampled_slot_matches_solo_generate(model_and_params):
+    # the round-5 schedule unification: a SAMPLED slot run reproduces the
+    # solo generate(rng=key(seed)) token stream exactly (f32) — the noise
+    # is fold_in(key(seed), ordinal) in both paths
+    model, params = model_and_params
+    slot_model, cache = decode.init_slot_cache(model, 2)
+    step = _step_fn(slot_model, params)
+    prompt, n_new, temp = [4, 5, 6], 7, 0.9
+    seeds = [11, 23]
+    firsts = []
+    for row, seed in enumerate(seeds):
+        _, logits, cache = _prefill(slot_model, params, cache, prompt, row)
+        tok = int(jax.random.categorical(
+            jax.random.fold_in(jax.random.key(seed), 0),
+            logits[0] / temp))
+        firsts.append(tok)
+    seqs = [[firsts[0]], [firsts[1]]]
+    toks = np.asarray(firsts, np.int32)
+    temps = np.full(2, temp, np.float32)
+    for t in range(n_new - 1):
+        toks = np.asarray([seqs[0][-1], seqs[1][-1]], np.int32)
+        nxt, cache, _ = step(cache, toks, temps, np.asarray(seeds),
+                             np.full(2, t + 1, np.int32))
+        nxt = np.asarray(nxt)
+        seqs[0].append(int(nxt[0]))
+        seqs[1].append(int(nxt[1]))
+    for seq, seed in zip(seqs, seeds):
+        assert prompt + seq == _solo(model, params, prompt, n_new,
+                                     temperature=temp, seed=seed)
+    assert seqs[0] != seqs[1]          # different seeds, different noise
+
+
+def test_chunked_prefill_matches_whole_prompt(model_and_params):
+    # a prompt prefilled in chunks must leave the row in EXACTLY the
+    # state whole-prompt prefill leaves it: same first token, same
+    # continuation
+    model, params = model_and_params
+    prompt = [7, 1, 6, 2, 5, 3, 4, 4, 9, 8, 2]       # 11 tokens
+    n_new = 5
+    outs = []
+    for chunk in (None, 4, 3):
+        slot_model, cache = decode.init_slot_cache(model, 2)
+        step = _step_fn(slot_model, params)
+        bucket = 16 if chunk is None else 4
+        tok, _, cache = _prefill(slot_model, params, cache, prompt, 1,
+                                 bucket=bucket, chunk_size=chunk)
+        seq = [tok]
+        zeros = np.zeros(2, np.int32)
+        for t in range(n_new - 1):
+            toks = np.asarray([0, seq[-1]], np.int32)
+            nxt, cache, _ = step(cache, toks, zeros, zeros,
+                                 np.full(2, t + 1, np.int32))
+            seq.append(int(np.asarray(nxt)[1]))
+        outs.append(seq)
+    assert outs[0] == outs[1] == outs[2]
+    assert prompt + outs[0] == _solo(model, params, prompt, n_new)
+
+
 def test_slot_joins_mid_flight_and_reuses_retired_rows(model_and_params):
     model, params = model_and_params
     slot_model, cache = decode.init_slot_cache(model, 2)
-    step = decode._jitted_slot_step(slot_model)
-    temps = jnp.zeros((2,), jnp.float32)
+    step = _step_fn(slot_model, params)
+    zeros = np.zeros(2, np.int32)
 
     a = [5, 6, 7]
-    tok_a, cache = _prefill(slot_model, params, cache, a, 0)
+    tok_a, _, cache = _prefill(slot_model, params, cache, a, 0)
     seq_a = [tok_a]
     toks = np.zeros(2, np.int32)
-    for _ in range(3):                      # A decodes alone for a while
+    for t in range(3):                      # A decodes alone for a while
         toks[0] = seq_a[-1]
-        nxt, cache, _ = step(params, cache, jnp.asarray(toks), temps,
-                             jax.random.key(1))
+        nxt, cache, _ = step(cache, toks, zeros, zeros,
+                             np.full(2, t + 1, np.int32))
         seq_a.append(int(np.asarray(nxt)[0]))
 
     bjoin = [3, 1, 4, 1, 5]                 # B joins row 1 mid-flight
-    tok_b, cache = _prefill(slot_model, params, cache, bjoin, 1)
+    tok_b, _, cache = _prefill(slot_model, params, cache, bjoin, 1)
     seq_b = [tok_b]
-    for _ in range(2):
+    for t in range(2):
         toks[0], toks[1] = seq_a[-1], seq_b[-1]
-        nxt, cache, _ = step(params, cache, jnp.asarray(toks), temps,
-                             jax.random.key(2))
+        nxt, cache, _ = step(cache, toks, zeros, zeros,
+                             np.full(2, t + 4, np.int32))
         nxt = np.asarray(nxt)
         seq_a.append(int(nxt[0]))
         seq_b.append(int(nxt[1]))
@@ -102,12 +186,12 @@ def test_slot_joins_mid_flight_and_reuses_retired_rows(model_and_params):
 
     # A retires; C reuses row 0 over A's stale cache entries
     c = [2, 2, 9]
-    tok_c, cache = _prefill(slot_model, params, cache, c, 0)
+    tok_c, _, cache = _prefill(slot_model, params, cache, c, 0)
     seq_c = [tok_c]
-    for _ in range(3):
+    for t in range(3):
         toks[0], toks[1] = seq_c[-1], seq_b[-1]
-        nxt, cache, _ = step(params, cache, jnp.asarray(toks), temps,
-                             jax.random.key(3))
+        nxt, cache, _ = step(cache, toks, zeros, zeros,
+                             np.full(2, t + 1, np.int32))
         seq_c.append(int(np.asarray(nxt)[0]))
     assert c + seq_c == _solo(model, params, c, 4)
 
@@ -115,16 +199,56 @@ def test_slot_joins_mid_flight_and_reuses_retired_rows(model_and_params):
 def test_slot_sampling_is_per_row(model_and_params):
     model, params = model_and_params
     slot_model, cache = decode.init_slot_cache(model, 2)
-    step = decode._jitted_slot_step(slot_model)
-    _, cache = _prefill(slot_model, params, cache, [1, 2], 0)
-    _, cache = _prefill(slot_model, params, cache, [1, 2], 1)
+    step = _step_fn(slot_model, params)
+    _, _, cache = _prefill(slot_model, params, cache, [1, 2], 0)
+    _, _, cache = _prefill(slot_model, params, cache, [1, 2], 1)
     # row 0 greedy, row 1 hot sampling: over a few steps the rows diverge
-    temps = jnp.asarray([0.0, 3.0], jnp.float32)
-    toks = jnp.asarray([3, 3], jnp.int32)
+    temps = np.asarray([0.0, 3.0], np.float32)
+    toks = np.asarray([3, 3], np.int32)
+    seeds = np.asarray([0, 17], np.int32)
     rows = [[], []]
     for t in range(8):
-        toks, cache, _ = step(params, cache, toks, temps,
-                              jax.random.key(100 + t))
+        toks, cache, _ = step(cache, toks, temps, seeds,
+                              np.full(2, t + 1, np.int32))
+        toks = np.asarray(toks)
         rows[0].append(int(toks[0]))
         rows[1].append(int(toks[1]))
+        toks = jnp.asarray(toks)
     assert rows[0] != rows[1]
+
+
+def test_slot_spec_round_matches_greedy(model_and_params):
+    # fused speculative rounds commit EXACTLY the target's greedy tokens,
+    # at per-row acceptance rates (an unrelated draft only changes speed)
+    model, params = model_and_params
+    draft_cfg = TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_kv_heads=1, n_layers=1, d_ff=32,
+                                  max_seq_len=32, dtype="float32",
+                                  attention_impl="dense")
+    draft = Transformer(draft_cfg)
+    d_params = draft.init(jax.random.key(9),
+                          jnp.zeros((1, 4), jnp.int32))["params"]
+
+    n_slots, k, n_new = 2, 3, 7
+    slot_model, cache = decode.init_slot_cache(model, n_slots)
+    d_slot_model, d_cache = decode.init_slot_cache(draft, n_slots)
+    spec = decode._jitted_slot_spec_round(slot_model, d_slot_model, k)
+
+    prompts = [[1, 2, 3, 4], [9, 8, 7]]
+    firsts = []
+    for row, p in enumerate(prompts):
+        tok, _, cache = _prefill(slot_model, params, cache, p, row)
+        _, _, d_cache = _prefill(d_slot_model, d_params, d_cache, p, row)
+        firsts.append(tok)
+    seqs = [[t] for t in firsts]
+    toks = jnp.asarray(firsts, jnp.int32)
+    while min(len(s) for s in seqs) < n_new:
+        toks, t_next, commit, cache, d_cache = spec(
+            params, d_params, cache, d_cache, toks)
+        t_next, commit = np.asarray(t_next), np.asarray(commit)
+        assert ((1 <= commit) & (commit <= k)).all()
+        for r in range(n_slots):
+            seqs[r].extend(int(t) for t in t_next[r, :commit[r]])
+    for p, seq in zip(prompts, seqs):
+        want = _solo(model, params, p, n_new + k)   # spec may overshoot
+        assert (p + seq)[:len(p) + n_new] == want[:len(p) + n_new]
