@@ -191,6 +191,10 @@ def _drive_latency(args, model, params, prefill_chunk, n_short=None,
     adm.join(timeout=30)
     batcher.stop()
     gaps_ms = sorted(g * 1e3 for g in gaps)
+    if not gaps_ms:
+        raise RuntimeError(
+            "no inter-token gaps collected — every short stream failed "
+            f"before its first token (batcher dead? {batcher._dead!r})")
 
     def pct(q):
         return gaps_ms[min(len(gaps_ms) - 1, int(q * len(gaps_ms)))]

@@ -93,11 +93,22 @@ class TPUCluster:
     _backend = None
     _status = None
 
-    def train(self, data_partitions, num_epochs=1, feed_timeout=600, qname="input"):
+    def train(self, data_partitions, num_epochs=1, feed_timeout=600,
+              qname="input", skip_offsets=None, track_progress=False,
+              progress_every=512):
         """Feed partitions to the cluster (maps TFCluster.train, TFCluster.py:63-94).
 
         `data_partitions` is an RDD (Spark backend) or a list of record lists.
         Epochs repeat the data, like the reference's RDD union.
+
+        ``track_progress`` (feed-offset resume, used by `run_elastic`):
+        partitions are tagged with their index (post-epoch-expansion, so
+        ids are unique across epochs), feeders interleave
+        consumption-confirmed checkpoints every ``progress_every``
+        records and report high-water marks to the reservation server;
+        ``skip_offsets`` ({partition id: consumed offset}, from a failed
+        attempt's `Server.progress_snapshot`) makes each feeder skip the
+        records a previous attempt already delivered.
         """
         assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
         logger.info("feeding training data (epochs=%d)", max(num_epochs, 1))
@@ -110,10 +121,27 @@ class TPUCluster:
                 parts = repeated
             else:
                 parts = [p for _ in range(num_epochs) for p in parts]
+        if track_progress:
+            # tag AFTER epoch expansion: union renumbers partitions
+            # 0..N*epochs-1, so every fed partition id is unique
+            if hasattr(parts, "mapPartitionsWithIndex"):
+                import itertools
+                header = node.PROGRESS_HEADER
+
+                def _tag(i, it):
+                    return itertools.chain([(header, i)], it)
+
+                parts = parts.mapPartitionsWithIndex(_tag)
+            else:
+                parts = [[(node.PROGRESS_HEADER, i)] + list(p)
+                         for i, p in enumerate(parts)]
         self._check_driver_error()
         self._backend.foreach_partition(
             parts, node.train(self.cluster_info, self.cluster_meta,
-                              feed_timeout=feed_timeout, qname=qname))
+                              feed_timeout=feed_timeout, qname=qname,
+                              skip_offsets=skip_offsets,
+                              track_progress=track_progress,
+                              progress_every=progress_every))
 
     def train_stream(self, stream, feed_timeout=600, qname="input"):
         """Feed an unbounded stream of data (maps the reference's DStream
@@ -419,19 +447,26 @@ def run_elastic(backend_factory, map_fun, tf_args=None, *, train_data=None,
 
     ``train_data`` — partitions/RDD fed via ``cluster.train`` each
     attempt (InputMode.SPARK).  Delivery across restarts is
-    AT-LEAST-ONCE: a relaunch re-feeds the interrupted call's data; the
-    training fn must resume model state from its checkpoint (step
-    counters and loss continue; duplicate records within the interrupted
-    epoch are the documented cost — exactly-once feed offsets are a
-    non-goal here).  ``train_data=None`` runs NATIVE mode: nodes read
-    their own (resumable) input.
+    AT-LEAST-ONCE with a BOUNDED duplicate window (feed-offset resume):
+    feeders interleave consumption-confirmed checkpoints every
+    ``progress_every`` records and report per-partition high-water marks
+    to the driver's reservation server; a relaunch skips the records a
+    previous attempt already consumed, so duplicates are limited to
+    ~one progress window per in-flight partition (plus anything consumed
+    after the last driver-side report — reports ride the feeder's 0.5 s
+    watchdog poll).  The training fn must still resume model state from
+    its checkpoint (step counters and loss continue).
+    ``train_data=None`` runs NATIVE mode: nodes read their own
+    (resumable) input.
 
     Raises after ``max_restarts`` failed relaunches.
     """
     input_mode = run_kwargs.pop(
         "input_mode",
         InputMode.SPARK if train_data is not None else InputMode.NATIVE)
+    progress_every = run_kwargs.pop("progress_every", 512)
     attempt = 0
+    consumed = {}          # partition id -> high-water mark across attempts
     while True:
         backend = backend_factory() if callable(backend_factory) \
             else backend_factory
@@ -441,14 +476,25 @@ def run_elastic(backend_factory, map_fun, tf_args=None, *, train_data=None,
                     **run_kwargs)
             if train_data is not None:
                 c.train(train_data, num_epochs=num_epochs,
-                        feed_timeout=feed_timeout)
+                        feed_timeout=feed_timeout, track_progress=True,
+                        skip_offsets=dict(consumed),
+                        progress_every=progress_every)
             c.shutdown(grace_secs=grace_secs)
             return
         except Exception as e:
             attempt += 1
             logger.warning("cluster attempt %d failed: %s", attempt, e)
             if c is not None:
+                try:
+                    for pid, off in c.server.progress_snapshot().items():
+                        consumed[pid] = max(consumed.get(pid, 0), off)
+                except Exception:
+                    logger.warning("could not read feed progress",
+                                   exc_info=True)
                 c.abort()
             if attempt > max_restarts:
                 raise
+            if consumed:
+                logger.info("relaunch will skip consumed records: %s",
+                            consumed)
             time.sleep(restart_backoff)

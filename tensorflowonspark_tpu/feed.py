@@ -118,6 +118,7 @@ class DataFeed:
         # python row objects (the packed-transport fast path)
         self._segments = []
         self._partition_break = False
+        self._progress = {}    # pid -> consumed offset (Progress markers)
         self._ring = None
         self._ring_checked = False
         # queue proxies are cached: every mgr.get_queue() builds a fresh
@@ -212,6 +213,18 @@ class DataFeed:
                 break
             if item is None:
                 self.done_feeding = True
+                q.task_done()
+            elif isinstance(item, marker.Progress):
+                # consumption-confirmed high-water mark: every record
+                # queued before this marker has been handed out, so the
+                # offset is safe to publish (feed-offset resume)
+                self._progress[item.pid] = max(
+                    self._progress.get(item.pid, 0), item.offset)
+                try:
+                    self.mgr.set("feed_progress", dict(self._progress))
+                except Exception:
+                    logger.warning("could not publish feed progress",
+                                   exc_info=True)
                 q.task_done()
             elif isinstance(item, marker.EndPartition):
                 q.task_done()

@@ -21,6 +21,26 @@ class EndPartition(Marker):
     """Marks the end of one input partition within the feed."""
 
 
+class Progress(Marker):
+    """In-band consumption checkpoint (feed-offset resume, net-new).
+
+    The feeder interleaves these with record chunks; when the consumer
+    (`feed.DataFeed`) dequeues one, every record before it has been
+    consumed, so ``offset`` is a consumption-CONFIRMED high-water mark
+    for partition ``pid`` — exactly what `cluster.run_elastic` needs to
+    skip already-delivered records on relaunch without ever skipping an
+    unconsumed one."""
+
+    __slots__ = ("pid", "offset")
+
+    def __init__(self, pid, offset):
+        self.pid = int(pid)
+        self.offset = int(offset)
+
+    def __repr__(self):
+        return f"Progress(pid={self.pid}, offset={self.offset})"
+
+
 class Chunk:
     """A list of records transported as a single queue item.
 

@@ -410,7 +410,8 @@ def _bootstrap(executor_id, job_name, task_index, client, map_fun, tf_args,
             raise  # _mapfn's outer handler reports to the server, then BYEs
 
 
-def _push_chunks(q, iterator, mgr=None, timeout=600.0, equeue=None):
+def _push_chunks(q, iterator, mgr=None, timeout=600.0, equeue=None,
+                 progress_fn=None, progress_every=512, poll_cb=None):
     """Push records as chunk batches; returns the record count.  Shared by
     the train and inference feeders — inference's 1:1 result accounting
     depends on this count being exact.
@@ -440,11 +441,25 @@ def _push_chunks(q, iterator, mgr=None, timeout=600.0, equeue=None):
     pending = []        # packed sub-chunks awaiting one coalesced write
     pending_bytes = 0
 
+    last_poll = [time.time()]
+
+    def _maybe_poll():
+        # progress reports must flow DURING the push too: under ring
+        # backpressure the feeder spends the whole epoch here, and a
+        # crash would otherwise find an empty high-water map
+        if poll_cb is not None and time.time() - last_poll[0] >= 0.5:
+            last_poll[0] = time.time()
+            try:
+                poll_cb()
+            except Exception:
+                logger.warning("progress poll failed", exc_info=True)
+
     def _abort_on_error():
         # polled while a ring write blocks on a full ring: a dead/failed
         # consumer should surface its error, not a generic RingTimeout
         # (maps the reference's error polling during queue.join(),
         # TFSparkNode.py:488-495)
+        _maybe_poll()
         tb = _peek_error(equeue) if equeue is not None else None
         if tb is not None:
             raise RuntimeError(f"training function failed:\n{tb}")
@@ -514,23 +529,55 @@ def _push_chunks(q, iterator, mgr=None, timeout=600.0, equeue=None):
             _flush()
 
     count = 0
+    last_mark = 0
     chunk = []
     for item in iterator:
         chunk.append(item)
-        if len(chunk) >= CHUNK_SIZE:
+        # a due progress marker cuts the chunk early: markers must land
+        # every ~progress_every records even when that is smaller than
+        # the transport chunk
+        marker_due = (progress_fn is not None
+                      and count + len(chunk) - last_mark >= progress_every)
+        if len(chunk) >= CHUNK_SIZE or marker_due:
             _send(chunk)
             count += len(chunk)
             chunk = []
+            _maybe_poll()
+            if marker_due:
+                # records must be IN the queue before the marker claims
+                # them (a marker racing ahead of its chunk would confirm
+                # consumption of records still in the pending buffer)
+                _flush()
+                q.put(progress_fn(count))
+                last_mark = count
     if chunk:
         _send(chunk)
         count += len(chunk)
     _flush()
+    if progress_fn is not None and count > last_mark:
+        q.put(progress_fn(count))
     return count
 
 
-def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+PROGRESS_HEADER = "__tfos_pid__"
+
+
+def train(cluster_info, cluster_meta, feed_timeout=600, qname="input",
+          skip_offsets=None, track_progress=False, progress_every=512):
     """Build the feeder closure for training data (maps TFSparkNode.train,
-    TFSparkNode.py:448-515)."""
+    TFSparkNode.py:448-515).
+
+    ``track_progress`` (feed-offset resume, net-new): each partition's
+    first record is a ``(PROGRESS_HEADER, pid)`` tag (cluster.train adds
+    it); the feeder strips it, skips the first ``skip_offsets[pid]``
+    records (already consumed by a previous attempt), interleaves
+    consumption-confirmed `marker.Progress` checkpoints every
+    ``progress_every`` records, and forwards the high-water marks to the
+    driver's reservation server — both while feeding and while waiting
+    for consumption — so `cluster.run_elastic` can bound duplicate
+    delivery on relaunch to ~one progress window.
+    """
+    import itertools
 
     def _train(iterator):
         mgr = _get_manager(cluster_info, util.get_ip_address(), util.read_executor_id())
@@ -553,11 +600,47 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
         q = mgr.get_queue(qname)
         equeue = mgr.get_queue("error")
+        progress_fn = poll_cb = None
+        client = None
+        skip = 0
+        if track_progress:
+            head = next(iterator, None)
+            if not (isinstance(head, tuple) and len(head) == 2
+                    and head[0] == PROGRESS_HEADER):
+                raise RuntimeError(
+                    "track_progress feeder got an untagged partition "
+                    "(cluster.train tags partitions when tracking)")
+            pid = int(head[1])
+            skip = int((skip_offsets or {}).get(pid, 0))
+            if skip:
+                logger.info("partition %d: skipping %d already-consumed "
+                            "records (feed-offset resume)", pid, skip)
+                consumed = sum(1 for _ in itertools.islice(iterator, skip))
+                skip = consumed      # short partition: skip what exists
+            progress_fn = lambda n: marker.Progress(pid, skip + n)  # noqa
+            client = reservation.Client(cluster_meta["server_addr"],
+                                        connect=False)
+            last_sent = {}
+
+            def poll_cb():
+                got = manager.get_value(mgr, "feed_progress") or {}
+                fresh = {p: o for p, o in got.items()
+                         if o > last_sent.get(p, 0)}
+                if fresh:
+                    client.send_progress(fresh)
+                    last_sent.update(fresh)
+
         count = _push_chunks(q, iterator, mgr=mgr, timeout=feed_timeout,
-                             equeue=equeue)
+                             equeue=equeue, progress_fn=progress_fn,
+                             progress_every=progress_every, poll_cb=poll_cb)
         logger.info("pushed %d records into %s queue", count, qname)
 
-        _join_with_watchdog(q, equeue, feed_timeout)
+        _join_with_watchdog(q, equeue, feed_timeout, poll_cb=poll_cb)
+        if client is not None:
+            # join returned: everything pushed was consumed — report the
+            # exact final offset, then release the connection
+            client.send_progress({pid: skip + count})
+            client.close()
 
     return _train
 
@@ -604,9 +687,11 @@ def _peek_error(equeue):
     return tb
 
 
-def _join_with_watchdog(q, equeue, timeout):
+def _join_with_watchdog(q, equeue, timeout, poll_cb=None):
     """queue.join() with error propagation + feed timeout (maps
-    TFSparkNode.py:485-495)."""
+    TFSparkNode.py:485-495).  ``poll_cb`` (feed-offset resume) runs every
+    poll tick — most consumption happens while the feeder waits here, so
+    this is where high-water marks actually reach the driver."""
     import threading
 
     joined = threading.Event()
@@ -626,6 +711,11 @@ def _join_with_watchdog(q, equeue, timeout):
             raise TimeoutError(
                 f"data feed not consumed within {timeout}s — the training "
                 f"process is likely dead or stuck")
+        if poll_cb is not None:
+            try:
+                poll_cb()
+            except Exception:
+                logger.warning("progress poll failed", exc_info=True)
         joined.wait(0.5)
 
 

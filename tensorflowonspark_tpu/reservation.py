@@ -24,6 +24,11 @@ Message types (reference: reservation.py:130-146 had REG/QUERY/QINFO/STOP):
 - ``BEAT``  {executor_id}              -> ``OK``       (net-new: liveness heartbeat)
 - ``BYE``   {executor_id}              -> ``OK``       (net-new: announced exit, so
                                           the monitor won't flag this node)
+- ``PROGRESS`` {offsets: {pid: off}}   -> ``OK``       (net-new: feed high-water
+                                          marks, consumed-record offsets per
+                                          partition; cluster.run_elastic reads
+                                          them to bound duplicate delivery on
+                                          relaunch)
 - ``STOP``  {}                         -> ``OK``, server shuts down
 """
 import logging
@@ -127,6 +132,7 @@ class Server(MessageSocket):
         # silent peer loss; the coordinator must notice instead).
         self._beats = {}        # executor_id -> last beat monotonic time
         self._finished = set()  # executor_ids that sent BYE (normal exit)
+        self._progress = {}     # partition id -> consumed-record high water
         self._flagged = set()   # executor_ids already reported dead
         self._beat_lock = threading.Lock()
 
@@ -204,6 +210,13 @@ class Server(MessageSocket):
                 self._finished.add(msg.get("executor_id"))
             logger.info("node %s finished (BYE)", msg.get("executor_id"))
             self.send(sock, {"type": "OK"})
+        elif mtype == "PROGRESS":
+            with self._beat_lock:
+                for pid, off in (msg.get("offsets") or {}).items():
+                    pid = int(pid)
+                    self._progress[pid] = max(self._progress.get(pid, 0),
+                                              int(off))
+            self.send(sock, {"type": "OK"})
         elif mtype == "ERROR":
             logger.error("node reported error: %s", msg.get("error"))
             self.reservations.add_error(
@@ -238,6 +251,12 @@ class Server(MessageSocket):
             time.sleep(1)
         logger.info("all %d reservations completed", self.reservations.required)
         return self.reservations.get()
+
+    def progress_snapshot(self):
+        """Consumed-record high-water marks {partition id: offset} reported
+        via PROGRESS (feed-offset resume, cluster.run_elastic)."""
+        with self._beat_lock:
+            return dict(self._progress)
 
     def dead_nodes(self, timeout):
         """Executor ids that heartbeated once but have been silent for
@@ -376,6 +395,20 @@ class Client(MessageSocket):
             return self._request({"type": "STOP"})
         except (ConnectionError, OSError):
             return {"type": "OK"}  # server already gone
+
+    def send_progress(self, offsets):
+        """Report consumed-record high-water marks {partition: offset};
+        best-effort (a lost report only widens the duplicate window)."""
+        if not offsets:
+            return
+        try:
+            # keys stringified: msgpack's strict_map_key (the receive-side
+            # default) rejects int map keys; the server re-ints them
+            return self._request({"type": "PROGRESS",
+                                  "offsets": {str(p): int(o)
+                                              for p, o in offsets.items()}})
+        except (ConnectionError, OSError):
+            logger.warning("could not report feed progress")
 
     def start_heartbeat(self, executor_id, interval=5.0):
         """Beat on a daemon thread until `stop_heartbeat`/`close`/`bye`.

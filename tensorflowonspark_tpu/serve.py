@@ -258,6 +258,17 @@ class ModelService:
                     self._gen = False
             return self._gen or None
 
+    def close(self):
+        """Release serving resources: stops the slot batcher's driver
+        thread (otherwise it busy-polls forever after server teardown)."""
+        with self._gen_lock:
+            if self._gen:
+                try:
+                    self._gen.batcher.stop()
+                except Exception:
+                    logger.warning("batcher stop failed", exc_info=True)
+            self._gen = False   # later :generate probes refuse cleanly
+
     def metadata(self):
         out = {"model": {"export_dir": self.export_dir,
                          "engine": self.desc,
@@ -739,14 +750,21 @@ class GenerateService:
         self._auto_seed = itertools.count(1 << 20)
         self.requests = 0
 
+    # values that reach the batcher's driver thread become int32 device
+    # scalars there; an out-of-range int raising INSIDE the single driver
+    # loop would kill the whole engine, so the range check happens here
+    # (per-request 400, not a bricked server)
+    _I32 = 1 << 31
+
     def _validate(self, req):
         inputs = req.get("inputs")
         if (not isinstance(inputs, list) or not inputs
                 or not all(isinstance(p, list) and p and
-                           all(isinstance(t, int) for t in p)
+                           all(isinstance(t, int)
+                               and 0 <= t < self._I32 for t in p)
                            for p in inputs)):
             raise ValueError('"inputs" must be a non-empty list of '
-                             "non-empty token-id lists")
+                             "non-empty lists of token ids in [0, 2^31)")
         max_new = req.get("max_new_tokens", 16)
         if not isinstance(max_new, int) or not 1 <= max_new <= self.limit:
             raise ValueError(f'"max_new_tokens" must be an int in '
@@ -755,10 +773,15 @@ class GenerateService:
         if temperature < 0:
             raise ValueError('"temperature" must be >= 0')
         eos_id = req.get("eos_id")
-        if eos_id is not None and not isinstance(eos_id, int):
-            raise ValueError('"eos_id" must be an int')
+        if eos_id is not None and not (isinstance(eos_id, int)
+                                       and -self._I32 <= eos_id < self._I32):
+            raise ValueError('"eos_id" must be an int32')
         seed = req.get("seed")
         if seed is not None:
+            if not (isinstance(seed, int)
+                    and -self._I32 <= seed < self._I32 - len(inputs)):
+                raise ValueError('"seed" must be an int32 (with headroom '
+                                 "for per-prompt offsets)")
             seed = int(seed)
         return inputs, max_new, temperature, eos_id, seed
 
@@ -926,7 +949,16 @@ def make_server(args):
                          "grouped path onto them)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
-    server = ThreadingHTTPServer((args.host, args.port), handler)
+
+    class _Server(ThreadingHTTPServer):
+        # server_close() tears the service down too (slot-batcher driver
+        # thread, device caches) so `with`-style and finally-block
+        # shutdowns release everything
+        def server_close(self):
+            super().server_close()
+            service.close()
+
+    server = _Server((args.host, args.port), handler)
     return server, service
 
 

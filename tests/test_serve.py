@@ -255,6 +255,7 @@ def lm_server(tmp_path_factory):
     t.start()
     yield server, service, model, params
     server.shutdown()
+    server.server_close()
 
 
 def _post_gen(server, path, payload):
@@ -498,6 +499,7 @@ def slot_server(tmp_path_factory):
     t.start()
     yield server, service, model, params
     server.shutdown()
+    server.server_close()
 
 
 def test_slots_greedy_matches_decode(slot_server):
@@ -609,16 +611,18 @@ def test_slots_compose_with_draft(tmp_path):
                                   {"inputs": [[1, 2, 3], [4, 5, 6, 7]],
                                    "max_new_tokens": 6})
             assert code == 200
-            return out["outputs"], svc
+            gen = svc.generate_service()
+            spec_rounds = gen.batcher._spec_rounds if gen else 0
+            return out["outputs"], spec_rounds
         finally:
             srv.shutdown()
             srv.server_close()
 
     plain, _ = serve_and_generate([])
-    drafted, svc = serve_and_generate(["--draft_export_dir", draft,
-                                       "--draft_k", "3"])
+    drafted, spec_rounds = serve_and_generate(["--draft_export_dir", draft,
+                                               "--draft_k", "3"])
     assert drafted == plain
-    assert svc.generate_service().batcher._spec_rounds > 0
+    assert spec_rounds > 0
 
 
 def test_make_server_rejects_zero_slots():
