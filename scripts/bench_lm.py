@@ -21,9 +21,12 @@ def main():
     from tensorflowonspark_tpu import benchmarks
 
     p = argparse.ArgumentParser()
-    p.add_argument("--preset", default=None, choices=[None, "flagship"],
-                   help="flagship = benchmarks.FLAGSHIP_LM, exactly the "
-                        "bench.py round-3 driver-metric config")
+    p.add_argument("--preset", default=None,
+                   choices=[None, "flagship", "flagship_v1"],
+                   help="flagship = benchmarks.FLAGSHIP_LM_V2 (rmsnorm), "
+                        "exactly the bench.py round-5 driver-metric "
+                        "config; flagship_v1 = the round-3/4 LayerNorm "
+                        "config (FLAGSHIP_LM)")
     p.add_argument("--batch_size", type=int, default=16)
     p.add_argument("--seq_len", type=int, default=1024)
     p.add_argument("--d_model", type=int, default=512)
@@ -47,11 +50,15 @@ def main():
 
     import jax
 
-    if args.preset == "flagship":
+    if args.preset in ("flagship", "flagship_v1"):
         # the EXACT driver-metric step — no reassembled look-alike
-        step, state, tokens, n_params = benchmarks.make_flagship_step()
+        config = "v2" if args.preset == "flagship" else "v1"
+        step, state, tokens, n_params = benchmarks.make_flagship_step(
+            config=config)
         B, S = tokens.shape[0], tokens.shape[1] - 1
-        attention = benchmarks.FLAGSHIP_LM["attention_impl"]
+        cfg_dict = (benchmarks.FLAGSHIP_LM_V2 if config == "v2"
+                    else benchmarks.FLAGSHIP_LM)
+        attention = cfg_dict["attention_impl"]
     else:
         import jax.numpy as jnp
 

@@ -109,17 +109,26 @@ def _bootstrap_trampoline(fn, executor_id, workdir, status_q, manager_linger=600
         # children FIRST (the background node process — a grandchild
         # nothing else tracks; left alive it would keep training and
         # writing checkpoints into a relaunched attempt's resume), then
-        # the manager server, then exit
-        for child in mp.active_children():
-            try:
-                child.terminate()
-            except Exception:
-                pass
+        # the manager server, then exit.  Async-signal-LEAN: raw os.kill
+        # on snapshot-able pids only — no joins (active_children() reaps,
+        # which can deadlock if SIGTERM lands while the main thread holds
+        # the process lock) and no manager RPCs (shutdown() does a full
+        # connection round trip)
+        import multiprocessing.process as mp_process
+
+        try:
+            children = list(getattr(mp_process, "_children", ()))
+        except Exception:
+            children = []
+        pids = [getattr(c, "pid", None) for c in children]
         for m in manager_mod._started_managers:
-            try:
-                m.shutdown()
-            except Exception:
-                pass
+            pids.append(getattr(getattr(m, "_process", None), "pid", None))
+        for pid in pids:
+            if pid:
+                try:
+                    os.kill(pid, signal_mod.SIGTERM)
+                except OSError:
+                    pass
         os._exit(143)
 
     try:
@@ -330,10 +339,18 @@ class LocalBackend(Backend):
         ev = getattr(self, "_tasks_cancelled", None)
         if ev is not None:
             ev.set()
-        for p in list(self._bootstrap_procs) + list(
-                getattr(self, "_live_task_procs", [])):
+        procs = list(self._bootstrap_procs) + list(
+            getattr(self, "_live_task_procs", []))
+        for p in procs:
             if p.is_alive():
                 p.terminate()
+        # SIGKILL escalation: a SIGTERM handler wedged on a lock (or a
+        # process mid-fork) must not survive teardown
+        deadline = time.time() + 5.0
+        for p in procs:
+            p.join(timeout=max(0.0, deadline - time.time()))
+            if p.is_alive():
+                p.kill()
 
 
 class SparkBackend(Backend):

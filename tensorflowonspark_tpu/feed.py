@@ -118,7 +118,8 @@ class DataFeed:
         # python row objects (the packed-transport fast path)
         self._segments = []
         self._partition_break = False
-        self._progress = {}    # pid -> consumed offset (Progress markers)
+        self._progress = {}         # pid -> PUBLISHED delivered offset
+        self._staged_progress = {}  # pid -> offset awaiting batch return
         self._ring = None
         self._ring_checked = False
         # queue proxies are cached: every mgr.get_queue() builds a fresh
@@ -179,6 +180,22 @@ class DataFeed:
         columnar PackedChunk slices), handling the marker protocol."""
         import queue as queue_mod
 
+        # staged offsets from the PREVIOUS take are safe now: that batch
+        # was returned to the training fn before this call
+        if self._staged_progress:
+            publish = False
+            for pid, off in self._staged_progress.items():
+                if off > self._progress.get(pid, 0):
+                    self._progress[pid] = off
+                    publish = True
+            self._staged_progress = {}
+            if publish:
+                try:
+                    self.mgr.set("feed_progress", dict(self._progress))
+                except Exception:
+                    logger.warning("could not publish feed progress",
+                                   exc_info=True)
+
         q = self._queue_in()
         blocks, n = [], 0
         while n < batch_size:
@@ -215,16 +232,15 @@ class DataFeed:
                 self.done_feeding = True
                 q.task_done()
             elif isinstance(item, marker.Progress):
-                # consumption-confirmed high-water mark: every record
-                # queued before this marker has been handed out, so the
-                # offset is safe to publish (feed-offset resume)
-                self._progress[item.pid] = max(
-                    self._progress.get(item.pid, 0), item.offset)
-                try:
-                    self.mgr.set("feed_progress", dict(self._progress))
-                except Exception:
-                    logger.warning("could not publish feed progress",
-                                   exc_info=True)
+                # DEFERRED high-water mark: records before this marker
+                # have been drained into the current batch, but that
+                # batch has not been RETURNED to the training fn yet — a
+                # crash in that window must re-deliver them.  The offset
+                # is staged here and published at the start of the NEXT
+                # take (by which time the batch was handed out), so a
+                # published offset never covers an undelivered record
+                self._staged_progress[item.pid] = max(
+                    self._staged_progress.get(item.pid, 0), item.offset)
                 q.task_done()
             elif isinstance(item, marker.EndPartition):
                 q.task_done()

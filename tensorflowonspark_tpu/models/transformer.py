@@ -173,17 +173,19 @@ class Attention(nn.Module):
             # the bytes); the local cores broadcast to full heads on-device
             out = _seqpar_dispatch(q, k, v, cfg)
         else:
-            # non-CP paths: broadcast back to full heads for the attention
-            # cores (the narrow projection already saved the params +
-            # kv-cache HBM; XLA fuses the repeat)
-            from tensorflowonspark_tpu.parallel.ring_attention import (
-                _kv_repeat)
-            k, v = _kv_repeat(q, k, v)
             if mask is None and (cfg.attention_impl == "flash" or (
                     cfg.attention_impl == "auto"
                     and jax.default_backend() == "tpu")):
+                # GQA-native kernel: narrow k/v go straight in (no
+                # repeated kv in HBM, dk/dv come back narrow)
                 out = _flash_dispatch(q, k, v, cfg)
             else:
+                # dense path: broadcast back to full heads for the
+                # attention cores (the narrow projection already saved
+                # the params + kv-cache HBM; XLA fuses the repeat)
+                from tensorflowonspark_tpu.parallel.ring_attention import (
+                    _kv_repeat)
+                k, v = _kv_repeat(q, k, v)
                 if mask is not None and cfg.attention_impl == "flash":
                     # arbitrary key-padding masks aren't implemented in the
                     # pallas kernel; an explicit 'flash' request must not
@@ -336,6 +338,7 @@ def _flash_dispatch(q, k, v, cfg):
     divide the batch/head dims.
     """
     from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+    from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return flash_attention(q, k, v, causal=cfg.causal)
@@ -344,14 +347,18 @@ def _flash_dispatch(q, k, v, cfg):
     def _divides(axis, dim):
         return axis in axes and dim % mesh.shape[axis] == 0
 
+    # tp must divide BOTH head dims (the kernel takes narrow GQA k/v;
+    # shard_map splits q and kv heads by the same axis)
     dp = "dp" if _divides("dp", q.shape[0]) else None
-    tp = "tp" if _divides("tp", q.shape[2]) else None
+    tp = ("tp" if _divides("tp", q.shape[2]) and _divides("tp", k.shape[2])
+          else None)
     # dense fallback when a >1-sized mesh axis can't shard its dim: a
     # replicated in_spec there would all-gather the sharded activations and
     # recompute attention redundantly on every member of that axis
     for name, got in (("dp", dp), ("tp", tp)):
         if got is None and name in axes and mesh.shape[name] > 1:
-            return dot_product_attention(q, k, v, causal=cfg.causal)
+            kf, vf = _kv_repeat(q, k, v)   # dense core needs full heads
+            return dot_product_attention(q, kf, vf, causal=cfg.causal)
     import functools
     from jax.sharding import PartitionSpec as P
     spec = P(dp, None, tp, None)

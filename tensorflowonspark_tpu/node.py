@@ -637,9 +637,16 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input",
 
         _join_with_watchdog(q, equeue, feed_timeout, poll_cb=poll_cb)
         if client is not None:
-            # join returned: everything pushed was consumed — report the
-            # exact final offset, then release the connection
-            client.send_progress({pid: skip + count})
+            # join means every item was DEQUEUED, not that every record
+            # was handed to the training fn (drained-but-unreturned
+            # segments exist) — so the final report forwards the
+            # consumer's own delivered-confirmed kv value, never
+            # skip+count; an unconfirmed tail is re-fed next attempt
+            # (bounded by one progress window)
+            try:
+                poll_cb()
+            except Exception:
+                logger.warning("final progress poll failed", exc_info=True)
             client.close()
 
     return _train

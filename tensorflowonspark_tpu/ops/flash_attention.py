@@ -157,11 +157,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
                     sm_scale, causal, block_q, block_k, seq_len):
+    # grid (B, H_kv, nk, group, nq): dk/dv accumulate across the GQA
+    # group's q heads AND the q blocks before one narrow write — the
+    # output block index is constant over both inner dims, so pallas
+    # keeps it resident until the last (g, qi) visit
     ki = pl.program_id(2)
-    qi = pl.program_id(3)                             # q innermost here
-    nq = pl.num_programs(3)
+    g = pl.program_id(3)
+    qi = pl.program_id(4)                             # q innermost here
+    ng = pl.num_programs(3)
+    nq = pl.num_programs(4)
 
-    @pl.when(qi == 0)
+    @pl.when(jnp.logical_and(g == 0, qi == 0))
     def _init():
         dk_scr[:] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
         dv_scr[:] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
@@ -197,7 +203,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
     else:
         _block()
 
-    @pl.when(qi == nq - 1)
+    @pl.when(jnp.logical_and(g == ng - 1, qi == nq - 1))
     def _finish():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
@@ -222,6 +228,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     entirely — pallas outputs can't be dead-code-eliminated, so an unused
     lse would cost real HBM writes on every inference forward."""
     B, S, H, D = q.shape
+    group = H // k.shape[2]   # GQA: q heads per (narrow) kv head
     qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)
     kt = _pad_seq(k.transpose(0, 2, 1, 3), block_k)
     vt = _pad_seq(v.transpose(0, 2, 1, 3), block_k)
@@ -234,13 +241,17 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     o_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     lse_spec = pl.BlockSpec((1, 1, block_q, _LANES),
                             lambda b, h, i, j: (b, h, i, 0))
+    # narrow kv blocks are indexed by the q head's GROUP — no repeated
+    # kv ever materializes in HBM (the GQA bandwidth win, kept here)
+    kv_spec = pl.BlockSpec((1, 1, block_k, D),
+                           lambda b, h, i, j: (b, h // group, j, 0))
     result = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[o_spec] + ([lse_spec] if need_lse else []),
         out_shape=[jax.ShapeDtypeStruct(qt.shape, q.dtype)] + (
@@ -260,6 +271,8 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                     interpret, g_lse=None):
     B, S, H, D = q.shape
+    group = H // k.shape[2]   # GQA: q heads per (narrow) kv head
+    H_kv = k.shape[2]
     qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)
     kt = _pad_seq(k.transpose(0, 2, 1, 3), block_k)
     vt = _pad_seq(v.transpose(0, 2, 1, 3), block_k)
@@ -278,7 +291,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
     delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, _LANES))
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
-    k_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, i, j: (b, h // group, j, 0))
     r_spec = pl.BlockSpec((1, 1, block_q, _LANES),
                           lambda b, h, i, j: (b, h, i, 0))
 
@@ -293,15 +307,19 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         interpret=interpret,
     )(qt, kt, vt, dot, lse, delta)
 
-    # swap grid roles: (b, h, k-block, q-block), q innermost
-    qk_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
-    kk_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
+    # swap grid roles: (b, kv-head, k-block, group-member, q-block) —
+    # q innermost; dk/dv come out NARROW, accumulated across the group
+    # (the narrow output replaces the former repeat-then-sum cotangent)
+    qk_spec = pl.BlockSpec((1, 1, block_q, D),
+                           lambda b, kh, j, g, i: (b, kh * group + g, i, 0))
+    kk_spec = pl.BlockSpec((1, 1, block_k, D),
+                           lambda b, kh, j, g, i: (b, kh, j, 0))
     rk_spec = pl.BlockSpec((1, 1, block_q, _LANES),
-                           lambda b, h, j, i: (b, h, i, 0))
+                           lambda b, kh, j, g, i: (b, kh * group + g, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=S),
-        grid=(B, H, nk, nq),
+        grid=(B, H_kv, nk, group, nq),
         in_specs=[qk_spec, kk_spec, kk_spec, qk_spec, rk_spec, rk_spec],
         out_specs=[kk_spec, kk_spec],
         out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype),
@@ -316,7 +334,12 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 
 def attention_reference(q, k, v, causal=True, sm_scale=None):
     """Dense reference with semantics identical to the kernel (f32 softmax,
-    large-finite mask).  Used for tests and as the dense fallback."""
+    large-finite mask).  Used for tests and as the dense fallback.
+    Accepts narrow (GQA) k/v like the kernel does — repeated here."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     D = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
@@ -400,6 +423,11 @@ def _resolve_call_args(q, k, sm_scale, block_q, block_k, interpret):
     v5e at S in [4096, 8192] (f32 score tiles stay well inside v5e-class
     ~128MB VMEM; pre-v4 generations with small VMEM may need block sizes
     passed explicitly)."""
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads {q.shape[2]} must be a multiple of kv heads "
+            f"{k.shape[2]} (GQA: narrow k/v feed the kernel directly; "
+            "no repeat needed)")
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
@@ -423,8 +451,11 @@ def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None,
 
 def flash_attention(q, k, v, causal=True, sm_scale=None,
                     block_q=1024, block_k=1024, interpret=None):
-    """Flash attention over [B, S, H, D] q/k/v.
+    """Flash attention over [B, S, H, D] q and [B, S, H_kv, D] k/v.
 
+    GQA-native: ``H_kv`` may be any divisor of ``H`` — narrow k/v blocks
+    are indexed per q-head group inside the kernel, so the repeated k/v
+    (and the repeat's summed cotangent) never materialize in HBM.
     Sequence lengths need not be multiples of the block sizes (padded rows
     and keys are masked out of both passes).  `interpret=None`
     auto-selects: native Mosaic on TPU, interpreter elsewhere (the CPU test
