@@ -96,7 +96,16 @@ typedef struct tos_exec {
   size_t num_outputs;
 } tos_exec;
 
-tos_runner* tos_runner_create(const char* plugin_path, char* err, int errlen) {
+// Create-option marshalling: kinds 0 = string, 1 = int64.  Plugins like
+// libtpu take no options; tunneled/proxying plugins require them (their
+// PJRT_Client_Create rejects an empty NamedValue list), so the extended
+// entry point forwards key/value pairs as PJRT_NamedValues.
+tos_runner* tos_runner_create_opts(const char* plugin_path,
+                                   const char* const* opt_keys,
+                                   const char* const* opt_str_vals,
+                                   const long long* opt_int_vals,
+                                   const int* opt_kinds, int n_opts,
+                                   char* err, int errlen) {
   void* dl = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
   if (!dl) {
     set_err(err, errlen, std::string("dlopen failed: ") + dlerror());
@@ -126,9 +135,30 @@ tos_runner* tos_runner_create(const char* plugin_path, char* err, int errlen) {
     }
   }
 
+  std::vector<PJRT_NamedValue> named(n_opts > 0 ? n_opts : 0);
+  for (int i = 0; i < n_opts; ++i) {
+    std::memset(&named[i], 0, sizeof(PJRT_NamedValue));
+    named[i].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    named[i].name = opt_keys[i];
+    named[i].name_size = std::strlen(opt_keys[i]);
+    if (opt_kinds[i] == 0) {
+      named[i].type = PJRT_NamedValue_kString;
+      named[i].string_value = opt_str_vals[i];
+      named[i].value_size = std::strlen(opt_str_vals[i]);
+    } else {
+      named[i].type = PJRT_NamedValue_kInt64;
+      named[i].int64_value = static_cast<int64_t>(opt_int_vals[i]);
+      named[i].value_size = 1;
+    }
+  }
+
   PJRT_Client_Create_Args cargs;
   std::memset(&cargs, 0, sizeof(cargs));
   cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (n_opts > 0) {
+    cargs.create_options = named.data();
+    cargs.num_options = static_cast<size_t>(n_opts);
+  }
   if (take_error(api, api->PJRT_Client_Create(&cargs), err, errlen)) {
     dlclose(dl);
     return nullptr;
@@ -177,6 +207,12 @@ tos_runner* tos_runner_create(const char* plugin_path, char* err, int errlen) {
   r->num_devices = dargs.num_addressable_devices;
   r->platform = platform;
   return r;
+}
+
+tos_runner* tos_runner_create(const char* plugin_path, char* err,
+                              int errlen) {
+  return tos_runner_create_opts(plugin_path, nullptr, nullptr, nullptr,
+                                nullptr, 0, err, errlen);
 }
 
 void tos_runner_destroy(tos_runner* r) {
@@ -388,10 +424,28 @@ int tos_exec_run(tos_exec* x, const tos_buffer* ins, int n_in, tos_buffer* outs,
       return -1;
     }
 
+    // Request an explicit DENSE ROW-MAJOR host layout: with host_layout
+    // null, PJRT copies in the SOURCE buffer's layout — on real TPUs the
+    // compiler may pick a non-row-major device layout (observed on a
+    // [64, 10] output: column-major, i.e. the host saw a transposed
+    // array), and only the mock/CPU paths happen to match row-major.
+    std::vector<int64_t> m2m(dargs.num_dims);
+    for (size_t d = 0; d < dargs.num_dims; ++d) {
+      m2m[d] = static_cast<int64_t>(dargs.num_dims - 1 - d);
+    }
+    PJRT_Buffer_MemoryLayout row_major;
+    std::memset(&row_major, 0, sizeof(row_major));
+    row_major.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+    row_major.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+    row_major.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+    row_major.tiled.minor_to_major = m2m.data();
+    row_major.tiled.minor_to_major_size = dargs.num_dims;
+
     PJRT_Buffer_ToHostBuffer_Args hargs;
     std::memset(&hargs, 0, sizeof(hargs));
     hargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
     hargs.src = out_bufs[i];
+    hargs.host_layout = &row_major;
     hargs.dst = nullptr;  // size query
     if (take_error(api, api->PJRT_Buffer_ToHostBuffer(&hargs), err, errlen)) {
       cleanup_outputs(i);

@@ -44,7 +44,7 @@ _PJRT_DTYPE_INV = {v: k for k, v in _PJRT_DTYPE.items()}
 # --------------------------------------------------------------------------
 
 def export_aot(export_dir, apply_fn, params, signature, batch_sizes=(1, 64),
-               platforms=("cpu", "tpu")):
+               platforms=("cpu", "tpu"), matmul_precision=None):
     """Serialize ``apply_fn(params, *inputs)`` at fixed batch sizes.
 
     Params are closed over (baked into the module as constants) so the
@@ -52,6 +52,12 @@ def export_aot(export_dir, apply_fn, params, signature, batch_sizes=(1, 64),
     param files, mirroring the reference's SavedModelBundle.
     ``signature`` uses the export.py schema ({"inputs": {name: {"shape",
     "dtype"}}, "outputs": [...]}); shapes exclude the batch dim.
+
+    ``matmul_precision`` ("highest"/"float32" etc.) pins the dot/conv
+    precision INTO the artifact: TPU compilers lower default-precision
+    f32 matmuls to bf16 passes, so an artifact exported without this
+    only matches a float32 host reference to ~bf16 tolerance (measured
+    on a real chip — BASELINE.md round 5).
 
     One artifact is written PER platform (jax.export cross-lowers, so a CPU
     host can export for TPU serving): single-platform modules keep the plain
@@ -66,6 +72,9 @@ def export_aot(export_dir, apply_fn, params, signature, batch_sizes=(1, 64),
     os.makedirs(aot_dir, exist_ok=True)
 
     def fn(*inputs):
+        if matmul_precision is not None:
+            with jax.default_matmul_precision(matmul_precision):
+                return apply_fn(params, *inputs)
         return apply_fn(params, *inputs)
 
     platforms = list(platforms) if platforms else ["cpu", "tpu"]
@@ -151,6 +160,19 @@ def _load_runner_lib():
     lib.tos_runner_create.restype = ctypes.c_void_p
     lib.tos_runner_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                       ctypes.c_int]
+    try:
+        lib.tos_runner_create_opts.restype = ctypes.c_void_p
+        lib.tos_runner_create_opts.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int]
+        lib.tos_has_create_opts = True
+    except AttributeError:
+        # a libtos_pjrt.so built before the create-options extension:
+        # still fully usable for optionless plugins (libtpu, the mock)
+        lib.tos_has_create_opts = False
     lib.tos_runner_destroy.argtypes = [ctypes.c_void_p]
     lib.tos_runner_device_count.argtypes = [ctypes.c_void_p]
     lib.tos_runner_device_count.restype = ctypes.c_int
@@ -192,12 +214,37 @@ class NativeRunner:
     """One PJRT client + one compiled executable (per process, like the
     reference's per-executor-JVM session singleton)."""
 
-    def __init__(self, mlir_text, compile_options, plugin_path=None):
+    def __init__(self, mlir_text, compile_options, plugin_path=None,
+                 create_options=None):
+        """``create_options`` ({key: str|int}) are forwarded to
+        PJRT_Client_Create as NamedValues — libtpu needs none, but
+        tunneled/proxying plugins reject an optionless create."""
         self._lib = _load_runner_lib()
         plugin = plugin_path or default_plugin_path()
         err = ctypes.create_string_buffer(4096)
-        self._runner = self._lib.tos_runner_create(
-            plugin.encode(), err, len(err))
+        opts = dict(create_options or {})
+        if not getattr(self._lib, "tos_has_create_opts", False):
+            if opts:
+                raise RuntimeError(
+                    "this libtos_pjrt.so predates create-option support; "
+                    "rebuild it (`make -C native`) to pass create_options")
+            self._runner = self._lib.tos_runner_create(
+                plugin.encode(), err, len(err))
+        else:
+            n = len(opts)
+            keys = (ctypes.c_char_p * n)()
+            svals = (ctypes.c_char_p * n)()
+            ivals = (ctypes.c_longlong * n)()
+            kinds = (ctypes.c_int * n)()
+            for i, (key, val) in enumerate(opts.items()):
+                keys[i] = str(key).encode()
+                if isinstance(val, (int, bool)):     # bools ride as int64
+                    kinds[i], ivals[i], svals[i] = 1, int(val), b""
+                else:
+                    kinds[i], svals[i] = 0, str(val).encode()
+            self._runner = self._lib.tos_runner_create_opts(
+                plugin.encode(), keys, svals, ivals, kinds, n, err,
+                len(err))
         if not self._runner:
             raise RuntimeError(f"PJRT client init failed: {err.value.decode()}")
         mlir = mlir_text.encode() if isinstance(mlir_text, str) else mlir_text
@@ -293,7 +340,7 @@ def _platform_artifact(aot_dir, bs, ext, want):
 
 
 def load_aot(export_dir, batch_size=None, engine="auto", plugin_path=None,
-             platform=None):
+             platform=None, create_options=None):
     """Return ``(predict, spec, bs)``: a fixed-batch predict(arrays)->arrays
     callable for the chosen engine, the artifact spec, and the compiled
     batch size (callers pad/split with `predict_batched`).
@@ -302,6 +349,8 @@ def load_aot(export_dir, batch_size=None, engine="auto", plugin_path=None,
     or 'auto' (native if the runner lib + a plugin are available).
     ``platform`` picks the per-platform artifact; defaults to 'tpu' for the
     native engine (libtpu) and the current jax backend for the jax engine.
+    ``create_options`` ({key: str|int}) forward to PJRT_Client_Create for
+    plugins that require them (see NativeRunner).
     """
     spec = read_spec(export_dir)
     bs = _pick_batch_size(spec, batch_size)
@@ -325,7 +374,8 @@ def load_aot(export_dir, batch_size=None, engine="auto", plugin_path=None,
             mlir = f.read()
         with open(os.path.join(aot_dir, "compile_options.pb"), "rb") as f:
             copts = f.read()
-        runner = NativeRunner(mlir, copts, plugin_path)
+        runner = NativeRunner(mlir, copts, plugin_path,
+                              create_options=create_options)
         logger.info("native PJRT runner on platform %r (batch=%d)",
                     runner.platform, bs)
 
