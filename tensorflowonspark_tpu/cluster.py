@@ -8,6 +8,7 @@ propagation that aborts the whole job, and a duplicate-registration sanity
 check.
 """
 import logging
+from typing import Any, Dict, Optional
 import random
 import threading
 import time
@@ -93,9 +94,11 @@ class TPUCluster:
     _backend = None
     _status = None
 
-    def train(self, data_partitions, num_epochs=1, feed_timeout=600,
-              qname="input", skip_offsets=None, track_progress=False,
-              progress_every=512):
+    def train(self, data_partitions: Any, num_epochs: int = 1,
+              feed_timeout: float = 600, qname: str = "input",
+              skip_offsets: Optional[Dict[int, int]] = None,
+              track_progress: bool = False,
+              progress_every: int = 512) -> None:
         """Feed partitions to the cluster (maps TFCluster.train, TFCluster.py:63-94).
 
         `data_partitions` is an RDD (Spark backend) or a list of record lists.
@@ -143,7 +146,8 @@ class TPUCluster:
                               track_progress=track_progress,
                               progress_every=progress_every))
 
-    def train_stream(self, stream, feed_timeout=600, qname="input"):
+    def train_stream(self, stream: Any, feed_timeout: float = 600,
+                     qname: str = "input") -> None:
         """Feed an unbounded stream of data (maps the reference's DStream
         support, TFCluster.py:83-85 + the streaming example
         examples/mnist/estimator/mnist_spark_streaming.py).
@@ -172,12 +176,13 @@ class TPUCluster:
             self._check_driver_error()
             self._backend.foreach_partition(batch, feeder)
 
-    def stop_requested(self):
+    def stop_requested(self) -> bool:
         """True once a STOP message reached the reservation server (the
         streaming-job termination signal, reference: reservation.py:141-144)."""
         return self.server.done.is_set()
 
-    def inference(self, data_partitions, qname="input"):
+    def inference(self, data_partitions: Any,
+                  qname: str = "input") -> list:
         """Run distributed inference over partitions, returning results
         (maps TFCluster.inference, TFCluster.py:96-115)."""
         assert self.input_mode == InputMode.SPARK, "inference() requires InputMode.SPARK"
@@ -186,7 +191,8 @@ class TPUCluster:
             data_partitions, node.inference(self.cluster_info, self.cluster_meta,
                                             qname=qname))
 
-    def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
+    def shutdown(self, ssc: Any = None, grace_secs: float = 0,
+                 timeout: float = 259200) -> None:
         """Stop the cluster (maps TFCluster.shutdown, TFCluster.py:117-205).
 
         Pushes end-of-feed sentinels to every worker, waits out grace_secs
@@ -260,7 +266,7 @@ class TPUCluster:
             if err:
                 raise RuntimeError(f"node failed during run:\n{err}")
 
-    def tensorboard_url(self):
+    def tensorboard_url(self) -> Optional[str]:
         """URL of the chief's profiler/TensorBoard endpoint, if enabled
         (maps TFCluster.tensorboard_url, TFCluster.py:207-212)."""
         for n in self.cluster_info:
@@ -268,7 +274,7 @@ class TPUCluster:
                 return f"http://{n['host']}:{n['tb_port']}"
         return None
 
-    def abort(self):
+    def abort(self) -> None:
         """Forceful teardown after a node failure: kill executors, stop
         the rendezvous server, best-effort-close every node manager.
         Unlike `shutdown`, never raises — it exists so `run_elastic` can
@@ -312,7 +318,8 @@ class TPUCluster:
             raise RuntimeError(f"cluster failed:\n{err}")
 
 
-def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
+def run(backend_or_sc: Any, map_fun: Any, tf_args: Any = None,
+        num_executors: Optional[int] = None, num_ps: int = 0,
         tensorboard=False, input_mode=InputMode.NATIVE, log_dir=None,
         master_node="chief", reservation_timeout=600,
         queues=("input", "output", "error", "control"), eval_node=False,
@@ -425,9 +432,11 @@ def run(backend_or_sc, map_fun, tf_args=None, num_executors=None, num_ps=0,
     return cluster
 
 
-def run_elastic(backend_factory, map_fun, tf_args=None, *, train_data=None,
-                num_epochs=1, feed_timeout=600, grace_secs=0,
-                max_restarts=2, restart_backoff=2.0, **run_kwargs):
+def run_elastic(backend_factory: Any, map_fun: Any, tf_args: Any = None,
+                *, train_data: Any = None, num_epochs: int = 1,
+                feed_timeout: float = 600, grace_secs: float = 0,
+                max_restarts: int = 2, restart_backoff: float = 2.0,
+                **run_kwargs: Any) -> None:
     """Run a cluster end-to-end (launch -> feed -> shutdown) with
     automatic RELAUNCH on node failure — the elasticity the reference's
     fixed-size cluster never had (SURVEY.md §5 "no elasticity"), built

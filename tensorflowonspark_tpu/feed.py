@@ -11,6 +11,7 @@ pickled queue puts are the reference's throughput ceiling (SURVEY.md §7).
 arrays ready for `jax.device_put`; `iter_batches` wraps the loop.
 """
 import logging
+from typing import Any, Iterable, Iterator, Optional
 
 from . import marker
 from . import shm as shm_mod
@@ -18,7 +19,8 @@ from . import shm as shm_mod
 logger = logging.getLogger(__name__)
 
 
-def device_prefetch(batch_iter, sharding=None, depth=2):
+def device_prefetch(batch_iter: Iterable, sharding: Any = None,
+                    depth: int = 2) -> Iterator:
     """Overlap host->HBM transfer with compute.
 
     Wraps an iterator of host batches (numpy pytrees) and yields
@@ -55,7 +57,7 @@ def device_prefetch(batch_iter, sharding=None, depth=2):
         yield buf.popleft()
 
 
-def pad_batch(batch, batch_size):
+def pad_batch(batch: Any, batch_size: int) -> Any:
     """Repeat-pad every array in a batch (array, tuple, or dict of arrays)
     along axis 0 up to `batch_size`; full batches pass through untouched."""
     import numpy as np
@@ -76,7 +78,7 @@ def pad_batch(batch, batch_size):
     return _pad(batch)
 
 
-def hdfs_path(ctx, path):
+def hdfs_path(ctx: Any, path: str) -> str:
     """Normalize a path per the filesystem schemes the cluster uses.
 
     Maps reference TFNode.hdfs_path (TFNode.py:29-64): absolute and
@@ -291,7 +293,8 @@ class DataFeed:
             return rows if row_type is list else [row_type(r) for r in rows]
         return [row_type(c[i] for c in cols) for i in range(len(data))]
 
-    def next_batch(self, batch_size, timeout=None):
+    def next_batch(self, batch_size: int,
+                   timeout: Optional[float] = None) -> Any:
         """Return up to `batch_size` records.
 
         Returns fewer records at a partition boundary (so inference result
@@ -322,7 +325,8 @@ class DataFeed:
                 cols[name].append(rec[key])
         return cols
 
-    def next_numpy_batch(self, batch_size, dtype=None, timeout=None):
+    def next_numpy_batch(self, batch_size: int, dtype: Any = None,
+                         timeout: Optional[float] = None) -> Any:
         """Like next_batch but stacks records into numpy arrays.
 
         Records that are tuples/lists of fields become a tuple of arrays
@@ -398,7 +402,8 @@ class DataFeed:
             return all(len(v) == 0 for v in batch) or not batch
         return hasattr(batch, "__len__") and len(batch) == 0
 
-    def iter_batches(self, batch_size, numpy=False):
+    def iter_batches(self, batch_size: int,
+                     numpy: bool = False) -> Iterator:
         """Generator over batches until end-of-feed."""
         while not self.should_stop():
             batch = (self.next_numpy_batch(batch_size) if numpy

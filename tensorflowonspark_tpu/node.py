@@ -17,6 +17,7 @@ executors through a `Backend`.  Differences from the reference, by design
 - Data feeding is chunked (`marker.Chunk`) rather than per-record.
 """
 import logging
+from typing import Any, Callable, Dict, Optional
 import multiprocessing as mp
 import os
 import time
@@ -562,8 +563,11 @@ def _push_chunks(q, iterator, mgr=None, timeout=600.0, equeue=None,
 PROGRESS_HEADER = "__tfos_pid__"
 
 
-def train(cluster_info, cluster_meta, feed_timeout=600, qname="input",
-          skip_offsets=None, track_progress=False, progress_every=512):
+def train(cluster_info: Any, cluster_meta: Any, feed_timeout: float = 600,
+          qname: str = "input",
+          skip_offsets: Optional[Dict[int, int]] = None,
+          track_progress: bool = False,
+          progress_every: int = 512) -> Callable:
     """Build the feeder closure for training data (maps TFSparkNode.train,
     TFSparkNode.py:448-515).
 
@@ -652,7 +656,8 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input",
     return _train
 
 
-def inference(cluster_info, cluster_meta, qname="input"):
+def inference(cluster_info: Any, cluster_meta: Any,
+              qname: str = "input") -> Callable:
     """Build the feeder/collector closure for inference (maps
     TFSparkNode.inference, TFSparkNode.py:518-579).  Returns exactly one
     result per input record, per partition."""
@@ -726,7 +731,8 @@ def _join_with_watchdog(q, equeue, timeout, poll_cb=None):
         joined.wait(0.5)
 
 
-def shutdown(cluster_info, queues=("input",), grace_secs=0):
+def shutdown(cluster_info: Any, queues: Any = ("input",),
+             grace_secs: float = 0) -> Callable:
     """Build the per-executor shutdown closure (maps TFSparkNode.shutdown,
     TFSparkNode.py:582-636): push end-of-feed sentinels, wait out the grace
     period (chief may still be exporting), surface late errors, mark stopped."""

@@ -17,6 +17,7 @@ Parity inventory (reference pipeline.py):
   module-global model cache (`:492-496`).
 """
 import logging
+from typing import Any
 
 from . import backend as backend_mod
 from . import cluster as cluster_mod
@@ -150,7 +151,7 @@ class TFEstimator(TFParams):
         self.export_fn = export_fn
         self.args = Namespace(tf_args if tf_args is not None else {})
 
-    def fit(self, dataset, backend=None):
+    def fit(self, dataset: Any, backend: Any = None) -> "TFModel":
         return self._fit(dataset, backend)
 
     def _fit(self, dataset, backend=None):
@@ -192,7 +193,8 @@ class TFModel(TFParams):
         super().__init__()
         self.args = Namespace(tf_args if tf_args is not None else {})
 
-    def transform(self, dataset, backend=None, box=None):
+    def transform(self, dataset: Any, backend: Any = None,
+                  box: Any = None) -> Any:
         """Run batch inference over ``dataset``; returns rows in input order.
 
         ``box`` controls the row value types:

@@ -18,6 +18,7 @@ a lock so concurrent requests serialize instead of interleaving
 executions.
 """
 import argparse
+from typing import Any
 import json
 import logging
 import threading
@@ -937,7 +938,7 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug("http: " + fmt, *args)
 
 
-def make_server(args):
+def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
     """Build (server, service); caller runs serve_forever()."""
     # fail FAST on invalid combinations: GenerateService is constructed
     # lazily on the first :generate request, where a config error would
@@ -962,7 +963,7 @@ def make_server(args):
     return server, service
 
 
-def main(argv=None):
+def main(argv: Any = None) -> None:
     args = build_argparser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

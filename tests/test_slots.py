@@ -231,8 +231,6 @@ def test_cross_path_sampling_exact_and_statistical(model_and_params):
     per-seed token agreement over 64 draws stays high.  Seeded and
     deterministic: the only variation source is the fixed seed list.
     """
-    import queue as queue_mod
-
     from tensorflowonspark_tpu import serve
 
     model, params = model_and_params

@@ -13,12 +13,31 @@ machine with pyspark proves conformance unmodified):
     # or directly:
     pytest tests/test_spark_real.py -q
 
-Known environment needs: a JVM (JAVA_HOME), and the repo root on the
-executors' PYTHONPATH (the fixture forwards it via
-``spark.executorEnv.PYTHONPATH``).  docs/source/minispark_gaps.rst lists
-the semantic gaps of the minispark tier that make this one necessary.
+Known environment needs (each with its own clear skip/ship path):
+
+- **JVM**: pyspark shells out to ``java``; the fixture skips with an
+  actionable message when neither ``$JAVA_HOME/bin/java`` nor ``java``
+  on PATH exists (an ImportError-free box can still lack a JVM, and the
+  raw failure — a JavaGateway timeout after ~30 s — is opaque).
+- **`spark_surface` on executors**, via BOTH routes: ``sc.addPyFile``
+  ships the module into every executor's working dir (works on real
+  clusters where ``tests/`` is not on a shared filesystem — Spark's
+  documented mechanism for exactly this), AND
+  ``spark.executorEnv.PYTHONPATH`` covers pyspark versions/deploy modes
+  where the driver-side path is also visible (local-cluster on one
+  host).  The map functions cloudpickle BY REFERENCE to the
+  ``spark_surface`` module, so executors must be able to import it by
+  name — addPyFile guarantees that without a shared FS.
+- **the package on executors**: ``tensorflowonspark_tpu`` itself rides
+  executorEnv PYTHONPATH (repo root).  On a multi-HOST cluster install
+  the package on workers or submit it with ``--py-files`` as a zip;
+  local-cluster (this tier's target) shares the driver's filesystem.
+
+docs/source/minispark_gaps.rst lists the semantic gaps of the minispark
+tier that make this one necessary.
 """
 import os
+import shutil
 import sys
 
 import pytest
@@ -37,14 +56,26 @@ _TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO_ROOT = os.path.dirname(_TESTS_DIR)
 
 
+def _java_missing():
+    home = os.environ.get("JAVA_HOME")
+    if home and os.path.exists(os.path.join(home, "bin", "java")):
+        return None
+    if shutil.which("java"):
+        return None
+    return ("real pyspark needs a JVM: no $JAVA_HOME/bin/java and no "
+            "`java` on PATH (install a JRE or set JAVA_HOME)")
+
+
 @pytest.fixture(scope="module")
 def _real_sc():
+    reason = _java_missing()
+    if reason:
+        pytest.skip(reason)
     import pyspark
 
-    # executors must import BOTH the package (repo root) and the
-    # spark_surface module (tests/) — the map functions cloudpickle by
-    # reference to 'spark_surface'; executorEnv must be set BEFORE
-    # context creation (pyspark reads it during init only)
+    # executorEnv must be set BEFORE context creation (pyspark reads it
+    # during init only); it carries the PACKAGE (repo root) and — for
+    # same-host deploys — the tests dir
     conf = (pyspark.SparkConf()
             .setMaster(f"local-cluster[{NUM_EXECUTORS},1,1024]")
             .setAppName("tfos-tpu-conformance")
@@ -54,6 +85,9 @@ def _real_sc():
             .set("spark.python.worker.reuse", "true")
             .set("spark.ui.enabled", "false"))
     context = pyspark.SparkContext(conf=conf)
+    # ship spark_surface to executors regardless of shared-FS layout:
+    # the map functions pickle by reference to this module's name
+    context.addPyFile(os.path.join(_TESTS_DIR, "spark_surface.py"))
     sys.path.insert(0, _REPO_ROOT)
     yield context
     context.stop()
