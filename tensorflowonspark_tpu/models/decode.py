@@ -112,8 +112,10 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
 # these).  Net-new beyond the reference (its serving is batch forward
 # only, TFModel.scala:245-292).
 
-def init_slot_cache(model_or_cfg, n_slots):
-    """Build the slot-decode model + empty cache with `n_slots` rows."""
+def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0):
+    """Build the slot-decode model + empty cache with `n_slots` rows.
+    ``page_size``/``n_pages`` > 0 switches to the PAGED kv layout
+    (see `init_paged_slot_cache`)."""
     from tensorflowonspark_tpu.models.transformer import (
         Transformer, TransformerConfig)
 
@@ -123,7 +125,8 @@ def init_slot_cache(model_or_cfg, n_slots):
         raise TypeError(f"expected Transformer or TransformerConfig, "
                         f"got {type(model_or_cfg)}")
     slot_model = Transformer(
-        dataclasses.replace(cfg, decode=True, decode_slots=True))
+        dataclasses.replace(cfg, decode=True, decode_slots=True,
+                            kv_page_size=page_size, kv_pages=n_pages))
     shapes = jax.eval_shape(
         lambda: slot_model.init(jax.random.key(0),
                                 jnp.zeros((n_slots, 1), jnp.int32)))
@@ -132,15 +135,56 @@ def init_slot_cache(model_or_cfg, n_slots):
     return slot_model, cache
 
 
+def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages):
+    """Build a PAGED slot-decode model + empty cache: kv lives in a
+    shared pool of ``n_pages`` pages of ``page_size`` tokens, mapped per
+    row through a page table (TransformerConfig.kv_page_size).  The
+    serving layer owns page allocation (serve.ContinuousBatcher's free
+    list); `_jitted_set_row_page_table` installs a row's pages before
+    its prefill.  CALLER CONTRACT: reserve one pool page as a garbage
+    SINK and point every unallocated/retired table entry at it — tail
+    blocks DO receive writes (bucket-padded prefill overshoot,
+    post-retirement garbage steps), so entries must never default to a
+    page another row owns (serve.ContinuousBatcher allocates
+    kv_pages + 1 and uses the extra page as the sink)."""
+    return init_slot_cache(model_or_cfg, n_slots, page_size=page_size,
+                           n_pages=n_pages)
+
+
+def _leaf_name(path):
+    last = path[-1]
+    return getattr(last, "key", getattr(last, "name", None))
+
+
+_POOL_LEAVES = ("pages_key", "pages_value")   # dim 0 = pool, not rows
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_set_row_page_table(slot_model):
+    """Install row `row`'s page mapping (serving-side allocation): every
+    layer's page_table gets `entries` [max_pages] at that row."""
+
+    # donate: the cache (incl. the full kv pool) must update in place —
+    # an undonated call would copy multi-GB of pool per admission
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def set_table(cache, row, entries):
+        def set_leaf(path, leaf):
+            if _leaf_name(path) == "page_table":
+                return leaf.at[row].set(entries.astype(jnp.int32))
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+    return set_table
+
+
 def _reset_row_indices(row_cache, value):
     """Set every per-row index leaf (cache_index / pos_index) of a sliced
     single-row cache to `value`."""
     value = jnp.asarray(value, jnp.int32)
 
     def set_leaf(path, leaf):
-        last = path[-1]
-        name = getattr(last, "key", getattr(last, "name", None))
-        if name in ("cache_index", "pos_index"):
+        if _leaf_name(path) in ("cache_index", "pos_index"):
             return jnp.full(leaf.shape, value, jnp.int32)
         return leaf
 
@@ -161,16 +205,27 @@ def _jitted_slot_prefill(slot_model):
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def prefill(params, cache, chunk, row, start, n_valid):
-        row_cache = jax.tree_util.tree_map(
-            lambda a: jax.lax.dynamic_slice_in_dim(a, row, 1, 0), cache)
+        # pool leaves (paged kv) are SHARED across rows: they pass into
+        # the row apply whole and come back whole; per-row leaves
+        # (cached kv, indices, page_table) slice to the row
+        def _slice(path, a):
+            if _leaf_name(path) in _POOL_LEAVES:
+                return a
+            return jax.lax.dynamic_slice_in_dim(a, row, 1, 0)
+
+        row_cache = jax.tree_util.tree_map_with_path(_slice, cache)
         row_cache = _reset_row_indices(row_cache, start)
         logits, mut = slot_model.apply(
             {"params": params, "cache": row_cache}, chunk,
             mutable=["cache"])
         new_row = _reset_row_indices(mut["cache"], start + n_valid)
-        cache = jax.tree_util.tree_map(
-            lambda full, upd: jax.lax.dynamic_update_slice_in_dim(
-                full, upd, row, 0), cache, new_row)
+
+        def _write(path, full, upd):
+            if _leaf_name(path) in _POOL_LEAVES:
+                return upd
+            return jax.lax.dynamic_update_slice_in_dim(full, upd, row, 0)
+
+        cache = jax.tree_util.tree_map_with_path(_write, cache, new_row)
         last = jax.lax.dynamic_slice_in_dim(logits, n_valid - 1, 1, 1)
         return last[:, 0], cache          # [1, V], updated batch cache
 

@@ -70,6 +70,13 @@ class TransformerConfig:
     # is PER ROW [B] (vmapped cache writes, per-row rope positions and
     # visibility), so each batch row is an independent serving slot that
     # requests can join/leave at token boundaries (serve.ContinuousBatcher)
+    kv_page_size: int = 0         # >0 (with decode_slots): PAGED kv cache —
+    # kv lives in a shared pool of kv_pages pages of this many tokens;
+    # each row maps logical blocks to pool pages via a per-row page_table
+    # (vLLM-style).  Rows then consume pool pages proportional to their
+    # ACTUAL sequence need instead of reserving max_seq_len each — the
+    # capacity win that lets n_slots exceed the dense-cache HBM limit.
+    kv_pages: int = 0             # pool size (pages) when kv_page_size > 0
 
 
 def apply_rope(x, positions, theta=10000.0):
@@ -119,7 +126,9 @@ class Attention(nn.Module):
         q = q.reshape(B, S, cfg.n_heads, head_dim)
         k = k.reshape(B, S, n_kv, head_dim)
         v = v.reshape(B, S, n_kv, head_dim)
-        decoding = cfg.decode and self.has_variable("cache", "cached_key")
+        decoding = cfg.decode and (
+            self.has_variable("cache", "cached_key")
+            or self.has_variable("cache", "pages_key"))
         cache_index = None
         if decoding:
             cache_index = self.get_variable("cache", "cache_index")
@@ -221,6 +230,17 @@ class Attention(nn.Module):
         B, S, n_kv, Dh = k.shape
         L = cfg.max_seq_len
         dtype = k.dtype
+        if cfg.kv_page_size:
+            if not cfg.decode_slots:
+                raise ValueError("kv_page_size requires decode_slots=True "
+                                 "(pages are a serving-slot feature)")
+            if L % cfg.kv_page_size:
+                raise ValueError(
+                    f"max_seq_len={L} must be a multiple of "
+                    f"kv_page_size={cfg.kv_page_size}")
+            if cfg.kv_pages < 1:
+                raise ValueError("kv_page_size > 0 requires kv_pages >= 1")
+            return _paged_attention_body(self, q, k, v)
         ck = self.variable("cache", "cached_key", jnp.zeros,
                            (B, L, n_kv, Dh), dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros,
@@ -270,6 +290,81 @@ class Attention(nn.Module):
             logits = jnp.where(visible[None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+
+
+def _paged_attention_body(attn_self, q, k, v):
+    """Paged continuous-batching decode attention (vLLM-style layout,
+    blend-write discipline).
+
+    kv lives in a SHARED pool ``pages_key/pages_value [kv_pages,
+    page, n_kv, Dh]``; each row owns the pool pages its per-row
+    ``page_table [B, max_seq/page]`` names (the serving layer allocates
+    them from a free list at admission and returns them at retirement —
+    serve.ContinuousBatcher).  Writes follow the measured slot-cache
+    rule (one-hot masked blend, never a scatter: BASELINE.md round 4);
+    reads gather each row's pages back into the logical [B, L, n_kv,
+    Dh] view, which costs the same HBM read attention performs anyway —
+    the pool saves RESIDENT memory, not step bandwidth.
+
+    CONTRACT: a row's table must name valid pool pages for every
+    position it will touch before those positions are written (admission
+    allocates ceil(need/page) up front), and every OTHER entry —
+    unallocated tails, retired rows — must alias a caller-reserved
+    garbage SINK page: tail blocks DO receive writes (bucket-padded
+    prefill overshoot, the post-retirement garbage steps of a freed
+    row), so a tail defaulting to a real page would corrupt its owner.
+    serve.ContinuousBatcher reserves pool page `kv_pages` as the sink.
+    Reads of sink garbage are hidden by the visibility mask for every
+    live row.
+    """
+    cfg = attn_self.cfg
+    from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
+    B, S, n_kv, Dh = k.shape
+    P, NP = cfg.kv_page_size, cfg.kv_pages
+    max_pages = cfg.max_seq_len // P
+    L = max_pages * P
+    dtype = k.dtype
+    pk = attn_self.variable("cache", "pages_key", jnp.zeros,
+                            (NP, P, n_kv, Dh), dtype)
+    pv = attn_self.variable("cache", "pages_value", jnp.zeros,
+                            (NP, P, n_kv, Dh), dtype)
+    table = attn_self.variable(
+        "cache", "page_table",
+        lambda: jnp.zeros((B, max_pages), jnp.int32))
+    ci = attn_self.variable("cache", "cache_index",
+                            lambda: jnp.zeros((B,), jnp.int32))
+    if attn_self.is_initializing():
+        kf, vf = _kv_repeat(q, k, v)
+        return dot_product_attention(q, kf, vf, causal=cfg.causal)
+    idx = ci.value
+    pos = idx[:, None] + jnp.arange(S)[None, :]              # [B, S]
+    block = jnp.clip(pos // P, 0, max_pages - 1)
+    phys = jnp.take_along_axis(table.value, block, axis=1)   # [B, S]
+    oh_p = (jnp.arange(NP)[None, None, :]
+            == phys[:, :, None]).astype(dtype)               # [B, S, NP]
+    oh_o = (jnp.arange(P)[None, None, :]
+            == (pos % P)[:, :, None]).astype(dtype)          # [B, S, P]
+    upd_k = jnp.einsum("bsn,bso,bshd->nohd", oh_p, oh_o, k.astype(dtype))
+    upd_v = jnp.einsum("bsn,bso,bshd->nohd", oh_p, oh_o, v.astype(dtype))
+    wmask = (jnp.einsum("bsn,bso->no", oh_p, oh_o)
+             > 0)[:, :, None, None]                          # [NP, P, 1, 1]
+    pk.value = jnp.where(wmask, upd_k, pk.value)
+    pv.value = jnp.where(wmask, upd_v, pv.value)
+    ci.value = idx + S
+    # read: each row's logical kv view, gathered from its pages
+    kb = jnp.take(pk.value, table.value, axis=0)  # [B, mp, P, n_kv, Dh]
+    vb = jnp.take(pv.value, table.value, axis=0)
+    kf, vf = _kv_repeat(q, kb.reshape(B, L, n_kv, Dh),
+                        vb.reshape(B, L, n_kv, Dh))
+    scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf).astype(jnp.float32)
+    logits = logits * scale
+    visible = (jnp.arange(L)[None, None, :]
+               <= (idx[:, None, None]
+                   + jnp.arange(S)[None, :, None]))          # [B, S, L]
+    logits = jnp.where(visible[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
 
 
 def _seqpar_dispatch(q, k, v, cfg):

@@ -68,6 +68,13 @@ def build_argparser():
     p.add_argument("--generate_timeout_s", type=float, default=None,
                    help="wall-time bound on one :generate request "
                         "(default: max(600, 2*max_new_tokens_limit))")
+    p.add_argument("--generate_kv_page_size", type=int, default=0,
+                   help=">0 enables a PAGED kv cache for the :generate "
+                        "slots: rows draw pages of this many tokens from "
+                        "a shared pool instead of reserving max_seq_len "
+                        "each (requires --generate_kv_pages)")
+    p.add_argument("--generate_kv_pages", type=int, default=0,
+                   help="pool size (pages) for --generate_kv_page_size")
     p.add_argument("--input_mapping", default=None)
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
@@ -220,6 +227,8 @@ class ModelService:
         self._gen_prefill_chunk = getattr(args, "generate_prefill_chunk",
                                           512) or 512
         self._gen_timeout_s = getattr(args, "generate_timeout_s", None)
+        self._gen_kv_page_size = getattr(args, "generate_kv_page_size", 0)
+        self._gen_kv_pages = getattr(args, "generate_kv_pages", 0)
         self._batcher = None
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
@@ -253,7 +262,9 @@ class ModelService:
                         draft_k=self._draft_k, slots=self._gen_slots,
                         read_chunk=self._gen_read_chunk,
                         prefill_chunk=self._gen_prefill_chunk,
-                        request_timeout_s=self._gen_timeout_s)
+                        request_timeout_s=self._gen_timeout_s,
+                        kv_page_size=self._gen_kv_page_size,
+                        kv_pages=self._gen_kv_pages)
                 except (TypeError, ValueError) as e:
                     logger.info(":generate unavailable: %s", e)
                     self._gen = False
@@ -347,7 +358,7 @@ class ContinuousBatcher:
 
     def __init__(self, model, params, n_slots=8, max_pending=1024,
                  read_chunk=8, prefill_chunk=512, draft_model=None,
-                 draft_params=None, draft_k=4):
+                 draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0):
         import queue as queue_mod
 
         import jax.numpy as jnp
@@ -355,8 +366,44 @@ class ContinuousBatcher:
         from .models import decode as decode_mod
 
         self.model, self.params = model, params
-        self.slot_model, self._cache = decode_mod.init_slot_cache(model,
-                                                                  n_slots)
+        self.kv_page_size = int(kv_page_size or 0)
+        if self.kv_page_size and int(kv_pages) < 1:
+            raise ValueError(
+                "kv_page_size > 0 requires kv_pages >= 1 (the shared "
+                "pool's size; --generate_kv_pages on the CLI)")
+        if self.kv_page_size:
+            # PAGED kv: rows draw pages from a shared pool sized by
+            # kv_pages instead of reserving max_seq_len each — n_slots
+            # can exceed the dense-cache HBM limit when requests are
+            # shorter than max_seq (vLLM-style; decode.init_paged_slot_
+            # cache).  Admission allocates a row's whole projected need
+            # from the free list and retirement returns it; when the
+            # pool is empty, admissions WAIT (natural backpressure).
+            # One EXTRA page is the garbage SINK: free rows keep
+            # decoding junk until re-occupied (the device loop steps
+            # every row; the _gen filter drops their tokens), and with
+            # a shared pool those junk writes must never land in pages
+            # another row now owns — a freed row's table is pointed at
+            # the sink, where writes are harmless.
+            self._sink = int(kv_pages)
+            self._total_pages = int(kv_pages)
+            self.slot_model, self._cache = decode_mod.init_paged_slot_cache(
+                model, n_slots, self.kv_page_size, int(kv_pages) + 1)
+            self._set_table = decode_mod._jitted_set_row_page_table(
+                self.slot_model)
+            self._free_pages = list(range(int(kv_pages)))
+            self._row_pages = [None] * n_slots
+            max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
+            self._sink_entries = jnp.full((max_pages,), self._sink,
+                                          jnp.int32)
+            for row in range(n_slots):   # unoccupied rows start at sink
+                self._cache = self._set_table(
+                    self._cache, jnp.asarray(row, jnp.int32),
+                    self._sink_entries)
+        else:
+            self.slot_model, self._cache = decode_mod.init_slot_cache(
+                model, n_slots)
+        self._parked = None    # admission waiting for pool pages (FIFO)
         self._prefill = decode_mod._jitted_slot_prefill(self.slot_model)
         self._step = decode_mod._jitted_slot_step(self.slot_model)
         self._set_row = decode_mod._jitted_set_row(self.slot_model)
@@ -411,6 +458,9 @@ class ContinuousBatcher:
         adm, self._admitting = self._admitting, None
         if adm is not None:
             adm["item"][0]._fail(err)
+        parked, self._parked = self._parked, None
+        if parked is not None:
+            parked[1][0]._fail(err)
         for s in self._slots:
             if s is not None:
                 s["handle"]._fail(err)
@@ -424,6 +474,15 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new} exceeds "
                 f"max_seq_len {self.max_seq}")
+        if self.kv_page_size:
+            need = self._pages_needed(len(prompt), max_new)
+            if need > self._total_pages:
+                # a request the WHOLE pool cannot hold would park forever
+                # at the head of the line, wedging every later admission
+                raise ValueError(
+                    f"request needs {need} kv pages but the pool only "
+                    f"has {self._total_pages}; raise --generate_kv_pages "
+                    "or shorten the request")
         h = SlotHandle(prompt)
         self._pending.put((h, list(prompt), max_new, float(temperature),
                            eos_id, int(seed)))
@@ -470,11 +529,52 @@ class ContinuousBatcher:
         sizes.append(rest)
         return sizes
 
+    def _pages_needed(self, prompt_len, max_new):
+        headroom = self.draft_k if self.draft_model is not None else 0
+        return -(-(prompt_len + max_new + headroom) // self.kv_page_size)
+
+    def _try_allocate(self, row, item):
+        """Reserve `item`'s whole projected page need for `row`; False =
+        pool exhausted (caller parks the item until pages free)."""
+        import jax.numpy as jnp
+
+        need = self._pages_needed(len(item[1]), item[2])
+        if len(self._free_pages) < need:
+            return False
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self._row_pages[row] = pages
+        max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
+        # unallocated tail entries alias the SINK (never page 0 — that
+        # may belong to someone)
+        entries = jnp.asarray(pages + [self._sink] * (max_pages - len(pages)),
+                              jnp.int32)
+        self._cache = self._set_table(self._cache,
+                                      jnp.asarray(row, jnp.int32), entries)
+        return True
+
+    def _free_row(self, row):
+        """Retire `row`: return its pool pages to the free list and point
+        its table at the sink page, so the row's post-retirement garbage
+        decode can never write into pages a later owner holds (paged
+        mode; no-op otherwise).  Call wherever a slot empties."""
+        import jax.numpy as jnp
+
+        self._slots[row] = None
+        if self.kv_page_size and self._row_pages[row] is not None:
+            self._free_pages.extend(self._row_pages[row])
+            self._row_pages[row] = None
+            self._cache = self._set_table(
+                self._cache, jnp.asarray(row, jnp.int32),
+                self._sink_entries)
+
     def _start_admission(self, row, item):
         h, prompt, max_new, temp, eos_id, seed = item
         if h.cancelled.is_set():        # client gone before admission
             h._finish(list(prompt))
             return
+        if self.kv_page_size and not self._try_allocate(row, item):
+            self._parked = (row, item)   # wait for pages (FIFO: nothing
+            return                       # else admits while parked)
         self._admitting = {"row": row, "item": item, "offset": 0,
                            "sizes": self._prefill_chunk_sizes(len(prompt))}
         self._continue_admission()
@@ -491,6 +591,7 @@ class ContinuousBatcher:
         row, off = adm["row"], adm["offset"]
         if h.cancelled.is_set():
             self._admitting = None
+            self._free_row(row)     # mid-admission cancel: release pages
             h._finish(list(prompt))
             return
         size = adm["sizes"][adm.get("i", 0)]
@@ -514,6 +615,7 @@ class ContinuousBatcher:
         h.tokens.put(tok)
         seq = prompt + [tok]
         if max_new <= 1 or (eos_id is not None and tok == eos_id):
+            self._free_row(row)
             h._finish(seq)
             self.requests += 1
             return
@@ -533,6 +635,20 @@ class ContinuousBatcher:
         if self._admitting is not None:
             self._continue_admission()
             return
+        if self._parked is not None:
+            # a pool-starved admission waits at the head of the line;
+            # retirement may have freed its pages by now
+            row, item = self._parked
+            self._parked = None
+            if self._slots[row] is not None:   # row was never occupied,
+                row = next((r for r in range(self.n_slots)   # but be safe
+                            if self._slots[r] is None), None)
+                if row is None:
+                    self._parked = (0, item)
+                    return
+            self._start_admission(row, item)
+            if self._admitting is not None or self._parked is not None:
+                return
         for row in range(self.n_slots):
             if self._slots[row] is not None:
                 continue
@@ -541,7 +657,7 @@ class ContinuousBatcher:
             except queue_mod.Empty:
                 return
             self._start_admission(row, item)
-            if self._admitting is not None:
+            if self._admitting is not None or self._parked is not None:
                 return    # chunked admission in progress: one at a time
             block = False    # only the first admit may block (idle wake)
 
@@ -565,7 +681,7 @@ class ContinuousBatcher:
                     # client gone: stop burning device time on this slot
                     s["handle"]._finish(s["seq"])
                     self.requests += 1
-                    self._slots[r] = None
+                    self._free_row(r)
                     continue
                 if counts is None:
                     toks = [int(row_toks[r])]
@@ -580,8 +696,9 @@ class ContinuousBatcher:
                                                and tok == s["eos"]):
                         s["handle"]._finish(s["seq"])
                         self.requests += 1
-                        self._slots[r] = None   # row frees; in-flight
-                        # steps decode garbage that _gen filters out
+                        self._free_row(r)   # row (and its pool pages)
+                        # free; in-flight steps decode garbage that the
+                        # _gen filter drops
                         break
 
     def _dispatch(self):
@@ -635,6 +752,7 @@ class ContinuousBatcher:
             while not self._stop.is_set():
                 idle = (all(s is None for s in self._slots)
                         and self._admitting is None
+                        and self._parked is None
                         and not reads and inflight is None)
                 self._admit(block=idle)
                 active = any(s is not None for s in self._slots)
@@ -674,6 +792,9 @@ class ContinuousBatcher:
             adm, self._admitting = self._admitting, None
             if adm is not None:
                 adm["item"][0]._fail(e)
+            parked, self._parked = self._parked, None
+            if parked is not None:
+                parked[1][0]._fail(e)
             for s in self._slots:
                 if s is not None:
                     s["handle"]._fail(e)
@@ -724,7 +845,8 @@ class GenerateService:
 
     def __init__(self, export_dir, max_new_tokens_limit=512,
                  draft_export_dir=None, draft_k=4, slots=8, read_chunk=8,
-                 prefill_chunk=512, request_timeout_s=None):
+                 prefill_chunk=512, request_timeout_s=None,
+                 kv_page_size=0, kv_pages=0):
         import itertools
 
         self.model, self.params = self._load_lm(export_dir)
@@ -738,7 +860,7 @@ class GenerateService:
             self.model, self.params, n_slots=slots or 8,
             read_chunk=read_chunk, prefill_chunk=prefill_chunk,
             draft_model=draft_model, draft_params=draft_params,
-            draft_k=draft_k)
+            draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages)
         self.limit = max_new_tokens_limit
         # bound on a single request's wall time: decoding its own tokens
         # plus waiting behind a full house of equally-long requests, with
@@ -948,6 +1070,10 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
         raise ValueError("--generate_slots must be >= 1: slots are the "
                          ":generate decode engine (round 5 unified the "
                          "grouped path onto them)")
+    if getattr(args, "generate_kv_page_size", 0) and \
+            getattr(args, "generate_kv_pages", 0) < 1:
+        raise ValueError("--generate_kv_page_size needs "
+                         "--generate_kv_pages >= 1 (the shared pool size)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
 

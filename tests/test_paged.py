@@ -1,0 +1,205 @@
+"""Paged kv cache for serving slots (vLLM-style, round-5 stretch).
+
+kv lives in a shared pool of fixed-size pages; each slot row maps
+logical blocks to pool pages via a per-row page table, so rows consume
+pool memory proportional to their ACTUAL need instead of reserving
+max_seq_len each.  Criteria (round-4 verdict #10): parity with the
+dense-cache path, a free list with reuse, and a capacity gain at fixed
+HBM.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, temperature=0.0, seed=0):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None))
+    return np.asarray(out)[0].tolist()
+
+
+def test_paged_primitives_match_solo(model_and_params):
+    # manual page allocation at the decode-primitive level: paged slot
+    # decoding is token-identical to solo generate, with a pool SMALLER
+    # than the dense per-row reservation
+    model, params = model_and_params
+    P, NP, n_slots = 8, 6, 2            # dense would need 2 * 32/8 = 8
+    pm, cache = decode.init_paged_slot_cache(model, n_slots, P, NP)
+    pre = decode._jitted_slot_prefill(pm)
+    step = decode._jitted_slot_step(pm)
+    set_table = decode._jitted_set_row_page_table(pm)
+    max_pages = model.cfg.max_seq_len // P
+
+    prompts = [[1, 2, 3, 4], [9, 8, 7]]
+    n_new = 6
+    sink = NP - 1          # caller contract: tails alias a reserved sink
+    free = list(range(NP - 1))
+    firsts = []
+    for row, p in enumerate(prompts):
+        need = -(-(len(p) + n_new) // P)
+        pages = [free.pop() for _ in range(need)]
+        entries = jnp.asarray(pages + [sink] * (max_pages - len(pages)),
+                              jnp.int32)
+        cache = set_table(cache, jnp.asarray(row, jnp.int32), entries)
+        padded = p + [0] * (8 - len(p))
+        logits, cache = pre(params, cache,
+                            jnp.asarray([padded], jnp.int32),
+                            jnp.asarray(row, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(len(p), jnp.int32))
+        firsts.append(int(jnp.argmax(logits[0])))
+    seqs = [[t] for t in firsts]
+    zeros = np.zeros(n_slots, np.int32)
+    for t in range(n_new - 1):
+        toks = np.asarray([seqs[0][-1], seqs[1][-1]], np.int32)
+        nxt, cache, _ = step(params, cache, jnp.asarray(toks),
+                             jnp.zeros(n_slots, jnp.float32),
+                             jnp.asarray(zeros),
+                             jnp.full(n_slots, t + 1, jnp.int32))
+        nxt = np.asarray(nxt)
+        seqs[0].append(int(nxt[0]))
+        seqs[1].append(int(nxt[1]))
+    for p, seq in zip(prompts, seqs):
+        assert p + seq == _solo(model, params, p, n_new)
+
+
+def test_paged_batcher_matches_dense_and_reuses_pages(model_and_params):
+    model, params = model_and_params
+    # pool = HALF the dense-equivalent reservation (4 slots x 4 pages)
+    batcher = serve.ContinuousBatcher(model, params, n_slots=4,
+                                      read_chunk=2, kv_page_size=8,
+                                      kv_pages=8)
+    try:
+        prompts = [[1, 2, 3], [7, 7], [5, 4, 3, 2], [9, 1]]
+        outs = [batcher.submit(p, 5).result(timeout=120) for p in prompts]
+        for p, got in zip(prompts, outs):
+            assert got == _solo(model, params, p, 5)
+        # sampled requests too (shared fold_in schedule)
+        got = batcher.submit([4, 5, 6], 4, temperature=0.9,
+                             seed=13).result(timeout=120)
+        assert got == _solo(model, params, [4, 5, 6], 4, temperature=0.9,
+                            seed=13)
+        # every page returned to the free list after retirement
+        assert sorted(batcher._free_pages) == list(range(8))
+        assert all(rp is None for rp in batcher._row_pages)
+        # and pages get REUSED: run more total requests than the pool
+        # could ever hold at once
+        for i in range(6):
+            out = batcher.submit([i + 1, i + 2], 4).result(timeout=120)
+            assert out == _solo(model, params, [i + 1, i + 2], 4)
+        assert sorted(batcher._free_pages) == list(range(8))
+    finally:
+        batcher.stop()
+
+
+def test_paged_pool_backpressure(model_and_params):
+    # pool holds exactly ONE in-flight request's pages: concurrent
+    # submissions serialize through the free list instead of failing
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=3,
+                                      read_chunk=1, kv_page_size=8,
+                                      kv_pages=2)   # 16 tokens of pool
+    try:
+        prompts = [[1, 2, 3], [9, 8], [4, 4, 4]]
+        handles = [batcher.submit(p, 8) for p in prompts]   # need 2 pages
+        outs = [h.result(timeout=180) for h in handles]
+        for p, got in zip(prompts, outs):
+            assert got == _solo(model, params, p, 8)
+        assert sorted(batcher._free_pages) == [0, 1]
+    finally:
+        batcher.stop()
+
+
+def test_paged_capacity_exceeds_dense_limit(model_and_params):
+    # the capacity claim, stated in bytes: slots * max_seq of dense cache
+    # vs the pool the SAME workload actually needs.  8 slots of
+    # max_seq=32 dense-reserve 256 token-slots; these short requests
+    # live within a 12-page (96-token) pool — 2.7x less resident kv.
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=8,
+                                      read_chunk=2, kv_page_size=8,
+                                      kv_pages=12)
+    try:
+        prompts = [[i + 1, i + 2, i + 3] for i in range(8)]
+        handles = [batcher.submit(p, 5) for p in prompts]
+        outs = [h.result(timeout=180) for h in handles]
+        for p, got in zip(prompts, outs):
+            assert got == _solo(model, params, p, 5)
+        dense_tokens = 8 * model.cfg.max_seq_len
+        pool_tokens = 12 * 8
+        assert pool_tokens * 2 < dense_tokens   # >2x capacity at fixed HBM
+    finally:
+        batcher.stop()
+
+
+def test_paged_with_draft_speculation(model_and_params):
+    # speculation composes with paging: allocation includes draft_k
+    # headroom for the verify overshoot; tokens stay the target's greedy
+    model, params = model_and_params
+    draft_cfg = TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_kv_heads=1, n_layers=1, d_ff=32,
+                                  max_seq_len=32, dtype="float32",
+                                  attention_impl="dense")
+    draft = Transformer(draft_cfg)
+    d_params = draft.init(jax.random.key(9),
+                          jnp.zeros((1, 4), jnp.int32))["params"]
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=2, kv_page_size=8,
+                                      kv_pages=8, draft_model=draft,
+                                      draft_params=d_params, draft_k=3)
+    try:
+        prompts = [[1, 2, 3], [9, 8, 7, 6]]
+        handles = [batcher.submit(p, 6) for p in prompts]
+        outs = [h.result(timeout=180) for h in handles]
+        for p, got in zip(prompts, outs):
+            assert got == _solo(model, params, p, 6)
+        assert batcher._spec_rounds > 0
+        assert sorted(batcher._free_pages) == list(range(8))
+    finally:
+        batcher.stop()
+
+
+def test_paged_config_validation(model_and_params):
+    cfg = TransformerConfig(vocab_size=16, d_model=8, n_heads=2,
+                            n_kv_heads=1, n_layers=1, d_ff=16,
+                            max_seq_len=12, dtype="float32",
+                            attention_impl="dense")
+    with pytest.raises(ValueError, match="multiple of"):
+        decode.init_paged_slot_cache(cfg, 2, 8, 4)   # 12 % 8 != 0
+    model, params = model_and_params
+    # page size without a pool is a constructor error, not a hang
+    with pytest.raises(ValueError, match="kv_pages"):
+        serve.ContinuousBatcher(model, params, n_slots=2, kv_page_size=8)
+    # a request no pool state could ever satisfy fails at submit, not by
+    # parking forever at the head of the admission line
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      kv_page_size=8, kv_pages=2)
+    try:
+        with pytest.raises(ValueError, match="kv pages"):
+            batcher.submit([1] * 10, 10)    # needs 3 pages, pool has 2
+    finally:
+        batcher.stop()
