@@ -55,13 +55,17 @@ SLOW_FILES = {
     "test_examples.py",         # >10 min — example subprocesses
     "test_hybrid_mesh.py",      # 11 s — multi-slice mesh compiles
     "test_lora.py",             # 25 s
-    "test_optim8bit.py",        # 14 s
+    "test_optim8bit.py",        # 14 s (round 5 grew it: layout parity)
+    "test_paged.py",            # 40 s — paged-kv batcher compiles
     "test_metrics_vit.py",      # 82 s
     "test_minispark.py",        # 60 s — spawn-started executor pools
     "test_models.py",           # 88 s
     "test_ops.py",              # 47 s — pallas kernels (interpret mode)
     "test_pipeline.py",         # 45 s
     "test_pipelined_lm.py",     # 25 s
+    "test_quantize.py",         # 9 s — non-core (serving-width weights);
+    # moved round 5 to keep the fast tier under its 90 s budget as the
+    # round's layout/sampling tests accreted onto fast files
     "test_ring_attention.py",   # 31 s
     "test_serve.py",            # 68 s — HTTP servers + decode compiles
     "test_slots.py",            # 31 s — slot-decode parity compiles
