@@ -293,6 +293,7 @@ class ModelService:
                                         else "unavailable")
             if self._gen and self._gen.batcher is not None:
                 out["model"]["generate_slots"] = self._gen.batcher.n_slots
+                out["model"]["generate_stats"] = self._gen.batcher.stats()
         return out
 
 
@@ -446,6 +447,26 @@ class ContinuousBatcher:
         self._thread = threading.Thread(target=self._loop,
                                         name="slot-batcher", daemon=True)
         self._thread.start()
+
+    def stats(self):
+        """Operational snapshot for the metadata endpoint: occupancy,
+        queue depth, dispatch counters, and (paged mode) pool state.
+        Read without locks — values are monotone counters and small
+        lists whose momentary skew is fine for monitoring."""
+        out = {
+            "slots_busy": sum(s is not None for s in self._slots),
+            "pending": self._pending.qsize(),
+            "admitting": self._admitting is not None,
+            "requests_served": self.requests,
+            "decode_steps": self._steps,
+            "spec_rounds": self._spec_rounds,
+        }
+        if self.kv_page_size:
+            out["kv_pages_free"] = len(self._free_pages)
+            out["kv_pages_total"] = self._total_pages
+            out["kv_page_size"] = self.kv_page_size
+            out["admission_waiting_for_pages"] = self._parked is not None
+        return out
 
     def stop(self, timeout=30):
         """Shut the driver loop down cleanly (benches/tests teardown): the

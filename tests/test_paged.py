@@ -183,6 +183,22 @@ def test_paged_with_draft_speculation(model_and_params):
         batcher.stop()
 
 
+def test_batcher_stats_snapshot(model_and_params):
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      kv_page_size=8, kv_pages=4)
+    try:
+        batcher.submit([1, 2, 3], 4).result(timeout=120)
+        s = batcher.stats()
+        assert s["requests_served"] == 1
+        assert s["slots_busy"] == 0
+        assert s["kv_pages_total"] == 4
+        assert s["kv_pages_free"] == 4      # returned after retirement
+        assert s["decode_steps"] > 0
+    finally:
+        batcher.stop()
+
+
 def test_paged_config_validation(model_and_params):
     cfg = TransformerConfig(vocab_size=16, d_model=8, n_heads=2,
                             n_kv_heads=1, n_layers=1, d_ff=16,
