@@ -394,6 +394,14 @@ class ContinuousBatcher:
                 self.slot_model)
             self._free_pages = list(range(int(kv_pages)))
             self._row_pages = [None] * n_slots
+            # prefix cache state (see the prefix-cache section below)
+            self._prefix = {}        # cumulative-prefix key -> pool page
+            self._prefix_lru = {}    # key -> lru tick
+            self._page_rc = {}       # page -> live-row refcount (managed)
+            self._lru_tick = 0
+            self._row_shared_n = [0] * n_slots
+            self._row_prefix_keys = [None] * n_slots
+            self.prefill_tokens_shared = 0
             max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
             self._sink_entries = jnp.full((max_pages,), self._sink,
                                           jnp.int32)
@@ -466,6 +474,8 @@ class ContinuousBatcher:
             out["kv_pages_total"] = self._total_pages
             out["kv_page_size"] = self.kv_page_size
             out["admission_waiting_for_pages"] = self._parked is not None
+            out["prefix_pages_cached"] = len(self._prefix)
+            out["prefill_tokens_shared"] = self.prefill_tokens_shared
         return out
 
     def stop(self, timeout=30):
@@ -554,16 +564,95 @@ class ContinuousBatcher:
         headroom = self.draft_k if self.draft_model is not None else 0
         return -(-(prompt_len + max_new + headroom) // self.kv_page_size)
 
+    # ---- prefix cache (paged mode) --------------------------------------
+    # Page-granular KV reuse: a full prompt page whose CUMULATIVE token
+    # prefix was already computed by an earlier request maps to the same
+    # pool page read-only (causal attention + absolute rope make prefix
+    # kv a pure function of the prefix tokens, so reuse is exact).  A
+    # row's prefill then starts AFTER its shared pages — a repeated
+    # prompt admits with ~zero prefill compute.  Shared pages are
+    # refcounted; at rc==0 they stay cached (evicted LRU only under pool
+    # pressure).  At most len(prompt)-1 tokens can be shared: the last
+    # prompt token must run through prefill to produce the first-token
+    # logits.
+
+    def _prefix_keys(self, prompt, upto_tokens):
+        """Rolling cumulative-prefix keys for each FULL page up to
+        `upto_tokens` (exclusive page count bound).  Keys are NESTED
+        TUPLES (prev_key, page_tokens) — structural equality makes the
+        cache lookup EXACT (hash() alone would let two colliding
+        prefixes serve each other's kv: silent wrong output and
+        cross-request content leakage); structure sharing keeps each
+        key O(1) extra memory."""
+        P = self.kv_page_size
+        keys, k = [], ()
+        n_full = upto_tokens // P
+        for i in range(n_full):
+            k = (k, tuple(prompt[i * P:(i + 1) * P]))
+            keys.append(k)
+        return keys
+
+    def _prefix_lookup(self, prompt):
+        """(shared_pages, keys_for_all_full_pages): the longest cached
+        run of full prompt pages, capped at len(prompt)-1 tokens."""
+        keys = self._prefix_keys(prompt, len(prompt) - 1)
+        shared = []
+        for key in keys:
+            page = self._prefix.get(key)
+            if page is None:
+                break
+            shared.append(page)
+            self._lru_tick += 1
+            self._prefix_lru[key] = self._lru_tick
+        return shared, keys
+
+    def _evict_cached_pages(self, want):
+        """Free up to `want` pages by evicting rc==0 cached prefix pages,
+        least recently used first.  Returns number freed."""
+        evictable = sorted(
+            (k for k, p in self._prefix.items()
+             if self._page_rc.get(p, 0) == 0),
+            key=lambda k: self._prefix_lru.get(k, 0))
+        freed = 0
+        for key in evictable:
+            if freed >= want:
+                break
+            page = self._prefix.pop(key)
+            self._prefix_lru.pop(key, None)
+            self._page_rc.pop(page, None)
+            self._free_pages.append(page)
+            freed += 1
+        return freed
+
     def _try_allocate(self, row, item):
-        """Reserve `item`'s whole projected page need for `row`; False =
-        pool exhausted (caller parks the item until pages free)."""
+        """Reserve `item`'s page need for `row` — reusing cached prefix
+        pages where the prompt matches — or False when the pool (after
+        LRU eviction of unreferenced cached pages) cannot cover the
+        rest; the caller parks the item until pages free."""
         import jax.numpy as jnp
 
-        need = self._pages_needed(len(item[1]), item[2])
-        if len(self._free_pages) < need:
+        prompt, max_new = item[1], item[2]
+        need = self._pages_needed(len(prompt), max_new)
+        shared, keys = self._prefix_lookup(prompt)
+        # hold refs BEFORE any eviction: rc==0 shared pages would
+        # otherwise be evictable by our own eviction pass, get re-popped
+        # as "fresh", and end up mapped twice in this row's table
+        # (corrupted kv + a permanently leaked page via negative rc)
+        for page in shared:
+            self._page_rc[page] = self._page_rc.get(page, 0) + 1
+        fresh_need = need - len(shared)
+        if len(self._free_pages) < fresh_need:
+            self._evict_cached_pages(fresh_need - len(self._free_pages))
+        if len(self._free_pages) < fresh_need:
+            for page in shared:                  # roll back before parking
+                self._page_rc[page] -= 1
             return False
-        pages = [self._free_pages.pop() for _ in range(need)]
+        fresh = [self._free_pages.pop() for _ in range(fresh_need)]
+        pages = shared + fresh
         self._row_pages[row] = pages
+        self._row_shared_n[row] = len(shared)
+        self._row_prefix_keys[row] = keys        # for post-prefill registration
+        self.prefill_tokens_shared += len(shared) * self.kv_page_size
         max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
         # unallocated tail entries alias the SINK (never page 0 — that
         # may belong to someone)
@@ -573,17 +662,45 @@ class ContinuousBatcher:
                                       jnp.asarray(row, jnp.int32), entries)
         return True
 
+    def _register_prefix_pages(self, row):
+        """After `row`'s prefill completed, publish its freshly computed
+        full-prefix pages into the cache so later identical prompts can
+        share them.  Invariant: a page is prefix-managed iff it is in
+        ``_page_rc``; the count is the number of LIVE rows using it (the
+        cache may hold rc==0 pages until eviction).  A concurrent twin
+        that lost the registration race keeps its copy exclusively owned
+        (freed normally at retirement)."""
+        keys = self._row_prefix_keys[row] or []
+        pages = self._row_pages[row] or []
+        for i, key in enumerate(keys):
+            if i >= len(pages):
+                break
+            if i < self._row_shared_n[row]:
+                continue                 # already managed + held by us
+            if key not in self._prefix:
+                self._prefix[key] = pages[i]
+                self._lru_tick += 1
+                self._prefix_lru[key] = self._lru_tick
+                self._page_rc[pages[i]] = 1   # this row's live reference
+
     def _free_row(self, row):
-        """Retire `row`: return its pool pages to the free list and point
-        its table at the sink page, so the row's post-retirement garbage
-        decode can never write into pages a later owner holds (paged
-        mode; no-op otherwise).  Call wherever a slot empties."""
+        """Retire `row`: release prefix-cached pages (rc--; they STAY
+        cached at rc==0 for future reuse), return exclusively-owned
+        pages to the free list, and point the row's table at the sink
+        page so post-retirement garbage decode can never write into
+        pages a later owner holds (paged mode; no-op otherwise)."""
         import jax.numpy as jnp
 
         self._slots[row] = None
         if self.kv_page_size and self._row_pages[row] is not None:
-            self._free_pages.extend(self._row_pages[row])
+            for page in self._row_pages[row]:
+                if page in self._page_rc:
+                    self._page_rc[page] -= 1     # cached: stays in pool
+                else:
+                    self._free_pages.append(page)
             self._row_pages[row] = None
+            self._row_shared_n[row] = 0
+            self._row_prefix_keys[row] = None
             self._cache = self._set_table(
                 self._cache, jnp.asarray(row, jnp.int32),
                 self._sink_entries)
@@ -596,8 +713,34 @@ class ContinuousBatcher:
         if self.kv_page_size and not self._try_allocate(row, item):
             self._parked = (row, item)   # wait for pages (FIFO: nothing
             return                       # else admits while parked)
-        self._admitting = {"row": row, "item": item, "offset": 0,
-                           "sizes": self._prefill_chunk_sizes(len(prompt))}
+        # prefix-shared pages already hold their kv: the TARGET prefill
+        # starts after them (a fully cached prompt prefills only its
+        # last page)
+        shared_tokens = (self._row_shared_n[row] * self.kv_page_size
+                         if self.kv_page_size else 0)
+        if shared_tokens and self.draft_model is not None:
+            # the DRAFT's dense per-row cache shares nothing: it must
+            # see the whole prompt or speculation proposes from garbage
+            # context and acceptance collapses.  Its prefill is the
+            # cheap half, run inline over the shared region here.
+            import jax.numpy as jnp
+
+            off = 0
+            for size in self._prefill_chunk_sizes(shared_tokens):
+                chunk = prompt[off:off + size]
+                bucket = min(max(8, 1 << (len(chunk) - 1).bit_length()),
+                             self.prefill_chunk)
+                padded = chunk + [0] * (bucket - len(chunk))
+                _, self._d_cache = self._d_prefill(
+                    self.draft_params, self._d_cache,
+                    jnp.asarray([padded], jnp.int32),
+                    jnp.asarray(row, jnp.int32),
+                    jnp.asarray(off, jnp.int32),
+                    jnp.asarray(len(chunk), jnp.int32))
+                off += size
+        self._admitting = {
+            "row": row, "item": item, "offset": shared_tokens,
+            "sizes": self._prefill_chunk_sizes(len(prompt) - shared_tokens)}
         self._continue_admission()
 
     def _continue_admission(self):
@@ -632,6 +775,10 @@ class ContinuousBatcher:
         if adm["offset"] < len(prompt):
             return                       # more chunks to go
         self._admitting = None
+        if self.kv_page_size:
+            # this row's full-prefix pages now hold computed kv: publish
+            # them so later identical prompts skip their prefill
+            self._register_prefix_pages(row)
         tok = self._pick_first(logits[0], temp, seed)
         h.tokens.put(tok)
         seq = prompt + [tok]
@@ -699,10 +846,12 @@ class ContinuousBatcher:
                 if s is None or self._gen[r] != gens[r]:
                     continue      # freed or re-occupied since dispatch
                 if s["handle"].cancelled.is_set():
-                    # client gone: stop burning device time on this slot
+                    # client gone: stop burning device time on this slot.
+                    # retire BEFORE finishing the handle: a waiter woken
+                    # by result() must observe consistent pool accounting
+                    self._free_row(r)
                     s["handle"]._finish(s["seq"])
                     self.requests += 1
-                    self._free_row(r)
                     continue
                 if counts is None:
                     toks = [int(row_toks[r])]
@@ -715,11 +864,13 @@ class ContinuousBatcher:
                     s["handle"].tokens.put(tok)
                     if s["remaining"] <= 0 or (s["eos"] is not None
                                                and tok == s["eos"]):
+                        # retire BEFORE finishing: a waiter woken by
+                        # result() must observe consistent pool
+                        # accounting; in-flight steps decode garbage
+                        # that the _gen filter drops
+                        self._free_row(r)
                         s["handle"]._finish(s["seq"])
                         self.requests += 1
-                        self._free_row(r)   # row (and its pool pages)
-                        # free; in-flight steps decode garbage that the
-                        # _gen filter drops
                         break
 
     def _dispatch(self):

@@ -183,6 +183,100 @@ def test_paged_with_draft_speculation(model_and_params):
         batcher.stop()
 
 
+def test_prefix_cache_skips_repeated_prompt_prefill(model_and_params):
+    # page-granular prefix caching: a repeated prompt reuses the cached
+    # kv pages and skips their prefill; outputs stay exact
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=2, kv_page_size=8,
+                                      kv_pages=8)
+    try:
+        prompt = list(range(1, 19))          # 18 tokens = 2 full pages + 2
+        want = _solo(model, params, prompt, 5)
+        first = batcher.submit(prompt, 5).result(timeout=120)
+        assert first == want
+        assert batcher.stats()["prefix_pages_cached"] == 2
+        shared_before = batcher.prefill_tokens_shared
+        second = batcher.submit(prompt, 5).result(timeout=120)
+        assert second == want                # exact reuse
+        assert batcher.prefill_tokens_shared == shared_before + 16
+        # a prompt sharing only the FIRST page diverges correctly
+        forked = prompt[:8] + [33, 34, 35, 36, 37, 38, 39, 40, 41]
+        got = batcher.submit(forked, 5).result(timeout=120)
+        assert got == _solo(model, params, forked, 5)
+        # pages referenced by the cache stay out of the free list but the
+        # pool never leaks: free + cached-rc0 + sink accounts for all
+        s = batcher.stats()
+        assert s["kv_pages_free"] + s["prefix_pages_cached"] == 8
+    finally:
+        batcher.stop()
+
+
+def test_prefix_cache_eviction_under_pressure(model_and_params):
+    # rc==0 cached pages are evicted LRU when the free list runs dry —
+    # new requests keep working and stay correct
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=2, kv_page_size=8,
+                                      kv_pages=4)                # tiny pool
+    try:
+        for base in (1, 7, 13, 19, 25):      # distinct 10-token prompts
+            p = [base + i for i in range(10)]
+            got = batcher.submit(p, 4).result(timeout=120)
+            assert got == _solo(model, params, p, 4)
+        s = batcher.stats()
+        assert s["kv_pages_free"] + s["prefix_pages_cached"] == 4
+    finally:
+        batcher.stop()
+
+
+def test_prefix_cache_concurrent_share_survives_retirement(model_and_params):
+    # two rows share prefix pages; the first retires while the second
+    # still decodes — refcounting must keep the pages alive
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=1, kv_page_size=8,
+                                      kv_pages=8)
+    try:
+        prompt = list(range(2, 20))          # 18 tokens, 2 full pages
+        batcher.submit(prompt, 2).result(timeout=120)   # seed the cache
+        h_long = batcher.submit(prompt, 10)  # shares pages, decodes long
+        h_short = batcher.submit(prompt, 1)  # shares pages, retires fast
+        assert h_short.result(timeout=120) == _solo(model, params, prompt, 1)
+        assert h_long.result(timeout=180) == _solo(model, params, prompt, 10)
+    finally:
+        batcher.stop()
+
+
+def test_prefix_shared_pages_not_self_evicted_under_pressure(
+        model_and_params):
+    # review regression: an admission whose own shared prefix pages are
+    # the only rc==0 evictables must NOT evict them to satisfy its fresh
+    # need (that would map the same physical page twice in its table:
+    # corrupted kv + a leaked page).  With refs taken before eviction it
+    # parks instead, resumes when the live request retires, and stays
+    # exact — and the pool accounting balances afterwards.
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=1, kv_page_size=8,
+                                      kv_pages=6)
+    try:
+        prompt_a = list(range(1, 18))        # 17 tokens = 2 full pages
+        want_a2 = _solo(model, params, prompt_a, 13)
+        batcher.submit(prompt_a, 2).result(timeout=120)   # seed cache
+        h_live = batcher.submit([9, 9, 9], 20)   # holds 3 pages, decodes
+        h_rep = batcher.submit(prompt_a, 13)     # needs 4 total: 2 shared
+        # + 2 fresh, free=1 -> must park (its own cached pages are the
+        # only rc==0 candidates) until h_live retires
+        assert h_live.result(timeout=180) == _solo(model, params,
+                                                   [9, 9, 9], 20)
+        assert h_rep.result(timeout=180) == want_a2
+        s = batcher.stats()
+        assert s["kv_pages_free"] + s["prefix_pages_cached"] == 6, s
+    finally:
+        batcher.stop()
+
+
 def test_batcher_stats_snapshot(model_and_params):
     model, params = model_and_params
     batcher = serve.ContinuousBatcher(model, params, n_slots=2,
