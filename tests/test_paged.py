@@ -7,7 +7,6 @@ max_seq_len each.  Criteria (round-4 verdict #10): parity with the
 dense-cache path, a free list with reuse, and a capacity gain at fixed
 HBM.
 """
-import threading
 
 import numpy as np
 import pytest
