@@ -13,9 +13,12 @@ Stdlib-only (http.server), TF-Serving-compatible request shape:
 
 Engine selection mirrors the batch CLI: the AOT artifact (native PJRT
 runner where available) when the export carries one, else the rebuilt
-jitted model.  Requests batch within themselves; the device is guarded by
-a lock so concurrent requests serialize instead of interleaving
-executions.
+jitted model.  :predict requests batch within themselves (a lock
+serializes device executions; ``--batch_wait_ms`` coalesces concurrent
+requests instead).  :generate requests all run through the
+continuous-batching slot engine (GenerateService/ContinuousBatcher):
+concurrent generations share the in-flight batch at token boundaries —
+no request-level serialization.
 """
 import argparse
 from typing import Any
