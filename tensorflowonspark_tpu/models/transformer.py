@@ -443,8 +443,14 @@ def _flash_dispatch(q, k, v, cfg):
         return axis in axes and dim % mesh.shape[axis] == 0
 
     # tp must divide BOTH head dims (the kernel takes narrow GQA k/v;
-    # shard_map splits q and kv heads by the same axis)
+    # shard_map splits q and kv heads by the same axis).  When tp divides
+    # the q heads but not the narrow kv heads (tp > n_kv), repeat kv to
+    # full width first — the round-4 layout — so flash still runs
+    # instead of silently dropping to dense O(S^2) attention.
     dp = "dp" if _divides("dp", q.shape[0]) else None
+    if (_divides("tp", q.shape[2]) and not _divides("tp", k.shape[2])
+            and "tp" in axes and mesh.shape["tp"] > 1):
+        k, v = _kv_repeat(q, k, v)
     tp = ("tp" if _divides("tp", q.shape[2]) and _divides("tp", k.shape[2])
           else None)
     # dense fallback when a >1-sized mesh axis can't shard its dim: a

@@ -220,6 +220,7 @@ class ModelService:
         self.export_dir = args.export_dir
         self.model_name = getattr(args, "model_name", "default")
         self.requests = 0
+        self._gen_error = None          # why :generate is unavailable
         self._gen = None                # lazy GenerateService (or False =
         self._gen_lock = threading.Lock()   # probed and not a decoder LM)
         self._max_new_limit = getattr(args, "max_new_tokens_limit", 512)
@@ -268,9 +269,19 @@ class ModelService:
                         request_timeout_s=self._gen_timeout_s,
                         kv_page_size=self._gen_kv_page_size,
                         kv_pages=self._gen_kv_pages)
-                except (TypeError, ValueError) as e:
+                except TypeError as e:
+                    # genuinely not a decoder LM: the documented 404
                     logger.info(":generate unavailable: %s", e)
                     self._gen = False
+                    self._gen_error = str(e)
+                except ValueError as e:
+                    # a CONFIG error (page size vs max_seq_len, draft
+                    # vocab mismatch, ...) must not masquerade as "not a
+                    # decoder LM": log loudly and carry the reason into
+                    # the endpoint's error body
+                    logger.error(":generate misconfigured: %s", e)
+                    self._gen = False
+                    self._gen_error = str(e)
             return self._gen or None
 
     def close(self):
@@ -436,8 +447,11 @@ class ContinuousBatcher:
         self.n_slots = n_slots
         self.max_seq = self.slot_model.cfg.max_seq_len
         if draft_model is not None:
-            self.max_seq = min(self.max_seq,
-                               draft_model.cfg.max_seq_len) - draft_k
+            # both caches hold the sequence; only GREEDY requests need
+            # the extra draft_k verify-overshoot headroom (speculation
+            # never engages while a sampled row is active, and sampled
+            # rows never speculate) — per-request in submit()
+            self.max_seq = min(self.max_seq, draft_model.cfg.max_seq_len)
         self.read_chunk = max(1, read_chunk)
         self.prefill_chunk = max(8, prefill_chunk)
         self._pending = queue_mod.Queue(max_pending)
@@ -504,12 +518,20 @@ class ContinuousBatcher:
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0):
         if self._dead is not None:
             raise RuntimeError(f"batcher died: {self._dead}")
-        if len(prompt) + max_new > self.max_seq:
+        # greedy requests on a draft-equipped server need draft_k cache
+        # headroom for the speculative verify overshoot; sampled requests
+        # never speculate (and disable spec rounds while active), so they
+        # keep the full window
+        headroom = (self.draft_k if (self.draft_model is not None
+                                     and temperature == 0) else 0)
+        if len(prompt) + max_new + headroom > self.max_seq:
             raise ValueError(
-                f"prompt {len(prompt)} + max_new_tokens {max_new} exceeds "
-                f"max_seq_len {self.max_seq}")
+                f"prompt {len(prompt)} + max_new_tokens {max_new}"
+                + (f" + speculation headroom {headroom}" if headroom else "")
+                + f" exceeds max_seq_len {self.max_seq}")
         if self.kv_page_size:
-            need = self._pages_needed(len(prompt), max_new)
+            need = self._pages_needed(len(prompt), max_new,
+                                      temperature=temperature)
             if need > self._total_pages:
                 # a request the WHOLE pool cannot hold would park forever
                 # at the head of the line, wedging every later admission
@@ -563,8 +585,10 @@ class ContinuousBatcher:
         sizes.append(rest)
         return sizes
 
-    def _pages_needed(self, prompt_len, max_new):
-        headroom = self.draft_k if self.draft_model is not None else 0
+    def _pages_needed(self, prompt_len, max_new, temperature=0.0):
+        # verify-overshoot headroom: greedy-with-draft only (see submit)
+        headroom = (self.draft_k if (self.draft_model is not None
+                                     and temperature == 0) else 0)
         return -(-(prompt_len + max_new + headroom) // self.kv_page_size)
 
     # ---- prefix cache (paged mode) --------------------------------------
@@ -634,8 +658,8 @@ class ContinuousBatcher:
         rest; the caller parks the item until pages free."""
         import jax.numpy as jnp
 
-        prompt, max_new = item[1], item[2]
-        need = self._pages_needed(len(prompt), max_new)
+        prompt, max_new, temp = item[1], item[2], item[3]
+        need = self._pages_needed(len(prompt), max_new, temperature=temp)
         shared, keys = self._prefix_lookup(prompt)
         # hold refs BEFORE any eviction: rc==0 shared pages would
         # otherwise be evictable by our own eviction pass, get re-popped
@@ -718,32 +742,20 @@ class ContinuousBatcher:
             return                       # else admits while parked)
         # prefix-shared pages already hold their kv: the TARGET prefill
         # starts after them (a fully cached prompt prefills only its
-        # last page)
+        # last page).  The DRAFT's dense per-row cache shares nothing:
+        # it must still see positions [0, shared) or speculation
+        # proposes from garbage context — those catch-up chunks run
+        # through the SAME one-chunk-per-loop-iteration state machine
+        # (d_off below), preserving the at-most-one-chunk stall bound.
         shared_tokens = (self._row_shared_n[row] * self.kv_page_size
                          if self.kv_page_size else 0)
-        if shared_tokens and self.draft_model is not None:
-            # the DRAFT's dense per-row cache shares nothing: it must
-            # see the whole prompt or speculation proposes from garbage
-            # context and acceptance collapses.  Its prefill is the
-            # cheap half, run inline over the shared region here.
-            import jax.numpy as jnp
-
-            off = 0
-            for size in self._prefill_chunk_sizes(shared_tokens):
-                chunk = prompt[off:off + size]
-                bucket = min(max(8, 1 << (len(chunk) - 1).bit_length()),
-                             self.prefill_chunk)
-                padded = chunk + [0] * (bucket - len(chunk))
-                _, self._d_cache = self._d_prefill(
-                    self.draft_params, self._d_cache,
-                    jnp.asarray([padded], jnp.int32),
-                    jnp.asarray(row, jnp.int32),
-                    jnp.asarray(off, jnp.int32),
-                    jnp.asarray(len(chunk), jnp.int32))
-                off += size
         self._admitting = {
             "row": row, "item": item, "offset": shared_tokens,
-            "sizes": self._prefill_chunk_sizes(len(prompt) - shared_tokens)}
+            "sizes": self._prefill_chunk_sizes(len(prompt) - shared_tokens),
+            "d_off": 0, "di": 0,
+            "d_sizes": (self._prefill_chunk_sizes(shared_tokens)
+                        if shared_tokens and self.draft_model is not None
+                        else [])}
         self._continue_admission()
 
     def _continue_admission(self):
@@ -760,6 +772,24 @@ class ContinuousBatcher:
             self._admitting = None
             self._free_row(row)     # mid-admission cancel: release pages
             h._finish(list(prompt))
+            return
+        if adm["di"] < len(adm["d_sizes"]):
+            # draft catch-up over the prefix-shared region: one chunk
+            # per loop iteration, like every other admission step
+            size = adm["d_sizes"][adm["di"]]
+            d_off = adm["d_off"]
+            chunk = prompt[d_off:d_off + size]
+            bucket = min(max(8, 1 << (len(chunk) - 1).bit_length()),
+                         self.prefill_chunk)
+            padded = chunk + [0] * (bucket - len(chunk))
+            _, self._d_cache = self._d_prefill(
+                self.draft_params, self._d_cache,
+                jnp.asarray([padded], jnp.int32),
+                jnp.asarray(row, jnp.int32),
+                jnp.asarray(d_off, jnp.int32),
+                jnp.asarray(len(chunk), jnp.int32))
+            adm["d_off"] = d_off + size
+            adm["di"] += 1
             return
         size = adm["sizes"][adm.get("i", 0)]
         chunk = prompt[off:off + size]
@@ -1187,8 +1217,10 @@ class _Handler(BaseHTTPRequestHandler):
             if is_generate:
                 gen = self.service.generate_service()
                 if gen is None:
-                    self._send(404, {"error": "this export is not a "
-                                     "decoder LM; :generate unavailable"})
+                    reason = getattr(self.service, "_gen_error", None)
+                    self._send(404, {"error": ":generate unavailable: "
+                                     + (reason or "this export is not a "
+                                        "decoder LM")})
                     return
                 if req.get("stream"):
                     self._stream_events(gen.stream(req))

@@ -292,6 +292,33 @@ def test_batcher_stats_snapshot(model_and_params):
         batcher.stop()
 
 
+def test_draft_headroom_only_for_greedy(model_and_params):
+    # review regression: sampled requests never speculate, so a
+    # draft-equipped server must serve them up to the FULL window; only
+    # greedy requests reserve the verify-overshoot headroom
+    model, params = model_and_params
+    draft_cfg = TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                                  n_kv_heads=1, n_layers=1, d_ff=32,
+                                  max_seq_len=32, dtype="float32",
+                                  attention_impl="dense")
+    draft = Transformer(draft_cfg)
+    d_params = draft.init(jax.random.key(9),
+                          jnp.zeros((1, 4), jnp.int32))["params"]
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=1, draft_model=draft,
+                                      draft_params=d_params, draft_k=3)
+    try:
+        prompt = list(range(1, 27))          # 26 + 6 == max_seq_len 32
+        with pytest.raises(ValueError, match="headroom"):
+            batcher.submit(prompt, 6)        # greedy: needs 26+6+3 > 32
+        got = batcher.submit(prompt, 6, temperature=0.8,
+                             seed=5).result(timeout=120)
+        assert got == _solo(model, params, prompt, 6, temperature=0.8,
+                            seed=5)
+    finally:
+        batcher.stop()
+
+
 def test_paged_config_validation(model_and_params):
     cfg = TransformerConfig(vocab_size=16, d_model=8, n_heads=2,
                             n_kv_heads=1, n_layers=1, d_ff=16,
