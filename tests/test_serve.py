@@ -625,6 +625,54 @@ def test_slots_compose_with_draft(tmp_path):
     assert spec_rounds > 0
 
 
+def test_paged_kv_through_http(tmp_path):
+    # the CLI paging flags drive a real HTTP round trip; metadata carries
+    # the pool stats, and page-size-without-pool fails at startup
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=41, d_model=16, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=32, max_seq_len=64, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "lm"), "--port", "0",
+         "--generate_slots", "3", "--generate_kv_page_size", "8",
+         "--generate_kv_pages", "8"])
+    srv, svc = serve.make_server(args)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        code, out = _post_gen(srv, "/v1/models/default:generate",
+                              {"inputs": [[1, 2, 3]], "max_new_tokens": 5})
+        assert code == 200 and len(out["outputs"][0]) == 8
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/default") as r:
+            meta = json.loads(r.read())
+        stats = meta["model"]["generate_stats"]
+        assert stats["kv_pages_total"] == 8
+        assert stats["kv_pages_free"] + stats["prefix_pages_cached"] == 8
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    bad = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "lm"), "--port", "0",
+         "--generate_kv_page_size", "8"])
+    with pytest.raises(ValueError, match="kv_pages"):
+        serve.make_server(bad)
+
+
 def test_make_server_rejects_zero_slots():
     # slots ARE the decode engine now: a slot-less server is an error at
     # startup, not a lazy surprise
