@@ -126,7 +126,10 @@ def _ring_flash_local(q, k, v, axis_name, causal, interpret):
     def step_fn(carry, step):
         o, m, l, k_blk, v_blk = carry
         blk_idx = (my_idx - step) % axis_size
-        k_use, v_use = _kv_repeat(q, k_blk, v_blk)
+        # GQA kv stays NARROW all the way into the kernel (round 5: the
+        # flash kernel indexes kv blocks per q-head group itself) — the
+        # repeated kv no longer materializes even locally
+        k_use, v_use = k_blk, v_blk
 
         if causal:
             # 0: past block (fully visible), 1: diagonal (causal within),

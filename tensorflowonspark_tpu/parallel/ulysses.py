@@ -23,8 +23,11 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _ulysses_local(q, k, v, axis_name, causal, attn_fn):
-    """Body under shard_map: q/k/v are [B, S/N, H, D] local blocks."""
+def _ulysses_local(q, k, v, axis_name, causal, attn_fn, narrow_ok=False):
+    """Body under shard_map: q/k/v are [B, S/N, H, D] local blocks.
+    ``narrow_ok``: the attention core accepts GQA-narrow kv directly
+    (the default flash/reference cores do since round 5), so the local
+    post-all-to-all repeat is skipped; custom ``attn_fn``s keep it."""
     axis_size = lax.psum(1, axis_name)
 
     def seq_to_heads(x):
@@ -58,8 +61,12 @@ def _ulysses_local(q, k, v, axis_name, causal, attn_fn):
             k = jnp.repeat(k, pre, axis=2)
             v = jnp.repeat(v, pre, axis=2)
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
-    kg, vg = _kv_repeat(qg, kg, vg)
+    if not narrow_ok:
+        # a custom attention core may not understand GQA-narrow kv;
+        # the contiguous head split keeps group g's kv on the same
+        # device as its q heads, so the local repeat mapping is exact
+        from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
+        kg, vg = _kv_repeat(qg, kg, vg)
     out = attn_fn(qg, kg, vg, causal)
     return heads_to_seq(out)
 
@@ -84,15 +91,18 @@ def ulysses_attention(q, k, v, axis_name="tp", causal=True, mesh=None,
     shard_map with the sequence dim of [B, S, H, D] sharded over the axis
     and the batch dim over `batch_axes` (None = replicated).
     """
+    narrow_ok = attn_fn is None      # the default cores take GQA-narrow kv
     attn_fn = attn_fn or _default_attn
     if mesh is None:
-        return _ulysses_local(q, k, v, axis_name, causal, attn_fn)
+        return _ulysses_local(q, k, v, axis_name, causal, attn_fn,
+                              narrow_ok=narrow_ok)
 
     from jax.sharding import PartitionSpec as P
     from tensorflowonspark_tpu.parallel.ring_attention import _get_shard_map
     shard_map = _get_shard_map()
     spec = P(batch_axes, axis_name, None, None)
     fn = functools.partial(_ulysses_local, axis_name=axis_name,
-                           causal=causal, attn_fn=attn_fn)
+                           causal=causal, attn_fn=attn_fn,
+                           narrow_ok=narrow_ok)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)

@@ -135,3 +135,21 @@ def test_ulysses_narrow_kv_matches_repeated(qkv, n_kv):
                             mesh=mesh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_truly_narrow_kv_into_core(qkv):
+    # pre < rep: with tp=4 and n_kv=4 no pre-repeat happens (pre=1), so
+    # the attention core itself receives GQA-narrow kv after the
+    # all-to-all — the round-5 narrow_ok path is genuinely exercised
+    # (with tp=8, every n_kv<8 case fully pre-repeats and the skipped
+    # local repeat was a no-op)
+    q, k, v = qkv                      # H=8
+    kn, vn = k[:, :, :4], v[:, :, :4]
+    dense = dot_product_attention(q, jnp.repeat(kn, 2, axis=2),
+                                  jnp.repeat(vn, 2, axis=2), causal=True)
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=1, tp=4),
+                               devices=jax.devices()[:4])
+    out = ulysses_attention(q, kn, vn, axis_name="tp", causal=True,
+                            mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
