@@ -54,6 +54,9 @@ def build_argparser():
                    help=">0: paged kv cache (tokens per pool page)")
     p.add_argument("--kv_pages", type=int, default=0,
                    help="pool size (pages) for --kv_page_size")
+    p.add_argument("--quantize", choices=["none", "int8"], default="none",
+                   help="int8 = weight-only quantized serving (W8A16: "
+                        "~4x less weight HBM, inline dequant per step)")
     return p
 
 
@@ -100,6 +103,8 @@ def main(argv=None):
     if args.kv_page_size:
         serve_argv += ["--generate_kv_page_size", str(args.kv_page_size),
                        "--generate_kv_pages", str(args.kv_pages)]
+    if args.quantize != "none":
+        serve_argv += ["--generate_quantize", args.quantize]
     serve_args = serve.build_argparser().parse_args(serve_argv)
     server, service = serve.make_server(serve_args)
     host, port = server.server_address[:2]

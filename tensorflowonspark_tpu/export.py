@@ -165,7 +165,7 @@ def _restore_params(export_dir):
     return params
 
 
-def load_model(export_dir):
+def load_model(export_dir, dequantize=True):
     """Rebuild ``(built, params, spec)`` from an export dir — the raw
     builder object (flax Module or plain callable) plus deserialized
     params, WITHOUT wrapping into a signature apply fn.
@@ -173,14 +173,17 @@ def load_model(export_dir):
     This is the entry for consumers that need the module itself rather
     than a fixed forward — e.g. autoregressive generation, which re-enters
     the model once per token through its kv cache.  int8-quantized exports
-    dequantize EAGERLY here (generation touches the params every decode
-    step; per-step dequant would re-pay the conversion thousands of
-    times).
+    dequantize eagerly by default (callers that apply the module directly
+    expect float leaves); pass ``dequantize=False`` to receive the STORED
+    tree — every jitted decode entry point accepts the quantized form
+    as-is (decode._params_view dequantizes inline, fused into the matmul
+    operand read), which is how quantized serving avoids ever
+    materializing the full-width tree (serve.GenerateService._load_lm).
     """
     spec = _read_spec(export_dir)
     built = _resolve_builder(spec["builder"])(**spec["builder_kwargs"])
     params = _restore_params(export_dir)
-    if spec.get("quantized") == "int8":
+    if dequantize and spec.get("quantized") == "int8":
         from . import quantize as quantize_mod
         params = quantize_mod.dequantize_tree(
             params, dtype=spec.get("dequant_dtype"))

@@ -17,6 +17,24 @@ import jax
 import jax.numpy as jnp
 
 
+def _params_view(params):
+    """Model-ready view of `params` inside a jitted program.
+
+    Weight-only int8 leaves (``{"q", "scale"}`` dicts from
+    `quantize.quantize_tree`) dequantize HERE, under the trace — XLA fuses
+    the ``q.astype(f32) * scale`` into the consuming matmul's operand
+    read, so the full-precision kernel never materializes in HBM and each
+    decode step reads ~4x fewer weight bytes (decode is weight-bandwidth
+    bound).  Unquantized trees pass through untouched; the walk happens at
+    trace time only.  Every jitted decode entry point routes params
+    through this, so quantized trees work in solo `generate`, streaming,
+    speculative rounds, and the serving slot engine alike.
+    """
+    from tensorflowonspark_tpu.quantize import dequantize_tree
+
+    return dequantize_tree(params)
+
+
 def init_cache(model_or_cfg, batch_size):
     """Build the decode-mode model + empty cache.
 
@@ -53,7 +71,8 @@ def _jitted_step(decode_model):
     @jax.jit
     def step(params, tokens, cache):
         logits, mut = decode_model.apply(
-            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+            {"params": _params_view(params), "cache": cache}, tokens,
+            mutable=["cache"])
         return logits[:, -1], mut["cache"]
 
     return step
@@ -68,7 +87,8 @@ def _jitted_step_all(decode_model):
     @jax.jit
     def step(params, tokens, cache):
         logits, mut = decode_model.apply(
-            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+            {"params": _params_view(params), "cache": cache}, tokens,
+            mutable=["cache"])
         return logits, mut["cache"]
 
     return step
@@ -88,7 +108,7 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
     @functools.partial(jax.jit, donate_argnums=(2,))
     def body(params, tok, cache, done, rng_t, temperature, eos_id):
         logits, mut = decode_model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
+            {"params": _params_view(params), "cache": cache}, tok[:, None],
             mutable=["cache"])
         logits = logits[:, -1]
         if greedy:
@@ -216,7 +236,7 @@ def _jitted_slot_prefill(slot_model):
         row_cache = jax.tree_util.tree_map_with_path(_slice, cache)
         row_cache = _reset_row_indices(row_cache, start)
         logits, mut = slot_model.apply(
-            {"params": params, "cache": row_cache}, chunk,
+            {"params": _params_view(params), "cache": row_cache}, chunk,
             mutable=["cache"])
         new_row = _reset_row_indices(mut["cache"], start + n_valid)
 
@@ -250,7 +270,7 @@ def _jitted_slot_step(slot_model):
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step(params, cache, toks, temps, seeds, ords):
         logits, mut = slot_model.apply(
-            {"params": params, "cache": cache}, toks[:, None],
+            {"params": _params_view(params), "cache": cache}, toks[:, None],
             mutable=["cache"])
         logits = logits[:, -1]
         greedy = jnp.argmax(logits, axis=-1)
@@ -326,6 +346,8 @@ def _jitted_slot_spec_round(t_model, d_model, k):
 
     @functools.partial(jax.jit, donate_argnums=(2, 3))
     def spec_round(t_params, d_params, t_cache, d_cache, toks):
+        t_params = _params_view(t_params)
+        d_params = _params_view(d_params)
         # per-row committed length = cache_index before this round (all
         # layers agree; read one leaf)
         idx = _first_index_leaf(t_cache)

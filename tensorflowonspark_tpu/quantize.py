@@ -119,6 +119,29 @@ def dequantize_tree(qtree, dtype=None):
     return walk(qtree)
 
 
+def cast_float_leaves(tree, dtype):
+    """Cast floating leaves to `dtype`, SKIPPING quantized leaves — their
+    int8 payload is already narrow and their f32 scales must stay f32 (a
+    blanket cast would round the scales to the compute width).  The
+    serving load path uses this to store unquantized leaves (embeddings,
+    norm scales) at the model's compute width.  A tree_map with the
+    qleaf dicts as leaves, so any registered pytree container (FrozenDict,
+    custom nodes) traverses like the plain-dict case."""
+    import jax
+    import jax.numpy as jnp
+
+    target = jnp.dtype(dtype)
+
+    def cast(x):
+        if _is_qleaf(x):
+            return x
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(target)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree, is_leaf=_is_qleaf)
+
+
 def quantized_bytes(qtree):
     """(quantized_bytes, float_equivalent_bytes) over quantized leaves."""
     qb = fb = 0

@@ -150,3 +150,40 @@ def test_load_model_int8_export_generates(tmp_path):
     seq = decode.generate(built, loaded, jnp.zeros((1, 4), jnp.int32),
                           max_new_tokens=4, temperature=0.0)
     assert seq.shape == (1, 8)
+
+
+def test_load_model_dequantize_false_returns_stored_qtree(tmp_path):
+    # quantized serving takes the STORED tree (no dequant->requant round
+    # trip): dequantize=False hands back int8 leaves that the decode
+    # entry points consume directly (decode._params_view)
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import export as export_mod
+    from tensorflowonspark_tpu.models import decode
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+                  d_ff=64, max_seq_len=32, dtype="float32", rope=True,
+                  attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    out_dir = str(tmp_path / "q")
+    export_mod.export_saved_model(
+        out_dir, params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw, quantize_int8=True,
+        quantize_kwargs={"min_elements": 256})
+    built, stored, spec = export_mod.load_model(out_dir, dequantize=False)
+    assert spec.get("quantized") == "int8"
+    assert stored["lm_head"]["kernel"]["q"].dtype == jnp.int8
+    # the stored qtree decodes exactly like its materialized dequant
+    from tensorflowonspark_tpu import quantize
+    a = decode.generate(built, stored, jnp.zeros((1, 4), jnp.int32),
+                        max_new_tokens=4, loop="host")
+    b = decode.generate(built, quantize.dequantize_tree(stored),
+                        jnp.zeros((1, 4), jnp.int32),
+                        max_new_tokens=4, loop="host")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

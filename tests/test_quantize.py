@@ -95,3 +95,46 @@ def test_param_dict_named_q_scale_not_misdetected():
                                   np.ones((64, 64)))
     np.testing.assert_array_equal(np.asarray(deq["attn"]["scale"]),
                                   np.ones((64,)))
+
+
+# ------------------------------------------------- decode integration ----
+# Every jitted decode entry point routes params through
+# decode._params_view, so a quantized tree drops in anywhere a float tree
+# does.  Parity is EXACT (not approximate): the inline dequant computes
+# the identical f32 values a materialized dequantize_tree produces, so
+# the same tokens come out — these tests pin that seam.
+
+def test_quantized_generate_matches_materialized_dequant(lm):
+    from tensorflowonspark_tpu.models import decode
+
+    model, params = lm
+    qtree = quantize.quantize_tree(params, min_elements=64)
+    prompt = jnp.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], jnp.int32)
+    inline = decode.generate(model, qtree, prompt, max_new_tokens=8,
+                             loop="host")
+    materialized = decode.generate(model, quantize.dequantize_tree(qtree),
+                                   prompt, max_new_tokens=8, loop="host")
+    np.testing.assert_array_equal(np.asarray(inline),
+                                  np.asarray(materialized))
+    # and scan-loop agreement: the same program, one dispatch
+    scanned = decode.generate(model, qtree, prompt, max_new_tokens=8,
+                              loop="scan")
+    np.testing.assert_array_equal(np.asarray(inline), np.asarray(scanned))
+
+
+def test_quantized_slot_engine_matches_solo(lm):
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models import decode
+
+    model, params = lm
+    qtree = quantize.quantize_tree(params, min_elements=64)
+    solo = decode.generate(model, qtree,
+                           jnp.asarray([[1, 2, 3]], jnp.int32),
+                           max_new_tokens=6, loop="host")
+    b = serve_mod.ContinuousBatcher(model, qtree, n_slots=2,
+                                    read_chunk=1, prefill_chunk=8)
+    try:
+        got = b.submit([1, 2, 3], 6).result(timeout=300)
+    finally:
+        b.stop()
+    assert got == np.asarray(solo)[0].tolist()

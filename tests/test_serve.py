@@ -695,3 +695,103 @@ def test_slots_cancel_frees_slot(slot_server):
     # the batcher keeps serving new requests afterwards
     out = gen.batcher.submit([4, 5], 4).result(timeout=120)
     assert len(out) == 6
+
+
+def test_generate_quantized_through_http(tmp_path):
+    # --generate_quantize int8 serves through the same slot engine with
+    # weight-only int8 params; outputs match a direct quantized decode and
+    # metadata reports the weight-byte shrink.  d_model=64 so the kernels
+    # clear quantize's default min_elements=4096.
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import quantize
+    from tensorflowonspark_tpu.models import decode
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=41, d_model=64, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=64, max_seq_len=32, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "lm"), "--port", "0",
+         "--generate_slots", "2", "--generate_quantize", "int8"])
+    srv, svc = serve.make_server(args)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        code, out = _post_gen(srv, "/v1/models/default:generate",
+                              {"inputs": [[1, 2, 3]], "max_new_tokens": 5})
+        assert code == 200
+        qtree = quantize.quantize_tree(params)
+        ref = decode.generate(model, qtree,
+                              jnp.asarray([[1, 2, 3]], jnp.int32),
+                              max_new_tokens=5, temperature=0.0)
+        assert out["outputs"] == np.asarray(ref).tolist()
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/default") as r:
+            meta = json.loads(r.read())
+        qinfo = meta["model"]["generate_quantize"]
+        assert qinfo["mode"] == "int8"
+        assert qinfo["weight_bytes"] < qinfo["float_equivalent_bytes"] / 3.5
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_quantized_export_serves_without_requant(tmp_path):
+    # an artifact exported with quantize_int8=True + --generate_quantize
+    # int8 serves the STORED qtree (no dequant->requant round trip); the
+    # same artifact WITHOUT the flag serves full-width (the export's
+    # recorded dequant width)
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import export as export_mod, quantize
+    from tensorflowonspark_tpu.models import decode
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=41, d_model=32, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=32, max_seq_len=32, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export_mod.export_saved_model(
+        str(tmp_path / "qlm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw, quantize_int8=True,
+        quantize_kwargs={"min_elements": 256})
+    stored = export_mod.load_model(str(tmp_path / "qlm"),
+                                   dequantize=False)[1]
+
+    for mode, ref_params in (("int8", stored),
+                             ("none", quantize.dequantize_tree(stored))):
+        argv = ["--export_dir", str(tmp_path / "qlm"), "--port", "0",
+                "--generate_slots", "2"]
+        if mode != "none":
+            argv += ["--generate_quantize", mode]
+        srv, svc = serve.make_server(
+            serve.build_argparser().parse_args(argv))
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            code, out = _post_gen(srv, "/v1/models/default:generate",
+                                  {"inputs": [[1, 2, 3]],
+                                   "max_new_tokens": 5})
+            assert code == 200
+            ref = decode.generate(model, ref_params,
+                                  jnp.asarray([[1, 2, 3]], jnp.int32),
+                                  max_new_tokens=5, temperature=0.0)
+            assert out["outputs"] == np.asarray(ref).tolist(), mode
+        finally:
+            srv.shutdown()
+            srv.server_close()
