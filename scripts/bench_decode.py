@@ -34,6 +34,10 @@ def main():
     p.add_argument("--param_dtype", default="bfloat16",
                    help="serving weight width (bfloat16 = what serve's "
                         ":generate uses; float32 = training masters)")
+    p.add_argument("--quantize", default="none", choices=["none", "int8"],
+                   help="int8 = weight-only quantized decode (W8A16, "
+                        "inline dequant per step — serve's "
+                        "--generate_quantize int8)")
     p.add_argument("--d_model", type=int, default=2048)
     p.add_argument("--n_layers", type=int, default=16)
     p.add_argument("--n_heads", type=int, default=16)
@@ -63,11 +67,21 @@ def main():
         np.random.RandomState(0).randint(0, cfg.vocab_size,
                                          (B, args.prompt_len)), jnp.int32)
     params = model.init(jax.random.key(0), prompt)["params"]
-    if args.param_dtype != "float32":
-        pd = jnp.dtype(args.param_dtype)
-        params = jax.tree_util.tree_map(
-            lambda x: x.astype(pd)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    if args.quantize == "int8":
+        # mirror serve._load_lm: quantize from the f32 masters, then cast
+        # the unquantized remainder to the serving width
+        from tensorflowonspark_tpu import quantize as quantize_mod
+        params = quantize_mod.quantize_tree(params)
+        if args.param_dtype != "float32":
+            params = quantize_mod.cast_float_leaves(
+                params, jnp.dtype(args.param_dtype))
+        qb, fb = quantize_mod.quantized_bytes(params)
+        print(f"int8 weights: {qb / 1e6:.0f} MB quantized "
+              f"(f32-equivalent {fb / 1e6:.0f} MB)")
+    elif args.param_dtype != "float32":
+        from tensorflowonspark_tpu import quantize as quantize_mod
+        params = quantize_mod.cast_float_leaves(
+            params, jnp.dtype(args.param_dtype))
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
 
     def run():

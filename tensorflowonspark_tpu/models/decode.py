@@ -24,8 +24,9 @@ def _params_view(params):
     `quantize.quantize_tree`) dequantize HERE, under the trace — XLA fuses
     the ``q.astype(f32) * scale`` into the consuming matmul's operand
     read, so the full-precision kernel never materializes in HBM and each
-    decode step reads ~4x fewer weight bytes (decode is weight-bandwidth
-    bound).  Unquantized trees pass through untouched; the walk happens at
+    decode step reads ~2x fewer weight bytes than the W16 serving store
+    (~4x vs f32 masters; decode is weight-bandwidth bound).  Unquantized
+    trees pass through untouched; the walk happens at
     trace time only.  Every jitted decode entry point routes params
     through this, so quantized trees work in solo `generate`, streaming,
     speculative rounds, and the serving slot engine alike.
