@@ -57,6 +57,10 @@ def build_argparser():
     p.add_argument("--quantize", choices=["none", "int8"], default="none",
                    help="int8 = weight-only quantized serving (W8A16: "
                         "~4x less weight HBM, inline dequant per step)")
+    p.add_argument("--lora_rank", type=int, default=0,
+                   help=">0: multi-adapter LoRA bank on the slots; a "
+                        "demo adapter registers as 'demo' and the round "
+                        "trip generates with and without it")
     return p
 
 
@@ -105,6 +109,23 @@ def main(argv=None):
                        "--generate_kv_pages", str(args.kv_pages)]
     if args.quantize != "none":
         serve_argv += ["--generate_quantize", args.quantize]
+    if args.lora_rank:
+        # write a demo adapter next to the export and register it as
+        # 'demo': the round trip below generates with and without it
+        import jax
+
+        from tensorflowonspark_tpu import lora
+        adapters = lora.init(jax.random.key(1), params,
+                             rank=args.lora_rank)
+        for i, p in enumerate(sorted(adapters)):
+            adapters[p]["b"] = jax.random.normal(
+                jax.random.fold_in(jax.random.key(2), i),
+                adapters[p]["b"].shape)
+        lora_path = os.path.join(os.path.dirname(out_dir) or ".",
+                                 "demo_adapter.msgpack")
+        lora.save_adapters(lora_path, adapters, scale=1.0)
+        serve_argv += ["--generate_lora_rank", str(args.lora_rank),
+                       "--generate_lora", f"demo={lora_path}"]
     serve_args = serve.build_argparser().parse_args(serve_argv)
     server, service = serve.make_server(serve_args)
     host, port = server.server_address[:2]
@@ -129,6 +150,17 @@ def main(argv=None):
             outs = json.loads(r.read())["outputs"]
         for prompt, seq in zip(prompts, outs):
             print(f"prompt {prompt} -> continuation {seq[len(prompt):]}")
+        if args.lora_rank:
+            body["adapter"] = "demo"
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1/models/default:generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=600) as r:
+                aouts = json.loads(r.read())["outputs"]
+            for prompt, seq in zip(prompts, aouts):
+                print(f"prompt {prompt} -> adapter 'demo' continuation "
+                      f"{seq[len(prompt):]}")
         print("llama serving round trip complete")
     finally:
         server.shutdown()

@@ -114,3 +114,36 @@ def make_lora_loss(loss_fn, base_params, scale=1.0):
 
 def num_trainable(adapters):
     return sum(a["a"].size + a["b"].size for a in adapters.values())
+
+
+def save_adapters(path, adapters, scale=1.0):
+    """Persist an adapter tree (+ its merge scale) as one msgpack file —
+    the artifact `serve`'s multi-adapter bank loads per tenant
+    (``--generate_lora name=path``).  fs-agnostic via fsio (local/HDFS
+    paths like every other artifact)."""
+    import flax.serialization
+
+    from . import fsio
+
+    if not adapters:
+        raise ValueError("adapters tree is empty — nothing to save")
+    rank = next(iter(adapters.values()))["a"].shape[-1]
+    blob = flax.serialization.msgpack_serialize(
+        {"adapters": {k: {"a": v["a"], "b": v["b"]}
+                      for k, v in adapters.items()},
+         "meta": {"scale": float(scale), "rank": rank}})
+    with fsio.fopen(path, "wb") as f:
+        f.write(blob)
+
+
+def load_adapters(path):
+    """Restore ``(adapters, scale)`` written by `save_adapters`."""
+    import flax.serialization
+
+    from . import fsio
+
+    with fsio.fopen(path, "rb") as f:
+        obj = flax.serialization.msgpack_restore(f.read())
+    if not isinstance(obj, dict) or "adapters" not in obj:
+        raise ValueError(f"{path!r} is not a saved LoRA adapter file")
+    return obj["adapters"], float(obj.get("meta", {}).get("scale", 1.0))

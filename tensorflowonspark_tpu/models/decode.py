@@ -212,51 +212,57 @@ def _reset_row_indices(row_cache, value):
     return jax.tree_util.tree_map_with_path(set_leaf, row_cache)
 
 
+def _slot_prefill_body(slot_model, variables, cache, chunk, row, start,
+                       n_valid):
+    """Shared prefill core (plain and LoRA builders wrap it): slice row
+    `row` out of the batch cache, run the chunk through it starting at
+    position `start`, write the row back."""
+    # pool leaves (paged kv) are SHARED across rows: they pass into
+    # the row apply whole and come back whole; per-row leaves
+    # (cached kv, indices, page_table) slice to the row
+    def _slice(path, a):
+        if _leaf_name(path) in _POOL_LEAVES:
+            return a
+        return jax.lax.dynamic_slice_in_dim(a, row, 1, 0)
+
+    row_cache = jax.tree_util.tree_map_with_path(_slice, cache)
+    row_cache = _reset_row_indices(row_cache, start)
+    logits, mut = slot_model.apply(
+        dict(variables, cache=row_cache), chunk, mutable=["cache"])
+    new_row = _reset_row_indices(mut["cache"], start + n_valid)
+
+    def _write(path, full, upd):
+        if _leaf_name(path) in _POOL_LEAVES:
+            return upd
+        return jax.lax.dynamic_update_slice_in_dim(full, upd, row, 0)
+
+    cache = jax.tree_util.tree_map_with_path(_write, cache, new_row)
+    last = jax.lax.dynamic_slice_in_dim(logits, n_valid - 1, 1, 1)
+    return last[:, 0], cache          # [1, V], updated batch cache
+
+
 @functools.lru_cache(maxsize=32)
 def _jitted_slot_prefill(slot_model):
-    """Prefill ONE slot row with one prompt CHUNK: slice row `row` out of
-    the batch cache, run the chunk through it starting at position
-    `start`, write the row back.  `chunk` is bucket-padded to a static
-    length; `n_valid` (traced) is the number of real tokens in it — the
-    row index lands at ``start + n_valid`` so the pad tail is never
-    visible to later steps.  The returned logits are the LAST valid
-    position's distribution (only meaningful on the final chunk of a
-    prompt).  Whole-prompt prefill is the single-chunk case
+    """Prefill ONE slot row with one prompt CHUNK.  `chunk` is
+    bucket-padded to a static length; `n_valid` (traced) is the number of
+    real tokens in it — the row index lands at ``start + n_valid`` so the
+    pad tail is never visible to later steps.  The returned logits are
+    the LAST valid position's distribution (only meaningful on the final
+    chunk of a prompt).  Whole-prompt prefill is the single-chunk case
     (start=0, n_valid=true_len)."""
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def prefill(params, cache, chunk, row, start, n_valid):
-        # pool leaves (paged kv) are SHARED across rows: they pass into
-        # the row apply whole and come back whole; per-row leaves
-        # (cached kv, indices, page_table) slice to the row
-        def _slice(path, a):
-            if _leaf_name(path) in _POOL_LEAVES:
-                return a
-            return jax.lax.dynamic_slice_in_dim(a, row, 1, 0)
-
-        row_cache = jax.tree_util.tree_map_with_path(_slice, cache)
-        row_cache = _reset_row_indices(row_cache, start)
-        logits, mut = slot_model.apply(
-            {"params": _params_view(params), "cache": row_cache}, chunk,
-            mutable=["cache"])
-        new_row = _reset_row_indices(mut["cache"], start + n_valid)
-
-        def _write(path, full, upd):
-            if _leaf_name(path) in _POOL_LEAVES:
-                return upd
-            return jax.lax.dynamic_update_slice_in_dim(full, upd, row, 0)
-
-        cache = jax.tree_util.tree_map_with_path(_write, cache, new_row)
-        last = jax.lax.dynamic_slice_in_dim(logits, n_valid - 1, 1, 1)
-        return last[:, 0], cache          # [1, V], updated batch cache
+        return _slot_prefill_body(
+            slot_model, {"params": _params_view(params)}, cache, chunk,
+            row, start, n_valid)
 
     return prefill
 
 
-@functools.lru_cache(maxsize=32)
-def _jitted_slot_step(slot_model):
-    """One decode step over ALL slots: feed each row its current token,
-    per-row greedy/sampled pick (`temps[b] == 0` = greedy).
+def _slot_step_body(slot_model, variables, toks, temps, seeds, ords):
+    """Shared decode-step core: feed each row its current token, per-row
+    greedy/sampled pick (`temps[b] == 0` = greedy).
 
     Sampling keys follow the SHARED schedule (`step_keys`): row b's noise
     for its new-token ordinal ``ords[b]`` is ``fold_in(key(seeds[b]),
@@ -267,24 +273,88 @@ def _jitted_slot_step(slot_model):
     runtimes every extra per-step device op (a host fold_in, an h2d of
     tokens) costs a full round trip (measured ~200 ms/step with naive
     per-step host traffic vs ~20 ms with resident chains)."""
+    logits, mut = slot_model.apply(variables, toks[:, None],
+                                   mutable=["cache"])
+    logits = logits[:, -1]
+    greedy = jnp.argmax(logits, axis=-1)
+    keys = jax.vmap(
+        lambda s, t: jax.random.fold_in(jax.random.key(s), t))(
+            seeds, ords)
+    sampled = jax.vmap(
+        lambda k, lg, T: jax.random.categorical(k, lg / T))(
+            keys, logits, jnp.maximum(temps, 1e-6))
+    return (jnp.where(temps > 0, sampled, greedy), mut["cache"],
+            ords + 1)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_step(slot_model):
+    """One decode step over ALL slots (see `_slot_step_body`)."""
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step(params, cache, toks, temps, seeds, ords):
-        logits, mut = slot_model.apply(
-            {"params": _params_view(params), "cache": cache}, toks[:, None],
-            mutable=["cache"])
-        logits = logits[:, -1]
-        greedy = jnp.argmax(logits, axis=-1)
-        keys = jax.vmap(
-            lambda s, t: jax.random.fold_in(jax.random.key(s), t))(
-                seeds, ords)
-        sampled = jax.vmap(
-            lambda k, lg, T: jax.random.categorical(k, lg / T))(
-                keys, logits, jnp.maximum(temps, 1e-6))
-        return (jnp.where(temps > 0, sampled, greedy), mut["cache"],
-                ords + 1)
+        return _slot_step_body(
+            slot_model,
+            {"params": _params_view(params), "cache": cache},
+            toks, temps, seeds, ords)
 
     return step
+
+
+def _lora_with_ids(lora, ids):
+    """Insert the per-row adapter-id array into a lora bank tree: every
+    dict level holding adapter banks (a ``*_a`` key) gets ``ids`` — the
+    layout transformer.Attention._proj reads (serve.ContinuousBatcher
+    builds the bank tree; ids are the only per-step-varying leaves)."""
+    def walk(node):
+        if isinstance(node, dict):
+            out = {k: walk(v) for k, v in node.items()}
+            if any(k.endswith("_a") for k in node):
+                out["ids"] = ids
+            return out
+        return node
+
+    return walk(lora)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_step_lora(slot_model):
+    """`_jitted_slot_step` with a per-row LoRA adapter bank: the SAME
+    `_slot_step_body`, plus the ``lora`` collection (banks + resident
+    [n_slots] adapter ids) threaded into the apply — N tenants share the
+    one batched step (multi-adapter serving; see
+    transformer.Attention._proj for the math and the null-adapter-0
+    convention)."""
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def step(params, lora, cache, toks, temps, seeds, ords, ids):
+        return _slot_step_body(
+            slot_model,
+            {"params": _params_view(params), "cache": cache,
+             "lora": _lora_with_ids(lora, ids)},
+            toks, temps, seeds, ords)
+
+    return step
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_prefill_lora(slot_model):
+    """`_jitted_slot_prefill` with a LoRA bank: the SAME
+    `_slot_prefill_body`, with the joining row prefilling under ITS
+    adapter (``adapter_id``; the sliced row apply runs at batch 1, so
+    ids is the one-element array)."""
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def prefill(params, lora, cache, chunk, row, start, n_valid,
+                adapter_id):
+        ids = jnp.full((1,), adapter_id, jnp.int32)
+        return _slot_prefill_body(
+            slot_model,
+            {"params": _params_view(params),
+             "lora": _lora_with_ids(lora, ids)},
+            cache, chunk, row, start, n_valid)
+
+    return prefill
 
 
 @functools.lru_cache(maxsize=32)

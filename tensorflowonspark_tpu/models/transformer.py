@@ -104,6 +104,32 @@ def apply_rope(x, positions, theta=10000.0):
 class Attention(nn.Module):
     cfg: TransformerConfig
 
+    def _proj(self, name, features, x, dtype):
+        """One attention projection, with an optional PER-ROW LoRA delta.
+
+        When the caller passes a ``lora`` variable collection (multi-
+        adapter serving, serve.ContinuousBatcher), this module's subtree
+        holds banks ``{name}_a [L, d_in, r]`` / ``{name}_b [L, r, d_out]``
+        (scale pre-folded into b) plus ``ids [B]`` mapping each batch row
+        to its bank index; row ``n`` computes ``x_n @ W + (x_n @
+        A[ids_n]) @ B[ids_n]`` — N tenants share one batched step
+        (S-LoRA-style; net-new beyond the reference).  Index 0 is the
+        null adapter (all-zero b), so un-adapted rows are EXACTLY the
+        base model.  Without the collection this is a plain Dense."""
+        y = nn.Dense(features, use_bias=self.cfg.use_bias, name=name,
+                     dtype=dtype)(x)
+        if (not self.is_initializing()
+                and self.has_variable("lora", f"{name}_a")):
+            a = self.get_variable("lora", f"{name}_a")
+            b = self.get_variable("lora", f"{name}_b")
+            ids = self.get_variable("lora", "ids")
+            a = jnp.take(a, ids, axis=0)            # [B, d_in, r]
+            b = jnp.take(b, ids, axis=0)            # [B, r, d_out]
+            delta = jnp.einsum("bsd,bdr,bro->bso", x.astype(jnp.float32),
+                               a.astype(jnp.float32), b.astype(jnp.float32))
+            y = y + delta.astype(y.dtype)
+        return y
+
     @nn.compact
     def __call__(self, x, mask=None):
         cfg = self.cfg
@@ -116,12 +142,9 @@ class Attention(nn.Module):
             raise ValueError(
                 f"n_heads={cfg.n_heads} must be divisible by "
                 f"n_kv_heads={n_kv}")
-        q = nn.Dense(cfg.d_model, use_bias=cfg.use_bias, name="query",
-                     dtype=dtype)(x)
-        k = nn.Dense(n_kv * head_dim, use_bias=cfg.use_bias, name="key",
-                     dtype=dtype)(x)
-        v = nn.Dense(n_kv * head_dim, use_bias=cfg.use_bias, name="value",
-                     dtype=dtype)(x)
+        q = self._proj("query", cfg.d_model, x, dtype)
+        k = self._proj("key", n_kv * head_dim, x, dtype)
+        v = self._proj("value", n_kv * head_dim, x, dtype)
         B, S = x.shape[0], x.shape[1]
         q = q.reshape(B, S, cfg.n_heads, head_dim)
         k = k.reshape(B, S, n_kv, head_dim)
@@ -205,8 +228,7 @@ class Attention(nn.Module):
                 out = dot_product_attention(q, k, v, causal=cfg.causal,
                                             mask=mask)
         out = out.reshape(B, S, cfg.d_model)
-        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias, name="out",
-                        dtype=dtype)(out)
+        return self._proj("out", cfg.d_model, out, dtype)
 
     def _decode_attention(self, q, k, v, mask):
         """Incremental attention against the kv cache.

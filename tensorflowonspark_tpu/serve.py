@@ -78,6 +78,19 @@ def build_argparser():
                         "each (requires --generate_kv_pages)")
     p.add_argument("--generate_kv_pages", type=int, default=0,
                    help="pool size (pages) for --generate_kv_page_size")
+    p.add_argument("--generate_lora_rank", type=int, default=0,
+                   help=">0 enables a multi-adapter LoRA bank on the "
+                        ":generate slots: requests select a registered "
+                        "adapter by name ({\"adapter\": \"x\"}) and N "
+                        "tenants share the one batched decode step "
+                        "(rows without an adapter run the base model "
+                        "exactly)")
+    p.add_argument("--generate_lora_capacity", type=int, default=8,
+                   help="max adapters resident in the bank")
+    p.add_argument("--generate_lora", action="append", default=None,
+                   metavar="NAME=PATH",
+                   help="register adapter NAME from a lora.save_adapters "
+                        "file at startup (repeatable)")
     p.add_argument("--generate_quantize", choices=["none", "int8"],
                    default="none",
                    help="int8 = weight-only post-training quantization of "
@@ -243,6 +256,16 @@ class ModelService:
         self._gen_kv_pages = getattr(args, "generate_kv_pages", 0)
         self._gen_quantize = getattr(args, "generate_quantize",
                                      "none") or "none"
+        self._gen_lora_rank = getattr(args, "generate_lora_rank", 0) or 0
+        self._gen_lora_capacity = getattr(args, "generate_lora_capacity",
+                                          8) or 8
+        self._gen_lora = {}
+        for spec in (getattr(args, "generate_lora", None) or []):
+            name, sep, path = spec.partition("=")
+            if not sep or not name or not path:
+                raise ValueError(
+                    f"--generate_lora {spec!r} must be NAME=PATH")
+            self._gen_lora[name] = path
         self._batcher = None
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
@@ -279,7 +302,10 @@ class ModelService:
                         request_timeout_s=self._gen_timeout_s,
                         kv_page_size=self._gen_kv_page_size,
                         kv_pages=self._gen_kv_pages,
-                        quantize_mode=self._gen_quantize)
+                        quantize_mode=self._gen_quantize,
+                        lora_rank=self._gen_lora_rank,
+                        lora_capacity=self._gen_lora_capacity,
+                        lora_adapters=self._gen_lora)
                 except TypeError as e:
                     # genuinely not a decoder LM: the documented 404
                     logger.info(":generate unavailable: %s", e)
@@ -344,18 +370,32 @@ class SlotHandle:
         self._done = threading.Event()
         self._seq = None
         self._err = None
+        self._on_done = None   # fired exactly once at finish/fail (the
+        # batcher releases per-request resources here, e.g. the LoRA
+        # adapter's in-flight reference)
 
     def cancel(self):
         """Stop decoding for this request (client gone): the batcher
         retires its slot at the next readback boundary."""
         self.cancelled.set()
 
+    def _settle(self):
+        cb, self._on_done = self._on_done, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.warning("handle on_done callback failed",
+                               exc_info=True)
+
     def _finish(self, seq):
+        self._settle()
         self._seq = seq
         self._done.set()
         self.tokens.put(None)
 
     def _fail(self, err):
+        self._settle()
         self._err = err
         self._done.set()
         self.tokens.put(None)
@@ -392,7 +432,9 @@ class ContinuousBatcher:
 
     def __init__(self, model, params, n_slots=8, max_pending=1024,
                  read_chunk=8, prefill_chunk=512, draft_model=None,
-                 draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0):
+                 draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
+                 lora_rank=0, lora_capacity=8):
+        import itertools
         import queue as queue_mod
 
         import jax.numpy as jnp
@@ -446,8 +488,59 @@ class ContinuousBatcher:
             self.slot_model, self._cache = decode_mod.init_slot_cache(
                 model, n_slots)
         self._parked = None    # admission waiting for pool pages (FIFO)
-        self._prefill = decode_mod._jitted_slot_prefill(self.slot_model)
-        self._step = decode_mod._jitted_slot_step(self.slot_model)
+        # ---- multi-adapter LoRA bank (lora_rank > 0) --------------------
+        # N tenants share the batched step: per-layer stacked A/B banks
+        # ([capacity+1, ...]; index 0 = the all-zero NULL adapter, so
+        # un-adapted rows are exactly the base model) plus a resident
+        # [n_slots] adapter-id array.  transformer.Attention._proj applies
+        # the per-row delta; registration swaps in new bank arrays
+        # atomically (the driver thread reads the rebound reference at
+        # its next dispatch).  S-LoRA-style; net-new beyond the reference.
+        self.lora_rank = int(lora_rank or 0)
+        if self.lora_rank:
+            if draft_model is not None:
+                raise ValueError(
+                    "draft speculation does not compose with LoRA "
+                    "serving yet (the verify pass would need per-row "
+                    "adapters too) — drop --draft_export_dir or "
+                    "lora_rank")
+            cfg = model.cfg
+            head_dim = cfg.d_model // cfg.n_heads
+            n_kv = (cfg.n_heads if cfg.n_kv_heads is None
+                    else cfg.n_kv_heads)
+            self._lora_dims = {
+                "query": (cfg.d_model, cfg.d_model),
+                "key": (cfg.d_model, n_kv * head_dim),
+                "value": (cfg.d_model, n_kv * head_dim),
+                "out": (cfg.d_model, cfg.d_model)}
+            L = int(lora_capacity) + 1
+            self._lora_banks = {
+                f"layer_{i}": {"attn": {
+                    **{f"{p}_a": jnp.zeros((L, di, self.lora_rank),
+                                           jnp.float32)
+                       for p, (di, _) in self._lora_dims.items()},
+                    **{f"{p}_b": jnp.zeros((L, self.lora_rank, do),
+                                           jnp.float32)
+                       for p, (_, do) in self._lora_dims.items()}}}
+                for i in range(cfg.n_layers)}
+            self._lora_ids = jnp.zeros((n_slots,), jnp.int32)
+            self._adapters = {}          # name -> bank index
+            self._free_lora = list(range(1, L))
+            self._adapter_refs = {}      # index -> in-flight requests
+            # prefix-cache identity: kv prefilled under an adapter
+            # carries its k/v deltas, so prefix keys root on a UNIQUE
+            # per-registration token (never reused — a re-registered
+            # index gets a fresh token, so stale cached pages can never
+            # serve a different tenant; they age out via LRU)
+            self._adapter_token = {0: 0}  # bank index -> registration token
+            self._token_counter = itertools.count(1)
+            self._lora_lock = threading.Lock()
+            self._prefill = decode_mod._jitted_slot_prefill_lora(
+                self.slot_model)
+            self._step = decode_mod._jitted_slot_step_lora(self.slot_model)
+        else:
+            self._prefill = decode_mod._jitted_slot_prefill(self.slot_model)
+            self._step = decode_mod._jitted_slot_step(self.slot_model)
         self._set_row = decode_mod._jitted_set_row(self.slot_model)
         self.draft_model = self.draft_params = None
         self.draft_k = draft_k
@@ -512,7 +605,101 @@ class ContinuousBatcher:
             out["admission_waiting_for_pages"] = self._parked is not None
             out["prefix_pages_cached"] = len(self._prefix)
             out["prefill_tokens_shared"] = self.prefill_tokens_shared
+        if self.lora_rank:
+            out["lora_rank"] = self.lora_rank
+            out["lora_adapters"] = sorted(self._adapters)
+            out["lora_capacity_free"] = len(self._free_lora)
         return out
+
+    # ---- multi-adapter LoRA registry ------------------------------------
+
+    def register_adapter(self, name, adapters, scale=1.0):
+        """Install a LoRA adapter under `name` (requests select it via
+        ``submit(..., adapter=name)``).  `adapters` is the
+        `lora.init`-shaped tree ({"layer_i/attn/proj/kernel": {"a", "b"}},
+        attention projections only — the bank lives in Attention); `scale`
+        (alpha/rank) folds into the stored b.  Paths the adapter does not
+        cover stay zero (no delta).  Thread-safe; visible to the decode
+        loop from its next dispatch."""
+        import jax.numpy as jnp
+
+        if not self.lora_rank:
+            raise ValueError("no LoRA bank configured (lora_rank=0; pass "
+                             "lora_rank / --generate_lora_rank)")
+        by_slot = {}
+        for path, ab in adapters.items():
+            parts = path.split("/")
+            if (len(parts) != 4 or parts[1] != "attn"
+                    or parts[0] not in self._lora_banks
+                    or parts[2] not in self._lora_dims
+                    or parts[3] != "kernel"):
+                raise ValueError(
+                    f"adapter path {path!r} is not an attention projection "
+                    "of this model (expected layer_<i>/attn/"
+                    "<query|key|value|out>/kernel)")
+            a, b = ab["a"], ab["b"]
+            di, do = self._lora_dims[parts[2]]
+            if a.shape != (di, self.lora_rank) or \
+                    b.shape != (self.lora_rank, do):
+                raise ValueError(
+                    f"adapter {path!r} shapes a{tuple(a.shape)} "
+                    f"b{tuple(b.shape)} do not match bank "
+                    f"([{di}, {self.lora_rank}], [{self.lora_rank}, {do}])")
+            by_slot[(parts[0], parts[2])] = (a, b)
+        with self._lora_lock:
+            if name in self._adapters:
+                raise ValueError(f"adapter {name!r} already registered")
+            if not self._free_lora:
+                raise ValueError(
+                    f"adapter bank full ({len(self._adapters)} registered; "
+                    "raise lora_capacity / --generate_lora_capacity)")
+            idx = self._free_lora.pop()
+            banks = self._lora_banks
+            new = {}
+            for layer, sub in banks.items():
+                attn = dict(sub["attn"])
+                for proj in self._lora_dims:
+                    ab = by_slot.get((layer, proj))
+                    if ab is None:       # uncovered: zero this index
+                        attn[f"{proj}_a"] = attn[f"{proj}_a"].at[idx].set(0.0)
+                        attn[f"{proj}_b"] = attn[f"{proj}_b"].at[idx].set(0.0)
+                    else:
+                        a, b = ab
+                        attn[f"{proj}_a"] = attn[f"{proj}_a"].at[idx].set(
+                            jnp.asarray(a, jnp.float32))
+                        attn[f"{proj}_b"] = attn[f"{proj}_b"].at[idx].set(
+                            jnp.asarray(b, jnp.float32) * float(scale))
+                new[layer] = {"attn": attn}
+            self._lora_banks = new       # atomic rebind: the driver thread
+            self._adapters[name] = idx   # picks it up at its next dispatch
+            self._adapter_refs.setdefault(idx, 0)
+            # fresh prefix-cache identity for this registration (paged
+            # mode): pages prefilled under a PREVIOUS tenant of this
+            # index must never serve the new one
+            self._adapter_token[idx] = next(self._token_counter)
+        logger.info("registered LoRA adapter %r at bank index %d "
+                    "(%d paths, scale %.3g)", name, idx, len(adapters),
+                    scale)
+        return idx
+
+    def unregister_adapter(self, name):
+        """Remove `name`; refuses while requests using it are in flight
+        (their rows would silently decode under a freed/reused index)."""
+        with self._lora_lock:
+            idx = self._adapters.get(name)
+            if idx is None:
+                raise ValueError(f"adapter {name!r} is not registered")
+            if self._adapter_refs.get(idx, 0) > 0:
+                raise ValueError(
+                    f"adapter {name!r} has {self._adapter_refs[idx]} "
+                    "requests in flight")
+            del self._adapters[name]
+            self._free_lora.append(idx)
+
+    def _release_adapter(self, idx):
+        with self._lora_lock:
+            self._adapter_refs[idx] = max(
+                0, self._adapter_refs.get(idx, 0) - 1)
 
     def stop(self, timeout=30):
         """Shut the driver loop down cleanly (benches/tests teardown): the
@@ -534,9 +721,14 @@ class ContinuousBatcher:
         self._slots = [None] * self.n_slots
         self._drain_pending(err)
 
-    def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0):
+    def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
+               adapter=None):
         if self._dead is not None:
             raise RuntimeError(f"batcher died: {self._dead}")
+        if adapter is not None and not self.lora_rank:
+            raise ValueError(
+                "this server has no LoRA bank (start it with "
+                "--generate_lora_rank and --generate_lora)")
         # greedy requests on a draft-equipped server need draft_k cache
         # headroom for the speculative verify overshoot; sampled requests
         # never speculate (and disable spec rounds while active), so they
@@ -558,9 +750,24 @@ class ContinuousBatcher:
                     f"request needs {need} kv pages but the pool only "
                     f"has {self._total_pages}; raise --generate_kv_pages "
                     "or shorten the request")
+        # resolve the adapter LAST: the in-flight refcount must only be
+        # taken once every validation above has passed (a rejected
+        # request would otherwise leak its ref and wedge unregister)
+        aidx = 0
+        if adapter is not None:
+            with self._lora_lock:
+                if adapter not in self._adapters:
+                    raise ValueError(
+                        f"unknown adapter {adapter!r}; registered: "
+                        f"{sorted(self._adapters)}")
+                aidx = self._adapters[adapter]
+                self._adapter_refs[aidx] = self._adapter_refs.get(aidx,
+                                                                  0) + 1
         h = SlotHandle(prompt)
+        if aidx:
+            h._on_done = lambda idx=aidx: self._release_adapter(idx)
         self._pending.put((h, list(prompt), max_new, float(temperature),
-                           eos_id, int(seed)))
+                           eos_id, int(seed), aidx))
         if self._dead is not None:
             # the loop may have died between the check above and the put
             # (its death-drain already ran): fail whatever is queued,
@@ -622,26 +829,39 @@ class ContinuousBatcher:
     # prompt token must run through prefill to produce the first-token
     # logits.
 
-    def _prefix_keys(self, prompt, upto_tokens):
+    def _prefix_keys(self, prompt, upto_tokens, root=()):
         """Rolling cumulative-prefix keys for each FULL page up to
         `upto_tokens` (exclusive page count bound).  Keys are NESTED
         TUPLES (prev_key, page_tokens) — structural equality makes the
         cache lookup EXACT (hash() alone would let two colliding
         prefixes serve each other's kv: silent wrong output and
         cross-request content leakage); structure sharing keeps each
-        key O(1) extra memory."""
+        key O(1) extra memory.  ``root`` seeds the chain with the
+        request's LoRA identity: adapter-prefilled kv carries that
+        adapter's k/v deltas, so pages are only ever shared between
+        requests of the same registration (base requests keep the empty
+        root and the exact pre-LoRA keys)."""
         P = self.kv_page_size
-        keys, k = [], ()
+        keys, k = [], root
         n_full = upto_tokens // P
         for i in range(n_full):
             k = (k, tuple(prompt[i * P:(i + 1) * P]))
             keys.append(k)
         return keys
 
-    def _prefix_lookup(self, prompt):
+    def _lora_prefix_root(self, aidx):
+        """Prefix-key root for bank index `aidx`: () for the base model;
+        a never-reused per-registration token otherwise (a re-registered
+        index gets a fresh token, so stale cached pages can never serve
+        a different tenant — they just age out via LRU)."""
+        if not self.lora_rank or not aidx:
+            return ()
+        return ("lora", self._adapter_token.get(aidx, -1))
+
+    def _prefix_lookup(self, prompt, root=()):
         """(shared_pages, keys_for_all_full_pages): the longest cached
         run of full prompt pages, capped at len(prompt)-1 tokens."""
-        keys = self._prefix_keys(prompt, len(prompt) - 1)
+        keys = self._prefix_keys(prompt, len(prompt) - 1, root=root)
         shared = []
         for key in keys:
             page = self._prefix.get(key)
@@ -679,7 +899,8 @@ class ContinuousBatcher:
 
         prompt, max_new, temp = item[1], item[2], item[3]
         need = self._pages_needed(len(prompt), max_new, temperature=temp)
-        shared, keys = self._prefix_lookup(prompt)
+        shared, keys = self._prefix_lookup(
+            prompt, root=self._lora_prefix_root(item[6]))
         # hold refs BEFORE any eviction: rc==0 shared pages would
         # otherwise be evictable by our own eviction pass, get re-popped
         # as "fresh", and end up mapped twice in this row's table
@@ -738,6 +959,11 @@ class ContinuousBatcher:
         import jax.numpy as jnp
 
         self._slots[row] = None
+        if self.lora_rank:
+            # back to the null adapter: the freed row's garbage decode
+            # runs the base model (harmless either way — its tokens are
+            # dropped by the generation filter)
+            self._lora_ids = self._lora_ids.at[row].set(0)
         if self.kv_page_size and self._row_pages[row] is not None:
             for page in self._row_pages[row]:
                 if page in self._page_rc:
@@ -752,7 +978,7 @@ class ContinuousBatcher:
                 self._sink_entries)
 
     def _start_admission(self, row, item):
-        h, prompt, max_new, temp, eos_id, seed = item
+        h, prompt, max_new, temp, eos_id, seed, aidx = item
         if h.cancelled.is_set():        # client gone before admission
             h._finish(list(prompt))
             return
@@ -785,7 +1011,7 @@ class ContinuousBatcher:
         import jax.numpy as jnp
 
         adm = self._admitting
-        h, prompt, max_new, temp, eos_id, seed = adm["item"]
+        h, prompt, max_new, temp, eos_id, seed, aidx = adm["item"]
         row, off = adm["row"], adm["offset"]
         if h.cancelled.is_set():
             self._admitting = None
@@ -818,7 +1044,13 @@ class ContinuousBatcher:
         args = (jnp.asarray([padded], jnp.int32),
                 jnp.asarray(row, jnp.int32), jnp.asarray(off, jnp.int32),
                 jnp.asarray(len(chunk), jnp.int32))
-        logits, self._cache = self._prefill(self.params, self._cache, *args)
+        if self.lora_rank:
+            logits, self._cache = self._prefill(
+                self.params, self._lora_banks, self._cache, *args,
+                jnp.asarray(aidx, jnp.int32))
+        else:
+            logits, self._cache = self._prefill(self.params, self._cache,
+                                                *args)
         if self.draft_model is not None:
             _, self._d_cache = self._d_prefill(self.draft_params,
                                                self._d_cache, *args)
@@ -845,6 +1077,8 @@ class ContinuousBatcher:
             jnp.asarray(row, jnp.int32), jnp.asarray(tok, jnp.int32),
             jnp.asarray(temp, jnp.float32), jnp.asarray(seed, jnp.int32),
             jnp.asarray(1, jnp.int32))
+        if self.lora_rank:
+            self._lora_ids = self._lora_ids.at[row].set(aidx)
         self._slots[row] = {"handle": h, "seq": seq,
                             "remaining": max_new - 1, "temp": temp,
                             "eos": eos_id}
@@ -940,9 +1174,14 @@ class ContinuousBatcher:
             self._toks = nxt
             self._spec_rounds += 1
             return (t_next, commit, tuple(self._gen))
-        nxt, self._cache, self._ords = self._step(
-            self.params, self._cache, self._toks, self._temps,
-            self._seeds, self._ords)
+        if self.lora_rank:
+            nxt, self._cache, self._ords = self._step(
+                self.params, self._lora_banks, self._cache, self._toks,
+                self._temps, self._seeds, self._ords, self._lora_ids)
+        else:
+            nxt, self._cache, self._ords = self._step(
+                self.params, self._cache, self._toks, self._temps,
+                self._seeds, self._ords)
         self._toks = nxt
         self._steps += 1
         return (nxt, None, tuple(self._gen))
@@ -1096,7 +1335,8 @@ class GenerateService:
     def __init__(self, export_dir, max_new_tokens_limit=512,
                  draft_export_dir=None, draft_k=4, slots=8, read_chunk=8,
                  prefill_chunk=512, request_timeout_s=None,
-                 kv_page_size=0, kv_pages=0, quantize_mode="none"):
+                 kv_page_size=0, kv_pages=0, quantize_mode="none",
+                 lora_rank=0, lora_capacity=8, lora_adapters=None):
         import itertools
 
         self.quantize_mode = quantize_mode or "none"
@@ -1116,7 +1356,23 @@ class GenerateService:
             self.model, self.params, n_slots=slots or 8,
             read_chunk=read_chunk, prefill_chunk=prefill_chunk,
             draft_model=draft_model, draft_params=draft_params,
-            draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages)
+            draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages,
+            lora_rank=lora_rank, lora_capacity=lora_capacity)
+        try:
+            for name, path in (lora_adapters or {}).items():
+                # adapter files written by lora.save_adapters; a bad file
+                # or mismatched shapes raises here (startup), not
+                # per-request
+                from . import lora as lora_mod
+
+                adapters, scale = lora_mod.load_adapters(path)
+                self.batcher.register_adapter(name, adapters, scale=scale)
+        except Exception:
+            # the batcher's driver thread is already running: a failed
+            # startup registration must not leak it (and its device
+            # cache) behind the propagating error
+            self.batcher.stop()
+            raise
         self.limit = max_new_tokens_limit
         # bound on a single request's wall time: decoding its own tokens
         # plus waiting behind a full house of equally-long requests, with
@@ -1162,7 +1418,11 @@ class GenerateService:
                 raise ValueError('"seed" must be an int32 (with headroom '
                                  "for per-prompt offsets)")
             seed = int(seed)
-        return inputs, max_new, temperature, eos_id, seed
+        adapter = req.get("adapter")
+        if adapter is not None and not isinstance(adapter, str):
+            raise ValueError('"adapter" must be a registered adapter name '
+                             "(string)")
+        return inputs, max_new, temperature, eos_id, seed, adapter
 
     def _prompt_seeds(self, n, seed, temperature):
         """Per-prompt seeds: explicit seed s -> s, s+1, ... (documented
@@ -1182,13 +1442,14 @@ class GenerateService:
         ``{"done": true, "output": [...full sequence...]}``."""
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
-        inputs, max_new, temperature, eos_id, seed = self._validate(req)
+        inputs, max_new, temperature, eos_id, seed, adapter = \
+            self._validate(req)
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
         seed = self._prompt_seeds(1, seed, temperature)[0]
         h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
-                                eos_id=eos_id, seed=seed)
+                                eos_id=eos_id, seed=seed, adapter=adapter)
         self.requests += 1
 
         def slot_events():
@@ -1207,7 +1468,8 @@ class GenerateService:
         return slot_events()
 
     def generate(self, req):
-        inputs, max_new, temperature, eos_id, seed = self._validate(req)
+        inputs, max_new, temperature, eos_id, seed, adapter = \
+            self._validate(req)
         seeds = self._prompt_seeds(len(inputs), seed, temperature)
         # every prompt becomes a slot request; they decode concurrently
         # with each other AND with other HTTP requests' prompts (no
@@ -1217,7 +1479,7 @@ class GenerateService:
             for p, s in zip(inputs, seeds):
                 handles.append(self.batcher.submit(
                     p, max_new, temperature=temperature, eos_id=eos_id,
-                    seed=s))
+                    seed=s, adapter=adapter))
             outs = [h.result(timeout=self.timeout_s) for h in handles]
         except Exception:
             # a failed request (one prompt too long, a timeout) must not
@@ -1332,6 +1594,15 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
             getattr(args, "generate_kv_pages", 0) < 1:
         raise ValueError("--generate_kv_page_size needs "
                          "--generate_kv_pages >= 1 (the shared pool size)")
+    if getattr(args, "generate_lora", None) and \
+            not getattr(args, "generate_lora_rank", 0):
+        raise ValueError("--generate_lora needs --generate_lora_rank > 0 "
+                         "(the bank's adapter rank)")
+    if getattr(args, "generate_lora_rank", 0) and \
+            getattr(args, "draft_export_dir", None):
+        raise ValueError("--generate_lora_rank does not compose with "
+                         "--draft_export_dir (speculative verify has no "
+                         "per-row adapters yet)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
 

@@ -55,6 +55,7 @@ SLOW_FILES = {
     "test_examples.py",         # >10 min — example subprocesses
     "test_hybrid_mesh.py",      # 11 s — multi-slice mesh compiles
     "test_lora.py",             # 25 s
+    "test_lora_serving.py",     # ~60 s — multi-adapter slot engines
     "test_optim8bit.py",        # 14 s (round 5 grew it: layout parity)
     "test_paged.py",            # 40 s — paged-kv batcher compiles
     "test_metrics_vit.py",      # 82 s

@@ -1,0 +1,246 @@
+"""Multi-adapter LoRA serving (net-new beyond the reference).
+
+N tenants share ONE batched slot step: per-layer A/B banks + a resident
+per-row adapter-id array select each slot's adapter inside the attention
+projections (transformer.Attention._proj).  The contracts these tests
+pin:
+
+- a slot decoding under adapter X produces the SAME tokens as a solo
+  `decode.generate` over `lora.merge(params, X)` (the delta is applied
+  as base + (x@A)@B instead of x@(W+AB) — f32-equal to ~1e-6, same
+  argmax);
+- rows WITHOUT an adapter (bank index 0, all-zero) are EXACTLY the base
+  model — the delta is a multiply by a zero matrix, not an approximation;
+- the registry enforces capacity, name uniqueness, and refuses to drop
+  an adapter with requests in flight.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import lora, serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _adapter(params, seed, rank=4, scale=0.5, mag=1.0):
+    """A LoRA adapter whose delta is big enough to CHANGE greedy tokens
+    on the tiny fixture model (mag 1.0 measured to flip the argmax; the
+    parity assertions below are exact either way)."""
+    ad = lora.init(jax.random.key(seed), params, rank=rank)
+    for i, p in enumerate(sorted(ad)):
+        ad[p]["b"] = (jax.random.normal(jax.random.fold_in(
+            jax.random.key(seed + 100), i), ad[p]["b"].shape) * mag)
+    return ad, scale
+
+
+def _solo(model, params, prompt, n_new):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host")
+    return np.asarray(out)[0].tolist()
+
+
+def test_tenants_share_one_batch_and_match_merged_solo(lm):
+    model, params = lm
+    ad1, s1 = _adapter(params, seed=1)
+    ad2, s2 = _adapter(params, seed=2)
+    b = serve.ContinuousBatcher(model, params, n_slots=3, read_chunk=1,
+                                prefill_chunk=8, lora_rank=4,
+                                lora_capacity=4)
+    try:
+        b.register_adapter("a1", ad1, scale=s1)
+        b.register_adapter("a2", ad2, scale=s2)
+        hs = [b.submit([1, 2, 3], 6, adapter="a1"),
+              b.submit([1, 2, 3], 6),                    # base model
+              b.submit([4, 5], 6, adapter="a2")]
+        got = [h.result(timeout=300) for h in hs]
+    finally:
+        b.stop()
+    assert got[0] == _solo(model, lora.merge(params, ad1, s1), [1, 2, 3], 6)
+    assert got[1] == _solo(model, params, [1, 2, 3], 6)
+    assert got[2] == _solo(model, lora.merge(params, ad2, s2), [4, 5], 6)
+    # the adapted run actually diverged from base (the delta is real)
+    assert got[0] != got[1]
+
+
+def test_bank_without_adapters_is_exactly_base(lm):
+    model, params = lm
+    plain = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                    prefill_chunk=8)
+    with_bank = serve.ContinuousBatcher(model, params, n_slots=2,
+                                        read_chunk=1, prefill_chunk=8,
+                                        lora_rank=4)
+    try:
+        a = plain.submit([7, 8, 9], 6).result(timeout=300)
+        c = with_bank.submit([7, 8, 9], 6).result(timeout=300)
+    finally:
+        plain.stop()
+        with_bank.stop()
+    assert a == c
+
+
+def test_registry_rules(lm):
+    model, params = lm
+    ad, s = _adapter(params, seed=3)
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, lora_rank=4,
+                                lora_capacity=1)
+    try:
+        with pytest.raises(ValueError, match="unknown adapter"):
+            b.submit([1, 2], 4, adapter="nope")
+        b.register_adapter("a", ad, scale=s)
+        with pytest.raises(ValueError, match="already registered"):
+            b.register_adapter("a", ad)
+        with pytest.raises(ValueError, match="bank full"):
+            b.register_adapter("b", ad)
+        # in-flight refcount: unregister refuses until the request ends
+        h = b.submit([1, 2, 3], 8, adapter="a")
+        with pytest.raises(ValueError, match="in flight"):
+            b.unregister_adapter("a")
+        h.result(timeout=300)
+        b.unregister_adapter("a")
+        with pytest.raises(ValueError, match="not registered"):
+            b.unregister_adapter("a")
+        # freed capacity is reusable
+        b.register_adapter("c", ad, scale=s)
+    finally:
+        b.stop()
+    # wrong-rank adapters are rejected with shapes in the message
+    b2 = serve.ContinuousBatcher(model, params, n_slots=2, lora_rank=8)
+    try:
+        with pytest.raises(ValueError, match="do not match bank"):
+            b2.register_adapter("r4", ad)
+    finally:
+        b2.stop()
+
+
+def test_lora_with_draft_rejected(lm):
+    model, params = lm
+    with pytest.raises(ValueError, match="draft"):
+        serve.ContinuousBatcher(model, params, n_slots=2, lora_rank=4,
+                                draft_model=model, draft_params=params)
+
+
+def test_save_load_roundtrip_and_http(tmp_path):
+    import json
+    import threading
+    import urllib.request
+
+    from tensorflowonspark_tpu import export as export_mod
+
+    cfg_kw = dict(vocab_size=41, d_model=32, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=32, max_seq_len=32, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ad, s = _adapter(params, seed=5, rank=4, scale=0.8)
+    lora.save_adapters(str(tmp_path / "a.msgpack"), ad, scale=s)
+    loaded, ls = lora.load_adapters(str(tmp_path / "a.msgpack"))
+    assert ls == s and set(loaded) == set(ad)
+
+    export_mod.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "lm"), "--port", "0",
+         "--generate_slots", "2", "--generate_lora_rank", "4",
+         "--generate_lora", f"tenant1={tmp_path / 'a.msgpack'}"])
+    srv, svc = serve.make_server(args)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+
+    def post(payload):
+        port = srv.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/default:generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, out = post({"inputs": [[1, 2, 3]], "max_new_tokens": 5,
+                          "adapter": "tenant1"})
+        assert code == 200
+        ref = _solo(model, lora.merge(params, ad, s), [1, 2, 3], 5)
+        assert out["outputs"][0] == ref
+        # base-model requests on the same server take the null adapter
+        code, out = post({"inputs": [[1, 2, 3]], "max_new_tokens": 5})
+        assert code == 200
+        assert out["outputs"][0] == _solo(model, params, [1, 2, 3], 5)
+        # unknown adapter -> 400, server stays up
+        code, out = post({"inputs": [[1, 2]], "max_new_tokens": 2,
+                          "adapter": "nope"})
+        assert code == 400 and "unknown adapter" in out["error"]
+        # metadata lists the tenant
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/default") as r:
+            meta = json.loads(r.read())
+        assert meta["model"]["generate_stats"]["lora_adapters"] == \
+            ["tenant1"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_rejected_submit_leaks_no_adapter_ref(lm):
+    # a request that fails validation (too long) must not take the
+    # adapter's in-flight ref — unregister stays possible
+    model, params = lm
+    ad, s = _adapter(params, seed=7)
+    b = serve.ContinuousBatcher(model, params, n_slots=2, lora_rank=4)
+    try:
+        b.register_adapter("a", ad, scale=s)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            b.submit([1] * 30, 30, adapter="a")     # 60 > max_seq 32
+        b.unregister_adapter("a")                   # no leaked ref
+    finally:
+        b.stop()
+
+
+def test_prefix_cache_is_adapter_scoped(lm):
+    # paged mode: kv pages prefilled under an adapter carry its k/v
+    # deltas — a base request with the SAME prompt must NOT reuse them
+    # (and vice versa); same-adapter repeats still share
+    model, params = lm
+    ad, s = _adapter(params, seed=9)
+    prompt = list(range(1, 12))                     # 11 tokens, page 8:
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, lora_rank=4,
+                                kv_page_size=8, kv_pages=12)
+    try:
+        b.register_adapter("a", ad, scale=s)
+        with_a = b.submit(prompt, 5, adapter="a").result(timeout=300)
+        shared_after_a = b.prefill_tokens_shared
+        base = b.submit(prompt, 5).result(timeout=300)
+        # the base request shared NOTHING (different prefix root)
+        assert b.prefill_tokens_shared == shared_after_a
+        again_a = b.submit(prompt, 5, adapter="a").result(timeout=300)
+        # the same-adapter repeat DID share its full page
+        assert b.prefill_tokens_shared == shared_after_a + 8
+    finally:
+        b.stop()
+    assert base == _solo(model, params, prompt, 5)
+    assert with_a == _solo(model, lora.merge(params, ad, s), prompt, 5)
+    assert again_a == with_a
