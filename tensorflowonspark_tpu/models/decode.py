@@ -96,11 +96,13 @@ def _jitted_step_all(decode_model):
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted_decode_body(decode_model, greedy, with_eos):
+def _jitted_decode_body(decode_model, greedy, with_eos, top_k=0,
+                        top_p=1.0):
     """One fused host-loop decode step: model apply + token pick + eos
-    masking in a single dispatch.  `greedy`/`with_eos` are static (part of
-    the cache key); params/temperature/eos_id are arguments so parameter
-    trees and sampling knobs don't trigger retraces."""
+    masking in a single dispatch.  `greedy`/`with_eos`/`top_k`/`top_p`
+    are static (part of the cache key — the default 0/1.0 compiles the
+    exact unfiltered program); params/temperature/eos_id are arguments
+    so parameter trees and sampling knobs don't trigger retraces."""
 
     # the cache (argnum 2) is donated: each step's dynamic_update_slice
     # then writes in place instead of copying hundreds of MB of kv per
@@ -115,8 +117,13 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
         if greedy:
             nxt = jnp.argmax(logits, axis=-1)
         else:
-            nxt = jax.random.categorical(rng_t, logits / temperature,
-                                         axis=-1)
+            scaled = logits / temperature
+            if top_k or top_p < 1.0:
+                B = logits.shape[0]
+                scaled = filter_top_k_p(
+                    scaled, jnp.full((B,), top_k, jnp.int32),
+                    jnp.full((B,), top_p, jnp.float32))
+            nxt = jax.random.categorical(rng_t, scaled, axis=-1)
         if with_eos:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
@@ -260,7 +267,8 @@ def _jitted_slot_prefill(slot_model):
     return prefill
 
 
-def _slot_step_body(slot_model, variables, toks, temps, seeds, ords):
+def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
+                    topks=None, topps=None):
     """Shared decode-step core: feed each row its current token, per-row
     greedy/sampled pick (`temps[b] == 0` = greedy).
 
@@ -272,7 +280,12 @@ def _slot_step_body(slot_model, variables, toks, temps, seeds, ords):
     the serving loop issues exactly ONE dispatch per token — on tunneled
     runtimes every extra per-step device op (a host fold_in, an h2d of
     tokens) costs a full round trip (measured ~200 ms/step with naive
-    per-step host traffic vs ~20 ms with resident chains)."""
+    per-step host traffic vs ~20 ms with resident chains).
+
+    ``topks``/``topps`` (presence is STATIC — omitting them compiles the
+    exact unfiltered program) apply per-row top-k / nucleus filtering to
+    the temperature-scaled logits (`filter_top_k_p`); disabled rows
+    (k=0, p=1.0) keep the full distribution."""
     logits, mut = slot_model.apply(variables, toks[:, None],
                                    mutable=["cache"])
     logits = logits[:, -1]
@@ -280,9 +293,10 @@ def _slot_step_body(slot_model, variables, toks, temps, seeds, ords):
     keys = jax.vmap(
         lambda s, t: jax.random.fold_in(jax.random.key(s), t))(
             seeds, ords)
-    sampled = jax.vmap(
-        lambda k, lg, T: jax.random.categorical(k, lg / T))(
-            keys, logits, jnp.maximum(temps, 1e-6))
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if topks is not None:
+        scaled = filter_top_k_p(scaled, topks, topps)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return (jnp.where(temps > 0, sampled, greedy), mut["cache"],
             ords + 1)
 
@@ -292,11 +306,12 @@ def _jitted_slot_step(slot_model):
     """One decode step over ALL slots (see `_slot_step_body`)."""
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def step(params, cache, toks, temps, seeds, ords):
+    def step(params, cache, toks, temps, seeds, ords,
+             topks=None, topps=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache},
-            toks, temps, seeds, ords)
+            toks, temps, seeds, ords, topks, topps)
 
     return step
 
@@ -327,12 +342,13 @@ def _jitted_slot_step_lora(slot_model):
     convention)."""
 
     @functools.partial(jax.jit, donate_argnums=(2,))
-    def step(params, lora, cache, toks, temps, seeds, ords, ids):
+    def step(params, lora, cache, toks, temps, seeds, ords, ids,
+             topks=None, topps=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache,
              "lora": _lora_with_ids(lora, ids)},
-            toks, temps, seeds, ords)
+            toks, temps, seeds, ords, topks, topps)
 
     return step
 
@@ -364,9 +380,11 @@ def _jitted_set_row(slot_model):
     resident arrays."""
 
     @jax.jit
-    def set_row(toks, temps, seeds, ords, row, tok, temp, seed, ordinal):
+    def set_row(toks, temps, seeds, ords, topks, topps, row, tok, temp,
+                seed, ordinal, topk, topp):
         return (toks.at[row].set(tok), temps.at[row].set(temp),
-                seeds.at[row].set(seed), ords.at[row].set(ordinal))
+                seeds.at[row].set(seed), ords.at[row].set(ordinal),
+                topks.at[row].set(topk), topps.at[row].set(topp))
 
     return set_row
 
@@ -544,6 +562,35 @@ def _set_cache_index(cache, value):
     return jax.tree_util.tree_map_with_path(set_leaf, cache)
 
 
+def filter_top_k_p(logits, top_k, top_p):
+    """Per-row top-k / nucleus (top-p) logit filtering, shared by EVERY
+    sampling path (solo `generate`/`generate_stream` and the serving
+    slot step) so cross-path token parity holds with filters on.
+
+    `logits` [n, V] are the (already temperature-scaled) sampling logits;
+    `top_k` [n] int32 (0 disables) keeps each row's k highest;
+    `top_p` [n] f32 (1.0 disables) keeps the smallest prefix of the
+    descending-sorted distribution whose cumulative probability reaches
+    p (the top token always survives).  Filtered entries become -inf.
+    HF-warper ordering: temperature -> top_k -> top_p — top-p operates
+    on the RENORMALIZED top-k survivors (k=2 probs [.5, .3, .2] ->
+    [.625, .375], so p=0.6 keeps only the top token), matching HF's
+    chained LogitsWarper semantics."""
+    V = logits.shape[-1]
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]            # [n, V] desc
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    pos = jnp.arange(V)[None, :]
+    in_k = pos < k[:, None]                  # positional top-k on sorted
+    probs = jax.nn.softmax(jnp.where(in_k, sorted_l, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep sorted position i while the renormalized mass BEFORE it is
+    # < p (the first token always passes; ties at the kth/threshold
+    # value keep together via the value comparison below)
+    keep_sorted = in_k & ((cum - probs) < top_p[:, None])
+    thr = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf), axis=-1)
+    return jnp.where(logits >= thr[:, None], logits, -jnp.inf)
+
+
 def step_keys(rng, n):
     """The sampling key schedule shared by EVERY decode path: the key for
     new-token ordinal ``t`` is ``fold_in(rng, t)``.  A pure function of
@@ -555,8 +602,33 @@ def step_keys(rng, n):
     return jax.vmap(lambda t: jax.random.fold_in(rng, t))(jnp.arange(n))
 
 
+def _solo_pick_fn(temperature, top_k, top_p):
+    """The solo-path token pick (shared by `generate`/`generate_stream`):
+    greedy argmax, or temperature-scaled (optionally top-k/top-p
+    filtered, `filter_top_k_p`) categorical — the same math the serving
+    slot step applies per row, so cross-path parity holds with filters
+    on."""
+    if not (isinstance(top_k, int) and top_k >= 0):
+        raise ValueError(f"top_k={top_k!r} must be an int >= 0")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p!r} must be in (0, 1]")
+
+    def pick(logits, rng_t):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1)
+        scaled = logits / temperature
+        if top_k or top_p < 1.0:
+            B = logits.shape[0]
+            scaled = filter_top_k_p(
+                scaled, jnp.full((B,), top_k, jnp.int32),
+                jnp.full((B,), top_p, jnp.float32))
+        return jax.random.categorical(rng_t, scaled, axis=-1)
+
+    return pick
+
+
 def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
-                    rng=None, eos_id=None):
+                    rng=None, eos_id=None, top_k=0, top_p=1.0):
     """Yield each new token as a host numpy [B] array as soon as it is
     decoded — the streaming form of `generate` (host-loop only: a
     per-token readback is inherent to streaming).
@@ -565,12 +637,14 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     arguments: both draw token ``t``'s noise from ``fold_in(rng, t)``
     (see `step_keys`), so a streamed sampling run reproduces the batch
     call.  The serving layer forwards these as server-sent events
-    (`serve`'s ``:generate`` with ``"stream": true``).
+    (`serve`'s ``:generate`` with ``"stream": true``).  ``top_k`` /
+    ``top_p`` filter the sampled distribution (ignored when greedy).
     """
     import numpy as np
 
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires `rng`")
+    pick = _solo_pick_fn(temperature, top_k, top_p)
     if max_new_tokens <= 0:
         return
     decode_model, cache = init_cache(model, prompt.shape[0])
@@ -581,12 +655,6 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
             f"exceeds max_seq_len {cfg.max_seq_len}")
 
     _step = _jitted_step(decode_model)
-
-    def pick(logits, rng_t):
-        if temperature > 0:
-            return jax.random.categorical(rng_t, logits / temperature,
-                                          axis=-1)
-        return jnp.argmax(logits, axis=-1)
 
     rng = rng if rng is not None else jax.random.key(0)
     keys = step_keys(rng, max_new_tokens)
@@ -599,7 +667,9 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     yield np.asarray(tok)
 
     body = _jitted_decode_body(decode_model, temperature == 0,
-                               eos_id is not None)
+                               eos_id is not None,
+                               top_k if temperature > 0 else 0,
+                               top_p if temperature > 0 else 1.0)
     temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
     eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
     for t in range(max_new_tokens - 1):
@@ -702,10 +772,12 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
 
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None, eos_id=None, loop="auto"):
+             rng=None, eos_id=None, loop="auto", top_k=0, top_p=1.0):
     """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
 
-    temperature=0 is greedy argmax; >0 samples from softmax(logits/T).
+    temperature=0 is greedy argmax; >0 samples from softmax(logits/T),
+    optionally top-k / nucleus filtered (``top_k``/``top_p``; ignored
+    when greedy — see `filter_top_k_p`).
     With `eos_id`, sequences that emit it keep emitting eos_id (shapes stay
     static; trim host-side).  Runs as prefill (one call over the prompt)
     + the token loop.
@@ -732,6 +804,7 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
 
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires `rng`")
+    pick = _solo_pick_fn(temperature, top_k, top_p)
     if loop not in ("auto", "scan", "host"):
         raise ValueError(f"loop={loop!r} not in ('auto', 'scan', 'host')")
     if loop == "auto":
@@ -764,11 +837,6 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     def step(tokens, cache):
         return _step(params, tokens, cache)
 
-    def pick(logits, rng):
-        if temperature > 0:
-            return jax.random.categorical(rng, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
-
     rng = rng if rng is not None else jax.random.key(0)
     keys = step_keys(rng, max_new_tokens)
     last_logits, cache = step(prompt, cache)                  # prefill
@@ -793,7 +861,9 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         # per-token readback) — steady-state cost is max(device step,
         # dispatch) instead of the while-loop's per-iteration overhead
         body = _jitted_decode_body(decode_model, temperature == 0,
-                                   eos_id is not None)
+                                   eos_id is not None,
+                                   top_k if temperature > 0 else 0,
+                                   top_p if temperature > 0 else 1.0)
         temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
         eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
         toks = [tok]

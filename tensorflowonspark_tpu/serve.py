@@ -576,6 +576,11 @@ class ContinuousBatcher:
         self._temps = jnp.zeros((n_slots,), jnp.float32)
         self._seeds = jnp.zeros((n_slots,), jnp.int32)
         self._ords = jnp.zeros((n_slots,), jnp.int32)
+        # per-row sampling filters (top-k / nucleus); the step only pays
+        # the filter program while a filtered row is active
+        self._topks = jnp.zeros((n_slots,), jnp.int32)
+        self._topps = jnp.ones((n_slots,), jnp.float32)
+        self._n_filtered = 0
         self._steps = 0
         self._spec_rounds = 0
         self._dead = None     # set to the fatal exception if the loop dies
@@ -711,10 +716,10 @@ class ContinuousBatcher:
         self._dead = self._dead or err
         adm, self._admitting = self._admitting, None
         if adm is not None:
-            adm["item"][0]._fail(err)
+            adm["item"]["h"]._fail(err)
         parked, self._parked = self._parked, None
         if parked is not None:
-            parked[1][0]._fail(err)
+            parked[1]["h"]._fail(err)
         for s in self._slots:
             if s is not None:
                 s["handle"]._fail(err)
@@ -722,13 +727,33 @@ class ContinuousBatcher:
         self._drain_pending(err)
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
-               adapter=None):
+               adapter=None, top_k=0, top_p=1.0, stop=None):
         if self._dead is not None:
             raise RuntimeError(f"batcher died: {self._dead}")
         if adapter is not None and not self.lora_rank:
             raise ValueError(
                 "this server has no LoRA bank (start it with "
                 "--generate_lora_rank and --generate_lora)")
+        if not (isinstance(top_k, int) and 0 <= top_k < (1 << 31)):
+            # the upper bound matters: these become int32 device scalars
+            # on the single driver thread, where an overflow would brick
+            # the whole engine instead of 400ing one request
+            raise ValueError(f"top_k={top_k!r} must be an int32 >= 0")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p={top_p!r} must be in (0, 1]")
+        if (top_k or top_p < 1.0) and temperature <= 0:
+            raise ValueError("top_k/top_p filter the SAMPLED distribution "
+                             "— they require temperature > 0")
+        stops = []
+        for st in (stop or []):
+            if (not isinstance(st, (list, tuple)) or not st
+                    or not all(isinstance(t, int) for t in st)):
+                raise ValueError('"stop" must be a list of non-empty '
+                                 "token-id lists")
+            stops.append(list(st))
+        if len(stops) > 16 or any(len(st) > 32 for st in stops):
+            raise ValueError("at most 16 stop sequences of at most 32 "
+                             "tokens each")
         # greedy requests on a draft-equipped server need draft_k cache
         # headroom for the speculative verify overshoot; sampled requests
         # never speculate (and disable spec rounds while active), so they
@@ -766,8 +791,11 @@ class ContinuousBatcher:
         h = SlotHandle(prompt)
         if aidx:
             h._on_done = lambda idx=aidx: self._release_adapter(idx)
-        self._pending.put((h, list(prompt), max_new, float(temperature),
-                           eos_id, int(seed), aidx))
+        self._pending.put({
+            "h": h, "prompt": list(prompt), "max_new": max_new,
+            "temp": float(temperature), "eos": eos_id, "seed": int(seed),
+            "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
+            "stops": stops})
         if self._dead is not None:
             # the loop may have died between the check above and the put
             # (its death-drain already ran): fail whatever is queued,
@@ -783,21 +811,39 @@ class ContinuousBatcher:
                 item = self._pending.get_nowait()
             except queue_mod.Empty:
                 return
-            item[0]._fail(err)
+            item["h"]._fail(err)
 
     # ---- device loop (single driver thread owns the cache) --------------
 
-    def _pick_first(self, logits_row, temperature, seed):
+    def _pick_first(self, logits_row, temperature, seed, top_k=0,
+                    top_p=1.0):
         import jax
         import jax.numpy as jnp
 
+        from .models import decode as decode_mod
+
         if temperature > 0:
             # ordinal 0 of the shared schedule (decode.step_keys): the
-            # first sampled token matches a solo generate(rng=key(seed))
+            # first sampled token matches a solo generate(rng=key(seed)),
+            # including its top-k/top-p filter
+            scaled = logits_row[None, :] / temperature
+            if top_k or top_p < 1.0:
+                scaled = decode_mod.filter_top_k_p(
+                    scaled, jnp.asarray([top_k], jnp.int32),
+                    jnp.asarray([top_p], jnp.float32))
             return int(jax.random.categorical(
-                jax.random.fold_in(jax.random.key(seed), 0),
-                logits_row / temperature))
+                jax.random.fold_in(jax.random.key(seed), 0), scaled[0]))
         return int(jnp.argmax(logits_row))
+
+    @staticmethod
+    def _hit_stop(seq, stops, gen_start):
+        """True when `seq` ends with any of the request's stop token
+        sequences, matched ENTIRELY within the generated region (a stop
+        straddling the prompt/generation boundary does not count —
+        standard serving semantics).  Checked after every appended
+        token; matched stop tokens stay in the output, like eos."""
+        return any(len(seq) - len(st) >= gen_start
+                   and seq[-len(st):] == st for st in stops)
 
     def _prefill_chunk_sizes(self, length):
         """Split a prompt into chunk lengths: full `prefill_chunk` pieces
@@ -897,10 +943,10 @@ class ContinuousBatcher:
         rest; the caller parks the item until pages free."""
         import jax.numpy as jnp
 
-        prompt, max_new, temp = item[1], item[2], item[3]
+        prompt, max_new, temp = item["prompt"], item["max_new"], item["temp"]
         need = self._pages_needed(len(prompt), max_new, temperature=temp)
         shared, keys = self._prefix_lookup(
-            prompt, root=self._lora_prefix_root(item[6]))
+            prompt, root=self._lora_prefix_root(item["aidx"]))
         # hold refs BEFORE any eviction: rc==0 shared pages would
         # otherwise be evictable by our own eviction pass, get re-popped
         # as "fresh", and end up mapped twice in this row's table
@@ -958,6 +1004,9 @@ class ContinuousBatcher:
         pages a later owner holds (paged mode; no-op otherwise)."""
         import jax.numpy as jnp
 
+        s = self._slots[row]
+        if s is not None and s.get("filtered"):
+            self._n_filtered -= 1
         self._slots[row] = None
         if self.lora_rank:
             # back to the null adapter: the freed row's garbage decode
@@ -978,7 +1027,7 @@ class ContinuousBatcher:
                 self._sink_entries)
 
     def _start_admission(self, row, item):
-        h, prompt, max_new, temp, eos_id, seed, aidx = item
+        h, prompt = item["h"], item["prompt"]
         if h.cancelled.is_set():        # client gone before admission
             h._finish(list(prompt))
             return
@@ -1011,7 +1060,10 @@ class ContinuousBatcher:
         import jax.numpy as jnp
 
         adm = self._admitting
-        h, prompt, max_new, temp, eos_id, seed, aidx = adm["item"]
+        item = adm["item"]
+        h, prompt, max_new = item["h"], item["prompt"], item["max_new"]
+        temp, eos_id, seed = item["temp"], item["eos"], item["seed"]
+        aidx = item["aidx"]
         row, off = adm["row"], adm["offset"]
         if h.cancelled.is_set():
             self._admitting = None
@@ -1063,25 +1115,35 @@ class ContinuousBatcher:
             # this row's full-prefix pages now hold computed kv: publish
             # them so later identical prompts skip their prefill
             self._register_prefix_pages(row)
-        tok = self._pick_first(logits[0], temp, seed)
+        topk, topp = item["topk"], item["topp"]
+        stops = item["stops"]
+        tok = self._pick_first(logits[0], temp, seed, topk, topp)
         h.tokens.put(tok)
         seq = prompt + [tok]
-        if max_new <= 1 or (eos_id is not None and tok == eos_id):
+        if (max_new <= 1 or (eos_id is not None and tok == eos_id)
+                or self._hit_stop(seq, stops, len(prompt))):
             self._free_row(row)
             h._finish(seq)
             self.requests += 1
             return
         self._gen[row] += 1
-        self._toks, self._temps, self._seeds, self._ords = self._set_row(
+        (self._toks, self._temps, self._seeds, self._ords,
+         self._topks, self._topps) = self._set_row(
             self._toks, self._temps, self._seeds, self._ords,
+            self._topks, self._topps,
             jnp.asarray(row, jnp.int32), jnp.asarray(tok, jnp.int32),
             jnp.asarray(temp, jnp.float32), jnp.asarray(seed, jnp.int32),
-            jnp.asarray(1, jnp.int32))
+            jnp.asarray(1, jnp.int32), jnp.asarray(topk, jnp.int32),
+            jnp.asarray(topp, jnp.float32))
         if self.lora_rank:
             self._lora_ids = self._lora_ids.at[row].set(aidx)
+        filtered = bool(topk or topp < 1.0)
+        if filtered:
+            self._n_filtered += 1
         self._slots[row] = {"handle": h, "seq": seq,
                             "remaining": max_new - 1, "temp": temp,
-                            "eos": eos_id}
+                            "eos": eos_id, "stops": stops,
+                            "plen": len(prompt), "filtered": filtered}
 
     def _admit(self, block=False):
         import queue as queue_mod
@@ -1148,8 +1210,10 @@ class ContinuousBatcher:
                     s["seq"].append(tok)
                     s["remaining"] -= 1
                     s["handle"].tokens.put(tok)
-                    if s["remaining"] <= 0 or (s["eos"] is not None
-                                               and tok == s["eos"]):
+                    if (s["remaining"] <= 0
+                            or (s["eos"] is not None and tok == s["eos"])
+                            or self._hit_stop(s["seq"], s["stops"],
+                                              s["plen"])):
                         # retire BEFORE finishing: a waiter woken by
                         # result() must observe consistent pool
                         # accounting; in-flight steps decode garbage
@@ -1174,14 +1238,19 @@ class ContinuousBatcher:
             self._toks = nxt
             self._spec_rounds += 1
             return (t_next, commit, tuple(self._gen))
+        # the filter arrays are passed only while a filtered row is
+        # active: their PRESENCE is static under jit, so unfiltered
+        # workloads run the exact pre-filter program (no per-step sort)
+        extra = ((self._topks, self._topps) if self._n_filtered else ())
         if self.lora_rank:
             nxt, self._cache, self._ords = self._step(
                 self.params, self._lora_banks, self._cache, self._toks,
-                self._temps, self._seeds, self._ords, self._lora_ids)
+                self._temps, self._seeds, self._ords, self._lora_ids,
+                *extra)
         else:
             nxt, self._cache, self._ords = self._step(
                 self.params, self._cache, self._toks, self._temps,
-                self._seeds, self._ords)
+                self._seeds, self._ords, *extra)
         self._toks = nxt
         self._steps += 1
         return (nxt, None, tuple(self._gen))
@@ -1254,10 +1323,10 @@ class ContinuousBatcher:
             self._dead = e
             adm, self._admitting = self._admitting, None
             if adm is not None:
-                adm["item"][0]._fail(e)
+                adm["item"]["h"]._fail(e)
             parked, self._parked = self._parked, None
             if parked is not None:
-                parked[1][0]._fail(e)
+                parked[1]["h"]._fail(e)
             for s in self._slots:
                 if s is not None:
                     s["handle"]._fail(e)
@@ -1422,7 +1491,28 @@ class GenerateService:
         if adapter is not None and not isinstance(adapter, str):
             raise ValueError('"adapter" must be a registered adapter name '
                              "(string)")
-        return inputs, max_new, temperature, eos_id, seed, adapter
+        top_k = req.get("top_k", 0)
+        if not (isinstance(top_k, int) and 0 <= top_k < self._I32):
+            raise ValueError('"top_k" must be an int >= 0')
+        top_p = float(req.get("top_p", 1.0))
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError('"top_p" must be in (0, 1]')
+        if (top_k or top_p < 1.0) and temperature <= 0:
+            raise ValueError('"top_k"/"top_p" filter the sampled '
+                             'distribution — set "temperature" > 0')
+        stop = req.get("stop")
+        if stop is not None:
+            if (not isinstance(stop, list) or len(stop) > 16
+                    or not all(isinstance(st, list) and st and len(st) <= 32
+                               and all(isinstance(t, int)
+                                       and -self._I32 <= t < self._I32
+                                       for t in st)
+                               for st in stop)):
+                raise ValueError(
+                    '"stop" must be a list (<= 16) of non-empty token-id '
+                    "lists (<= 32 tokens each)")
+        return (inputs, max_new, temperature, eos_id, seed, adapter,
+                top_k, top_p, stop)
 
     def _prompt_seeds(self, n, seed, temperature):
         """Per-prompt seeds: explicit seed s -> s, s+1, ... (documented
@@ -1442,14 +1532,15 @@ class GenerateService:
         ``{"done": true, "output": [...full sequence...]}``."""
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
-        inputs, max_new, temperature, eos_id, seed, adapter = \
-            self._validate(req)
+        (inputs, max_new, temperature, eos_id, seed, adapter,
+         top_k, top_p, stop) = self._validate(req)
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
         seed = self._prompt_seeds(1, seed, temperature)[0]
         h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
-                                eos_id=eos_id, seed=seed, adapter=adapter)
+                                eos_id=eos_id, seed=seed, adapter=adapter,
+                                top_k=top_k, top_p=top_p, stop=stop)
         self.requests += 1
 
         def slot_events():
@@ -1468,8 +1559,8 @@ class GenerateService:
         return slot_events()
 
     def generate(self, req):
-        inputs, max_new, temperature, eos_id, seed, adapter = \
-            self._validate(req)
+        (inputs, max_new, temperature, eos_id, seed, adapter,
+         top_k, top_p, stop) = self._validate(req)
         seeds = self._prompt_seeds(len(inputs), seed, temperature)
         # every prompt becomes a slot request; they decode concurrently
         # with each other AND with other HTTP requests' prompts (no
@@ -1479,7 +1570,8 @@ class GenerateService:
             for p, s in zip(inputs, seeds):
                 handles.append(self.batcher.submit(
                     p, max_new, temperature=temperature, eos_id=eos_id,
-                    seed=s, adapter=adapter))
+                    seed=s, adapter=adapter, top_k=top_k, top_p=top_p,
+                    stop=stop))
             outs = [h.result(timeout=self.timeout_s) for h in handles]
         except Exception:
             # a failed request (one prompt too long, a timeout) must not

@@ -68,6 +68,7 @@ SLOW_FILES = {
     # moved round 5 to keep the fast tier under its 90 s budget as the
     # round's layout/sampling tests accreted onto fast files
     "test_ring_attention.py",   # 31 s
+    "test_sampling_controls.py",  # ~60 s — slot engines + decode compiles
     "test_serve.py",            # 68 s — HTTP servers + decode compiles
     "test_slots.py",            # 31 s — slot-decode parity compiles
     # (both grew past the fast budget with the round-4 continuous-

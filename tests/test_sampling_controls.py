@@ -1,0 +1,258 @@
+"""Sampling controls: per-request top-k / nucleus (top-p) filtering and
+stop sequences (net-new beyond the reference — its serving is batch
+feed-forward only).
+
+The contracts pinned here:
+
+- filters apply IDENTICALLY in solo `decode.generate` and serving slots
+  (one shared `filter_top_k_p`, same key schedule) — cross-path parity
+  holds with filters on;
+- `top_k=1` collapses sampling to greedy; disabled filters (k=0, p=1.0)
+  reproduce the unfiltered program's tokens even while OTHER rows in
+  the batch are filtered;
+- stop sequences end a request right after the matched tokens, in both
+  the step path and the prefill first-token path.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, **kw):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host", **kw)
+    return np.asarray(out)[0].tolist()
+
+
+def test_filter_top_k_p_semantics():
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0],
+                          [3.0, 2.0, 1.0, 0.0]], jnp.float32)
+    # k=2 keeps the two largest per row
+    out = decode.filter_top_k_p(logits, jnp.asarray([2, 2]),
+                                jnp.asarray([1.0, 1.0]))
+    assert np.isneginf(np.asarray(out)[0, :2]).all()
+    assert np.asarray(out)[0, 2:].tolist() == [2.0, 3.0]
+    assert np.isneginf(np.asarray(out)[1, 2:]).all()
+    # disabled filters pass logits through EXACTLY
+    out = decode.filter_top_k_p(logits, jnp.asarray([0, 0]),
+                                jnp.asarray([1.0, 1.0]))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(logits))
+    # tiny top_p keeps only the argmax
+    out = decode.filter_top_k_p(logits, jnp.asarray([0, 0]),
+                                jnp.asarray([1e-6, 1e-6]))
+    finite = np.isfinite(np.asarray(out))
+    assert finite.sum(axis=1).tolist() == [1, 1]
+    assert np.asarray(out)[0, 3] == 3.0 and np.asarray(out)[1, 0] == 3.0
+    # HF-warper composition: top_p operates on the RENORMALIZED top-k
+    # survivors.  probs [.5, .3, .2] -> k=2 renormalizes to [.625, .375]
+    # -> p=0.6 keeps only the top token (the unrenormalized composition
+    # would keep two)
+    lg = jnp.log(jnp.asarray([[0.5, 0.3, 0.2]], jnp.float32))
+    out = decode.filter_top_k_p(lg, jnp.asarray([2]), jnp.asarray([0.6]))
+    assert np.isfinite(np.asarray(out)).sum() == 1
+    assert np.isfinite(np.asarray(out)[0, 0])
+
+
+def test_top_k1_matches_greedy_and_solo_matches_slots(lm):
+    model, params = lm
+    prompt = [1, 2, 3]
+    greedy = _solo(model, params, prompt, 6)
+    k1 = _solo(model, params, prompt, 6, temperature=0.9,
+               rng=jax.random.key(7), top_k=1)
+    assert k1 == greedy
+    # filtered sampling: solo == slots (same seed/ordinal schedule)
+    solo = _solo(model, params, prompt, 6, temperature=0.9,
+                 rng=jax.random.key(5), top_k=5, top_p=0.9)
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8)
+    try:
+        got = b.submit(prompt, 6, temperature=0.9, seed=5, top_k=5,
+                       top_p=0.9).result(timeout=300)
+    finally:
+        b.stop()
+    assert got == solo
+
+
+def test_unfiltered_rows_keep_their_tokens_next_to_filtered(lm):
+    # while a filtered row is active the step runs the filter program;
+    # rows with DISABLED filters must still match their solo reference
+    model, params = lm
+    b = serve.ContinuousBatcher(model, params, n_slots=3, read_chunk=1,
+                                prefill_chunk=8)
+    try:
+        hs = [b.submit([1, 2, 3], 6, temperature=0.9, seed=11, top_k=3),
+              b.submit([4, 5, 6], 6, temperature=0.9, seed=12),
+              b.submit([7, 8], 6)]
+        got = [h.result(timeout=300) for h in hs]
+    finally:
+        b.stop()
+    assert got[0] == _solo(model, params, [1, 2, 3], 6, temperature=0.9,
+                           rng=jax.random.key(11), top_k=3)
+    assert got[1] == _solo(model, params, [4, 5, 6], 6, temperature=0.9,
+                           rng=jax.random.key(12))
+    assert got[2] == _solo(model, params, [7, 8], 6)
+
+
+def test_stream_matches_generate_with_filters(lm):
+    model, params = lm
+    ref = _solo(model, params, [3, 1, 4], 8, temperature=0.8,
+                rng=jax.random.key(9), top_k=4)
+    streamed = [int(t[0]) for t in decode.generate_stream(
+        model, params, jnp.asarray([[3, 1, 4]], jnp.int32), 8,
+        temperature=0.8, rng=jax.random.key(9), top_k=4)]
+    assert [3, 1, 4] + streamed == ref
+
+
+def test_stop_sequences_end_the_request(lm):
+    model, params = lm
+    prompt = [1, 2, 3]
+    full = _solo(model, params, prompt, 10)
+    new = full[len(prompt):]
+    stop = new[2:4]                       # 2-token stop
+
+    def first_stop_end(seq, start, st):
+        # earliest position where seq[:i] ends with st matched ENTIRELY
+        # in the generated region — the tiny model repeats tokens, so
+        # the stop may match before the slice it was cut from
+        for i in range(start + len(st), len(seq) + 1):
+            if seq[:i][-len(st):] == st:
+                return i
+        return len(seq)
+
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8)
+    try:
+        got = b.submit(prompt, 10, stop=[stop]).result(timeout=300)
+        # a stop that matches the FIRST token retires at admission
+        first = b.submit(prompt, 10, stop=[[new[0]]]).result(timeout=300)
+    finally:
+        b.stop()
+    assert got == full[:first_stop_end(full, len(prompt), stop)]
+    assert got[-2:] == stop                       # stop tokens included
+    assert first == prompt + [new[0]]
+
+
+def test_stop_never_matches_across_prompt_boundary(lm):
+    # a stop whose match would straddle prompt/generation must not fire:
+    # [prompt[-1], first_new] ends the sequence after one token ONLY if
+    # it re-appears fully inside the generated region
+    model, params = lm
+    prompt = [1, 2, 3]
+    full = _solo(model, params, prompt, 8)
+    new = full[len(prompt):]
+    straddle = [prompt[-1], new[0]]
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8)
+    try:
+        got = b.submit(prompt, 8, stop=[straddle]).result(timeout=300)
+    finally:
+        b.stop()
+    # expected: cut only at a match fully inside the generated tokens
+    expect = full
+    for i in range(len(prompt) + 2, len(full) + 1):
+        if full[i - 2:i] == straddle:
+            expect = full[:i]
+            break
+    assert got == expect
+    assert len(got) > len(prompt) + 1      # did NOT fire on token one
+
+
+def test_validation_rules(lm):
+    model, params = lm
+    b = serve.ContinuousBatcher(model, params, n_slots=2)
+    try:
+        with pytest.raises(ValueError, match="temperature"):
+            b.submit([1, 2], 4, top_k=3)          # filter without sampling
+        with pytest.raises(ValueError, match="top_p"):
+            b.submit([1, 2], 4, temperature=0.9, top_p=0.0)
+        with pytest.raises(ValueError, match="stop"):
+            b.submit([1, 2], 4, stop=[[]])
+        with pytest.raises(ValueError, match="16 stop"):
+            b.submit([1, 2], 4, stop=[[1]] * 17)
+    finally:
+        b.stop()
+
+
+def test_http_filters_and_stop(tmp_path):
+    import json
+    import threading
+    import urllib.request
+
+    from tensorflowonspark_tpu import export as export_mod
+
+    cfg_kw = dict(vocab_size=41, d_model=16, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=32, max_seq_len=32, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export_mod.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "lm"), "--port", "0",
+         "--generate_slots", "2"])
+    srv, svc = serve.make_server(args)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+
+    def post(payload):
+        port = srv.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/default:generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, out = post({"inputs": [[1, 2, 3]], "max_new_tokens": 6,
+                          "temperature": 0.9, "seed": 3, "top_k": 4,
+                          "top_p": 0.95})
+        assert code == 200
+        ref = _solo(model, params, [1, 2, 3], 6, temperature=0.9,
+                    rng=jax.random.key(3), top_k=4, top_p=0.95)
+        assert out["outputs"][0] == ref
+        # stop sequence over HTTP (the tiny model repeats tokens, so cut
+        # at the FIRST position where the stop token appears)
+        full = _solo(model, params, [5, 6], 6)
+        stop_tok = full[3]
+        cut = next(i for i in range(3, len(full) + 1)
+                   if full[i - 1] == stop_tok)
+        code, out = post({"inputs": [[5, 6]], "max_new_tokens": 6,
+                          "stop": [[stop_tok]]})
+        assert code == 200
+        assert out["outputs"][0] == full[:cut]
+        # validation 400s
+        for bad in ({"inputs": [[1]], "top_k": 2},          # no sampling
+                    {"inputs": [[1]], "temperature": 1.0, "top_p": 2.0},
+                    {"inputs": [[1]], "stop": [[]]},
+                    {"inputs": [[1]], "stop": "x"}):
+            code, out = post({"max_new_tokens": 2, **bad})
+            assert code == 400, (bad, out)
+    finally:
+        srv.shutdown()
+        srv.server_close()
