@@ -96,37 +96,42 @@ def _jitted_step_all(decode_model):
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted_decode_body(decode_model, greedy, with_eos, top_k=0,
-                        top_p=1.0):
+def _jitted_decode_body(decode_model, greedy, with_eos):
     """One fused host-loop decode step: model apply + token pick + eos
-    masking in a single dispatch.  `greedy`/`with_eos`/`top_k`/`top_p`
-    are static (part of the cache key — the default 0/1.0 compiles the
-    exact unfiltered program); params/temperature/eos_id are arguments
-    so parameter trees and sampling knobs don't trigger retraces."""
+    masking in a single dispatch.  `greedy`/`with_eos` are static (part
+    of the cache key); params/temperature/eos_id are arguments so
+    parameter trees don't trigger retraces.  The sampling-control
+    arguments (``topks``/``topps`` filter arrays, ``seen``/``rep``
+    repetition-penalty state) are PRESENCE-static like the slot step's:
+    omitted -> the exact plain program; passed -> dynamic device arrays,
+    so sweeping top_p values (or penalty rates) never recompiles."""
 
     # the cache (argnum 2) is donated: each step's dynamic_update_slice
     # then writes in place instead of copying hundreds of MB of kv per
     # token; the host loop rebinds the returned cache and never touches
     # the donated one again
     @functools.partial(jax.jit, donate_argnums=(2,))
-    def body(params, tok, cache, done, rng_t, temperature, eos_id):
+    def body(params, tok, cache, done, rng_t, temperature, eos_id,
+             topks=None, topps=None, seen=None, rep=None):
         logits, mut = decode_model.apply(
             {"params": _params_view(params), "cache": cache}, tok[:, None],
             mutable=["cache"])
         logits = logits[:, -1]
+        if seen is not None:
+            seen = seen.at[jnp.arange(tok.shape[0]), tok].set(1)
+            logits = apply_repetition_penalty(logits, seen, rep)
         if greedy:
             nxt = jnp.argmax(logits, axis=-1)
         else:
             scaled = logits / temperature
-            if top_k or top_p < 1.0:
-                B = logits.shape[0]
-                scaled = filter_top_k_p(
-                    scaled, jnp.full((B,), top_k, jnp.int32),
-                    jnp.full((B,), top_p, jnp.float32))
+            if topks is not None:
+                scaled = filter_top_k_p(scaled, topks, topps)
             nxt = jax.random.categorical(rng_t, scaled, axis=-1)
         if with_eos:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
+        if seen is not None:
+            return nxt, mut["cache"], done, seen
         return nxt, mut["cache"], done
 
     return body
@@ -268,7 +273,7 @@ def _jitted_slot_prefill(slot_model):
 
 
 def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
-                    topks=None, topps=None):
+                    topks=None, topps=None, seen=None, reps=None):
     """Shared decode-step core: feed each row its current token, per-row
     greedy/sampled pick (`temps[b] == 0` = greedy).
 
@@ -285,10 +290,17 @@ def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
     ``topks``/``topps`` (presence is STATIC — omitting them compiles the
     exact unfiltered program) apply per-row top-k / nucleus filtering to
     the temperature-scaled logits (`filter_top_k_p`); disabled rows
-    (k=0, p=1.0) keep the full distribution."""
+    (k=0, p=1.0) keep the full distribution.  ``seen``/``reps`` (also
+    statically present) apply per-row repetition penalty to the RAW
+    logits first (`apply_repetition_penalty`; the fed token joins `seen`
+    before the penalty, and the updated mask is returned as an extra
+    output)."""
     logits, mut = slot_model.apply(variables, toks[:, None],
                                    mutable=["cache"])
     logits = logits[:, -1]
+    if seen is not None:
+        seen = seen.at[jnp.arange(toks.shape[0]), toks].set(1)
+        logits = apply_repetition_penalty(logits, seen, reps)
     greedy = jnp.argmax(logits, axis=-1)
     keys = jax.vmap(
         lambda s, t: jax.random.fold_in(jax.random.key(s), t))(
@@ -297,8 +309,8 @@ def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
     if topks is not None:
         scaled = filter_top_k_p(scaled, topks, topps)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-    return (jnp.where(temps > 0, sampled, greedy), mut["cache"],
-            ords + 1)
+    out = (jnp.where(temps > 0, sampled, greedy), mut["cache"], ords + 1)
+    return out + (seen,) if seen is not None else out
 
 
 @functools.lru_cache(maxsize=32)
@@ -307,11 +319,11 @@ def _jitted_slot_step(slot_model):
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step(params, cache, toks, temps, seeds, ords,
-             topks=None, topps=None):
+             topks=None, topps=None, seen=None, reps=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache},
-            toks, temps, seeds, ords, topks, topps)
+            toks, temps, seeds, ords, topks, topps, seen, reps)
 
     return step
 
@@ -343,12 +355,12 @@ def _jitted_slot_step_lora(slot_model):
 
     @functools.partial(jax.jit, donate_argnums=(2,))
     def step(params, lora, cache, toks, temps, seeds, ords, ids,
-             topks=None, topps=None):
+             topks=None, topps=None, seen=None, reps=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache,
              "lora": _lora_with_ids(lora, ids)},
-            toks, temps, seeds, ords, topks, topps)
+            toks, temps, seeds, ords, topks, topps, seen, reps)
 
     return step
 
@@ -562,6 +574,27 @@ def _set_cache_index(cache, value):
     return jax.tree_util.tree_map_with_path(set_leaf, cache)
 
 
+def apply_repetition_penalty(logits, seen, rep):
+    """HF-style repetition penalty, shared by every decode path: logits
+    of tokens already seen (prompt + previously generated — `seen`
+    [n, V] nonzero marks them) divide by ``rep`` when positive and
+    multiply when negative (`rep` [n] f32; 1.0 = disabled).  Runs on the
+    RAW logits before temperature/top-k/top-p (HF processor-then-warper
+    ordering), so it shifts greedy argmax too."""
+    pen = jnp.where(logits > 0, logits / rep[:, None],
+                    logits * rep[:, None])
+    return jnp.where(seen > 0, pen, logits)
+
+
+def seen_from_prompt(prompt, vocab_size):
+    """[B, V] int8 presence mask of the prompt tokens — the initial
+    `seen` state of `apply_repetition_penalty` (each decode path then
+    marks tokens as it feeds them)."""
+    B = prompt.shape[0]
+    seen = jnp.zeros((B, vocab_size), jnp.int8)
+    return seen.at[jnp.arange(B)[:, None], prompt].set(1)
+
+
 def filter_top_k_p(logits, top_k, top_p):
     """Per-row top-k / nucleus (top-p) logit filtering, shared by EVERY
     sampling path (solo `generate`/`generate_stream` and the serving
@@ -602,6 +635,27 @@ def step_keys(rng, n):
     return jax.vmap(lambda t: jax.random.fold_in(rng, t))(jnp.arange(n))
 
 
+def _check_penalty(repetition_penalty):
+    """Validate a repetition penalty; True when active.  The finite cap
+    matters: rep=inf times a zero-valued seen logit is NaN, which would
+    poison the whole row's pick instead of erroring at the boundary."""
+    if not 0 < repetition_penalty <= 1e6:
+        raise ValueError(
+            f"repetition_penalty={repetition_penalty!r} must be in "
+            "(0, 1e6] (1.0 disables; >1 discourages repeats)")
+    return repetition_penalty != 1.0
+
+
+def _body_control_kwargs(batch, temperature, top_k, top_p):
+    """Dynamic top-k/top-p arrays for `_jitted_decode_body` (empty when
+    the filter is off — presence is the only static bit, so sweeping
+    filter values never recompiles)."""
+    if temperature > 0 and (top_k or top_p < 1.0):
+        return {"topks": jnp.full((batch,), top_k, jnp.int32),
+                "topps": jnp.full((batch,), top_p, jnp.float32)}
+    return {}
+
+
 def _solo_pick_fn(temperature, top_k, top_p):
     """The solo-path token pick (shared by `generate`/`generate_stream`):
     greedy argmax, or temperature-scaled (optionally top-k/top-p
@@ -628,7 +682,8 @@ def _solo_pick_fn(temperature, top_k, top_p):
 
 
 def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
-                    rng=None, eos_id=None, top_k=0, top_p=1.0):
+                    rng=None, eos_id=None, top_k=0, top_p=1.0,
+                    repetition_penalty=1.0):
     """Yield each new token as a host numpy [B] array as soon as it is
     decoded — the streaming form of `generate` (host-loop only: a
     per-token readback is inherent to streaming).
@@ -645,6 +700,7 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires `rng`")
     pick = _solo_pick_fn(temperature, top_k, top_p)
+    penalized = _check_penalty(repetition_penalty)
     if max_new_tokens <= 0:
         return
     decode_model, cache = init_cache(model, prompt.shape[0])
@@ -659,6 +715,11 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     rng = rng if rng is not None else jax.random.key(0)
     keys = step_keys(rng, max_new_tokens)
     last_logits, cache = _step(params, prompt, cache)         # prefill
+    seen = rep = None
+    if penalized:
+        seen = seen_from_prompt(prompt, cfg.vocab_size)
+        rep = jnp.full((prompt.shape[0],), repetition_penalty, jnp.float32)
+        last_logits = apply_repetition_penalty(last_logits, seen, rep)
     tok = pick(last_logits, keys[0])
     done = jnp.zeros(tok.shape, bool)
     if eos_id is not None:
@@ -667,14 +728,18 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     yield np.asarray(tok)
 
     body = _jitted_decode_body(decode_model, temperature == 0,
-                               eos_id is not None,
-                               top_k if temperature > 0 else 0,
-                               top_p if temperature > 0 else 1.0)
+                               eos_id is not None)
+    bkw = _body_control_kwargs(prompt.shape[0], temperature, top_k, top_p)
     temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
     eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
     for t in range(max_new_tokens - 1):
-        tok, cache, done = body(params, tok, cache, done, keys[t + 1],
-                                temp, eos)
+        if penalized:
+            tok, cache, done, seen = body(params, tok, cache, done,
+                                          keys[t + 1], temp, eos,
+                                          seen=seen, rep=rep, **bkw)
+        else:
+            tok, cache, done = body(params, tok, cache, done, keys[t + 1],
+                                    temp, eos, **bkw)
         yield np.asarray(tok)
 
 
@@ -772,12 +837,16 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
 
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None, eos_id=None, loop="auto", top_k=0, top_p=1.0):
+             rng=None, eos_id=None, loop="auto", top_k=0, top_p=1.0,
+             repetition_penalty=1.0):
     """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
 
     temperature=0 is greedy argmax; >0 samples from softmax(logits/T),
     optionally top-k / nucleus filtered (``top_k``/``top_p``; ignored
-    when greedy — see `filter_top_k_p`).
+    when greedy — see `filter_top_k_p`).  ``repetition_penalty`` > 1
+    discourages tokens already in the prompt or generated so far
+    (HF processor semantics — applied to the raw logits before
+    temperature, so it shifts greedy decoding too).
     With `eos_id`, sequences that emit it keep emitting eos_id (shapes stay
     static; trim host-side).  Runs as prefill (one call over the prompt)
     + the token loop.
@@ -805,6 +874,7 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires `rng`")
     pick = _solo_pick_fn(temperature, top_k, top_p)
+    penalized = _check_penalty(repetition_penalty)
     if loop not in ("auto", "scan", "host"):
         raise ValueError(f"loop={loop!r} not in ('auto', 'scan', 'host')")
     if loop == "auto":
@@ -840,6 +910,11 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     rng = rng if rng is not None else jax.random.key(0)
     keys = step_keys(rng, max_new_tokens)
     last_logits, cache = step(prompt, cache)                  # prefill
+    seen = rep = None
+    if penalized:
+        seen = seen_from_prompt(prompt, cfg.vocab_size)
+        rep = jnp.full((prompt.shape[0],), repetition_penalty, jnp.float32)
+        last_logits = apply_repetition_penalty(last_logits, seen, rep)
     tok = pick(last_logits, keys[0])                          # [B]
     done = jnp.zeros(tok.shape, bool)
     if eos_id is not None:
@@ -847,13 +922,16 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         tok = jnp.where(done, eos_id, tok)
 
     def scan_body(carry, rng_t):
-        tok, cache, done = carry
+        tok, cache, done, seen = carry
         logits, cache = step(tok[:, None], cache)
+        if penalized:
+            seen = seen.at[jnp.arange(tok.shape[0]), tok].set(1)
+            logits = apply_repetition_penalty(logits, seen, rep)
         nxt = pick(logits, rng_t)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
-        return (nxt, cache, done), nxt
+        return (nxt, cache, done, seen), nxt
 
     if loop == "host":
         # same per-token program, host-dispatched: ONE jitted call per
@@ -861,19 +939,27 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         # per-token readback) — steady-state cost is max(device step,
         # dispatch) instead of the while-loop's per-iteration overhead
         body = _jitted_decode_body(decode_model, temperature == 0,
-                                   eos_id is not None,
-                                   top_k if temperature > 0 else 0,
-                                   top_p if temperature > 0 else 1.0)
+                                   eos_id is not None)
+        bkw = _body_control_kwargs(prompt.shape[0], temperature, top_k,
+                                   top_p)
         temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
         eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
         toks = [tok]
         for t in range(max_new_tokens - 1):
-            tok, cache, done = body(params, tok, cache, done, keys[t + 1],
-                                    temp, eos)
+            if penalized:
+                tok, cache, done, seen = body(params, tok, cache, done,
+                                              keys[t + 1], temp, eos,
+                                              seen=seen, rep=rep, **bkw)
+            else:
+                tok, cache, done = body(params, tok, cache, done,
+                                        keys[t + 1], temp, eos, **bkw)
             toks.append(tok)
         new_tokens = jnp.stack(toks, axis=1)
     else:
-        (_, _, _), rest = jax.lax.scan(scan_body, (tok, cache, done),
-                                       keys[1:])
+        # seen rides the scan carry (a [B, V] int8 — trivial next to the
+        # kv cache already there); None when the penalty is off
+        carry0 = (tok, cache, done,
+                  seen if penalized else jnp.zeros((), jnp.int8))
+        (_, _, _, _), rest = jax.lax.scan(scan_body, carry0, keys[1:])
         new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, new_tokens], axis=1)

@@ -581,6 +581,14 @@ class ContinuousBatcher:
         self._topks = jnp.zeros((n_slots,), jnp.int32)
         self._topps = jnp.ones((n_slots,), jnp.float32)
         self._n_filtered = 0
+        # per-row repetition penalty: seen-token mask + rate (1.0 =
+        # disabled; rows at 1.0 are bit-exact identity even while other
+        # rows penalize, since x/1.0 == x).  [n_slots, V] int8 is a few
+        # hundred KB — resident unconditionally
+        self._seen = jnp.zeros((n_slots, self.slot_model.cfg.vocab_size),
+                               jnp.int8)
+        self._reps = jnp.ones((n_slots,), jnp.float32)
+        self._n_penalized = 0
         self._steps = 0
         self._spec_rounds = 0
         self._dead = None     # set to the fatal exception if the loop dies
@@ -727,7 +735,8 @@ class ContinuousBatcher:
         self._drain_pending(err)
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
-               adapter=None, top_k=0, top_p=1.0, stop=None):
+               adapter=None, top_k=0, top_p=1.0, stop=None,
+               repetition_penalty=1.0):
         if self._dead is not None:
             raise RuntimeError(f"batcher died: {self._dead}")
         if adapter is not None and not self.lora_rank:
@@ -754,6 +763,12 @@ class ContinuousBatcher:
         if len(stops) > 16 or any(len(st) > 32 for st in stops):
             raise ValueError("at most 16 stop sequences of at most 32 "
                              "tokens each")
+        if not 0 < repetition_penalty <= 1e6:
+            # the finite cap matters: inf times a zero-valued seen logit
+            # is NaN, poisoning the row's pick instead of 400ing here
+            raise ValueError(
+                f"repetition_penalty={repetition_penalty!r} must be in "
+                "(0, 1e6] (1.0 disables; >1 discourages repeats)")
         # greedy requests on a draft-equipped server need draft_k cache
         # headroom for the speculative verify overshoot; sampled requests
         # never speculate (and disable spec rounds while active), so they
@@ -795,7 +810,7 @@ class ContinuousBatcher:
             "h": h, "prompt": list(prompt), "max_new": max_new,
             "temp": float(temperature), "eos": eos_id, "seed": int(seed),
             "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
-            "stops": stops})
+            "stops": stops, "rep": float(repetition_penalty)})
         if self._dead is not None:
             # the loop may have died between the check above and the put
             # (its death-drain already ran): fail whatever is queued,
@@ -816,24 +831,27 @@ class ContinuousBatcher:
     # ---- device loop (single driver thread owns the cache) --------------
 
     def _pick_first(self, logits_row, temperature, seed, top_k=0,
-                    top_p=1.0):
+                    top_p=1.0, rep=1.0, prompt=None):
         import jax
         import jax.numpy as jnp
 
         from .models import decode as decode_mod
 
-        if temperature > 0:
-            # ordinal 0 of the shared schedule (decode.step_keys): the
-            # first sampled token matches a solo generate(rng=key(seed)),
-            # including its top-k/top-p filter
-            scaled = logits_row[None, :] / temperature
-            if top_k or top_p < 1.0:
-                scaled = decode_mod.filter_top_k_p(
-                    scaled, jnp.asarray([top_k], jnp.int32),
-                    jnp.asarray([top_p], jnp.float32))
-            return int(jax.random.categorical(
-                jax.random.fold_in(jax.random.key(seed), 0), scaled[0]))
-        return int(jnp.argmax(logits_row))
+        if rep != 1.0:
+            # first token's penalty sees the prompt tokens (the shared
+            # seen-state the solo paths start from)
+            seen = decode_mod.seen_from_prompt(
+                jnp.asarray([prompt], jnp.int32), logits_row.shape[-1])
+            logits_row = decode_mod.apply_repetition_penalty(
+                logits_row[None, :], seen,
+                jnp.asarray([rep], jnp.float32))[0]
+        # THE solo pick (decode._solo_pick_fn — one implementation, not a
+        # re-derivation): ordinal 0 of the shared key schedule, so the
+        # first slot token matches a solo generate(rng=key(seed))
+        # including its filters
+        pick = decode_mod._solo_pick_fn(temperature, top_k, top_p)
+        return int(pick(logits_row[None, :],
+                        jax.random.fold_in(jax.random.key(seed), 0))[0])
 
     @staticmethod
     def _hit_stop(seq, stops, gen_start):
@@ -1007,6 +1025,10 @@ class ContinuousBatcher:
         s = self._slots[row]
         if s is not None and s.get("filtered"):
             self._n_filtered -= 1
+        if s is not None and s.get("pen"):
+            self._n_penalized -= 1
+            self._reps = self._reps.at[row].set(1.0)  # identity for the
+            # row's garbage decode AND for the next (unpenalized) tenant
         self._slots[row] = None
         if self.lora_rank:
             # back to the null adapter: the freed row's garbage decode
@@ -1116,8 +1138,9 @@ class ContinuousBatcher:
             # them so later identical prompts skip their prefill
             self._register_prefix_pages(row)
         topk, topp = item["topk"], item["topp"]
-        stops = item["stops"]
-        tok = self._pick_first(logits[0], temp, seed, topk, topp)
+        stops, rep = item["stops"], item["rep"]
+        tok = self._pick_first(logits[0], temp, seed, topk, topp, rep,
+                               prompt)
         h.tokens.put(tok)
         seq = prompt + [tok]
         if (max_new <= 1 or (eos_id is not None and tok == eos_id)
@@ -1140,10 +1163,17 @@ class ContinuousBatcher:
         filtered = bool(topk or topp < 1.0)
         if filtered:
             self._n_filtered += 1
+        penalized = rep != 1.0
+        if penalized:
+            self._seen = self._seen.at[row].set(0).at[
+                row, jnp.asarray(prompt, jnp.int32)].set(1)
+            self._reps = self._reps.at[row].set(rep)
+            self._n_penalized += 1
         self._slots[row] = {"handle": h, "seq": seq,
                             "remaining": max_new - 1, "temp": temp,
                             "eos": eos_id, "stops": stops,
-                            "plen": len(prompt), "filtered": filtered}
+                            "plen": len(prompt), "filtered": filtered,
+                            "pen": penalized}
 
     def _admit(self, block=False):
         import queue as queue_mod
@@ -1228,7 +1258,8 @@ class ContinuousBatcher:
         round when a draft is loaded and every active row is greedy, else
         one plain step.  Returns the readback entry."""
         use_spec = (self.draft_model is not None
-                    and all(s is None or s["temp"] == 0
+                    and all(s is None or (s["temp"] == 0
+                                          and not s.get("pen"))
                             for s in self._slots))
         if use_spec:
             (nxt, t_next, commit, self._cache,
@@ -1238,19 +1269,27 @@ class ContinuousBatcher:
             self._toks = nxt
             self._spec_rounds += 1
             return (t_next, commit, tuple(self._gen))
-        # the filter arrays are passed only while a filtered row is
-        # active: their PRESENCE is static under jit, so unfiltered
-        # workloads run the exact pre-filter program (no per-step sort)
-        extra = ((self._topks, self._topps) if self._n_filtered else ())
+        # filter/penalty arrays are passed only while such a row is
+        # active: their PRESENCE is static under jit, so plain workloads
+        # run the exact pre-feature program (no per-step sort / mask)
+        kw = {}
+        if self._n_filtered:
+            kw.update(topks=self._topks, topps=self._topps)
+        if self._n_penalized:
+            kw.update(seen=self._seen, reps=self._reps)
         if self.lora_rank:
-            nxt, self._cache, self._ords = self._step(
+            ret = self._step(
                 self.params, self._lora_banks, self._cache, self._toks,
                 self._temps, self._seeds, self._ords, self._lora_ids,
-                *extra)
+                **kw)
         else:
-            nxt, self._cache, self._ords = self._step(
+            ret = self._step(
                 self.params, self._cache, self._toks, self._temps,
-                self._seeds, self._ords, *extra)
+                self._seeds, self._ords, **kw)
+        if self._n_penalized:
+            nxt, self._cache, self._ords, self._seen = ret
+        else:
+            nxt, self._cache, self._ords = ret
         self._toks = nxt
         self._steps += 1
         return (nxt, None, tuple(self._gen))
@@ -1511,8 +1550,12 @@ class GenerateService:
                 raise ValueError(
                     '"stop" must be a list (<= 16) of non-empty token-id '
                     "lists (<= 32 tokens each)")
+        rep = req.get("repetition_penalty", 1.0)
+        if not (isinstance(rep, (int, float)) and 0 < rep <= 1e6):
+            raise ValueError('"repetition_penalty" must be a number in '
+                             "(0, 1e6] (1.0 disables)")
         return (inputs, max_new, temperature, eos_id, seed, adapter,
-                top_k, top_p, stop)
+                top_k, top_p, stop, float(rep))
 
     def _prompt_seeds(self, n, seed, temperature):
         """Per-prompt seeds: explicit seed s -> s, s+1, ... (documented
@@ -1533,14 +1576,15 @@ class GenerateService:
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, stop) = self._validate(req)
+         top_k, top_p, stop, rep) = self._validate(req)
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
         seed = self._prompt_seeds(1, seed, temperature)[0]
         h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
                                 eos_id=eos_id, seed=seed, adapter=adapter,
-                                top_k=top_k, top_p=top_p, stop=stop)
+                                top_k=top_k, top_p=top_p, stop=stop,
+                                repetition_penalty=rep)
         self.requests += 1
 
         def slot_events():
@@ -1560,7 +1604,7 @@ class GenerateService:
 
     def generate(self, req):
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, stop) = self._validate(req)
+         top_k, top_p, stop, rep) = self._validate(req)
         seeds = self._prompt_seeds(len(inputs), seed, temperature)
         # every prompt becomes a slot request; they decode concurrently
         # with each other AND with other HTTP requests' prompts (no
@@ -1571,7 +1615,7 @@ class GenerateService:
                 handles.append(self.batcher.submit(
                     p, max_new, temperature=temperature, eos_id=eos_id,
                     seed=s, adapter=adapter, top_k=top_k, top_p=top_p,
-                    stop=stop))
+                    stop=stop, repetition_penalty=rep))
             outs = [h.result(timeout=self.timeout_s) for h in handles]
         except Exception:
             # a failed request (one prompt too long, a timeout) must not

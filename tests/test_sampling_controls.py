@@ -256,3 +256,57 @@ def test_http_filters_and_stop(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_repetition_penalty_solo_paths_agree(lm):
+    # greedy + penalty changes tokens (the tiny model repeats; a strong
+    # penalty breaks the loop), and scan/host/stream all agree
+    model, params = lm
+    prompt = [1, 2, 3]
+    plain = _solo(model, params, prompt, 8)
+    pen_host = _solo(model, params, prompt, 8, repetition_penalty=2.0)
+    assert pen_host != plain                    # the penalty is real
+    assert len(set(pen_host[len(prompt):])) > len(set(plain[len(prompt):]))
+    pen_scan = np.asarray(decode.generate(
+        model, params, jnp.asarray([prompt], jnp.int32), 8,
+        loop="scan", repetition_penalty=2.0))[0].tolist()
+    assert pen_scan == pen_host
+    streamed = [int(t[0]) for t in decode.generate_stream(
+        model, params, jnp.asarray([prompt], jnp.int32), 8,
+        repetition_penalty=2.0)]
+    assert prompt + streamed == pen_host
+
+
+def test_repetition_penalty_slots_match_solo(lm):
+    model, params = lm
+    prompt = [1, 2, 3]
+    solo_greedy = _solo(model, params, prompt, 8, repetition_penalty=2.0)
+    solo_sampled = _solo(model, params, prompt, 8, temperature=0.9,
+                         rng=jax.random.key(4), repetition_penalty=1.7)
+    plain_ref = _solo(model, params, [7, 8], 8)
+    b = serve.ContinuousBatcher(model, params, n_slots=3, read_chunk=1,
+                                prefill_chunk=8)
+    try:
+        hs = [b.submit(prompt, 8, repetition_penalty=2.0),
+              b.submit(prompt, 8, temperature=0.9, seed=4,
+                       repetition_penalty=1.7),
+              b.submit([7, 8], 8)]        # un-penalized row, same batch
+        got = [h.result(timeout=300) for h in hs]
+    finally:
+        b.stop()
+    assert got[0] == solo_greedy
+    assert got[1] == solo_sampled
+    assert got[2] == plain_ref
+
+
+def test_repetition_penalty_validation(lm):
+    model, params = lm
+    b = serve.ContinuousBatcher(model, params, n_slots=2)
+    try:
+        with pytest.raises(ValueError, match="repetition_penalty"):
+            b.submit([1, 2], 4, repetition_penalty=0.0)
+    finally:
+        b.stop()
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        decode.generate(model, params, jnp.asarray([[1]], jnp.int32), 2,
+                        repetition_penalty=-1.0)
