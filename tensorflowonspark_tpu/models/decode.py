@@ -36,13 +36,14 @@ def _params_view(params):
     return dequantize_tree(params)
 
 
-def init_cache(model_or_cfg, batch_size):
+def init_cache(model_or_cfg, batch_size, kv_dtype=None):
     """Build the decode-mode model + empty cache.
 
     Accepts a Transformer (or its config); returns (decode_model, cache).
     The cache is all-zeros by construction, so only its SHAPES are derived
     from the model (jax.eval_shape — no throwaway parameter init, no
-    transient 2x parameter HBM).
+    transient 2x parameter HBM).  ``kv_dtype`` overrides the config's
+    cache storage ("int8" = quantized kv, TransformerConfig.kv_dtype).
     """
     from tensorflowonspark_tpu.models.transformer import (
         Transformer, TransformerConfig)
@@ -52,7 +53,9 @@ def init_cache(model_or_cfg, batch_size):
     if not isinstance(cfg, TransformerConfig):
         raise TypeError(f"expected Transformer or TransformerConfig, "
                         f"got {type(model_or_cfg)}")
-    decode_model = Transformer(dataclasses.replace(cfg, decode=True))
+    decode_model = Transformer(dataclasses.replace(
+        cfg, decode=True,
+        **({"kv_dtype": kv_dtype} if kv_dtype is not None else {})))
     shapes = jax.eval_shape(
         lambda: decode_model.init(jax.random.key(0),
                                   jnp.zeros((batch_size, 1), jnp.int32)))
@@ -145,10 +148,12 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
 # these).  Net-new beyond the reference (its serving is batch forward
 # only, TFModel.scala:245-292).
 
-def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0):
+def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
+                    kv_dtype=None):
     """Build the slot-decode model + empty cache with `n_slots` rows.
     ``page_size``/``n_pages`` > 0 switches to the PAGED kv layout
-    (see `init_paged_slot_cache`)."""
+    (see `init_paged_slot_cache`); ``kv_dtype="int8"`` quantizes the
+    cache storage (TransformerConfig.kv_dtype)."""
     from tensorflowonspark_tpu.models.transformer import (
         Transformer, TransformerConfig)
 
@@ -158,8 +163,10 @@ def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0):
         raise TypeError(f"expected Transformer or TransformerConfig, "
                         f"got {type(model_or_cfg)}")
     slot_model = Transformer(
-        dataclasses.replace(cfg, decode=True, decode_slots=True,
-                            kv_page_size=page_size, kv_pages=n_pages))
+        dataclasses.replace(
+            cfg, decode=True, decode_slots=True,
+            kv_page_size=page_size, kv_pages=n_pages,
+            **({"kv_dtype": kv_dtype} if kv_dtype is not None else {})))
     shapes = jax.eval_shape(
         lambda: slot_model.init(jax.random.key(0),
                                 jnp.zeros((n_slots, 1), jnp.int32)))
@@ -168,7 +175,8 @@ def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0):
     return slot_model, cache
 
 
-def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages):
+def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages,
+                          kv_dtype=None):
     """Build a PAGED slot-decode model + empty cache: kv lives in a
     shared pool of ``n_pages`` pages of ``page_size`` tokens, mapped per
     row through a page table (TransformerConfig.kv_page_size).  The
@@ -181,7 +189,7 @@ def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages):
     page another row owns (serve.ContinuousBatcher allocates
     kv_pages + 1 and uses the extra page as the sink)."""
     return init_slot_cache(model_or_cfg, n_slots, page_size=page_size,
-                           n_pages=n_pages)
+                           n_pages=n_pages, kv_dtype=kv_dtype)
 
 
 def _leaf_name(path):
@@ -189,7 +197,8 @@ def _leaf_name(path):
     return getattr(last, "key", getattr(last, "name", None))
 
 
-_POOL_LEAVES = ("pages_key", "pages_value")   # dim 0 = pool, not rows
+_POOL_LEAVES = ("pages_key", "pages_value",   # dim 0 = pool, not rows
+                "pages_key_scale", "pages_value_scale")  # int8 kv scales
 
 
 @functools.lru_cache(maxsize=32)
@@ -683,7 +692,7 @@ def _solo_pick_fn(temperature, top_k, top_p):
 
 def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
                     rng=None, eos_id=None, top_k=0, top_p=1.0,
-                    repetition_penalty=1.0):
+                    repetition_penalty=1.0, kv_dtype=None):
     """Yield each new token as a host numpy [B] array as soon as it is
     decoded — the streaming form of `generate` (host-loop only: a
     per-token readback is inherent to streaming).
@@ -703,7 +712,8 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     penalized = _check_penalty(repetition_penalty)
     if max_new_tokens <= 0:
         return
-    decode_model, cache = init_cache(model, prompt.shape[0])
+    decode_model, cache = init_cache(model, prompt.shape[0],
+                                     kv_dtype=kv_dtype)
     cfg = decode_model.cfg
     if prompt.shape[1] + max_new_tokens > cfg.max_seq_len:
         raise ValueError(
@@ -838,7 +848,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
              rng=None, eos_id=None, loop="auto", top_k=0, top_p=1.0,
-             repetition_penalty=1.0):
+             repetition_penalty=1.0, kv_dtype=None):
     """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
 
     temperature=0 is greedy argmax; >0 samples from softmax(logits/T),
@@ -895,7 +905,8 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
                 f"TFOS_TPU_DECODE_LOOP={loop!r} not in ('scan', 'host')")
     if max_new_tokens <= 0:
         return prompt
-    decode_model, cache = init_cache(model, prompt.shape[0])
+    decode_model, cache = init_cache(model, prompt.shape[0],
+                                     kv_dtype=kv_dtype)
     cfg = decode_model.cfg
     if prompt.shape[1] + max_new_tokens > cfg.max_seq_len:
         raise ValueError(

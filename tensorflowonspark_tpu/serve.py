@@ -78,6 +78,12 @@ def build_argparser():
                         "each (requires --generate_kv_pages)")
     p.add_argument("--generate_kv_pages", type=int, default=0,
                    help="pool size (pages) for --generate_kv_page_size")
+    p.add_argument("--generate_kv_dtype", choices=["auto", "int8"],
+                   default="auto",
+                   help="int8 = quantized slot kv cache (int8 payload + "
+                        "per-token-head scales): ~2x less resident kv vs "
+                        "bf16, composing with --generate_kv_page_size "
+                        "paging and every sampling control")
     p.add_argument("--generate_lora_rank", type=int, default=0,
                    help=">0 enables a multi-adapter LoRA bank on the "
                         ":generate slots: requests select a registered "
@@ -254,6 +260,8 @@ class ModelService:
         self._gen_timeout_s = getattr(args, "generate_timeout_s", None)
         self._gen_kv_page_size = getattr(args, "generate_kv_page_size", 0)
         self._gen_kv_pages = getattr(args, "generate_kv_pages", 0)
+        self._gen_kv_dtype = getattr(args, "generate_kv_dtype",
+                                     "auto") or "auto"
         self._gen_quantize = getattr(args, "generate_quantize",
                                      "none") or "none"
         self._gen_lora_rank = getattr(args, "generate_lora_rank", 0) or 0
@@ -305,7 +313,8 @@ class ModelService:
                         quantize_mode=self._gen_quantize,
                         lora_rank=self._gen_lora_rank,
                         lora_capacity=self._gen_lora_capacity,
-                        lora_adapters=self._gen_lora)
+                        lora_adapters=self._gen_lora,
+                        kv_dtype=self._gen_kv_dtype)
                 except TypeError as e:
                     # genuinely not a decoder LM: the documented 404
                     logger.info(":generate unavailable: %s", e)
@@ -433,7 +442,7 @@ class ContinuousBatcher:
     def __init__(self, model, params, n_slots=8, max_pending=1024,
                  read_chunk=8, prefill_chunk=512, draft_model=None,
                  draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
-                 lora_rank=0, lora_capacity=8):
+                 lora_rank=0, lora_capacity=8, kv_dtype=None):
         import itertools
         import queue as queue_mod
 
@@ -442,6 +451,11 @@ class ContinuousBatcher:
         from .models import decode as decode_mod
 
         self.model, self.params = model, params
+        # "int8" stores the slot kv cache quantized (int8 payload +
+        # per-(token, head) f32 scales — TransformerConfig.kv_dtype):
+        # ~2x less resident kv vs bf16, composing with paging (pool
+        # pages quantize too) and every sampling control
+        self.kv_dtype = kv_dtype
         self.kv_page_size = int(kv_page_size or 0)
         if self.kv_page_size and int(kv_pages) < 1:
             raise ValueError(
@@ -464,7 +478,8 @@ class ContinuousBatcher:
             self._sink = int(kv_pages)
             self._total_pages = int(kv_pages)
             self.slot_model, self._cache = decode_mod.init_paged_slot_cache(
-                model, n_slots, self.kv_page_size, int(kv_pages) + 1)
+                model, n_slots, self.kv_page_size, int(kv_pages) + 1,
+                kv_dtype=kv_dtype)
             self._set_table = decode_mod._jitted_set_row_page_table(
                 self.slot_model)
             self._free_pages = list(range(int(kv_pages)))
@@ -486,7 +501,7 @@ class ContinuousBatcher:
                     self._sink_entries)
         else:
             self.slot_model, self._cache = decode_mod.init_slot_cache(
-                model, n_slots)
+                model, n_slots, kv_dtype=kv_dtype)
         self._parked = None    # admission waiting for pool pages (FIFO)
         # ---- multi-adapter LoRA bank (lora_rank > 0) --------------------
         # N tenants share the batched step: per-layer stacked A/B banks
@@ -551,7 +566,7 @@ class ContinuousBatcher:
                     f"vocab {model.cfg.vocab_size}")
             self.draft_model, self.draft_params = draft_model, draft_params
             self.d_slot_model, self._d_cache = decode_mod.init_slot_cache(
-                draft_model, n_slots)
+                draft_model, n_slots, kv_dtype=kv_dtype)
             self._d_prefill = decode_mod._jitted_slot_prefill(
                 self.d_slot_model)
             self._spec_round = decode_mod._jitted_slot_spec_round(
@@ -622,6 +637,8 @@ class ContinuousBatcher:
             out["lora_rank"] = self.lora_rank
             out["lora_adapters"] = sorted(self._adapters)
             out["lora_capacity_free"] = len(self._free_lora)
+        if self.kv_dtype:
+            out["kv_dtype"] = self.kv_dtype
         return out
 
     # ---- multi-adapter LoRA registry ------------------------------------
@@ -1444,7 +1461,8 @@ class GenerateService:
                  draft_export_dir=None, draft_k=4, slots=8, read_chunk=8,
                  prefill_chunk=512, request_timeout_s=None,
                  kv_page_size=0, kv_pages=0, quantize_mode="none",
-                 lora_rank=0, lora_capacity=8, lora_adapters=None):
+                 lora_rank=0, lora_capacity=8, lora_adapters=None,
+                 kv_dtype="auto"):
         import itertools
 
         self.quantize_mode = quantize_mode or "none"
@@ -1465,7 +1483,8 @@ class GenerateService:
             read_chunk=read_chunk, prefill_chunk=prefill_chunk,
             draft_model=draft_model, draft_params=draft_params,
             draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages,
-            lora_rank=lora_rank, lora_capacity=lora_capacity)
+            lora_rank=lora_rank, lora_capacity=lora_capacity,
+            kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype))
         try:
             for name, path in (lora_adapters or {}).items():
                 # adapter files written by lora.save_adapters; a bad file

@@ -54,6 +54,7 @@ SLOW_FILES = {
     "test_elastic.py",          # ~80 s — SIGKILL + relaunch integration (LocalBackend + minispark paths)
     "test_examples.py",         # >10 min — example subprocesses
     "test_hybrid_mesh.py",      # 11 s — multi-slice mesh compiles
+    "test_kv_int8.py",          # ~60 s — quantized-cache engines compile
     "test_lora.py",             # 25 s
     "test_lora_serving.py",     # ~60 s — multi-adapter slot engines
     "test_optim8bit.py",        # 14 s (round 5 grew it: layout parity)
