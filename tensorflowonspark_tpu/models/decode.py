@@ -115,7 +115,8 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
     # the donated one again
     @functools.partial(jax.jit, donate_argnums=(2,))
     def body(params, tok, cache, done, rng_t, temperature, eos_id,
-             topks=None, topps=None, seen=None, rep=None):
+             topks=None, topps=None, minps=None, seen=None,
+             rep=None):
         logits, mut = decode_model.apply(
             {"params": _params_view(params), "cache": cache}, tok[:, None],
             mutable=["cache"])
@@ -128,7 +129,7 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
         else:
             scaled = logits / temperature
             if topks is not None:
-                scaled = filter_top_k_p(scaled, topks, topps)
+                scaled = filter_top_k_p(scaled, topks, topps, minps)
             nxt = jax.random.categorical(rng_t, scaled, axis=-1)
         if with_eos:
             nxt = jnp.where(done, eos_id, nxt)
@@ -282,7 +283,8 @@ def _jitted_slot_prefill(slot_model):
 
 
 def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
-                    topks=None, topps=None, seen=None, reps=None):
+                    topks=None, topps=None, minps=None, seen=None,
+                    reps=None):
     """Shared decode-step core: feed each row its current token, per-row
     greedy/sampled pick (`temps[b] == 0` = greedy).
 
@@ -316,7 +318,7 @@ def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
             seeds, ords)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     if topks is not None:
-        scaled = filter_top_k_p(scaled, topks, topps)
+        scaled = filter_top_k_p(scaled, topks, topps, minps)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     out = (jnp.where(temps > 0, sampled, greedy), mut["cache"], ords + 1)
     return out + (seen,) if seen is not None else out
@@ -328,11 +330,13 @@ def _jitted_slot_step(slot_model):
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step(params, cache, toks, temps, seeds, ords,
-             topks=None, topps=None, seen=None, reps=None):
+             topks=None, topps=None, minps=None, seen=None,
+             reps=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache},
-            toks, temps, seeds, ords, topks, topps, seen, reps)
+            toks, temps, seeds, ords, topks, topps, minps, seen,
+            reps)
 
     return step
 
@@ -364,12 +368,14 @@ def _jitted_slot_step_lora(slot_model):
 
     @functools.partial(jax.jit, donate_argnums=(2,))
     def step(params, lora, cache, toks, temps, seeds, ords, ids,
-             topks=None, topps=None, seen=None, reps=None):
+             topks=None, topps=None, minps=None, seen=None,
+             reps=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache,
              "lora": _lora_with_ids(lora, ids)},
-            toks, temps, seeds, ords, topks, topps, seen, reps)
+            toks, temps, seeds, ords, topks, topps, minps, seen,
+            reps)
 
     return step
 
@@ -401,11 +407,12 @@ def _jitted_set_row(slot_model):
     resident arrays."""
 
     @jax.jit
-    def set_row(toks, temps, seeds, ords, topks, topps, row, tok, temp,
-                seed, ordinal, topk, topp):
+    def set_row(toks, temps, seeds, ords, topks, topps, minps, row, tok,
+                temp, seed, ordinal, topk, topp, minp):
         return (toks.at[row].set(tok), temps.at[row].set(temp),
                 seeds.at[row].set(seed), ords.at[row].set(ordinal),
-                topks.at[row].set(topk), topps.at[row].set(topp))
+                topks.at[row].set(topk), topps.at[row].set(topp),
+                minps.at[row].set(minp))
 
     return set_row
 
@@ -604,20 +611,22 @@ def seen_from_prompt(prompt, vocab_size):
     return seen.at[jnp.arange(B)[:, None], prompt].set(1)
 
 
-def filter_top_k_p(logits, top_k, top_p):
-    """Per-row top-k / nucleus (top-p) logit filtering, shared by EVERY
-    sampling path (solo `generate`/`generate_stream` and the serving
-    slot step) so cross-path token parity holds with filters on.
+def filter_top_k_p(logits, top_k, top_p, min_p=None):
+    """Per-row top-k / nucleus (top-p) / min-p logit filtering, shared by
+    EVERY sampling path (solo `generate`/`generate_stream` and the
+    serving slot step) so cross-path token parity holds with filters on.
 
     `logits` [n, V] are the (already temperature-scaled) sampling logits;
     `top_k` [n] int32 (0 disables) keeps each row's k highest;
     `top_p` [n] f32 (1.0 disables) keeps the smallest prefix of the
     descending-sorted distribution whose cumulative probability reaches
-    p (the top token always survives).  Filtered entries become -inf.
-    HF-warper ordering: temperature -> top_k -> top_p — top-p operates
-    on the RENORMALIZED top-k survivors (k=2 probs [.5, .3, .2] ->
-    [.625, .375], so p=0.6 keeps only the top token), matching HF's
-    chained LogitsWarper semantics."""
+    p (the top token always survives); `min_p` [n] f32 (0.0 disables)
+    then drops tokens whose probability under the SURVIVING distribution
+    is below ``min_p * max_prob`` (llama.cpp-style relative floor).
+    Filtered entries become -inf.  HF-warper ordering: temperature ->
+    top_k -> top_p -> min_p, each operating on the RENORMALIZED
+    survivors of the previous (k=2 probs [.5, .3, .2] -> [.625, .375],
+    so p=0.6 keeps only the top token)."""
     V = logits.shape[-1]
     sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]            # [n, V] desc
     k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
@@ -629,6 +638,14 @@ def filter_top_k_p(logits, top_k, top_p):
     # < p (the first token always passes; ties at the kth/threshold
     # value keep together via the value comparison below)
     keep_sorted = in_k & ((cum - probs) < top_p[:, None])
+    if min_p is not None:
+        # relative floor on the top-k/top-p survivors: renormalized
+        # prob >= min_p * max (the max survives by construction, so
+        # this never empties a row)
+        probs2 = jax.nn.softmax(
+            jnp.where(keep_sorted, sorted_l, -jnp.inf), axis=-1)
+        keep_sorted = keep_sorted & (
+            probs2 >= min_p[:, None] * probs2[:, :1])
     thr = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf), axis=-1)
     return jnp.where(logits >= thr[:, None], logits, -jnp.inf)
 
@@ -655,19 +672,20 @@ def _check_penalty(repetition_penalty):
     return repetition_penalty != 1.0
 
 
-def _body_control_kwargs(batch, temperature, top_k, top_p):
-    """Dynamic top-k/top-p arrays for `_jitted_decode_body` (empty when
-    the filter is off — presence is the only static bit, so sweeping
-    filter values never recompiles)."""
-    if temperature > 0 and (top_k or top_p < 1.0):
+def _body_control_kwargs(batch, temperature, top_k, top_p, min_p=0.0):
+    """Dynamic top-k/top-p/min-p arrays for `_jitted_decode_body` (empty
+    when the filter is off — presence is the only static bit, so
+    sweeping filter values never recompiles)."""
+    if temperature > 0 and (top_k or top_p < 1.0 or min_p > 0.0):
         return {"topks": jnp.full((batch,), top_k, jnp.int32),
-                "topps": jnp.full((batch,), top_p, jnp.float32)}
+                "topps": jnp.full((batch,), top_p, jnp.float32),
+                "minps": jnp.full((batch,), min_p, jnp.float32)}
     return {}
 
 
-def _solo_pick_fn(temperature, top_k, top_p):
+def _solo_pick_fn(temperature, top_k, top_p, min_p=0.0):
     """The solo-path token pick (shared by `generate`/`generate_stream`):
-    greedy argmax, or temperature-scaled (optionally top-k/top-p
+    greedy argmax, or temperature-scaled (optionally top-k/top-p/min-p
     filtered, `filter_top_k_p`) categorical — the same math the serving
     slot step applies per row, so cross-path parity holds with filters
     on."""
@@ -675,16 +693,19 @@ def _solo_pick_fn(temperature, top_k, top_p):
         raise ValueError(f"top_k={top_k!r} must be an int >= 0")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p={top_p!r} must be in (0, 1]")
+    if not 0.0 <= min_p < 1.0:
+        raise ValueError(f"min_p={min_p!r} must be in [0, 1)")
 
     def pick(logits, rng_t):
         if temperature <= 0:
             return jnp.argmax(logits, axis=-1)
         scaled = logits / temperature
-        if top_k or top_p < 1.0:
+        if top_k or top_p < 1.0 or min_p > 0.0:
             B = logits.shape[0]
             scaled = filter_top_k_p(
                 scaled, jnp.full((B,), top_k, jnp.int32),
-                jnp.full((B,), top_p, jnp.float32))
+                jnp.full((B,), top_p, jnp.float32),
+                jnp.full((B,), min_p, jnp.float32))
         return jax.random.categorical(rng_t, scaled, axis=-1)
 
     return pick
@@ -692,7 +713,7 @@ def _solo_pick_fn(temperature, top_k, top_p):
 
 def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
                     rng=None, eos_id=None, top_k=0, top_p=1.0,
-                    repetition_penalty=1.0, kv_dtype=None):
+                    min_p=0.0, repetition_penalty=1.0, kv_dtype=None):
     """Yield each new token as a host numpy [B] array as soon as it is
     decoded — the streaming form of `generate` (host-loop only: a
     per-token readback is inherent to streaming).
@@ -708,7 +729,7 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
 
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires `rng`")
-    pick = _solo_pick_fn(temperature, top_k, top_p)
+    pick = _solo_pick_fn(temperature, top_k, top_p, min_p)
     penalized = _check_penalty(repetition_penalty)
     if max_new_tokens <= 0:
         return
@@ -739,7 +760,8 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
 
     body = _jitted_decode_body(decode_model, temperature == 0,
                                eos_id is not None)
-    bkw = _body_control_kwargs(prompt.shape[0], temperature, top_k, top_p)
+    bkw = _body_control_kwargs(prompt.shape[0], temperature, top_k,
+                               top_p, min_p)
     temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
     eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
     for t in range(max_new_tokens - 1):
@@ -848,7 +870,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
              rng=None, eos_id=None, loop="auto", top_k=0, top_p=1.0,
-             repetition_penalty=1.0, kv_dtype=None):
+             min_p=0.0, repetition_penalty=1.0, kv_dtype=None):
     """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
 
     temperature=0 is greedy argmax; >0 samples from softmax(logits/T),
@@ -883,7 +905,7 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
 
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires `rng`")
-    pick = _solo_pick_fn(temperature, top_k, top_p)
+    pick = _solo_pick_fn(temperature, top_k, top_p, min_p)
     penalized = _check_penalty(repetition_penalty)
     if loop not in ("auto", "scan", "host"):
         raise ValueError(f"loop={loop!r} not in ('auto', 'scan', 'host')")
@@ -952,7 +974,7 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         body = _jitted_decode_body(decode_model, temperature == 0,
                                    eos_id is not None)
         bkw = _body_control_kwargs(prompt.shape[0], temperature, top_k,
-                                   top_p)
+                                   top_p, min_p)
         temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
         eos = jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32)
         toks = [tok]

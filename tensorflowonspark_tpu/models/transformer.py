@@ -313,9 +313,9 @@ class Attention(nn.Module):
             # ONE payload blend for both storages: int8 payloads blend
             # at the ACTIVATION dtype (±127 is exact in bf16/f32; a
             # wider blend would double the write traffic that dominates
-            # this op — a f32 blend measured 26% of serving throughput)
-            # and the trailing astype(store) is a no-op when
-            # store == dtype
+            # this op — an f32 blend measured 26% SLOWER end-to-end
+            # serving, BASELINE.md round 5) and the trailing
+            # astype(store) is a no-op when store == dtype
             oh = onehot.astype(dtype)
             ck.value = jnp.where(write_mask, jnp.einsum(
                 "bsl,bshd->blhd", oh,

@@ -595,6 +595,7 @@ class ContinuousBatcher:
         # the filter program while a filtered row is active
         self._topks = jnp.zeros((n_slots,), jnp.int32)
         self._topps = jnp.ones((n_slots,), jnp.float32)
+        self._minps = jnp.zeros((n_slots,), jnp.float32)
         self._n_filtered = 0
         # per-row repetition penalty: seen-token mask + rate (1.0 =
         # disabled; rows at 1.0 are bit-exact identity even while other
@@ -752,7 +753,7 @@ class ContinuousBatcher:
         self._drain_pending(err)
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
-               adapter=None, top_k=0, top_p=1.0, stop=None,
+               adapter=None, top_k=0, top_p=1.0, min_p=0.0, stop=None,
                repetition_penalty=1.0):
         if self._dead is not None:
             raise RuntimeError(f"batcher died: {self._dead}")
@@ -767,9 +768,11 @@ class ContinuousBatcher:
             raise ValueError(f"top_k={top_k!r} must be an int32 >= 0")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p={top_p!r} must be in (0, 1]")
-        if (top_k or top_p < 1.0) and temperature <= 0:
-            raise ValueError("top_k/top_p filter the SAMPLED distribution "
-                             "— they require temperature > 0")
+        if not 0.0 <= min_p < 1.0:
+            raise ValueError(f"min_p={min_p!r} must be in [0, 1)")
+        if (top_k or top_p < 1.0 or min_p > 0.0) and temperature <= 0:
+            raise ValueError("top_k/top_p/min_p filter the SAMPLED "
+                             "distribution — they require temperature > 0")
         stops = []
         for st in (stop or []):
             if (not isinstance(st, (list, tuple)) or not st
@@ -827,7 +830,8 @@ class ContinuousBatcher:
             "h": h, "prompt": list(prompt), "max_new": max_new,
             "temp": float(temperature), "eos": eos_id, "seed": int(seed),
             "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
-            "stops": stops, "rep": float(repetition_penalty)})
+            "minp": float(min_p), "stops": stops,
+            "rep": float(repetition_penalty)})
         if self._dead is not None:
             # the loop may have died between the check above and the put
             # (its death-drain already ran): fail whatever is queued,
@@ -848,7 +852,7 @@ class ContinuousBatcher:
     # ---- device loop (single driver thread owns the cache) --------------
 
     def _pick_first(self, logits_row, temperature, seed, top_k=0,
-                    top_p=1.0, rep=1.0, prompt=None):
+                    top_p=1.0, min_p=0.0, rep=1.0, prompt=None):
         import jax
         import jax.numpy as jnp
 
@@ -866,7 +870,7 @@ class ContinuousBatcher:
         # re-derivation): ordinal 0 of the shared key schedule, so the
         # first slot token matches a solo generate(rng=key(seed))
         # including its filters
-        pick = decode_mod._solo_pick_fn(temperature, top_k, top_p)
+        pick = decode_mod._solo_pick_fn(temperature, top_k, top_p, min_p)
         return int(pick(logits_row[None, :],
                         jax.random.fold_in(jax.random.key(seed), 0))[0])
 
@@ -1154,10 +1158,10 @@ class ContinuousBatcher:
             # this row's full-prefix pages now hold computed kv: publish
             # them so later identical prompts skip their prefill
             self._register_prefix_pages(row)
-        topk, topp = item["topk"], item["topp"]
+        topk, topp, minp = item["topk"], item["topp"], item["minp"]
         stops, rep = item["stops"], item["rep"]
-        tok = self._pick_first(logits[0], temp, seed, topk, topp, rep,
-                               prompt)
+        tok = self._pick_first(logits[0], temp, seed, topk, topp, minp,
+                               rep, prompt)
         h.tokens.put(tok)
         seq = prompt + [tok]
         if (max_new <= 1 or (eos_id is not None and tok == eos_id)
@@ -1168,16 +1172,16 @@ class ContinuousBatcher:
             return
         self._gen[row] += 1
         (self._toks, self._temps, self._seeds, self._ords,
-         self._topks, self._topps) = self._set_row(
+         self._topks, self._topps, self._minps) = self._set_row(
             self._toks, self._temps, self._seeds, self._ords,
-            self._topks, self._topps,
+            self._topks, self._topps, self._minps,
             jnp.asarray(row, jnp.int32), jnp.asarray(tok, jnp.int32),
             jnp.asarray(temp, jnp.float32), jnp.asarray(seed, jnp.int32),
             jnp.asarray(1, jnp.int32), jnp.asarray(topk, jnp.int32),
-            jnp.asarray(topp, jnp.float32))
+            jnp.asarray(topp, jnp.float32), jnp.asarray(minp, jnp.float32))
         if self.lora_rank:
             self._lora_ids = self._lora_ids.at[row].set(aidx)
-        filtered = bool(topk or topp < 1.0)
+        filtered = bool(topk or topp < 1.0 or minp > 0.0)
         if filtered:
             self._n_filtered += 1
         penalized = rep != 1.0
@@ -1291,7 +1295,8 @@ class ContinuousBatcher:
         # run the exact pre-feature program (no per-step sort / mask)
         kw = {}
         if self._n_filtered:
-            kw.update(topks=self._topks, topps=self._topps)
+            kw.update(topks=self._topks, topps=self._topps,
+                      minps=self._minps)
         if self._n_penalized:
             kw.update(seen=self._seen, reps=self._reps)
         if self.lora_rank:
@@ -1555,8 +1560,11 @@ class GenerateService:
         top_p = float(req.get("top_p", 1.0))
         if not 0.0 < top_p <= 1.0:
             raise ValueError('"top_p" must be in (0, 1]')
-        if (top_k or top_p < 1.0) and temperature <= 0:
-            raise ValueError('"top_k"/"top_p" filter the sampled '
+        min_p = float(req.get("min_p", 0.0))
+        if not 0.0 <= min_p < 1.0:
+            raise ValueError('"min_p" must be in [0, 1)')
+        if (top_k or top_p < 1.0 or min_p > 0.0) and temperature <= 0:
+            raise ValueError('"top_k"/"top_p"/"min_p" filter the sampled '
                              'distribution — set "temperature" > 0')
         stop = req.get("stop")
         if stop is not None:
@@ -1574,7 +1582,7 @@ class GenerateService:
             raise ValueError('"repetition_penalty" must be a number in '
                              "(0, 1e6] (1.0 disables)")
         return (inputs, max_new, temperature, eos_id, seed, adapter,
-                top_k, top_p, stop, float(rep))
+                top_k, top_p, min_p, stop, float(rep))
 
     def _prompt_seeds(self, n, seed, temperature):
         """Per-prompt seeds: explicit seed s -> s, s+1, ... (documented
@@ -1595,15 +1603,15 @@ class GenerateService:
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, stop, rep) = self._validate(req)
+         top_k, top_p, min_p, stop, rep) = self._validate(req)
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
         seed = self._prompt_seeds(1, seed, temperature)[0]
         h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
                                 eos_id=eos_id, seed=seed, adapter=adapter,
-                                top_k=top_k, top_p=top_p, stop=stop,
-                                repetition_penalty=rep)
+                                top_k=top_k, top_p=top_p, min_p=min_p,
+                                stop=stop, repetition_penalty=rep)
         self.requests += 1
 
         def slot_events():
@@ -1623,7 +1631,7 @@ class GenerateService:
 
     def generate(self, req):
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, stop, rep) = self._validate(req)
+         top_k, top_p, min_p, stop, rep) = self._validate(req)
         seeds = self._prompt_seeds(len(inputs), seed, temperature)
         # every prompt becomes a slot request; they decode concurrently
         # with each other AND with other HTTP requests' prompts (no
@@ -1634,7 +1642,7 @@ class GenerateService:
                 handles.append(self.batcher.submit(
                     p, max_new, temperature=temperature, eos_id=eos_id,
                     seed=s, adapter=adapter, top_k=top_k, top_p=top_p,
-                    stop=stop, repetition_penalty=rep))
+                    min_p=min_p, stop=stop, repetition_penalty=rep))
             outs = [h.result(timeout=self.timeout_s) for h in handles]
         except Exception:
             # a failed request (one prompt too long, a timeout) must not

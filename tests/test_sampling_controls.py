@@ -310,3 +310,40 @@ def test_repetition_penalty_validation(lm):
     with pytest.raises(ValueError, match="repetition_penalty"):
         decode.generate(model, params, jnp.asarray([[1]], jnp.int32), 2,
                         repetition_penalty=-1.0)
+
+
+def test_min_p_semantics_and_parity(lm):
+    # unit semantics: a strong min_p floor keeps only near-max tokens
+    lg = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]], jnp.float32))
+    out = decode.filter_top_k_p(lg, jnp.asarray([0]), jnp.asarray([1.0]),
+                                jnp.asarray([0.5]))
+    # floor = 0.5 * 0.5 = 0.25: keeps 0.5 and 0.3 only
+    assert np.isfinite(np.asarray(out)[0]).tolist() == [True, True,
+                                                        False, False]
+    # min_p composes with top_p on the RENORMALIZED survivors
+    out = decode.filter_top_k_p(lg, jnp.asarray([0]), jnp.asarray([0.8]),
+                                jnp.asarray([0.4]))
+    # top_p=0.8 keeps [.5, .3] -> renorm [.625, .375]; floor .25 keeps both
+    assert np.isfinite(np.asarray(out)[0]).sum() == 2
+    # disabled min_p changes nothing
+    out0 = decode.filter_top_k_p(lg, jnp.asarray([2]), jnp.asarray([1.0]))
+    out1 = decode.filter_top_k_p(lg, jnp.asarray([2]), jnp.asarray([1.0]),
+                                 jnp.asarray([0.0]))
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(out1))
+
+    # cross-path parity: slots with min_p reproduce the solo call
+    model, params = lm
+    solo = _solo(model, params, [1, 2, 3], 6, temperature=0.9,
+                 rng=jax.random.key(21), min_p=0.1)
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8)
+    try:
+        got = b.submit([1, 2, 3], 6, temperature=0.9, seed=21,
+                       min_p=0.1).result(timeout=300)
+        with pytest.raises(ValueError, match="min_p"):
+            b.submit([1, 2], 4, min_p=1.5, temperature=0.9)
+        with pytest.raises(ValueError, match="temperature"):
+            b.submit([1, 2], 4, min_p=0.2)
+    finally:
+        b.stop()
+    assert got == solo
