@@ -61,6 +61,8 @@ def build_argparser():
                    help=">0: multi-adapter LoRA bank on the slots; a "
                         "demo adapter registers as 'demo' and the round "
                         "trip generates with and without it")
+    p.add_argument("--kv_dtype", choices=["auto", "int8"], default="auto",
+                   help="int8 = quantized kv cache (~2x less resident kv)")
     return p
 
 
@@ -109,6 +111,8 @@ def main(argv=None):
                        "--generate_kv_pages", str(args.kv_pages)]
     if args.quantize != "none":
         serve_argv += ["--generate_quantize", args.quantize]
+    if args.kv_dtype != "auto":
+        serve_argv += ["--generate_kv_dtype", args.kv_dtype]
     if args.lora_rank:
         # write a demo adapter next to the export and register it as
         # 'demo': the round trip below generates with and without it

@@ -723,7 +723,8 @@ def generate_stream(model, params, prompt, max_new_tokens, temperature=0.0,
     (see `step_keys`), so a streamed sampling run reproduces the batch
     call.  The serving layer forwards these as server-sent events
     (`serve`'s ``:generate`` with ``"stream": true``).  ``top_k`` /
-    ``top_p`` filter the sampled distribution (ignored when greedy).
+    ``top_p`` / ``min_p`` (in [0, 1)) filter the sampled distribution
+    (ignored when greedy — see `filter_top_k_p`).
     """
     import numpy as np
 
@@ -874,8 +875,8 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     """Generate continuations of `prompt` [B, T0] -> [B, T0+max_new_tokens].
 
     temperature=0 is greedy argmax; >0 samples from softmax(logits/T),
-    optionally top-k / nucleus filtered (``top_k``/``top_p``; ignored
-    when greedy — see `filter_top_k_p`).  ``repetition_penalty`` > 1
+    optionally top-k / nucleus / min-p filtered (``top_k``/``top_p``/
+    ``min_p`` in [0, 1); ignored when greedy — see `filter_top_k_p`).  ``repetition_penalty`` > 1
     discourages tokens already in the prompt or generated so far
     (HF processor semantics — applied to the raw logits before
     temperature, so it shifts greedy decoding too).

@@ -19,6 +19,15 @@ requests instead).  :generate requests all run through the
 continuous-batching slot engine (GenerateService/ContinuousBatcher):
 concurrent generations share the in-flight batch at token boundaries —
 no request-level serialization.
+
+The engine composes (docs/source/serving.rst for each): paged kv with
+prefix caching (``--generate_kv_page_size``/``--generate_kv_pages``),
+fused speculative decoding (``--draft_export_dir``), weight-only int8
+(``--generate_quantize``), an int8 kv cache (``--generate_kv_dtype``),
+multi-adapter LoRA (``--generate_lora_rank``/``--generate_lora``), and
+per-request sampling controls (``top_k``/``top_p``/``min_p``/
+``repetition_penalty``/``stop``) that reproduce solo library calls
+token-for-token via one shared implementation.
 """
 import argparse
 from typing import Any
