@@ -308,3 +308,33 @@ def test_slot_spec_round_matches_greedy(model_and_params):
     for p, seq in zip(prompts, seqs):
         want = _solo(model, params, p, n_new + k)   # spec may overshoot
         assert (p + seq)[:len(p) + n_new] == want[:len(p) + n_new]
+
+
+def test_slot_engine_serves_tp_sharded_params(model_and_params):
+    # distributed serving: the continuous batcher over Megatron-TP
+    # sharded weights produces the exact tokens of the unsharded engine
+    # (the jitted slot step propagates param shardings through the
+    # per-row cache update; no mesh context needed in the driver thread —
+    # the arrays carry their shardings)
+    from tensorflowonspark_tpu import serve
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import sharding as sharding_mod
+
+    model, params = model_and_params
+    ref_engine = serve.ContinuousBatcher(model, params, n_slots=2,
+                                         read_chunk=1, prefill_chunk=8)
+    try:
+        ref = ref_engine.submit([1, 2, 3], 6).result(timeout=300)
+    finally:
+        ref_engine.stop()
+
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(dp=2, tp=4))
+    sh = sharding_mod.infer_param_shardings(params, mesh)
+    sharded = sharding_mod.shard_params(params, sh)
+    b = serve.ContinuousBatcher(model, sharded, n_slots=2, read_chunk=1,
+                                prefill_chunk=8)
+    try:
+        got = b.submit([1, 2, 3], 6).result(timeout=300)
+    finally:
+        b.stop()
+    assert got == ref
