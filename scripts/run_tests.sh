@@ -4,7 +4,7 @@
 # first — the LocalBackend inside the suite plays that role here).
 #
 #   scripts/run_tests.sh            # full suite (>20 min on a 1-core box)
-#   scripts/run_tests.sh --fast     # core-runtime tier (<90 s)
+#   scripts/run_tests.sh --fast     # core-runtime tier (~100 s)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 make -C native

@@ -40,7 +40,8 @@ def mp_ctx():
 # runnable in one sitting.  Inclusion rule: a file is slow if it measured
 # >=20 s standalone (timing sweep recorded 2026-07-31) OR is non-core
 # (models/parallelism/optimizer features, peripheral utils) and the fast
-# tier would otherwise exceed its <90 s budget — that covers the sub-20 s
+# tier would otherwise exceed its budget (~100 s as of round 5 on an
+# idle 1-core box) — that covers the sub-20 s
 # entries (hybrid_mesh 11 s, optim8bit 14 s, summary 9 s).  Everything
 # else forms the fast tier:
 #     pytest -m "not slow"        (also: scripts/run_tests.sh --fast)
