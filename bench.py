@@ -13,11 +13,20 @@ standard 6·N·T FLOP estimate over the chip's bf16 peak — conservative
 
 Metric history: rounds 1-2 used MNIST CNN images/sec (kept in aux);
 rounds 3-4 used the same dims with LayerNorm (`FLAGSHIP_LM`, frozen for
-comparability).  Round 5 re-baselines to RMSNorm — the config the
-framework has recommended since round 3 — per the round-4 verdict; the
-v1 LayerNorm config is measured in aux for THIS transition round
-(`lm_mfu_layernorm_v1`), exactly like the round-3 metric change recorded
-its predecessor.
+comparability).  Round 5 re-baselined to RMSNorm (its `lm_mfu_
+layernorm_v1` transition row has served its round and is retired).
+Round 6 switches the flagship OPTIMIZER to the single-pass fused AdamW
+kernel (`benchmarks.FLAGSHIP_OPTIMIZER = "adamw_fused"`,
+ops/fused_optim.py) — same math and model config, fewer HBM passes; the
+optax reference is measured in aux for THIS transition round
+(`lm_mfu_adamw_unfused`), the same protocol as every metric change.
+
+Round 6 also adds an `opt_ms` aux segment: the flagship step re-timed
+with a zero-lr momentum-less SGD update ("sgd0" — the cheapest possible
+optimizer) and `opt_ms = step_ms - step_ms_sgd0`, isolating what the
+optimizer update costs per step so the fused kernel's win stays visible
+in the trajectory.  `bench.py --segments` runs ONLY that comparison
+(and exits 0 with a "skipped" line off-TPU, so CI can smoke the path).
 
 On a device whose bf16 peak is unknown (not in benchmarks.PEAK_BF16) the
 metric falls back to tokens/sec — an MFU percent against a guessed peak
@@ -32,21 +41,25 @@ Timing methodology (unchanged from round 1): host-readback barrier
 tunneled device plugins; device-resident batches; donated train state;
 best-of-3 windows against dispatch-latency noise.
 """
+import argparse
 import json
+import sys
 import time
 
 from tensorflowonspark_tpu.benchmarks import (
     FLAGSHIP_BATCH, ROUND1_LM_MFU, bf16_peak, make_flagship_step)
 
 
-def bench_flagship_lm(steps=10, windows=3, config="v2"):
+def bench_flagship_lm(steps=10, windows=3, config="v2", optimizer=None):
     """Best-of-`windows` step time for the flagship LM; returns
-    (mfu_pct_or_None, tokens_per_sec, step_ms, n_params)."""
+    (mfu_pct_or_None, tokens_per_sec, step_ms, n_params).  ``optimizer``
+    passes through to make_flagship_step (None = the headline default)."""
     import numpy as np
 
     import jax
 
-    step, state, tokens, n_params = make_flagship_step(config=config)
+    step, state, tokens, n_params = make_flagship_step(config=config,
+                                                       optimizer=optimizer)
     B, S = tokens.shape[0], tokens.shape[1] - 1
 
     state, m = step(state, tokens, jax.random.key(1))
@@ -109,19 +122,61 @@ def bench_mnist_cnn(batch_size=1024, steps=240, warmup=10):
     return best
 
 
-def main():
+def bench_opt_segment(steps=10, windows=3):
+    """The optimizer segment of the flagship step: full fused update vs
+    the zero-lr momentum-less SGD floor.  Returns (full_ms, sgd0_ms,
+    opt_ms) — opt_ms is what the optimizer update costs per step."""
+    _, _, full_ms, _ = bench_flagship_lm(steps=steps, windows=windows)
+    _, _, sgd0_ms, _ = bench_flagship_lm(steps=steps, windows=windows,
+                                         optimizer="sgd0")
+    return full_ms, sgd0_ms, full_ms - sgd0_ms
+
+
+def segments_main():
+    """`bench.py --segments`: the opt_ms comparison alone.  Off-TPU it
+    exits 0 with a skipped line BEFORE building the 0.87B model — the CI
+    smoke path (scripts/run_tests.sh boxes have no accelerator)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"metric": "opt_ms", "skipped":
+                          "segment bench needs TPU (backend is "
+                          f"{jax.default_backend()})"}))
+        return 0
+    full_ms, sgd0_ms, opt_ms = bench_opt_segment()
+    print(json.dumps({"metric": "opt_ms", "value": round(opt_ms, 1),
+                      "unit": "ms/step",
+                      "aux": {"lm_step_ms": round(full_ms, 1),
+                              "lm_step_ms_sgd0": round(sgd0_ms, 1)}}))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--segments", action="store_true",
+                    help="run only the opt_ms segment comparison "
+                         "(exits 0 with a skipped line off-TPU)")
+    args = ap.parse_args(argv)
+    if args.segments:
+        return segments_main()
+
     mfu, tps, step_ms, n_params = bench_flagship_lm()
-    # transition-round continuity: the round-3/4 LayerNorm config (v1),
-    # measured in the SAME session so the records stay comparable
-    v1_mfu, _, v1_step_ms, _ = bench_flagship_lm(config="v1")
+    # transition-round continuity: the optax adamw step (the round-5
+    # headline's optimizer), measured in the SAME session so the fused
+    # switch stays comparable in the records
+    uf_mfu, _, uf_step_ms, _ = bench_flagship_lm(optimizer="adamw")
+    # optimizer segment: the same step with the cheapest possible update
+    _, _, sgd0_step_ms, _ = bench_flagship_lm(optimizer="sgd0")
     mnist = bench_mnist_cnn()
     aux = {
         "lm_tokens_per_sec": round(tps, 0),
         "lm_step_ms": round(step_ms, 1),
         "lm_params": n_params,
         "lm_batch": FLAGSHIP_BATCH,
-        "lm_mfu_layernorm_v1": round(v1_mfu, 1) if v1_mfu else None,
-        "lm_step_ms_layernorm_v1": round(v1_step_ms, 1),
+        "opt_ms": round(step_ms - sgd0_step_ms, 1),
+        "lm_step_ms_sgd0": round(sgd0_step_ms, 1),
+        "lm_mfu_adamw_unfused": round(uf_mfu, 1) if uf_mfu else None,
+        "lm_step_ms_adamw_unfused": round(uf_step_ms, 1),
         "mnist_cnn_images_per_sec": round(mnist, 0),
     }
     if mfu is not None:
@@ -134,7 +189,8 @@ def main():
         out = {"metric": "flagship_lm_tokens_per_sec", "value": round(tps, 0),
                "unit": "tokens/sec", "vs_baseline": 1.0, "aux": aux}
     print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

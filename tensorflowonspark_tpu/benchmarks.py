@@ -38,15 +38,26 @@ FLAGSHIP_LM = dict(
 FLAGSHIP_LM_V2 = dict(FLAGSHIP_LM, norm_type="rmsnorm")
 FLAGSHIP_BATCH = 8
 FLAGSHIP_MU_DTYPE = "bfloat16"
+# Round-6 headline optimizer: the single-pass fused AdamW kernel
+# (ops/fused_optim.py) — same math as optax adamw(mu_dtype=bfloat16), one
+# HBM pass over grad/param/moments instead of the optax chain's several.
+# The optax reference stays measurable via make_flagship_step(
+# optimizer="adamw") and bench.py's transition aux row.
+FLAGSHIP_OPTIMIZER = "adamw_fused"
 ROUND1_LM_MFU = 47.0  # BASELINE.md round-1 flagship-LM row (vs_baseline denom)
 
 
-def make_flagship_step(batch_size=None, seq_len=None, config="v2"):
+def make_flagship_step(batch_size=None, seq_len=None, config="v2",
+                       optimizer=None):
     """Build the flagship-LM training step exactly as the driver metric
     runs it: returns (step, state, tokens, n_params).  Donated state —
     call as ``state, m = step(state, tokens, rng)``.
     ``config``: "v2" (rmsnorm, the round-5 headline) or "v1" (the frozen
-    round-3 layernorm config, kept for the transition round's aux row)."""
+    round-3 layernorm config, kept for the transition round's aux row).
+    ``optimizer``: None -> FLAGSHIP_OPTIMIZER (adamw_fused, the round-6
+    headline); "adamw" -> the optax reference (transition aux row);
+    "sgd0" -> zero-lr momentum-less SGD, the near-free update whose step
+    time isolates the optimizer segment (bench.py's opt_ms)."""
     import numpy as np
 
     import jax
@@ -74,8 +85,15 @@ def make_flagship_step(batch_size=None, seq_len=None, config="v2"):
         return lm_loss(model.apply({"params": p}, batch[:, :-1]),
                        batch[:, 1:])
 
-    opt, _ = make_optimizer("adamw", learning_rate=3e-4,
-                            mu_dtype=FLAGSHIP_MU_DTYPE)
+    name = optimizer or FLAGSHIP_OPTIMIZER
+    if name == "sgd0":
+        # momentum=None (not 0.0): optax.sgd keeps a full trace state for
+        # any non-None momentum, which would put optimizer bandwidth back
+        # into the "no optimizer" segment baseline
+        opt, _ = make_optimizer("sgd", learning_rate=0.0, momentum=None)
+    else:
+        opt, _ = make_optimizer(name, learning_rate=3e-4,
+                                mu_dtype=FLAGSHIP_MU_DTYPE)
     state = train_mod.create_train_state(params, opt)
     step = train_mod.make_train_step(loss_fn, opt, donate=True)
     return step, state, tokens, n_params

@@ -14,12 +14,22 @@ def tree_map(f, *trees):
 
 
 def shard_map():
-    """Return the shard_map callable across jax versions."""
+    """Return the shard_map callable across jax versions, normalized to
+    the current kwarg spelling: call sites pass ``check_vma``; on older
+    jax (experimental entry point, ``check_rep``) a shim translates."""
+    import inspect
+
     import jax
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map as sm
-    return sm
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    if "check_vma" in inspect.signature(sm).parameters:
+        return sm
+
+    def _compat(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return sm(f, **kw)
+    return _compat
 
 
 def make_mesh(axis_shapes, axis_names, devices=None):

@@ -584,10 +584,12 @@ def _flash_dispatch(q, k, v, cfg):
             return dot_product_attention(q, kf, vf, causal=cfg.causal)
     import functools
     from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel.ring_attention import _get_shard_map
     spec = P(dp, None, tp, None)
     local = functools.partial(flash_attention, causal=cfg.causal)
-    return jax.shard_map(local, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return _get_shard_map()(local, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(q, k, v)
 
 
 def dot_product_attention(q, k, v, causal=True, mask=None):

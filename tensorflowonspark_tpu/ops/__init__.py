@@ -11,12 +11,17 @@ interpret-mode path so the same kernels are testable on the CPU mesh.
 - fused_unembed_xent : chunked lm_head matmul + cross entropy, no
   materialized logits (XLA scan, not Pallas — the MXU matmul is already
   optimal; the win is memory, see ops/xent.py)
+- adamw_fused / lion_fused : single-pass optimizer updates — read
+  grad/param/moments once, write param/moments once, clip scale inlined
+  (see ops/fused_optim.py; surfaced via optim.make_optimizer)
 """
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+from tensorflowonspark_tpu.ops.fused_optim import adamw_fused, lion_fused
 from tensorflowonspark_tpu.ops.layernorm import fused_layernorm
 from tensorflowonspark_tpu.ops.xent import fused_unembed_xent
 
-__all__ = ["flash_attention", "fused_layernorm", "fused_unembed_xent"]
+__all__ = ["flash_attention", "fused_layernorm", "fused_unembed_xent",
+           "adamw_fused", "lion_fused"]
 
 
 def default_interpret():

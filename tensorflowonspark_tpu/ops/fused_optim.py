@@ -1,0 +1,400 @@
+"""Single-pass fused optimizer kernels (Pallas): AdamW and Lion.
+
+The optax update for the flagship LM is a chain of elementwise
+transforms — clip -> moments -> weight decay -> lr scale -> apply — and
+each link reads and writes the full f32 optimizer state in HBM.  At
+0.87B params that is several complete passes over ~10 GB of state per
+step, pure bandwidth the matmuls cannot hide (BENCH_r05: the optimizer
+dominates the non-matmul remainder at 71.4% MFU).  These kernels apply
+the ENTIRE update in one pass per parameter block:
+
+    read  grad, param, mu[, nu]   (once)
+    write param, mu[, nu]         (once)
+
+Global-norm clipping folds in as a pre-computed scalar: one cheap
+reduction pass over the gradients (``optax.global_norm``, which the
+train step's metrics already compute — XLA CSEs the two), then the
+scale rides into the fused apply as an SMEM scalar.  Bias corrections
+and the schedule's learning rate enter the same way, so the kernel body
+is a single VPU expression per block.
+
+HBM traffic model (f32 everything, P = param count, one step):
+
+    optax adamw chain   ~>=10 P reads/writes (clip copy, scale_by_adam
+                        in/out, decayed-weights add, lr scale, apply)
+    fused kernel          7 P  (4 reads + 3 writes), 5 P with bf16 mu
+
+Exposed as an optax-compatible ``GradientTransformation`` with one
+extra method:
+
+    ``update(grads, state, params)`` -> (updates, state)   # optax protocol
+    ``apply(grads, state, params)``  -> (new_params, state) # single-pass
+
+``update`` keeps every optax composition working (tests verify parity
+against ``optax.chain(clip_by_global_norm, adamw)`` step-for-step);
+``apply`` additionally fuses the final ``optax.apply_updates`` add into
+the kernel (the parameter write shares the pass), so no ``updates`` tree
+ever materializes — the path ``parallel.train.make_train_step`` takes
+automatically; the train step's jit donation recycles the old
+param/moment buffers.  Both run the SAME kernel body, so the CPU test
+tier (interpret=True) exercises the real kernel code.
+
+State layout: the moments keep each parameter's exact shape and mirror
+the parameter pytree (``FusedAdamWState.mu/nu``), so under explicit
+shardings the state shards by the param's OWN spec — fsdp and tp axes
+alike — with zero extra machinery (``parallel.train._opt_state_
+shardings`` maps the mirrored tree onto the param shardings, the same
+placement rule f32 optax moments get).  Blocking to the kernel's
+(rows, 128) grid happens on flat views inside the jitted update, which
+XLA lowers to bitcasts (plus a pad copy only for parameters whose size
+is not a lane multiple — none of the flagship's are).
+
+``mu_dtype="bfloat16"`` stores the first moment in bf16 exactly like
+``optax.adamw(mu_dtype=...)`` (compute stays f32 in VMEM; the narrow
+store halves that operand's traffic).  The second moment stays at the
+parameter dtype, matching optax.  For MEMORY-bound settings prefer
+``optim8bit.adamw8bit`` (int8 state, 4x smaller); this kernel is the
+SPEED choice (fewest HBM passes, full-precision state).
+"""
+import functools
+import math
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled jaxlibs; interpret mode needs it not
+    from jax.experimental.pallas import tpu as pltpu
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _SMEM = None
+
+LANE = 128               # TPU lane width: last dim of every block
+DEFAULT_BLOCK_ROWS = 256  # (256, 128) f32 block = 128 KB per operand in VMEM
+_SUBLANE = 16            # sublane multiple that tiles bf16 and f32 alike
+
+
+class FusedAdamWState(NamedTuple):
+    """Fused-AdamW state; mu/nu mirror the param pytree shape-for-shape
+    (so state shardings mirror param shardings — see module doc)."""
+    count: Any
+    mu: Any
+    nu: Any
+
+
+class FusedLionState(NamedTuple):
+    count: Any
+    mu: Any
+
+
+class FusedOptimizer(NamedTuple):
+    """Duck-types as `optax.GradientTransformation` (init/update) with an
+    extra single-pass `apply(grads, state, params) -> (params, state)`.
+    NOTE: `optax.chain` strips `apply` — fold clipping/decay in via the
+    constructor arguments instead of chaining."""
+    init: Callable
+    update: Callable
+    apply: Callable
+
+
+# ---------------------------------------------------------------------------
+# kernels — one (block_rows, LANE) tile per grid step, everything f32 on the
+# VPU; scalars (lr, clip scale, bias corrections) ride in SMEM
+# ---------------------------------------------------------------------------
+
+def _adamw_kernel(s_ref, g_ref, p_ref, mu_ref, nu_ref,
+                  o_ref, mu_o_ref, nu_o_ref, *, b1, b2, eps, wd,
+                  write_param):
+    lr = s_ref[0, 0]
+    clip = s_ref[0, 1]
+    c1 = s_ref[0, 2]          # 1 - b1**t  (bias corrections, host-side pow)
+    c2 = s_ref[0, 3]
+    g = g_ref[:].astype(jnp.float32) * clip
+    # identical expression order to optax.tree_update_moment for tight parity
+    mu = (1.0 - b1) * g + b1 * mu_ref[:].astype(jnp.float32)
+    nu = (1.0 - b2) * (g * g) + b2 * nu_ref[:].astype(jnp.float32)
+    upd = (mu / c1) / (jnp.sqrt(nu / c2) + eps)
+    if wd or write_param:
+        p = p_ref[:].astype(jnp.float32)
+    if wd:
+        upd = upd + wd * p
+    if write_param:
+        o_ref[:] = (p - lr * upd).astype(o_ref.dtype)
+    else:
+        o_ref[:] = (-lr * upd).astype(o_ref.dtype)
+    mu_o_ref[:] = mu.astype(mu_o_ref.dtype)
+    nu_o_ref[:] = nu.astype(nu_o_ref.dtype)
+
+
+def _lion_kernel(s_ref, g_ref, p_ref, mu_ref, o_ref, mu_o_ref,
+                 *, b1, b2, wd, write_param):
+    lr = s_ref[0, 0]
+    clip = s_ref[0, 1]
+    g = g_ref[:].astype(jnp.float32) * clip
+    mu = mu_ref[:].astype(jnp.float32)
+    upd = jnp.sign((1.0 - b1) * g + b1 * mu)     # sign of the interpolation
+    new_mu = (1.0 - b2) * g + b2 * mu            # the stored momentum
+    if wd or write_param:
+        p = p_ref[:].astype(jnp.float32)
+    if wd:
+        upd = upd + wd * p
+    if write_param:
+        o_ref[:] = (p - lr * upd).astype(o_ref.dtype)
+    else:
+        o_ref[:] = (-lr * upd).astype(o_ref.dtype)
+    mu_o_ref[:] = new_mu.astype(mu_o_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# per-leaf driver: flatten to (rows, LANE), pad the tail block, run the grid
+# ---------------------------------------------------------------------------
+
+def _block_rows_for(n, block_rows):
+    """Rows per grid step: the default, shrunk for small params so a bias
+    vector does not pad out to a full block (sublane-multiple so one tile
+    size serves f32 and bf16 operands)."""
+    rows = -(-n // LANE)
+    return min(block_rows, -(-rows // _SUBLANE) * _SUBLANE)
+
+
+def _to_blocks(x, bm):
+    flat = x.reshape(-1)
+    per = bm * LANE
+    padded = -(-flat.shape[0] // per) * per
+    if padded != flat.shape[0]:
+        flat = jnp.pad(flat, (0, padded - flat.shape[0]))
+    return flat.reshape(-1, LANE)
+
+
+def _from_blocks(y, shape):
+    n = math.prod(shape) if shape else 1
+    return y.reshape(-1)[:n].reshape(shape)
+
+
+def _run_leaf(kernel, scalars, arrays, out_dtypes, block_rows, interpret):
+    """Run `kernel` over same-shaped leaf `arrays` blocked to (bm, LANE).
+
+    `arrays[0]` supplies the logical shape; outputs are the first
+    `len(out_dtypes)` kernel refs after the inputs, unpadded back to it.
+    Padding lanes hold zeros; both kernels map zero grad/state to zero
+    output (eps keeps the adam quotient finite), so the pad never NaNs.
+
+    Two deliberate sharding choices, both found the hard way on the
+    8-device mesh: (1) NO pallas-level input_output_aliases — under GSPMD
+    the compiler may pick different shardings for the flattened operand
+    and its output, and the runtime alias check then fails on mismatched
+    per-shard sizes; aliasing only saves a buffer allocation, not HBM
+    traffic (the read+write still happen exactly once here), and the
+    train step's jit donation already recycles the old state buffers.
+    (2) every output is pinned to its input's sharding via shard_alike —
+    the flatten/unflatten reshapes break GSPMD's propagation, and a
+    freshly-chosen output sharding makes the train step's donated state
+    aliases fail the same way.
+    """
+    from jax.experimental.shard_alike import shard_alike
+
+    shape = arrays[0].shape
+    n = math.prod(shape) if shape else 1
+    bm = _block_rows_for(n, block_rows)
+    blocks = [_to_blocks(a, bm) for a in arrays]
+    rows = blocks[0].shape[0]
+    bspec = pl.BlockSpec((bm, LANE), lambda i: (i, 0))
+    if _SMEM is not None:
+        sspec = pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=_SMEM)
+    else:  # pragma: no cover - CPU-only jaxlib
+        sspec = pl.BlockSpec((1, 4), lambda i: (0, 0))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(rows // bm,),
+        in_specs=[sspec] + [bspec] * len(blocks),
+        out_specs=[bspec] * len(out_dtypes),
+        out_shape=[jax.ShapeDtypeStruct((rows, LANE), d)
+                   for d in out_dtypes],
+        interpret=interpret,
+    )(scalars, *blocks)
+    outs = [_from_blocks(o, shape) for o in outs]
+    # outputs correspond positionally to the TRAILING inputs (adamw:
+    # out/new_mu/new_nu <- p/mu/nu; lion: out/new_mu <- p/mu)
+    srcs = arrays[len(arrays) - len(outs):]
+    return tuple(shard_alike(s, o)[1] for s, o in zip(srcs, outs))
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+def _resolve(value, params):
+    return value(params) if callable(value) else value
+
+
+def _decay_tree(params, weight_decay, mask):
+    """Static per-leaf weight decay (the mask routes decay away from
+    biases/norms; leaves must be static bools — they pick the compiled
+    kernel variant)."""
+    if not weight_decay:
+        return jax.tree_util.tree_map(lambda _: 0.0, params)
+    if mask is None:
+        return jax.tree_util.tree_map(lambda _: float(weight_decay), params)
+    m = _resolve(mask, params)
+    return jax.tree_util.tree_map(
+        lambda flag: float(weight_decay) if flag else 0.0, m)
+
+
+def _scalars(learning_rate, count, clip_norm, b1, b2, updates):
+    """Pack (lr, clip_scale, 1-b1^t, 1-b2^t) as the kernels' SMEM operand.
+    One global-norm reduction when clipping — the only non-fused pass."""
+    import optax
+
+    lr = _resolve(learning_rate, count)
+    t = optax.safe_int32_increment(count).astype(jnp.float32)
+    if clip_norm:
+        g_norm = optax.global_norm(updates)
+        # optax.clip_by_global_norm: identity below the threshold, exact
+        # max_norm/g_norm scale above it
+        clip = jnp.where(g_norm < clip_norm, 1.0,
+                         clip_norm / g_norm)
+    else:
+        clip = 1.0
+    return jnp.stack([jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(clip, jnp.float32),
+                      1.0 - b1 ** t,
+                      1.0 - b2 ** t]).reshape(1, 4)
+
+
+def _interpret_flag(interpret):
+    if interpret is None:
+        from tensorflowonspark_tpu.ops import default_interpret
+        return default_interpret()
+    return bool(interpret)
+
+
+def adamw_fused(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0, mask=None, clip_norm=None, mu_dtype=None,
+                block_rows=DEFAULT_BLOCK_ROWS, interpret=None):
+    """Fused AdamW: matches ``optax.chain(clip_by_global_norm(clip_norm),
+    adamw(...))`` step-for-step (tests assert rtol ~1e-6 in f32) while
+    touching HBM once per operand.  ``learning_rate`` may be a schedule
+    (called with the update count, optax convention).  See module doc for
+    the ``update`` vs ``apply`` split."""
+    mu_dtype = jnp.dtype(mu_dtype) if mu_dtype is not None else None
+
+    def init_fn(params):
+        # zeros_like, not zeros: it inherits each param's placement, so
+        # moments created from already-sharded params land sharded too
+        return FusedAdamWState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype),
+                params),
+            nu=jax.tree_util.tree_map(jnp.zeros_like, params))
+
+    def _run(updates, state, params, write_param):
+        if params is None:
+            if weight_decay:
+                raise ValueError(
+                    "adamw_fused with weight_decay requires params "
+                    "(optax convention: update(grads, state, params))")
+            if write_param:
+                raise ValueError("apply() requires params")
+            params = updates     # placeholder operand; kernels skip p reads
+        interp = _interpret_flag(interpret)
+        scal = _scalars(learning_rate, state.count, clip_norm, b1, b2,
+                        updates)
+        wds = _decay_tree(updates, weight_decay, mask)
+
+        def leaf(g, p, mu, nu, wd):
+            kern = functools.partial(
+                _adamw_kernel, b1=float(b1), b2=float(b2), eps=float(eps),
+                wd=float(wd), write_param=write_param)
+            out_dtype = p.dtype if write_param else g.dtype
+            out, new_mu, new_nu = _run_leaf(
+                kern, scal, [g, p, mu, nu],
+                [out_dtype, mu.dtype, nu.dtype], block_rows, interp)
+            return _LeafOut(out, new_mu, new_nu)
+
+        flat = jax.tree_util.tree_map(leaf, updates, params, state.mu,
+                                      state.nu, wds)
+        is_out = lambda x: isinstance(x, _LeafOut)  # noqa: E731
+        import optax
+        new_state = FusedAdamWState(
+            count=optax.safe_int32_increment(state.count),
+            mu=jax.tree_util.tree_map(lambda t: t.mu, flat, is_leaf=is_out),
+            nu=jax.tree_util.tree_map(lambda t: t.nu, flat, is_leaf=is_out))
+        out = jax.tree_util.tree_map(lambda t: t.out, flat, is_leaf=is_out)
+        return out, new_state
+
+    def update_fn(updates, state, params=None):
+        return _run(updates, state, params, write_param=False)
+
+    def apply_fn(updates, state, params):
+        return _run(updates, state, params, write_param=True)
+
+    return FusedOptimizer(init_fn, update_fn, apply_fn)
+
+
+def lion_fused(learning_rate, b1=0.9, b2=0.99, weight_decay=0.0, mask=None,
+               clip_norm=None, mu_dtype=None,
+               block_rows=DEFAULT_BLOCK_ROWS, interpret=None):
+    """Fused Lion (sign-momentum): matches ``optax.chain(clip_by_global_
+    norm, lion(...))``; half the moment state of AdamW and the same
+    single-pass traffic model."""
+    mu_dtype = jnp.dtype(mu_dtype) if mu_dtype is not None else None
+
+    def init_fn(params):
+        # zeros_like inherits each param's placement (see adamw_fused)
+        return FusedLionState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype),
+                params))
+
+    def _run(updates, state, params, write_param):
+        if params is None:
+            if weight_decay:
+                raise ValueError(
+                    "lion_fused with weight_decay requires params")
+            if write_param:
+                raise ValueError("apply() requires params")
+            params = updates
+        interp = _interpret_flag(interpret)
+        scal = _scalars(learning_rate, state.count, clip_norm, b1, b2,
+                        updates)
+        wds = _decay_tree(updates, weight_decay, mask)
+
+        def leaf(g, p, mu, wd):
+            kern = functools.partial(
+                _lion_kernel, b1=float(b1), b2=float(b2), wd=float(wd),
+                write_param=write_param)
+            out_dtype = p.dtype if write_param else g.dtype
+            out, new_mu = _run_leaf(
+                kern, scal, [g, p, mu], [out_dtype, mu.dtype],
+                block_rows, interp)
+            return _LeafOut(out, new_mu, None)
+
+        flat = jax.tree_util.tree_map(leaf, updates, params, state.mu, wds)
+        is_out = lambda x: isinstance(x, _LeafOut)  # noqa: E731
+        import optax
+        new_state = FusedLionState(
+            count=optax.safe_int32_increment(state.count),
+            mu=jax.tree_util.tree_map(lambda t: t.mu, flat, is_leaf=is_out))
+        out = jax.tree_util.tree_map(lambda t: t.out, flat, is_leaf=is_out)
+        return out, new_state
+
+    def update_fn(updates, state, params=None):
+        return _run(updates, state, params, write_param=False)
+
+    def apply_fn(updates, state, params):
+        return _run(updates, state, params, write_param=True)
+
+    return FusedOptimizer(init_fn, update_fn, apply_fn)
+
+
+class _LeafOut(NamedTuple):
+    """Per-leaf kernel results (a dedicated type so tree_map's is_leaf
+    cannot collide with tuple containers inside the user's param pytree —
+    same device as optim8bit._UpdOut)."""
+    out: Any
+    mu: Any
+    nu: Any

@@ -17,7 +17,11 @@ import logging
 logger = logging.getLogger(__name__)
 
 SCHEDULES = ("constant", "cosine", "linear", "rsqrt")
-OPTIMIZERS = ("adam", "adamw", "adamw8bit", "sgd", "lion", "adafactor")
+OPTIMIZERS = ("adam", "adamw", "adamw_fused", "adamw8bit", "sgd", "lion",
+              "lion_fused", "adafactor")
+# single-pass Pallas kernels (ops/fused_optim): clipping/decay/lr fold INTO
+# the fused update instead of an optax.chain around it
+_FUSED = ("adamw_fused", "lion_fused")
 
 
 def make_schedule(learning_rate, schedule="constant", warmup_steps=0,
@@ -66,19 +70,29 @@ def make_optimizer(name="adamw", learning_rate=1e-3, schedule="constant",
     (adam, sgd, adafactor) refuse a nonzero `weight_decay` rather than
     silently dropping it.
 
-    `mu_dtype` (adam/adamw/lion) stores the first moment in a narrower
-    dtype — ``"bfloat16"`` halves that state's HBM footprint AND the
-    optimizer update's bandwidth (momentum is noise-tolerant; the
-    second moment stays float32).  On one v5e chip this took the 0.87B
-    flagship-LM step from 351 ms (61.8% MFU) to 326 ms (66.6% MFU, the
-    canonical bench.py run); see BASELINE.md round 3.
+    `mu_dtype` (adam/adamw/lion and the fused variants) stores the first
+    moment in a narrower dtype — ``"bfloat16"`` halves that state's HBM
+    footprint AND the optimizer update's bandwidth (momentum is
+    noise-tolerant; the second moment stays float32).  On one v5e chip
+    this took the 0.87B flagship-LM step from 351 ms (61.8% MFU) to
+    326 ms (66.6% MFU, the canonical bench.py run); see BASELINE.md
+    round 3.
+
+    ``adamw_fused`` / ``lion_fused`` run the whole update — clip scale,
+    moments, decay, lr — as ONE Pallas pass per parameter block
+    (ops/fused_optim.py): `clip_norm` folds in as a pre-computed scalar
+    instead of a chained transform, and the returned object carries an
+    extra single-pass ``apply(grads, state, params)`` the train-step
+    harness uses automatically.  Same math as the optax references
+    (tests assert step-for-step parity); fewest HBM passes of any
+    optimizer here — the SPEED choice, vs adamw8bit (memory).
     """
     import optax
 
     if isinstance(mu_dtype, str):
         import jax.numpy as jnp
         mu_dtype = jnp.dtype(mu_dtype)
-    if mu_dtype is not None and name not in ("adam", "adamw", "lion"):
+    if mu_dtype is not None and name not in ("adam", "adamw", "lion") + _FUSED:
         raise ValueError(f"optimizer={name!r} has no mu_dtype knob")
     if layouts is not None and name != "adamw8bit":
         raise ValueError(
@@ -88,10 +102,11 @@ def make_optimizer(name="adamw", learning_rate=1e-3, schedule="constant",
     if name not in OPTIMIZERS:
         raise ValueError(f"optimizer={name!r} not in {OPTIMIZERS}")
     if (weight_decay or decay_mask is not None) and name not in (
-            "adamw", "adamw8bit", "lion"):
+            "adamw", "adamw8bit", "lion") + _FUSED:
         raise ValueError(
             f"optimizer={name!r} has no decoupled weight decay; use adamw, "
-            "adamw8bit, or lion (or drop weight_decay/decay_mask)")
+            "adamw_fused, adamw8bit, or lion (or drop "
+            "weight_decay/decay_mask)")
     sched = make_schedule(learning_rate, schedule, warmup_steps,
                           total_steps, end_value)
     if name == "adam":
@@ -101,6 +116,21 @@ def make_optimizer(name="adamw", learning_rate=1e-3, schedule="constant",
         core = optax.adamw(sched, b1=b1 or 0.9, b2=b2 or 0.999,
                            weight_decay=weight_decay, mask=decay_mask,
                            mu_dtype=mu_dtype)
+    elif name in _FUSED:
+        # single-pass Pallas kernels: clip_norm and decay fold INTO the
+        # fused update (chaining optax.clip around them would both waste
+        # a pass and strip the .apply method the train step fuses on)
+        from tensorflowonspark_tpu.ops import fused_optim
+        if name == "adamw_fused":
+            core = fused_optim.adamw_fused(
+                sched, b1=b1 or 0.9, b2=b2 or 0.999,
+                weight_decay=weight_decay, mask=decay_mask,
+                clip_norm=clip_norm, mu_dtype=mu_dtype)
+        else:
+            core = fused_optim.lion_fused(
+                sched, b1=b1 or 0.9, b2=b2 or 0.99,
+                weight_decay=weight_decay, mask=decay_mask,
+                clip_norm=clip_norm, mu_dtype=mu_dtype)
     elif name == "adamw8bit":
         # int8 blockwise moments — 4x less optimizer HBM and update
         # bandwidth than f32 adamw (see optim8bit module doc); mu_dtype
@@ -117,7 +147,7 @@ def make_optimizer(name="adamw", learning_rate=1e-3, schedule="constant",
                           mu_dtype=mu_dtype)
     else:  # adafactor: the memory-frugal choice for big models
         core = optax.adafactor(sched)
-    if clip_norm:
+    if clip_norm and name not in _FUSED:
         core = optax.chain(optax.clip_by_global_norm(clip_norm), core)
     logger.info("optimizer %s lr=%s schedule=%s warmup=%d wd=%s clip=%s",
                 name, learning_rate, schedule, warmup_steps, weight_decay,

@@ -75,9 +75,8 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, mesh, axis="pp",
     """
     from jax.sharding import PartitionSpec as P
 
-    shard_map = jax.shard_map if hasattr(jax, "shard_map") else None
-    if shard_map is None:  # pragma: no cover - old jax
-        from jax.experimental.shard_map import shard_map
+    from tensorflowonspark_tpu.parallel.ring_attention import _get_shard_map
+    shard_map = _get_shard_map()
 
     n_micro = x_micro.shape[0]
     param_specs = jax.tree_util.tree_map(

@@ -202,7 +202,7 @@ def ring_attention(q, k, v, axis_name="tp", causal=True, mesh=None,
 
 
 def _get_shard_map():
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
-    return shard_map
+    """shard_map normalized to the current kwarg spelling (compat.py owns
+    the version translation — check_vma vs the older check_rep)."""
+    from tensorflowonspark_tpu.compat import shard_map
+    return shard_map()

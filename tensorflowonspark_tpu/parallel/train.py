@@ -46,6 +46,13 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
     sharded), jit's sharding propagation makes XLA emit the gradient
     allreduce automatically.
 
+    An optimizer exposing a single-pass ``apply(grads, state, params) ->
+    (params, state)`` (ops/fused_optim's adamw_fused/lion_fused, via
+    ``optim.make_optimizer``) takes that path instead of
+    ``update`` + ``optax.apply_updates``: the parameter write happens
+    inside the fused kernel's one pass over the state, and jit donation
+    recycles the old param/moment buffers.
+
     ``example_params`` (arrays or ShapeDtypeStructs matching the real
     parameters) is only needed with `param_shardings` AND an optimizer
     whose state the shardings alone cannot place — optim8bit's quantized
@@ -91,11 +98,21 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
         else:
             loss, grads = jax.value_and_grad(_loss)(state.params, batch, rng)
 
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         import optax
-        params = optax.apply_updates(state.params, updates)
+        fused_apply = getattr(optimizer, "apply", None)
+        if callable(fused_apply):
+            # single-pass fused optimizer: param write fused into the
+            # kernel's one pass over grad/moments (no apply_updates pass)
+            params, opt_state = fused_apply(grads, state.opt_state,
+                                            state.params)
+        else:
+            updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+            params = optax.apply_updates(state.params, updates)
         new_state = TrainState(step=state.step + 1, params=params,
                                opt_state=opt_state)
+        # the fused path computes this same reduction for its clip scale;
+        # XLA CSEs the two, so the metric stays free there
         metrics = {"loss": loss,
                    "grad_norm": optax.global_norm(grads)}
         return new_state, metrics
@@ -112,6 +129,29 @@ def make_train_step(loss_fn, optimizer, mesh=None, param_shardings=None,
     repl = NamedSharding(mesh, PartitionSpec())
     batch_shard = mesh_mod.batch_sharding(mesh)
     if param_shardings is None:
+        if callable(getattr(optimizer, "apply", None)):
+            # fused-optimizer path: pallas_call is a custom call GSPMD
+            # cannot partition, so sharding does not propagate through it
+            # the way it does through the optax update — left unpinned,
+            # the compiler picks fresh output shardings and the donated
+            # state aliases fail at runtime on mismatched shard sizes.
+            # Pin the state outputs to the incoming placement, derived
+            # from the first state actually passed in.
+            cache = {}
+
+            def step(state, batch, rng):
+                if "fn" not in cache:
+                    state_sh = jax.tree_util.tree_map(
+                        lambda x: x.sharding
+                        if isinstance(x.sharding, NamedSharding) else repl,
+                        state)
+                    cache["fn"] = jax.jit(
+                        _step,
+                        in_shardings=(state_sh, batch_shard, repl),
+                        out_shardings=(state_sh, repl),
+                        donate_argnums=(0,) if donate else ())
+                return cache["fn"](state, batch, rng)
+            return step
         state_shardings = None  # let jit infer from input placement
         in_shardings = (None, batch_shard, repl)
         out_shardings = (None, repl)
@@ -132,6 +172,15 @@ def _opt_state_shardings(optimizer, param_shardings, repl,
                          example_params=None, layouts=None):
     """Mirror param shardings onto optimizer slots (mu/nu mirror the param
     tree and inherit its shardings; scalar slots like counts replicate).
+
+    The fused single-pass optimizers (ops/fused_optim's FusedAdamWState /
+    FusedLionState) are placed by the NamedTuple recursion below: their
+    moments keep each parameter's exact shape and mirror the param
+    pytree, so every moment shards by its param's OWN spec — fsdp and tp
+    axes alike, the placement f32 optax moments get — and the kernel's
+    (rows, 128) blocking happens per shard inside the jitted step with
+    no cross-shard blocks (the fused analog of optim8bit's shard-aligned
+    layouts, with alignment by construction instead of a layouts= knob).
 
     ``example_params`` (a pytree of arrays or ShapeDtypeStructs matching
     the real parameters) enables shape-aware placement for state the

@@ -122,6 +122,13 @@ def build_argparser():
     return p
 
 
+def _is_int(x):
+    """A REAL int: JSON `true`/`false` arrive as Python bools, which are
+    ints by inheritance — `{"top_k": true}` would otherwise sail through
+    int validation as top_k=1 instead of 400ing."""
+    return isinstance(x, int) and not isinstance(x, bool)
+
+
 def _instances_to_columns(instances, input_names=None):
     """[{feature: value}, ...] -> ({feature: [values]}, n).
 
@@ -463,8 +470,12 @@ class ContinuousBatcher:
         # "int8" stores the slot kv cache quantized (int8 payload +
         # per-(token, head) f32 scales — TransformerConfig.kv_dtype):
         # ~2x less resident kv vs bf16, composing with paging (pool
-        # pages quantize too) and every sampling control
-        self.kv_dtype = kv_dtype
+        # pages quantize too) and every sampling control.  "auto" (the
+        # CLI default GenerateService forwards) normalizes to None HERE
+        # so a directly-constructed batcher behaves identically and
+        # stats() never reports a phantom quantized cache
+        self.kv_dtype = None if kv_dtype == "auto" else kv_dtype
+        kv_dtype = self.kv_dtype
         self.kv_page_size = int(kv_page_size or 0)
         if self.kv_page_size and int(kv_pages) < 1:
             raise ValueError(
@@ -626,8 +637,10 @@ class ContinuousBatcher:
     def stats(self):
         """Operational snapshot for the metadata endpoint: occupancy,
         queue depth, dispatch counters, and (paged mode) pool state.
-        Read without locks — values are monotone counters and small
-        lists whose momentary skew is fine for monitoring."""
+        Mostly read without locks — monotone counters and small lists
+        whose momentary skew is fine for monitoring; the LoRA registry
+        (a dict concurrent register_adapter calls resize) is the one
+        read snapshotted under its lock."""
         out = {
             "slots_busy": sum(s is not None for s in self._slots),
             "pending": self._pending.qsize(),
@@ -645,8 +658,14 @@ class ContinuousBatcher:
             out["prefill_tokens_shared"] = self.prefill_tokens_shared
         if self.lora_rank:
             out["lora_rank"] = self.lora_rank
-            out["lora_adapters"] = sorted(self._adapters)
-            out["lora_capacity_free"] = len(self._free_lora)
+            # the one mutable-container read: snapshot under _lora_lock so
+            # a concurrent register_adapter cannot resize the dict
+            # mid-iteration ("dictionary changed size during iteration")
+            with self._lora_lock:
+                adapters = sorted(self._adapters)
+                free = len(self._free_lora)
+            out["lora_adapters"] = adapters
+            out["lora_capacity_free"] = free
         if self.kv_dtype:
             out["kv_dtype"] = self.kv_dtype
         return out
@@ -770,10 +789,11 @@ class ContinuousBatcher:
             raise ValueError(
                 "this server has no LoRA bank (start it with "
                 "--generate_lora_rank and --generate_lora)")
-        if not (isinstance(top_k, int) and 0 <= top_k < (1 << 31)):
+        if not (_is_int(top_k) and 0 <= top_k < (1 << 31)):
             # the upper bound matters: these become int32 device scalars
             # on the single driver thread, where an overflow would brick
-            # the whole engine instead of 400ing one request
+            # the whole engine instead of 400ing one request (and bools
+            # are excluded: JSON true would silently mean top_k=1)
             raise ValueError(f"top_k={top_k!r} must be an int32 >= 0")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p={top_p!r} must be in (0, 1]")
@@ -785,7 +805,7 @@ class ContinuousBatcher:
         stops = []
         for st in (stop or []):
             if (not isinstance(st, (list, tuple)) or not st
-                    or not all(isinstance(t, int) for t in st)):
+                    or not all(_is_int(t) for t in st)):
                 raise ValueError('"stop" must be a list of non-empty '
                                  "token-id lists")
             stops.append(list(st))
@@ -1536,25 +1556,25 @@ class GenerateService:
         inputs = req.get("inputs")
         if (not isinstance(inputs, list) or not inputs
                 or not all(isinstance(p, list) and p and
-                           all(isinstance(t, int)
+                           all(_is_int(t)
                                and 0 <= t < self._I32 for t in p)
                            for p in inputs)):
             raise ValueError('"inputs" must be a non-empty list of '
                              "non-empty lists of token ids in [0, 2^31)")
         max_new = req.get("max_new_tokens", 16)
-        if not isinstance(max_new, int) or not 1 <= max_new <= self.limit:
+        if not _is_int(max_new) or not 1 <= max_new <= self.limit:
             raise ValueError(f'"max_new_tokens" must be an int in '
                              f"[1, {self.limit}]")
         temperature = float(req.get("temperature", 0.0))
         if temperature < 0:
             raise ValueError('"temperature" must be >= 0')
         eos_id = req.get("eos_id")
-        if eos_id is not None and not (isinstance(eos_id, int)
+        if eos_id is not None and not (_is_int(eos_id)
                                        and -self._I32 <= eos_id < self._I32):
             raise ValueError('"eos_id" must be an int32')
         seed = req.get("seed")
         if seed is not None:
-            if not (isinstance(seed, int)
+            if not (_is_int(seed)
                     and -self._I32 <= seed < self._I32 - len(inputs)):
                 raise ValueError('"seed" must be an int32 (with headroom '
                                  "for per-prompt offsets)")
@@ -1564,7 +1584,7 @@ class GenerateService:
             raise ValueError('"adapter" must be a registered adapter name '
                              "(string)")
         top_k = req.get("top_k", 0)
-        if not (isinstance(top_k, int) and 0 <= top_k < self._I32):
+        if not (_is_int(top_k) and 0 <= top_k < self._I32):
             raise ValueError('"top_k" must be an int >= 0')
         top_p = float(req.get("top_p", 1.0))
         if not 0.0 < top_p <= 1.0:
@@ -1579,7 +1599,7 @@ class GenerateService:
         if stop is not None:
             if (not isinstance(stop, list) or len(stop) > 16
                     or not all(isinstance(st, list) and st and len(st) <= 32
-                               and all(isinstance(t, int)
+                               and all(_is_int(t)
                                        and -self._I32 <= t < self._I32
                                        for t in st)
                                for st in stop)):
@@ -1587,7 +1607,8 @@ class GenerateService:
                     '"stop" must be a list (<= 16) of non-empty token-id '
                     "lists (<= 32 tokens each)")
         rep = req.get("repetition_penalty", 1.0)
-        if not (isinstance(rep, (int, float)) and 0 < rep <= 1e6):
+        if not (isinstance(rep, (int, float)) and not isinstance(rep, bool)
+                and 0 < rep <= 1e6):
             raise ValueError('"repetition_penalty" must be a number in '
                              "(0, 1e6] (1.0 disables)")
         return (inputs, max_new, temperature, eos_id, seed, adapter,

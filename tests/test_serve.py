@@ -302,6 +302,15 @@ def test_generate_validation_400s(lm_server):
                 {"inputs": [[1, 2]], "max_new_tokens": 99},   # over limit
                 {"inputs": [["a"]]},
                 {"inputs": [[1]], "temperature": -1},
+                # JSON booleans are Python bools — ints by inheritance —
+                # and must NOT pass int validation (true would mean 1)
+                {"inputs": [[1]], "top_k": True, "temperature": 1.0},
+                {"inputs": [[1]], "max_new_tokens": True},
+                {"inputs": [[1]], "seed": False},
+                {"inputs": [[1]], "eos_id": True},
+                {"inputs": [[True, 2]]},
+                {"inputs": [[1]], "stop": [True]},
+                {"inputs": [[1]], "repetition_penalty": True},
                 {"inputs": [[1] * 40, ], "max_new_tokens": 8}):  # > max_seq
         code, out = _post_gen(server, "/v1/models/default:generate", bad)
         assert code == 400, (bad, out)
@@ -695,6 +704,35 @@ def test_slots_cancel_frees_slot(slot_server):
     # the batcher keeps serving new requests afterwards
     out = gen.batcher.submit([4, 5], 4).result(timeout=120)
     assert len(out) == 6
+
+
+def test_slots_submit_rejects_bool_sampling_ints(slot_server):
+    # bools are ints by inheritance: submit() must refuse them the same
+    # way the HTTP layer does (True would silently mean top_k=1)
+    _, service, model, params = slot_server
+    b = service.generate_service().batcher
+    with pytest.raises(ValueError, match="top_k"):
+        b.submit([1, 2], 4, temperature=1.0, top_k=True)
+    with pytest.raises(ValueError, match="stop"):
+        b.submit([1, 2], 4, stop=[[True]])
+    # real ints still sail through to a result
+    out = b.submit([1, 2], 2, temperature=1.0, top_k=3,
+                   seed=7).result(timeout=120)
+    assert len(out) == 4
+
+
+def test_kv_dtype_auto_normalizes_to_none(slot_server):
+    # a directly-constructed batcher must not report a phantom quantized
+    # cache when handed the argparser's literal "auto" default
+    _, service, model, params = slot_server
+    b = serve.ContinuousBatcher(model, params, n_slots=2, kv_dtype="auto")
+    try:
+        assert b.kv_dtype is None
+        assert "kv_dtype" not in b.stats()
+    finally:
+        b.stop()
+    # the running server (built through the same "auto" default) agrees
+    assert "kv_dtype" not in service.generate_service().batcher.stats()
 
 
 def test_generate_quantized_through_http(tmp_path):
