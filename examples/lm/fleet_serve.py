@@ -1,0 +1,142 @@
+"""Two-replica fleet serving: gateway + replicas in one process.
+
+The horizontal half of the serving story (llama_serve.py covers one
+replica): a `fleet.Gateway` fronts TWO `serve.py` replicas of the same
+tiny decoder-LM export as ONE endpoint.  The replicas register over the
+reservation plane (the same protocol that rendezvouses training
+executors — the TFoS tie-in), heartbeat for liveness, and the gateway
+routes `:generate` by prefix affinity so requests sharing a prompt
+prefix land where their paged-KV prefix pages are already warm:
+
+1. build + export a small random decoder LM (offline, no checkpoints);
+2. start `fleet.Gateway` (HTTP front + reservation registry);
+3. start two `serve.make_server` replicas, each registered via
+   `fleet_client.register_replica` and heartbeating;
+4. send shared-prefix `:generate` batches THROUGH THE GATEWAY and show
+   (via `GET /v1/fleet`) that they all landed on one replica
+   (affinity_hits) while distinct prefixes spread;
+5. drain one replica (`POST /v1/fleet:drain?replica=`) and show traffic
+   continuing on the survivor — the rolling-restart move.
+
+Run:
+    python examples/lm/fleet_serve.py --new_tokens 8 --platform cpu
+"""
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+
+def build_argparser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--new_tokens", type=int, default=8)
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots per replica")
+    p.add_argument("--kv_page_size", type=int, default=16,
+                   help="paged-kv page size; also the gateway's "
+                        "prefix-affinity hash length")
+    p.add_argument("--kv_pages", type=int, default=32)
+    p.add_argument("--platform", default=None,
+                   help="pin jax platform (e.g. cpu)")
+    return p
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    if args.platform:
+        from tensorflowonspark_tpu import util
+        util.pin_platform(args.platform)
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import export, fleet, fleet_client, serve
+    from tensorflowonspark_tpu.models.transformer import (TransformerConfig,
+                                                          build_transformer)
+
+    # 1. one shared export both replicas serve --------------------------
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_seq_len=64)
+    params = build_transformer(**dataclasses.asdict(cfg)).init(
+        jax.random.key(0), np.zeros((1, 8), "int32"))["params"]
+    out_dir = os.path.join(tempfile.mkdtemp(), "lm_export")
+    export.export_saved_model(
+        out_dir, params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=dataclasses.asdict(cfg))
+    print(f"exported tiny LM to {out_dir}")
+
+    # 2. the gateway: HTTP front + reservation registry -----------------
+    gw = fleet.Gateway(heartbeat_timeout_s=5.0)
+    (ghost, gport), registry_addr = gw.start()
+    print(f"gateway on http://{ghost}:{gport} "
+          f"(registry {registry_addr[0]}:{registry_addr[1]})")
+
+    # 3. two replicas, each registered + heartbeating -------------------
+    replicas, registrations = [], []
+    for i in range(2):
+        serve_args = serve.build_argparser().parse_args(
+            ["--export_dir", out_dir, "--port", "0",
+             "--generate_slots", str(args.slots),
+             "--generate_kv_page_size", str(args.kv_page_size),
+             "--generate_kv_pages", str(args.kv_pages)])
+        server, _service = serve.make_server(serve_args)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        host, port = server.server_address[:2]
+        reg = fleet_client.register_replica(
+            registry_addr, host, port, n_slots=args.slots,
+            features={"kv_page_size": args.kv_page_size},
+            heartbeat_interval_s=1.0)
+        replicas.append(server)
+        registrations.append(reg)
+        print(f"replica {i}: http://{host}:{port} registered as "
+              f"{reg.replica_id}")
+
+    client = fleet_client.FleetClient(ghost, gport)
+    try:
+        # 4. shared-prefix generations through the ONE endpoint ---------
+        prefix = list(range(1, 1 + args.kv_page_size))
+        for tail in range(3):
+            status, out = client.generate(
+                [prefix + [100 + tail]], max_new_tokens=args.new_tokens)
+            assert status == 200, out
+            seq = out["outputs"][0]
+            print(f"shared-prefix request {tail}: "
+                  f"continuation {seq[len(prefix) + 1:]}")
+        _, stats = client.fleet_stats(probe=False)
+        print(f"affinity_hits={stats['counters'].get('affinity_hits', 0)} "
+              f"(all {3} shared-prefix requests on one replica)")
+
+        # 5. rolling restart: drain one replica, traffic survives -------
+        victim = registrations[0].replica_id
+        status, out = client.drain(victim, timeout_s=30)
+        print(f"drained {victim}: {out.get('drained')} "
+              f"(waited {out.get('waited_s')}s)")
+        status, out = client.generate([prefix],
+                                      max_new_tokens=args.new_tokens)
+        assert status == 200, out
+        print("post-drain generation served by the survivor")
+        _, stats = client.fleet_stats(probe=False)
+        print(f"fleet counters: {stats['counters']}")
+        print("fleet serving round trip complete")
+    finally:
+        for reg in registrations:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for server in replicas:
+            server.shutdown()
+            server.server_close()
+        gw.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,8 @@ Public surface (lazily imported to keep `import tensorflowonspark_tpu` cheap):
 - ``parallel_runner`` — embarrassingly-parallel runner        (maps TFParallel.py)
 - ``parallel``       — mesh / sharding / train-step harness   (TPU-native, net-new)
 - ``models``, ``ops`` — model zoo and Pallas kernels          (TPU-native, net-new)
+- ``fleet``, ``fleet_client`` — multi-replica serving gateway over the
+  reservation plane (prefix-affine routing, drain)            (net-new)
 """
 import logging
 
@@ -40,6 +42,7 @@ _LAZY_SUBMODULES = {
     "cluster", "node", "feed", "reservation", "manager", "tpu_info", "util",
     "compat", "marker", "dfutil", "tfrecord", "pipeline", "parallel_runner",
     "backend", "parallel", "models", "ops", "utils", "export",
+    "fleet", "fleet_client", "metrics",
 }
 
 _LAZY_ATTRS = {

@@ -1,0 +1,722 @@
+"""Serving fleet gateway: N ``serve.py`` replicas behind ONE endpoint.
+
+The paper's essence is cluster orchestration — the TFoS reservation
+protocol turns N executors into one addressable cluster.  This module
+points the SAME plane at serving: replicas register with the gateway
+through the existing :mod:`reservation` ``Server``/``Client`` protocol
+(msgpack-framed REG/BEAT/BYE — nothing serving-specific was added to the
+wire format), announce capacity (``n_slots``, engine features), and
+heartbeat for liveness; the gateway fronts them as one HTTP endpoint:
+
+    python -m tensorflowonspark_tpu.fleet --port 8500 --registry_port 8400
+    python -m tensorflowonspark_tpu.serve --export_dir /models/m \\
+        --port 8501 --fleet 127.0.0.1:8400        # replica 1
+    python -m tensorflowonspark_tpu.serve --export_dir /models/m \\
+        --port 8502 --fleet 127.0.0.1:8400        # replica 2
+
+Routing policy (stdlib-only, no extra deps):
+
+- ``POST /v1/models/<name>:predict`` — least-outstanding-requests, with
+  ONE hedged retry to a different replica on connect failure / 5xx
+  (predict is idempotent; a duplicate execution is harmless).
+- ``POST /v1/models/<name>:generate`` — prefix-affine: the request hashes
+  the prompt's first ``prefix_tokens`` tokens (defaulting to the
+  replicas' announced ``kv_page_size`` — exactly one paged-KV prefix
+  page, the unit the replica-side prefix cache shares) and rendezvous
+  hashing (highest-random-weight) maps that key to a replica, so
+  follow-ups with a shared prefix land where their KV pages are warm.
+  When the affine replica's queue depth exceeds its bound the request
+  spills to the least-loaded replica (a cold prefill beats queueing).
+  Generation is NOT idempotent under sampling and may be mid-stream when
+  it fails, so there is no hedged retry: replica failure returns a typed
+  502 (``{"type": "replica_failure", "replica": ...}``) and the client
+  decides.
+- Unhappy paths: heartbeat-miss ejection with automatic re-admission
+  when beats resume (or the replica re-registers), per-replica circuit
+  breaking (consecutive failures open the breaker for a cooldown),
+  bounded per-replica queues with 429 + ``Retry-After`` backpressure,
+  and ``POST /v1/fleet:drain?replica=<id>`` for rolling restarts: stop
+  new admissions, wait for in-flight work (gateway-proxied AND the
+  replica's own slot generations, via the replica drain hook), then
+  deregister.
+- ``GET /v1/fleet`` — per-replica state + proxied ``stats()`` snapshots
+  (slots busy, queue depth, prefix-cache sharing) plus the gateway's
+  :class:`metrics.Counters` (ejections, re-admissions, hedged retries,
+  429s, affinity hits/spills) and fleet-wide totals.
+- ``GET /healthz`` (gateway liveness) / ``GET /readyz`` (>= 1 routable
+  replica) — the same liveness/readiness split the replicas expose.
+"""
+import argparse
+import hashlib
+import http.client
+import json
+import logging
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import reservation, util
+from .metrics import Counters
+
+logger = logging.getLogger(__name__)
+
+# replica states
+UP = "up"
+EJECTED = "ejected"        # heartbeat lost; auto-readmitted when beats resume
+DRAINING = "draining"      # no new admissions; removed once drained
+
+
+class Replica:
+    """Gateway-side view of one registered serving replica."""
+
+    def __init__(self, meta):
+        try:
+            self.id = str(meta["replica_id"])
+            self.host = str(meta["host"])
+            self.port = int(meta["port"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"replica meta must carry replica_id/host/"
+                             f"port: {meta!r} ({e})")
+        self.model_name = str(meta.get("model_name") or "default")
+        self.n_slots = int(meta.get("n_slots") or 8)
+        self.features = dict(meta.get("features") or {})
+        self.state = UP
+        self.outstanding = 0     # gateway-proxied requests in flight
+        self.requests = 0        # total forwarded (monotone)
+        self.errors = 0          # connect/5xx failures observed (monotone)
+        self.failures = 0        # CONSECUTIVE failures (breaker input)
+        self.open_until = 0.0    # breaker open until this monotonic time
+        self.registered_at = time.time()
+
+    def describe(self):
+        return {"host": self.host, "port": self.port,
+                "model_name": self.model_name, "n_slots": self.n_slots,
+                "features": self.features, "state": self.state,
+                "outstanding": self.outstanding, "requests": self.requests,
+                "errors": self.errors,
+                "breaker_open": self.open_until > time.monotonic()}
+
+
+class _Registry(reservation.Server):
+    """The TFoS reservation server, re-aimed at serving-replica
+    membership: REG admits a replica into the routing table, BYE
+    deregisters it, BEAT feeds the ejection monitor — same frames, same
+    framing, same heartbeat client on the replica side as the training
+    cluster plane.  Base behavior (reservations list, QUERY/QINFO,
+    PROGRESS, STOP) is preserved by delegation."""
+
+    def __init__(self, gateway):
+        # count=1: the fleet has no fixed size — `done()` semantics are
+        # unused; membership is the routing table, not the node list
+        super().__init__(count=1)
+        self._gateway = gateway
+
+    def _dispatch(self, sock, msg):
+        mtype = msg.get("type")
+        if mtype == "REG":
+            try:
+                self._gateway._admit(msg.get("node") or {})
+            except ValueError as e:
+                # malformed replica meta must 4xx the registrant, not
+                # land a broken row in the routing table
+                self.send(sock, {"type": "ERR", "error": str(e)})
+                return
+        elif mtype == "BYE":
+            self._gateway._on_bye(msg.get("executor_id"))
+        super()._dispatch(sock, msg)
+
+
+class Gateway:
+    """The fleet routing plane.  Construct, :meth:`start`, point
+    replicas at ``registry_addr``, serve traffic at ``http_addr``."""
+
+    def __init__(self, host="127.0.0.1", port=0, registry_host=None,
+                 registry_port=0, heartbeat_timeout_s=10.0,
+                 monitor_interval_s=None, prefix_tokens=None,
+                 queue_depth_factor=2.0, breaker_threshold=3,
+                 breaker_cooldown_s=5.0, connect_timeout_s=5.0,
+                 replica_timeout_s=600.0, probe_timeout_s=5.0,
+                 retry_after_s=1.0):
+        self.host, self.port = host, int(port)
+        self.registry_host = registry_host or host
+        self.registry_port = int(registry_port)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.monitor_interval_s = (monitor_interval_s
+                                   or max(self.heartbeat_timeout_s / 4.0,
+                                          0.05))
+        # None = adopt the first registrant's announced kv_page_size
+        # (the replica-side prefix-cache unit), else 64
+        self._prefix_tokens = prefix_tokens
+        self.queue_depth_factor = float(queue_depth_factor)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.replica_timeout_s = float(replica_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.retry_after_s = retry_after_s
+        self.counters = Counters()
+        self._replicas = {}
+        self._lock = threading.RLock()
+        self._registry = _Registry(self)
+        self._stop = threading.Event()
+        self._http = None
+        self.http_addr = None
+        self.registry_addr = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self):
+        """Start registry + monitor + HTTP front; return
+        (http_addr, registry_addr)."""
+        self.registry_addr = self._registry.start(
+            host=self.registry_host,
+            ports=[self.registry_port] if self.registry_port else None)
+        threading.Thread(target=self._monitor, name="fleet-monitor",
+                         daemon=True).start()
+        gw = self
+
+        class _BoundHandler(_GatewayHandler):
+            gateway = gw
+
+        self._http = ThreadingHTTPServer((self.host, self.port),
+                                         _BoundHandler)
+        self.http_addr = self._http.server_address[:2]
+        threading.Thread(target=self._http.serve_forever,
+                         name="fleet-http", daemon=True).start()
+        logger.info("fleet gateway on http://%s:%d (registry %s:%d)",
+                    *self.http_addr, *self.registry_addr)
+        return self.http_addr, self.registry_addr
+
+    def stop(self):
+        self._stop.set()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+        self._registry.stop()
+
+    # ---- membership (driven by the reservation plane) --------------------
+
+    def _admit(self, meta):
+        r = Replica(meta)
+        with self._lock:
+            prior = self._replicas.get(r.id)
+            self._replicas[r.id] = r
+            if self._prefix_tokens is None:
+                # adopt the replica-side prefix-cache unit so affinity
+                # keys align with the pages replicas actually share
+                kps = int(r.features.get("kv_page_size") or 0)
+                self._prefix_tokens = kps if kps > 0 else 64
+        # a fresh REG is also the re-admission path for a restarted
+        # replica: seed its liveness window so the monitor does not
+        # instantly eject a node whose beat thread is still connecting
+        self._registry.seed_beat(r.id)
+        self.counters.inc("reregistrations" if prior else "registrations")
+        logger.info("replica %s %sregistered (%s:%d, %d slots)", r.id,
+                    "re-" if prior else "", r.host, r.port, r.n_slots)
+
+    def _on_bye(self, replica_id):
+        with self._lock:
+            gone = self._replicas.pop(str(replica_id), None)
+        if gone is not None:
+            self.counters.inc("deregistrations")
+            logger.info("replica %s deregistered (BYE)", replica_id)
+
+    def _monitor(self):
+        """Eject replicas whose heartbeat went silent; re-admit when
+        beats resume.  The beat table is the reservation server's own —
+        replicas run the stock `Client.start_heartbeat`."""
+        while not self._stop.is_set():
+            beats = self._registry.last_beats()
+            now = time.monotonic()
+            with self._lock:
+                for r in self._replicas.values():
+                    age = now - beats.get(r.id, now)
+                    if r.state == UP and age > self.heartbeat_timeout_s:
+                        r.state = EJECTED
+                        self.counters.inc("ejections")
+                        logger.warning("ejected replica %s (silent %.1fs)",
+                                       r.id, age)
+                    elif r.state == EJECTED and \
+                            age <= self.heartbeat_timeout_s:
+                        r.state = UP
+                        r.failures, r.open_until = 0, 0.0
+                        self.counters.inc("readmissions")
+                        logger.info("re-admitted replica %s (beats "
+                                    "resumed)", r.id)
+            self._stop.wait(self.monitor_interval_s)
+
+    # ---- routing ---------------------------------------------------------
+
+    def _max_outstanding(self, r):
+        return max(1, int(self.queue_depth_factor * r.n_slots))
+
+    def _routable(self, r, now=None):
+        """UP and breaker not open (an expired breaker half-opens: the
+        next request is the trial)."""
+        if r.state != UP:
+            return False
+        now = time.monotonic() if now is None else now
+        return not (r.failures >= self.breaker_threshold
+                    and r.open_until > now)
+
+    def _choose(self, prefix_key=None, exclude=()):
+        """Pick a replica, or raise :class:`NoReplica` /
+        :class:`Saturated`.  `prefix_key` engages affinity routing."""
+        with self._lock:
+            now = time.monotonic()
+            routable = [r for r in self._replicas.values()
+                        if r.id not in exclude and self._routable(r, now)]
+            if not routable:
+                if self._replicas:
+                    raise Saturated("no routable replica (ejected/"
+                                    "draining/circuit-open)")
+                raise NoReplica("no replicas registered")
+            open_ = [r for r in routable
+                     if r.outstanding < self._max_outstanding(r)]
+            if not open_:
+                raise Saturated("all replica queues at bound")
+            if prefix_key is not None:
+                # rendezvous (highest-random-weight) hashing: stateless,
+                # deterministic, and a membership change only remaps the
+                # keys that pointed at the departed replica
+                affine = max(routable, key=lambda r: _hrw(r.id, prefix_key))
+                if affine.outstanding < self._max_outstanding(affine):
+                    self.counters.inc("affinity_hits")
+                    affine.outstanding += 1
+                    return affine
+                self.counters.inc("affinity_spills")
+                open_ = [r for r in open_ if r.id != affine.id]
+                if not open_:
+                    raise Saturated("affine replica and all fallbacks at "
+                                    "queue bound")
+            pick = min(open_, key=lambda r: (r.outstanding, r.id))
+            pick.outstanding += 1
+            return pick
+
+    def _release(self, r, ok):
+        with self._lock:
+            r.outstanding = max(0, r.outstanding - 1)
+            r.requests += 1
+            if ok:
+                r.failures, r.open_until = 0, 0.0
+            else:
+                r.errors += 1
+                r.failures += 1
+                if r.failures >= self.breaker_threshold:
+                    was_open = r.open_until > time.monotonic()
+                    r.open_until = (time.monotonic()
+                                    + self.breaker_cooldown_s)
+                    if not was_open:
+                        self.counters.inc("breaker_opens")
+                        logger.warning("circuit OPEN for replica %s "
+                                       "(%d consecutive failures)",
+                                       r.id, r.failures)
+
+    def prefix_key(self, body):
+        """Affinity key for a :generate body: the first ``prefix_tokens``
+        token ids of the first prompt (None when absent/malformed — the
+        request falls back to least-loaded and the replica 400s it)."""
+        try:
+            prompt = body["inputs"][0]
+            n = self._prefix_tokens or 64
+            key = tuple(prompt[:n])
+            return key if key else None
+        except (KeyError, IndexError, TypeError):
+            return None
+
+    # ---- replica I/O -----------------------------------------------------
+
+    def _request(self, r, method, path, body=None, timeout=None):
+        """One HTTP exchange with a replica.  Returns the live
+        (connection, response) — the caller relays and closes."""
+        conn = http.client.HTTPConnection(
+            r.host, r.port, timeout=timeout or self.replica_timeout_s)
+        try:
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/json"})
+            return conn, conn.getresponse()
+        except Exception:
+            conn.close()
+            raise
+
+    def probe(self, r, path, timeout=None):
+        """GET `path` on a replica, JSON-decoded (stats aggregation)."""
+        conn, resp = self._request(r, "GET", path,
+                                   timeout=timeout or self.probe_timeout_s)
+        try:
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    # ---- drain (rolling restarts) ----------------------------------------
+
+    def drain(self, replica_id, timeout_s=60.0):
+        """Stop new admissions to `replica_id`, wait for in-flight work
+        (gateway-proxied requests AND the replica's own slot
+        generations, via its drain hook), then deregister.  Returns a
+        summary dict; ``drained: False`` when the wait timed out (the
+        replica is then left DRAINING — re-issue or restart it)."""
+        with self._lock:
+            r = self._replicas.get(str(replica_id))
+            if r is None:
+                raise KeyError(f"unknown replica {replica_id!r}")
+            r.state = DRAINING
+        self.counters.inc("drains_started")
+        t0 = time.monotonic()
+        deadline = t0 + float(timeout_s)
+        while r.outstanding > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        replica_report = None
+        if time.monotonic() < deadline:
+            try:
+                # the replica-side hook also fences direct (non-gateway)
+                # clients and waits for its continuous-batcher slots
+                conn, resp = self._request(
+                    r, "POST", "/v1/fleet:drain",
+                    timeout=max(0.1, deadline - time.monotonic()))
+                try:
+                    replica_report = json.loads(resp.read() or b"{}")
+                finally:
+                    conn.close()
+            except (OSError, ValueError) as e:
+                replica_report = {"error": str(e)}   # dead replica: fine,
+                # deregistering it is exactly what the caller wants
+        if r.outstanding > 0:
+            return {"drained": False, "replica": r.id,
+                    "in_flight": r.outstanding,
+                    "waited_s": round(time.monotonic() - t0, 3)}
+        with self._lock:
+            self._replicas.pop(r.id, None)
+        self.counters.inc("drains_completed")
+        return {"drained": True, "replica": r.id,
+                "waited_s": round(time.monotonic() - t0, 3),
+                "replica_report": replica_report}
+
+    # ---- observability ---------------------------------------------------
+
+    def ready(self):
+        with self._lock:
+            return any(self._routable(r) for r in self._replicas.values())
+
+    def fleet_stats(self, probe=True):
+        """The ``GET /v1/fleet`` body: per-replica state (+ proxied
+        replica ``stats()`` when `probe`), gateway counters, and
+        fleet-wide totals."""
+        beats = self._registry.last_beats()
+        now = time.monotonic()
+        with self._lock:
+            snap = {rid: (r, r.describe())
+                    for rid, r in self._replicas.items()}
+        totals = {"slots": 0, "slots_busy": 0, "queue_depth": 0,
+                  "prefill_tokens_shared": 0, "prefix_pages_cached": 0}
+        for rid, (r, desc) in snap.items():
+            if rid in beats:
+                desc["last_beat_age_s"] = round(now - beats[rid], 3)
+            totals["slots"] += desc["n_slots"]
+            if probe and r.state != EJECTED:
+                try:
+                    _, meta = self.probe(
+                        r, f"/v1/models/{r.model_name}")
+                    model = meta.get("model") or {}
+                    desc["model"] = model
+                    gstats = model.get("generate_stats") or {}
+                    totals["slots_busy"] += int(
+                        gstats.get("slots_busy") or 0)
+                    totals["queue_depth"] += int(gstats.get("pending") or 0)
+                    totals["prefill_tokens_shared"] += int(
+                        gstats.get("prefill_tokens_shared") or 0)
+                    totals["prefix_pages_cached"] += int(
+                        gstats.get("prefix_pages_cached") or 0)
+                except (OSError, ValueError) as e:
+                    desc["probe_error"] = str(e)
+        return {"replicas": {rid: desc for rid, (_, desc) in snap.items()},
+                "totals": totals,
+                "counters": self.counters.snapshot(),
+                "gateway": {"prefix_tokens": self._prefix_tokens,
+                            "heartbeat_timeout_s": self.heartbeat_timeout_s,
+                            "queue_depth_factor": self.queue_depth_factor,
+                            "breaker_threshold": self.breaker_threshold,
+                            "registry": list(self.registry_addr or ())}}
+
+
+class NoReplica(RuntimeError):
+    """No replicas registered at all (503)."""
+
+
+class Saturated(RuntimeError):
+    """Replicas exist but none can admit right now (429 + Retry-After)."""
+
+
+def _hrw(replica_id, key):
+    """Rendezvous weight of (replica, key) — the affine replica is the
+    argmax over replicas.  sha256 for stable cross-process hashing
+    (``hash()`` is per-process salted)."""
+    h = hashlib.sha256(repr((replica_id, key)).encode())
+    return int.from_bytes(h.digest()[:8], "big")
+
+
+class _GatewayHandler(BaseHTTPRequestHandler):
+    gateway = None           # injected by Gateway.start
+    protocol_version = "HTTP/1.1"
+
+    # -- helpers --
+
+    def _send(self, code, payload, headers=()):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reject(self, e):
+        gw = self.gateway
+        if isinstance(e, Saturated):
+            gw.counters.inc("rejected_429")
+            self._send(429, {"error": str(e), "type": "saturated"},
+                       headers=[("Retry-After",
+                                 str(gw.retry_after_s))])
+        else:
+            gw.counters.inc("rejected_no_replica")
+            self._send(503, {"error": str(e), "type": "no_replica"})
+
+    def _relay(self, conn, resp):
+        """Copy a replica response through verbatim — streamed chunk by
+        chunk when the replica streams (the :generate ndjson path), one
+        Content-Length body otherwise."""
+        try:
+            chunked = "chunked" in (resp.getheader("Transfer-Encoding")
+                                    or "").lower()
+            ctype = resp.getheader("Content-Type", "application/json")
+            self.send_response(resp.status)
+            self.send_header("Content-Type", ctype)
+            if chunked:
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    piece = resp.read(16384)
+                    if not piece:
+                        break
+                    self.wfile.write(f"{len(piece):X}\r\n".encode()
+                                     + piece + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            else:
+                data = resp.read()
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+        finally:
+            conn.close()
+
+    def _forward_once(self, r, path, body):
+        """One proxied POST to `r`.  Returns (ok, conn, resp);
+        ``ok=False`` (connect error or 5xx) has already updated the
+        breaker and closed the connection."""
+        gw = self.gateway
+        try:
+            conn, resp = gw._request(r, "POST", path, body=body)
+        except OSError as e:
+            gw._release(r, ok=False)
+            return False, None, e
+        if resp.status >= 500:
+            err = RuntimeError(
+                f"replica {r.id} returned {resp.status}: "
+                f"{resp.read(2048)!r}")
+            conn.close()
+            gw._release(r, ok=False)
+            return False, None, err
+        return True, conn, resp
+
+    # -- HTTP surface --
+
+    def do_GET(self):
+        gw = self.gateway
+        path = urllib.parse.urlsplit(self.path).path
+        if path == "/healthz":               # gateway process liveness
+            self._send(200, {"status": "ok"})
+        elif path == "/readyz":              # can we route anything?
+            if gw.ready():
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(503, {"status": "unavailable",
+                                 "error": "no routable replica"})
+        elif path in ("/", "/v1/fleet"):
+            qs = urllib.parse.parse_qs(urllib.parse.urlsplit(self.path).query)
+            probe = qs.get("probe", ["1"])[0] not in ("0", "false")
+            self._send(200, gw.fleet_stats(probe=probe))
+        elif path.startswith("/v1/models/"):
+            # metadata passthrough: any one healthy replica's view
+            try:
+                r = gw._choose()
+            except (NoReplica, Saturated) as e:
+                self._reject(e)
+                return
+            try:
+                conn, resp = gw._request(r, "GET", self.path,
+                                         timeout=gw.probe_timeout_s)
+            except OSError as e:
+                gw._release(r, ok=False)
+                self._send(502, {"error": f"replica {r.id}: {e}",
+                                 "type": "replica_failure",
+                                 "replica": r.id})
+                return
+            try:
+                self._relay(conn, resp)
+            finally:
+                gw._release(r, ok=True)
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        gw = self.gateway
+        split = urllib.parse.urlsplit(self.path)
+        path = split.path
+        if path == "/v1/fleet:drain":
+            qs = urllib.parse.parse_qs(split.query)
+            rid = (qs.get("replica") or [None])[0]
+            if not rid:
+                self._send(400, {"error": "missing ?replica=<id>"})
+                return
+            timeout_s = float((qs.get("timeout_s") or ["60"])[0])
+            try:
+                out = gw.drain(rid, timeout_s=timeout_s)
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+                return
+            self._send(200 if out["drained"] else 504, out)
+            return
+        is_predict = path.startswith("/v1/models/") and \
+            path.endswith(":predict")
+        is_generate = path.startswith("/v1/models/") and \
+            path.endswith(":generate")
+        if not (is_predict or is_generate):
+            self._send(404, {"error": f"unknown path {self.path}"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b"{}"
+        prefix_key = None
+        if is_generate:
+            try:
+                prefix_key = gw.prefix_key(json.loads(body))
+            except ValueError:
+                prefix_key = None   # replica will 400 the bad JSON
+        try:
+            r = gw._choose(prefix_key=prefix_key)
+        except (NoReplica, Saturated) as e:
+            self._reject(e)
+            return
+        ok, conn, resp_or_err = self._forward_once(r, self.path, body)
+        if ok:
+            try:
+                self._relay(conn, resp_or_err)
+            finally:
+                gw._release(r, ok=True)
+            return
+        if is_generate:
+            # NOT idempotent (sampling state, partial streams): fail
+            # fast with a typed error instead of silently re-running
+            gw.counters.inc("generate_failures")
+            self._send(502, {"error": str(resp_or_err),
+                             "type": "replica_failure", "replica": r.id,
+                             "retryable": True})
+            return
+        # predict: one hedged retry on a DIFFERENT replica
+        gw.counters.inc("hedged_retries")
+        try:
+            r2 = gw._choose(exclude=(r.id,))
+        except (NoReplica, Saturated):
+            self._send(502, {"error": f"replica {r.id} failed and no "
+                             f"alternative is admitting: {resp_or_err}",
+                             "type": "replica_failure", "replica": r.id})
+            return
+        ok2, conn2, resp_or_err2 = self._forward_once(r2, self.path, body)
+        if not ok2:
+            self._send(502, {"error": f"retry on {r2.id} failed too: "
+                             f"{resp_or_err2}",
+                             "type": "replica_failure", "replica": r2.id})
+            return
+        try:
+            self._relay(conn2, resp_or_err2)
+        finally:
+            gw._release(r2, ok=True)
+
+    def log_message(self, fmt, *args):
+        logger.debug("fleet http: " + fmt, *args)
+
+
+def build_argparser():
+    p = argparse.ArgumentParser(
+        prog="tensorflowonspark_tpu.fleet",
+        description="multi-replica serving gateway (reservation-based "
+                    "registration, prefix-affine routing, graceful drain)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8500,
+                   help="gateway HTTP port (0 = ephemeral)")
+    p.add_argument("--registry_host", default=None,
+                   help="bind host of the reservation-plane registry "
+                        "(default: --host)")
+    p.add_argument("--registry_port", type=int, default=8400,
+                   help="registry port replicas register with "
+                        "(serve.py --fleet HOST:THIS; 0 = ephemeral)")
+    p.add_argument("--heartbeat_timeout_s", type=float, default=10.0,
+                   help="eject a replica silent for this long; beats "
+                        "resuming re-admit it")
+    p.add_argument("--prefix_tokens", type=int, default=None,
+                   help=":generate affinity-hash prefix length (default: "
+                        "the first registrant's announced kv_page_size, "
+                        "else 64)")
+    p.add_argument("--queue_depth_factor", type=float, default=2.0,
+                   help="per-replica queue bound = factor * n_slots; "
+                        "beyond it requests spill, then 429")
+    p.add_argument("--breaker_threshold", type=int, default=3,
+                   help="consecutive failures that open a replica's "
+                        "circuit breaker")
+    p.add_argument("--breaker_cooldown_s", type=float, default=5.0)
+    p.add_argument("--connect_timeout_s", type=float, default=5.0)
+    p.add_argument("--replica_timeout_s", type=float, default=600.0,
+                   help="read timeout on proxied replica requests "
+                        "(:generate can be long)")
+    p.add_argument("--verbose", action="store_true")
+    return p
+
+
+def make_gateway(args):
+    """Build (and start) a Gateway from parsed args."""
+    gw = Gateway(host=args.host, port=args.port,
+                 registry_host=args.registry_host,
+                 registry_port=args.registry_port,
+                 heartbeat_timeout_s=args.heartbeat_timeout_s,
+                 prefix_tokens=args.prefix_tokens,
+                 queue_depth_factor=args.queue_depth_factor,
+                 breaker_threshold=args.breaker_threshold,
+                 breaker_cooldown_s=args.breaker_cooldown_s,
+                 connect_timeout_s=args.connect_timeout_s,
+                 replica_timeout_s=args.replica_timeout_s)
+    gw.start()
+    return gw
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(message)s")
+    gw = make_gateway(args)
+    print(f"fleet gateway on http://{gw.http_addr[0]}:{gw.http_addr[1]} "
+          f"(replicas register at {gw.registry_addr[0]}:"
+          f"{gw.registry_addr[1]})", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -11,12 +11,19 @@ dispatch and the final `result()` is the only host readback.
 All functions accept an optional boolean/0-1 `mask` (padding-aware eval,
 e.g. repeat-padded tail batches from `feed.pad_batch`: mask off the
 duplicated rows so they don't bias the metric).
+
+Also here: :class:`Counters`, host-side thread-safe monotone counters for
+the serving/orchestration plane (the fleet gateway's ejection/retry/429
+accounting).  JAX is imported lazily inside the eval functions so
+importing this module from a pure control-plane process (the gateway)
+never pays accelerator-runtime startup — the same discipline as `util`.
 """
-import jax
-import jax.numpy as jnp
+import threading
 
 
 def _masked_mean(values, mask):
+    import jax.numpy as jnp
+
     values = values.astype(jnp.float32)
     if mask is None:
         return values.mean(), values.size * jnp.ones((), jnp.float32)
@@ -27,12 +34,16 @@ def _masked_mean(values, mask):
 
 def accuracy(logits, labels, mask=None):
     """Top-1 accuracy over [..., num_classes] logits."""
+    import jax.numpy as jnp
+
     hit = (jnp.argmax(logits, axis=-1) == labels)
     return _masked_mean(hit, mask)[0]
 
 
 def topk_accuracy(logits, labels, k=5, mask=None):
     """Top-k accuracy: label within the k highest logits."""
+    import jax.numpy as jnp
+
     topk = jnp.argsort(logits, axis=-1)[..., -k:]
     hit = (topk == labels[..., None]).any(axis=-1)
     return _masked_mean(hit, mask)[0]
@@ -40,6 +51,9 @@ def topk_accuracy(logits, labels, k=5, mask=None):
 
 def cross_entropy(logits, labels, mask=None):
     """Mean softmax cross entropy with integer labels (f32 accumulators)."""
+    import jax
+    import jax.numpy as jnp
+
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None],
@@ -49,10 +63,14 @@ def cross_entropy(logits, labels, mask=None):
 
 def perplexity(logits, labels, mask=None):
     """exp(mean token cross entropy) — LM eval."""
+    import jax.numpy as jnp
+
     return jnp.exp(cross_entropy(logits, labels, mask))
 
 
 def mean_squared_error(pred, target, mask=None):
+    import jax.numpy as jnp
+
     return _masked_mean((pred.astype(jnp.float32)
                          - target.astype(jnp.float32)) ** 2, mask)[0]
 
@@ -64,6 +82,9 @@ def confusion_matrix(preds, labels, num_classes, mask=None):
     executes directly — no scatter, no sort, jit/SPMD-friendly (a
     per-shard matrix psums cleanly across data-parallel shards).
     """
+    import jax
+    import jax.numpy as jnp
+
     preds = preds.reshape(-1)
     labels = labels.reshape(-1)
     t = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
@@ -84,6 +105,8 @@ def mean_iou(logits, labels, mask=None, num_classes=None):
     multi-batch eval accumulate `confusion_matrix` per batch and call
     `iou_from_confusion` once.
     """
+    import jax.numpy as jnp
+
     num_classes = num_classes or logits.shape[-1]
     cm = confusion_matrix(jnp.argmax(logits, axis=-1), labels,
                           num_classes, mask)
@@ -92,6 +115,8 @@ def mean_iou(logits, labels, mask=None, num_classes=None):
 
 def iou_from_confusion(cm):
     """Mean IoU from an accumulated confusion matrix (rows = true)."""
+    import jax.numpy as jnp
+
     cm = cm.astype(jnp.float32)
     tp = jnp.diagonal(cm)
     fn = cm.sum(axis=1) - tp
@@ -140,4 +165,33 @@ class MetricAccumulator:
         import numpy as np
         return {tag: float(np.asarray(s)) / float(np.asarray(self._weights[tag]))
                 for tag, s in self._sums.items()}
+
+
+class Counters:
+    """Thread-safe named monotone counters for the host-side serving /
+    orchestration plane (no JAX involved).
+
+    The fleet gateway accounts its routing decisions here — ejections,
+    re-admissions, hedged retries, 429 rejections, prefix-affinity hits
+    and spills — and `GET /v1/fleet` surfaces `snapshot()` verbatim, so
+    every unhappy-path transition is observable.  Unknown names read as
+    0: dashboards can reference a counter before its first event."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def inc(self, name, n=1):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            return self._counts[name]
+
+    def get(self, name):
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self):
+        """{name: count} copy, safe to serialize."""
+        with self._lock:
+            return dict(self._counts)
 

@@ -174,9 +174,7 @@ class Attention(nn.Module):
             if cp_axis:
                 # under an enclosing shard_map the activations are the LOCAL
                 # sequence shard; rotate with global token positions
-                mesh = jax.sharding.get_abstract_mesh()
-                if (mesh is not None and not mesh.empty
-                        and cp_axis in getattr(mesh, "manual_axes", ())):
+                if cp_axis in _bound_axes(_ambient_mesh()):
                     pos = pos + jax.lax.axis_index(cp_axis) * S
             q = apply_rope(q, pos, cfg.rope_theta)
             k = apply_rope(k, pos, cfg.rope_theta)
@@ -489,6 +487,42 @@ def _paged_attention_body(attn_self, q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
 
 
+def _ambient_mesh():
+    """`jax.sharding.get_abstract_mesh()` or None, across jax versions.
+
+    Older jax has no abstract-mesh tracking; there the ambient mesh is
+    the ``with mesh:`` thread resource (empty → None, like the new API's
+    empty AbstractMesh).
+    """
+    get_am = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_am is not None:
+        mesh = get_am()
+        return None if mesh is None or mesh.empty else mesh
+    try:
+        from jax._src import mesh as _mesh_lib
+        mesh = _mesh_lib.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        return None
+    return None if mesh.empty else mesh
+
+
+def _bound_axes(mesh):
+    """Mesh axes already bound manual by an enclosing shard_map.
+
+    New jax records them on the abstract mesh (`manual_axes`); older jax
+    exposes them only through the tracing axis env.
+    """
+    if mesh is not None:
+        manual = getattr(mesh, "manual_axes", None)
+        if manual is not None:
+            return manual
+    try:
+        from jax._src import core as _core
+        return tuple(_core.get_axis_env().axis_sizes)
+    except (ImportError, AttributeError):
+        return ()
+
+
 def _seqpar_dispatch(q, k, v, cfg):
     """Route to ring / Ulysses context-parallel attention.
 
@@ -514,9 +548,9 @@ def _seqpar_dispatch(q, k, v, cfg):
                 lambda q, k, v, causal: dot_product_attention(
                     q, k, v, causal=causal))
 
-    mesh = jax.sharding.get_abstract_mesh()
-    in_mesh = mesh is not None and not mesh.empty and axis in mesh.axis_names
-    bound = in_mesh and axis in getattr(mesh, "manual_axes", ())
+    mesh = _ambient_mesh()
+    in_mesh = mesh is not None and axis in mesh.axis_names
+    bound = in_mesh and axis in _bound_axes(mesh)
     if bound or not in_mesh:
         # axis already bound by an enclosing shard_map (or no mesh at all,
         # in which case the collective will raise an unbound-axis error
@@ -527,7 +561,7 @@ def _seqpar_dispatch(q, k, v, cfg):
         raise ValueError(
             f"seq_len={q.shape[1]} must be divisible by the {axis!r} axis "
             f"size {mesh.shape[axis]} for context-parallel attention")
-    manual = getattr(mesh, "manual_axes", ())
+    manual = _bound_axes(mesh)
     batch_axes = tuple(
         a for a in ("dp", "fsdp")
         if a in mesh.axis_names and a != axis and a not in manual
@@ -556,8 +590,8 @@ def _flash_dispatch(q, k, v, cfg):
     """
     from tensorflowonspark_tpu.ops.flash_attention import flash_attention
     from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or mesh.empty:
+    mesh = _ambient_mesh()
+    if mesh is None:
         return flash_attention(q, k, v, causal=cfg.causal)
     axes = mesh.axis_names
 

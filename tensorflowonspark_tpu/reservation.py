@@ -51,6 +51,15 @@ SERVER_PORT_ENV = "TFOS_TPU_SERVER_PORT"
 
 CONNECT_RETRIES = 3
 CONNECT_RETRY_DELAY_SECS = 2
+CONNECT_RETRY_DELAY_CAP_SECS = 15.0
+CONNECT_TIMEOUT_SECS = 30.0
+RPC_TIMEOUT_SECS = 60.0
+
+
+def _backoff_delay(attempt, base, cap):
+    """Capped exponential delay before connect retry `attempt` (0-based):
+    base, 2*base, 4*base, ... never exceeding `cap`."""
+    return min(float(cap), float(base) * (2.0 ** attempt))
 
 
 class Reservations:
@@ -136,11 +145,17 @@ class Server(MessageSocket):
         self._flagged = set()   # executor_ids already reported dead
         self._beat_lock = threading.Lock()
 
-    def start(self):
-        """Bind per env overrides and start the listener thread; return (host, port)."""
-        host = os.environ.get(SERVER_HOST_ENV, util.get_ip_address())
-        port_spec = os.environ.get(SERVER_PORT_ENV)
-        ports = util.parse_port_spec(port_spec) if port_spec else None
+    def start(self, host=None, ports=None):
+        """Bind and start the listener thread; return (host, port).
+
+        `host`/`ports` (a candidate-port list) override the env knobs —
+        a fleet gateway binds an operator-chosen registry address while
+        the training driver keeps the env-driven path."""
+        if host is None:
+            host = os.environ.get(SERVER_HOST_ENV, util.get_ip_address())
+        if ports is None:
+            port_spec = os.environ.get(SERVER_PORT_ENV)
+            ports = util.parse_port_spec(port_spec) if port_spec else None
         self._sock = util.bind_socket(host, ports)
         addr = (host, self._sock.getsockname()[1])
         logger.info("reservation server listening on %s", addr)
@@ -258,6 +273,20 @@ class Server(MessageSocket):
         with self._beat_lock:
             return dict(self._progress)
 
+    def seed_beat(self, executor_id):
+        """Grant `executor_id` a fresh liveness window (as if it just
+        beat).  Registration-time seeding: a node whose heartbeat thread
+        has not connected yet must not read as instantly dead."""
+        with self._beat_lock:
+            self._beats[executor_id] = time.monotonic()
+
+    def last_beats(self):
+        """Snapshot of {executor_id: last-beat monotonic time}.  The
+        fleet gateway's ejection/re-admission monitor reads this (it
+        needs beat *recency* for re-admission, not just `dead_nodes`)."""
+        with self._beat_lock:
+            return dict(self._beats)
+
     def dead_nodes(self, timeout):
         """Executor ids that heartbeated once but have been silent for
         > `timeout` seconds and did not announce a normal exit (BYE)."""
@@ -325,12 +354,27 @@ class Server(MessageSocket):
 class Client(MessageSocket):
     """Executor-side rendezvous client (reference: reservation.py:234-301)."""
 
-    def __init__(self, server_addr, connect=True):
+    def __init__(self, server_addr, connect=True, connect_timeout=None,
+                 rpc_timeout=None, retries=None, retry_delay=None,
+                 retry_delay_cap=None):
         """`connect=False` defers the main-socket connect to the first
         RPC — used by heartbeat-only clients, whose beat thread makes its
         own connections and must start (and keep retrying) even while the
-        server is briefly unreachable."""
+        server is briefly unreachable.
+
+        The timeout knobs bound how long a dead or wedged server can
+        stall this client (a serving replica registering with a fleet
+        gateway must fail fast, not hang startup): `connect_timeout` /
+        `rpc_timeout` are per-dial socket timeouts, `retries` bounds the
+        connect attempts, and `retry_delay`/`retry_delay_cap` shape the
+        capped exponential backoff between them.  ``None`` defers to the
+        module defaults AT CALL TIME (so tests may monkeypatch them)."""
         self.server_addr = (server_addr[0], int(server_addr[1]))
+        self._connect_timeout = connect_timeout
+        self._rpc_timeout = rpc_timeout
+        self._retries = retries
+        self._retry_delay = retry_delay
+        self._retry_delay_cap = retry_delay_cap
         self._sock = self._connect() if connect else None
         self._lock = threading.Lock()
 
@@ -343,18 +387,34 @@ class Client(MessageSocket):
         s.settimeout(rpc_timeout)
         return s
 
+    def _effective_timeouts(self):
+        """(connect_timeout, rpc_timeout) with module defaults filled in.
+        Rendezvous RPCs complete in milliseconds; the 60s default covers
+        a driver briefly stalled by GC/oversubscription."""
+        ct = (self._connect_timeout if self._connect_timeout is not None
+              else CONNECT_TIMEOUT_SECS)
+        rt = (self._rpc_timeout if self._rpc_timeout is not None
+              else RPC_TIMEOUT_SECS)
+        return ct, rt
+
     def _connect(self):
+        retries = self._retries if self._retries is not None else \
+            CONNECT_RETRIES
+        base = (self._retry_delay if self._retry_delay is not None
+                else CONNECT_RETRY_DELAY_SECS)
+        cap = (self._retry_delay_cap if self._retry_delay_cap is not None
+               else CONNECT_RETRY_DELAY_CAP_SECS)
+        ct, rt = self._effective_timeouts()
         last = None
-        for attempt in range(CONNECT_RETRIES):
+        for attempt in range(retries):
             try:
-                # Rendezvous RPCs complete in milliseconds; 60s covers a
-                # driver briefly stalled by GC/oversubscription.
-                return self._dial(connect_timeout=30.0, rpc_timeout=60.0)
+                return self._dial(connect_timeout=ct, rpc_timeout=rt)
             except OSError as e:
                 last = e
                 logger.warning("connect to %s failed (%s); retry %d/%d",
-                               self.server_addr, e, attempt + 1, CONNECT_RETRIES)
-                time.sleep(CONNECT_RETRY_DELAY_SECS * (attempt + 1))
+                               self.server_addr, e, attempt + 1, retries)
+                if attempt < retries - 1:   # no pointless post-final sleep
+                    time.sleep(_backoff_delay(attempt, base, cap))
         raise ConnectionError(f"could not reach reservation server at {self.server_addr}: {last}")
 
     def _request(self, msg):
@@ -429,11 +489,12 @@ class Client(MessageSocket):
             # retry/backoff sleeps ignore the stop event): stop_heartbeat
             # must end this thread within ~one beat interval.
             hb = None
+            ct, rt = self._effective_timeouts()
             while not self._hb_stop.is_set():
                 try:
                     if hb is None:
-                        hb = self._dial(connect_timeout=5.0,
-                                        rpc_timeout=10.0)
+                        hb = self._dial(connect_timeout=min(5.0, ct),
+                                        rpc_timeout=min(10.0, rt))
                     self.send(hb, {"type": "BEAT",
                                    "executor_id": executor_id})
                     self.receive(hb)
@@ -482,9 +543,11 @@ class Client(MessageSocket):
         # receive() for the full 60s RPC timeout — longer than typical
         # monitor windows, so the "lost heartbeat" this method exists to
         # prevent would fire while BYE is stuck.  Fresh 5s dials only.
+        ct, rt = self._effective_timeouts()
         for attempt in range(CONNECT_RETRIES):
             try:
-                s = self._dial(connect_timeout=5.0, rpc_timeout=10.0)
+                s = self._dial(connect_timeout=min(5.0, ct),
+                               rpc_timeout=min(10.0, rt))
                 try:
                     self.send(s, msg)
                     return self.receive(s)

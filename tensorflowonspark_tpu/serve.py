@@ -34,6 +34,7 @@ from typing import Any
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 logger = logging.getLogger(__name__)
@@ -118,6 +119,17 @@ def build_argparser():
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
                    default="auto")
+    p.add_argument("--fleet", default=None, metavar="HOST:PORT",
+                   help="register this replica with a fleet gateway's "
+                        "registry (python -m tensorflowonspark_tpu.fleet) "
+                        "over the reservation protocol, and heartbeat "
+                        "until shutdown")
+    p.add_argument("--fleet_heartbeat_s", type=float, default=2.0,
+                   help="replica->gateway heartbeat interval (keep well "
+                        "under the gateway's --heartbeat_timeout_s)")
+    p.add_argument("--advertise_host", default=None,
+                   help="host the GATEWAY should dial this replica on "
+                        "(default: --host; set when binding 0.0.0.0)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -291,6 +303,7 @@ class ModelService:
                     f"--generate_lora {spec!r} must be NAME=PATH")
             self._gen_lora[name] = path
         self._batcher = None
+        self._draining = threading.Event()
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
         if wait_ms > 0:
             self._batcher = _MicroBatcher(
@@ -346,6 +359,42 @@ class ModelService:
                     self._gen_error = str(e)
             return self._gen or None
 
+    @property
+    def draining(self):
+        return self._draining.is_set()
+
+    def begin_drain(self):
+        """Fence admissions: :predict/:generate start 503ing (with
+        Retry-After) and /readyz flips to 503, while in-flight slot
+        generations keep decoding to completion."""
+        self._draining.set()
+
+    def drain(self, timeout_s=60.0, poll_s=0.05):
+        """The replica-side drain hook (``POST /v1/fleet:drain``): fence
+        admissions, then wait until the :generate slot engine is idle —
+        no busy slots, no queued prompts, no admission in progress.
+        :predict needs no wait of its own (each request holds its HTTP
+        thread until the device returns, so by the time the gateway has
+        seen its in-flight proxied requests settle there is nothing
+        left).  Returns {"drained": bool, "waited_s": s, ...}."""
+        self.begin_drain()
+        t0 = time.monotonic()
+        deadline = t0 + float(timeout_s)
+        with self._gen_lock:
+            gen = self._gen or None   # never FORCE-build an engine just
+            # to watch it be idle: un-probed == nothing ever generated
+        pending = 0
+        while gen is not None:
+            st = gen.batcher.stats()
+            pending = (st["slots_busy"] + st["pending"]
+                       + int(st["admitting"]))
+            if pending == 0 or time.monotonic() >= deadline:
+                break
+            time.sleep(poll_s)
+        return {"drained": pending == 0, "draining": True,
+                "in_flight": pending,
+                "waited_s": round(time.monotonic() - t0, 3)}
+
     def close(self):
         """Release serving resources: stops the slot batcher's driver
         thread (otherwise it busy-polls forever after server teardown)."""
@@ -361,7 +410,7 @@ class ModelService:
         out = {"model": {"export_dir": self.export_dir,
                          "engine": self.desc,
                          "requests_served": self.requests},
-               "status": "ok"}
+               "status": "draining" if self.draining else "ok"}
         if self._batcher is not None:
             out["model"]["batched_executions"] = self._batcher.executions
         if self._gen is not None:      # only report once probed (lazily)
@@ -1691,29 +1740,56 @@ class _Handler(BaseHTTPRequestHandler):
     # every non-stream response sets Content-Length, so keep-alive is safe
     protocol_version = "HTTP/1.1"
 
-    def _send(self, code, payload):
+    def _send(self, code, payload, headers=()):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
         name = self.service.model_name
-        if self.path.rstrip("/").endswith(f"/v1/models/{name}") or \
-                self.path in ("/healthz", "/"):
+        # EXACT path matching (modulo one trailing slash): endswith()
+        # previously served metadata for /anything/v1/models/<name>
+        path = self.path.rstrip("/") or "/"
+        if path == "/healthz":
+            # pure LIVENESS: the process answers.  Deliberately cheap and
+            # unconditional — a draining or still-warming replica is
+            # alive; restarts key off this, routing keys off /readyz.
+            self._send(200, {"status": "ok"})
+        elif path == "/readyz":
+            # READINESS: should this replica receive new work?
+            if self.service.draining:
+                self._send(503, {"status": "draining"},
+                           headers=[("Retry-After", "1")])
+            else:
+                self._send(200, {"status": "ok"})
+        elif path == "/" or path == f"/v1/models/{name}":
             self._send(200, self.service.metadata())
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
         name = self.service.model_name
+        if self.path.rstrip("/") == "/v1/fleet:drain":
+            # replica-side drain hook: fence admissions, wait for the
+            # slot engine to empty (fleet.Gateway.drain calls this after
+            # its own proxied in-flight count reaches zero)
+            self._send(200, self.service.drain())
+            return
         is_predict = self.path == f"/v1/models/{name}:predict"
         is_generate = self.path == f"/v1/models/{name}:generate"
         if not (is_predict or is_generate):
             self._send(404, {"error": f"unknown path {self.path} (serving "
                              f"model {name!r})"})
+            return
+        if self.service.draining:
+            self._send(503, {"error": "replica is draining",
+                             "type": "draining"},
+                       headers=[("Retry-After", "1")])
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -1811,6 +1887,39 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
     return server, service
 
 
+def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
+    """Join the fleet gateway named by ``--fleet HOST:PORT``: REG this
+    replica's advertised endpoint + capacity over the reservation plane
+    and start the liveness heartbeat.  Returns the live registration
+    (caller must ``deregister()`` at shutdown so the gateway drops the
+    replica immediately instead of waiting out the heartbeat window)."""
+    from . import fleet_client
+
+    ghost, _, gport = args.fleet.rpartition(":")
+    if not ghost or not gport.isdigit():
+        raise ValueError(f"--fleet must be HOST:PORT, got {args.fleet!r}")
+    features = {}
+    if getattr(args, "generate_kv_page_size", 0):
+        # the gateway sizes its :generate prefix-affinity hash off this,
+        # aligning routing keys with the replica prefix-cache page unit
+        features["kv_page_size"] = args.generate_kv_page_size
+        features["kv_pages"] = args.generate_kv_pages
+    if getattr(args, "draft_export_dir", None):
+        features["speculative"] = True
+    if getattr(args, "generate_quantize", "none") != "none":
+        features["quantize"] = args.generate_quantize
+    if getattr(args, "generate_lora_rank", 0):
+        features["lora_rank"] = args.generate_lora_rank
+    return fleet_client.register_replica(
+        (ghost, int(gport)),
+        args.advertise_host or args.host,
+        server.server_address[1],
+        model_name=args.model_name,
+        n_slots=getattr(args, "generate_slots", 8) or 8,
+        features=features,
+        heartbeat_interval_s=args.fleet_heartbeat_s)
+
+
 def main(argv: Any = None) -> None:
     args = build_argparser().parse_args(argv)
     logging.basicConfig(
@@ -1821,11 +1930,16 @@ def main(argv: Any = None) -> None:
     logger.info("serving %s (%s) on http://%s:%d", args.export_dir,
                 service.desc, host, port)
     print(f"serving on http://{host}:{port} ({service.desc})", flush=True)
+    registration = None
+    if getattr(args, "fleet", None):
+        registration = _register_with_fleet(args, server)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if registration is not None:
+            registration.deregister()
         server.server_close()
 
 

@@ -207,3 +207,16 @@ def test_llama_serve_end_to_end(tmp_path):
     assert "imported LLaMA" in out
     assert "serving on http://" in out
     assert "llama serving round trip complete" in out
+
+
+def test_fleet_serve_example_parses():
+    # parse-only (ISSUE 2 tooling satellite): the two-replica fleet
+    # walkthrough compiles spinning nothing up — the live gateway paths
+    # it demos are covered in-process by tests/test_fleet.py
+    path = os.path.join(EX, "lm", "fleet_serve.py")
+    with open(path) as f:
+        src = f.read()
+    compile(src, path, "exec")
+    assert "fleet.Gateway" in src
+    assert "register_replica" in src
+    assert "fleet:drain" in src or ".drain(" in src

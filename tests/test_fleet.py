@@ -1,0 +1,476 @@
+"""Fleet gateway tests: registration over the reservation plane, routing
+policies (least-loaded / prefix-affinity), and every unhappy path the
+gateway owns — ejection + re-admission, hedged retries, circuit breaking,
+429 backpressure, graceful drain.
+
+All CPU-only and model-free: replicas are :class:`StubReplica` HTTP
+servers (same surface as serve.py, canned responses) registered through
+the REAL reservation plane (`fleet_client` -> msgpack REG/BEAT/BYE), so
+the membership, heartbeat, and routing machinery under test is exactly
+what production runs — only the model behind each replica is fake.
+Threads, not processes; tests that sleep on heartbeat intervals carry
+``@pytest.mark.slow``.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tensorflowonspark_tpu import fleet, fleet_client
+
+
+def _wait_until(pred, timeout=5.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+class StubReplica:
+    """A serve.py stand-in: same HTTP surface (metadata / readyz /
+    :predict / :generate / drain hook), no model.  Responses carry
+    ``"replica": <id>`` so tests can observe where the gateway routed."""
+
+    def __init__(self, generate_delay_s=0.0):
+        self.generate_delay_s = generate_delay_s
+        self.predict_hits = 0
+        self.generate_hits = 0
+        self.generate_prompts = []
+        self.fail_next = 0          # respond 500 to this many POSTs
+        self.in_flight = 0
+        self.draining = False
+        self._lock = threading.Lock()
+        stub = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.rstrip("/") or "/"
+                if path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif path == "/readyz":
+                    self._send(503 if stub.draining else 200,
+                               {"status": "draining" if stub.draining
+                                else "ok"})
+                elif path == "/v1/models/default":
+                    self._send(200, {
+                        "status": "ok",
+                        "model": {"engine": "stub",
+                                  "generate_stats": {
+                                      "slots_busy": stub.in_flight,
+                                      "pending": 0,
+                                      "prefill_tokens_shared": 7,
+                                      "prefix_pages_cached": 3}}})
+                else:
+                    self._send(404, {"error": self.path})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.rstrip("/") == "/v1/fleet:drain":
+                    stub.draining = True
+                    _wait_until(lambda: stub.in_flight == 0, timeout=10)
+                    self._send(200, {"drained": stub.in_flight == 0,
+                                     "draining": True})
+                    return
+                with stub._lock:
+                    if stub.fail_next > 0:
+                        stub.fail_next -= 1
+                        self._send(500, {"error": "injected failure"})
+                        return
+                if self.path.endswith(":predict"):
+                    with stub._lock:
+                        stub.predict_hits += 1
+                    self._send(200, {"predictions": [{"y": [0.0]}],
+                                     "replica": stub.id})
+                elif self.path.endswith(":generate"):
+                    with stub._lock:
+                        stub.generate_hits += 1
+                        stub.generate_prompts.append(
+                            list(req.get("inputs", [[]])[0]))
+                        stub.in_flight += 1
+                    try:
+                        if stub.generate_delay_s:
+                            time.sleep(stub.generate_delay_s)
+                        self._send(200, {"outputs": [[1, 2, 3]],
+                                         "replica": stub.id})
+                    finally:
+                        with stub._lock:
+                            stub.in_flight -= 1
+                else:
+                    self._send(404, {"error": self.path})
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.host, self.port = self._server.server_address[:2]
+        self.id = f"{self.host}:{self.port}"
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def gateway():
+    gw = fleet.Gateway(heartbeat_timeout_s=0.6, monitor_interval_s=0.05,
+                       breaker_threshold=2, breaker_cooldown_s=0.3,
+                       connect_timeout_s=2.0, replica_timeout_s=10.0,
+                       probe_timeout_s=2.0)
+    gw.start()
+    stubs, regs = [], []
+    try:
+        yield gw, stubs, regs
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for s in stubs:
+            s.close()
+        gw.stop()
+
+
+def _spawn(gw, stubs, regs, n=2, n_slots=2, generate_delay_s=0.0,
+           heartbeat_s=0.15):
+    """Start `n` stub replicas and register each with the gateway."""
+    out = []
+    for _ in range(n):
+        s = StubReplica(generate_delay_s=generate_delay_s)
+        reg = fleet_client.register_replica(
+            gw.registry_addr, s.host, s.port, n_slots=n_slots,
+            features={"kv_page_size": 4},
+            heartbeat_interval_s=heartbeat_s)
+        stubs.append(s)
+        regs.append(reg)
+        out.append((s, reg))
+    assert _wait_until(
+        lambda: len(gw.fleet_stats(probe=False)["replicas"]) >= n)
+    return out
+
+
+def _client(gw):
+    return fleet_client.FleetClient(*gw.http_addr)
+
+
+def _affine_stub(gw, stubs, prompt):
+    """Which stub the gateway's rendezvous hash maps `prompt` to."""
+    key = gw.prefix_key({"inputs": [prompt]})
+    return max(stubs, key=lambda s: fleet._hrw(s.id, key))
+
+
+# ---------------------------------------------------------------- fast --
+
+def test_registration_fleet_stats_and_bye(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    c = _client(gw)
+    status, body = c.fleet_stats()     # probing: pulls stub metadata too
+    assert status == 200
+    assert set(body["replicas"]) == {stubs[0].id, stubs[1].id}
+    for desc in body["replicas"].values():
+        assert desc["state"] == "up"
+        assert desc["model"]["engine"] == "stub"
+    # totals aggregate the per-replica generate_stats the stubs report
+    assert body["totals"]["slots"] == 4
+    assert body["totals"]["prefill_tokens_shared"] == 14
+    assert body["totals"]["prefix_pages_cached"] == 6
+    assert body["counters"]["registrations"] == 2
+    assert body["gateway"]["prefix_tokens"] == 4   # adopted kv_page_size
+    # BYE drops the replica immediately (no heartbeat wait)
+    regs[0].deregister()
+    assert _wait_until(
+        lambda: stubs[0].id not in gw.fleet_stats(probe=False)["replicas"])
+    assert gw.counters.get("deregistrations") == 1
+
+
+def test_predict_routes_least_loaded(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    a, b = stubs
+    with gw._lock:                       # pin a queue depth on A
+        gw._replicas[a.id].outstanding = 3
+    status, body = _client(gw).predict([{"x": [1.0, 2.0]}])
+    assert status == 200
+    assert body["replica"] == b.id       # the less-loaded replica served
+    assert b.predict_hits == 1 and a.predict_hits == 0
+
+
+def test_generate_prefix_affinity_deterministic(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2, n_slots=4)
+    c = _client(gw)
+    shared = [7, 8, 9, 10]               # kv_page_size=4 -> the hash key
+    expect = _affine_stub(gw, stubs, shared)
+    served = set()
+    for tail in range(5):                # same prefix, different tails
+        status, body = c.generate([shared + [100 + tail]])
+        assert status == 200
+        served.add(body["replica"])
+    assert served == {expect.id}         # all on the affine replica
+    assert gw.counters.get("affinity_hits") == 5
+    # a DIFFERENT prefix may hash elsewhere but is equally deterministic
+    status, body = c.generate([[1, 2, 3, 4, 5]])
+    assert body["replica"] == _affine_stub(gw, stubs, [1, 2, 3, 4, 5]).id
+
+
+def test_generate_spills_when_affine_replica_saturated(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2, n_slots=2)
+    shared = [7, 8, 9, 10]
+    affine = _affine_stub(gw, stubs, shared)
+    other = next(s for s in stubs if s.id != affine.id)
+    with gw._lock:                       # queue bound = 2.0 * 2 slots
+        gw._replicas[affine.id].outstanding = 4
+    status, body = _client(gw).generate([shared])
+    assert status == 200
+    assert body["replica"] == other.id   # cold prefill beats queueing
+    assert gw.counters.get("affinity_spills") == 1
+
+
+def test_predict_hedged_retry_on_5xx(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    a, b = stubs
+    with gw._lock:                       # force first pick onto A...
+        gw._replicas[b.id].outstanding = 3
+    a.fail_next = 1                      # ...which 500s once
+    status, body = _client(gw).predict([{"x": [0.0, 0.0]}])
+    assert status == 200                 # client never sees the failure
+    assert body["replica"] == b.id       # retried on the OTHER replica
+    assert gw.counters.get("hedged_retries") == 1
+    with gw._lock:                       # A's breaker counted the failure
+        assert gw._replicas[a.id].errors == 1
+
+
+def test_generate_fails_fast_with_typed_error(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    shared = [5, 6, 7, 8]
+    affine = _affine_stub(gw, stubs, shared)
+    affine.fail_next = 1
+    status, body = _client(gw).generate([shared])
+    assert status == 502                 # NOT retried: not idempotent
+    assert body["type"] == "replica_failure"
+    assert body["replica"] == affine.id
+    assert body["retryable"] is True
+    assert gw.counters.get("generate_failures") == 1
+    assert gw.counters.get("hedged_retries") == 0
+    assert sum(s.generate_hits for s in stubs) == 0   # nobody re-ran it
+
+
+def test_circuit_breaker_opens_and_half_opens(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    a, b = stubs
+    shared = [5, 6, 7, 8]
+    affine = _affine_stub(gw, stubs, shared)
+    other = next(s for s in stubs if s.id != affine.id)
+    affine.fail_next = 2                 # breaker_threshold=2
+    c = _client(gw)
+    for _ in range(2):
+        status, _ = c.generate([shared])
+        assert status == 502
+    assert gw.counters.get("breaker_opens") == 1
+    # breaker OPEN: affinity ignores the sick replica, no 502s
+    status, body = c.generate([shared])
+    assert status == 200
+    assert body["replica"] == other.id
+    # after the cooldown the next request is the half-open trial — it
+    # succeeds (fail_next exhausted) and closes the breaker
+    time.sleep(0.35)
+    status, body = c.generate([shared])
+    assert status == 200
+    assert body["replica"] == affine.id
+    # the breaker reset lands in the handler thread AFTER the response
+    # body is relayed, so poll rather than assert immediately
+    assert _wait_until(
+        lambda: gw._replicas[affine.id].failures == 0)
+
+
+def test_backpressure_429_and_no_replica_503(gateway):
+    gw, stubs, regs = gateway
+    c = _client(gw)
+    # nothing registered at all -> 503
+    status, body = c.predict([{"x": [0.0]}])
+    assert status == 503
+    assert body["type"] == "no_replica"
+    (s, _reg), = _spawn(gw, stubs, regs, n=1, n_slots=2)
+    with gw._lock:                       # saturate the only replica
+        gw._replicas[s.id].outstanding = 4
+    req = urllib.request.Request(
+        "http://%s:%d/v1/models/default:predict" % gw.http_addr,
+        data=json.dumps({"instances": [{"x": [0.0]}]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 429
+    assert e.value.headers["Retry-After"] is not None
+    assert json.loads(e.value.read())["type"] == "saturated"
+    assert gw.counters.get("rejected_429") == 1
+    with gw._lock:                       # back under the bound: serves
+        gw._replicas[s.id].outstanding = 0
+    status, _ = c.predict([{"x": [0.0]}])
+    assert status == 200
+
+
+def test_drain_waits_for_in_flight_and_deregisters(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2, generate_delay_s=0.5)
+    shared = [9, 9, 9, 9]
+    affine = _affine_stub(gw, stubs, shared)
+    survivor = next(s for s in stubs if s.id != affine.id)
+    c = _client(gw)
+    results = {}
+
+    def _gen():
+        results["gen"] = c.generate([shared])
+
+    t = threading.Thread(target=_gen)
+    t.start()
+    assert _wait_until(lambda: affine.in_flight == 1)   # mid-generation
+    t0 = time.monotonic()
+    status, out = c.drain(affine.id, timeout_s=10)
+    waited = time.monotonic() - t0
+    t.join()
+    assert status == 200 and out["drained"] is True
+    assert waited >= 0.3                 # really waited for the in-flight
+    assert results["gen"][0] == 200      # ...which completed normally
+    assert out["replica_report"]["draining"] is True
+    # drained replica is deregistered; traffic flows to the survivor
+    assert affine.id not in gw.fleet_stats(probe=False)["replicas"]
+    status, body = c.generate([shared])
+    assert status == 200 and body["replica"] == survivor.id
+    assert gw.counters.get("drains_started") == 1
+    assert gw.counters.get("drains_completed") == 1
+
+
+def test_drain_unknown_replica_404(gateway):
+    gw, stubs, regs = gateway
+    status, body = _client(gw).drain("10.0.0.9:1234")
+    assert status == 404
+    assert "unknown replica" in body["error"]
+
+
+def test_gateway_metadata_passthrough(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=1)
+    status, body = _client(gw).metadata()
+    assert status == 200
+    assert body["model"]["engine"] == "stub"
+
+
+# ---------------------------------------------------------------- slow --
+# (sleep on heartbeat windows / spin extra replica threads)
+
+@pytest.mark.slow
+def test_heartbeat_ejection_and_readmission(gateway):
+    gw, stubs, regs = gateway
+    (s, reg), = _spawn(gw, stubs, regs, n=1, heartbeat_s=0.1)
+
+    def state():
+        reps = gw.fleet_stats(probe=False)["replicas"]
+        return reps.get(s.id, {}).get("state")
+
+    assert state() == "up"
+    reg.stop_heartbeat()                 # crash simulation: beats stop
+    assert _wait_until(lambda: state() == "ejected", timeout=5)
+    assert gw.counters.get("ejections") == 1
+    # ejected (not deregistered): requests get 429 backpressure, not 503
+    status, _ = _client(gw).predict([{"x": [0.0]}])
+    assert status == 429
+    # beats resume -> automatic re-admission, traffic flows again
+    reg._client.start_heartbeat(reg.replica_id, interval=0.1)
+    assert _wait_until(lambda: state() == "up", timeout=5)
+    assert gw.counters.get("readmissions") == 1
+    status, _ = _client(gw).predict([{"x": [0.0]}])
+    assert status == 200
+
+
+@pytest.mark.slow
+def test_two_replica_fleet_acceptance(gateway):
+    """The ISSUE acceptance scenario, end to end on one gateway:
+    (a) prefix-affine :generate routing, (b) replica kill -> ejection
+    within the heartbeat window while the survivor serves, (c) drain
+    returns only after in-flight generations finish while new requests
+    get 429 — each leg visible in the GET /v1/fleet counters."""
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2, n_slots=4, generate_delay_s=0.4,
+           heartbeat_s=0.1)
+    c = _client(gw)
+    shared = [3, 1, 4, 1]
+
+    # (a) shared-prefix generations all land on the affine replica
+    affine = _affine_stub(gw, stubs, shared)
+    survivor = next(s for s in stubs if s.id != affine.id)
+    for tail in range(3):
+        status, body = c.generate([shared + [tail]])
+        assert status == 200 and body["replica"] == affine.id
+    assert gw.counters.get("affinity_hits") == 3
+
+    # (b) kill the affine replica (process death: HTTP down, beats stop)
+    areg = next(r for r in regs if r.replica_id == affine.id)
+    areg.stop_heartbeat()
+    affine.close()
+    assert _wait_until(
+        lambda: gw.fleet_stats(probe=False)["replicas"][affine.id]
+        ["state"] == "ejected", timeout=5)
+    status, body = c.generate([shared])  # same prefix, re-mapped
+    assert status == 200 and body["replica"] == survivor.id
+    status, body = c.predict([{"x": [0.0]}])
+    assert status == 200 and body["replica"] == survivor.id
+
+    # (c) drain the survivor with a generation in flight
+    results = {}
+    t = threading.Thread(
+        target=lambda: results.update(gen=c.generate([shared])))
+    t.start()
+    assert _wait_until(lambda: survivor.in_flight == 1)
+    dres = {}
+    dt = threading.Thread(
+        target=lambda: dres.update(drain=c.drain(survivor.id,
+                                                 timeout_s=10)))
+    dt.start()
+    assert _wait_until(
+        lambda: gw.fleet_stats(probe=False)["replicas"]
+        .get(survivor.id, {}).get("state") == "draining")
+    # new work during the drain is refused with backpressure (the only
+    # other replica is ejected), never routed to the draining replica
+    req = urllib.request.Request(
+        "http://%s:%d/v1/models/default:predict" % gw.http_addr,
+        data=json.dumps({"instances": [{"x": [0.0]}]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 429
+    t.join()
+    dt.join()
+    assert results["gen"][0] == 200      # in-flight generation completed
+    status, out = dres["drain"]
+    assert status == 200 and out["drained"] is True
+    # every leg is visible in the fleet-level counters
+    counters = c.fleet_stats(probe=False)[1]["counters"]
+    assert counters["affinity_hits"] >= 3            # (a)
+    assert counters["ejections"] >= 1                # (b)
+    assert counters["drains_completed"] >= 1         # (c)
+    assert counters["rejected_429"] >= 1             # (c) backpressure
+    assert survivor.id not in c.fleet_stats(probe=False)[1]["replicas"]

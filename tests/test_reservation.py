@@ -136,3 +136,52 @@ def test_client_connect_to_dead_server_fails_cleanly(monkeypatch):
     s.close()
     with pytest.raises(ConnectionError, match="could not reach"):
         reservation.Client(addr)
+
+
+def test_backoff_delay_is_capped_exponential():
+    d = reservation._backoff_delay
+    assert [d(a, 0.5, 3.0) for a in range(5)] == [0.5, 1.0, 2.0, 3.0, 3.0]
+    assert d(0, 2, 15) == 2.0
+    assert d(10, 2, 15) == 15.0          # never exceeds the cap
+
+
+def test_client_timeout_knobs_fail_fast():
+    """Per-instance retries/retry_delay bound a dead-server connect
+    WITHOUT monkeypatching module globals (a serving replica registering
+    with a down gateway must not hang startup)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = s.getsockname()
+    s.close()
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="could not reach"):
+        reservation.Client(addr, retries=2, retry_delay=0.05,
+                           connect_timeout=1.0)
+    elapsed = time.monotonic() - t0
+    # 2 refused dials + one 0.05 s backoff — nowhere near the module
+    # defaults (3 retries x 2 s base delay)
+    assert elapsed < 2.0
+
+
+def test_client_rpc_timeout_bounds_wedged_server():
+    """A server that ACCEPTS but never responds must not block an RPC
+    past rpc_timeout (the indefinite-blocking satellite: previously
+    receive() on a wedged peer hung forever)."""
+    import socket
+
+    wedged = socket.socket()
+    wedged.bind(("127.0.0.1", 0))
+    wedged.listen(1)                     # accept queue, never served
+    addr = wedged.getsockname()
+    try:
+        client = reservation.Client(addr, connect_timeout=2.0,
+                                    rpc_timeout=0.5, retries=1)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):     # socket.timeout is an OSError
+            client.query()
+        assert time.monotonic() - t0 < 2.0
+        client.close()
+    finally:
+        wedged.close()

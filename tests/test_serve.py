@@ -84,6 +84,74 @@ def test_metadata_and_health(server):
         assert json.loads(r.read())["status"] == "ok"
 
 
+def test_metadata_path_matching_is_exact(server):
+    # regression: do_GET used endswith(), so /anything/v1/models/default
+    # served metadata for arbitrary prefixes
+    base, _ = server
+    for path in ("/anything/v1/models/default",
+                 "/v1/models/default/extra",
+                 "/v1/models/other"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + path, timeout=30)
+        assert e.value.code == 404
+    # exactly one trailing slash stays tolerated
+    with urllib.request.urlopen(base + "/v1/models/default/",
+                                timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_healthz_is_liveness_readyz_is_readiness(server):
+    base, _ = server
+    # liveness: unconditional and payload-free (no model introspection)
+    with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+        assert json.loads(r.read()) == {"status": "ok"}
+    with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_drain_fences_admissions(tmp_path):
+    # a dedicated server: draining is one-way, so the shared module
+    # fixture must not be drained out from under the other tests
+    from tensorflowonspark_tpu.models.linear import Linear
+
+    params = Linear(features=1).init(
+        jax.random.key(0), np.zeros((1, 2), "float32"))["params"]
+    export.export_saved_model(
+        str(tmp_path / "m"), params,
+        builder="tensorflowonspark_tpu.models.linear:Linear",
+        builder_kwargs={"features": 1},
+        signatures={"serving_default": {
+            "inputs": {"x": {"shape": [2], "dtype": "float32"}},
+            "outputs": ["y"]}})
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "m"), "--port", "0"])
+    srv, service = serve.make_server(args)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = "http://%s:%d" % srv.server_address[:2]
+    try:
+        _post(base + "/v1/models/default:predict",
+              {"instances": [{"x": [1.0, 2.0]}]})
+        out = _post(base + "/v1/fleet:drain", {})
+        assert out["drained"] is True      # nothing was in flight
+        # readiness flips, liveness does not
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/readyz", timeout=30)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "draining"
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        # new work is refused with backpressure, not served
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/v1/models/default:predict",
+                  {"instances": [{"x": [1.0, 2.0]}]})
+        assert e.value.code == 503
+        assert e.value.headers["Retry-After"] is not None
+        assert service.metadata()["status"] == "draining"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_bad_requests_get_400_server_stays_up(server):
     base, _ = server
     for payload in ({"instances": []},
