@@ -23,12 +23,10 @@ Run:
 """
 import argparse
 import dataclasses
-import json
 import os
 import sys
 import tempfile
 import threading
-import urllib.request
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..")))

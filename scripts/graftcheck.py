@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""graftcheck — JAX/TPU-aware semantic static analysis over the repo.
+
+Stdlib-only.  Runs the style tier (what scripts/lint.py runs) plus the
+semantic analyzers: tracer hazards inside jit/shard_map, mesh-axis and
+Pallas out-sharding lint, BlockSpec tile checks, and lock discipline for
+the fleet/serve/reservation plane.  Exit 0 = clean (modulo the checked-in
+baseline, scripts/graftcheck_baseline.json, which may only shrink).
+
+    python scripts/graftcheck.py                  # whole repo
+    python scripts/graftcheck.py --list-rules
+    python scripts/graftcheck.py path/to/file.py --json
+    python scripts/graftcheck.py --update-baseline
+"""
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from tensorflowonspark_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    # With no explicit paths the default scan set is repo-relative; anchor it
+    # (and the default baseline path) so the CLI works from any cwd.
+    if not any(not a.startswith("-") for a in sys.argv[1:]):
+        os.chdir(_ROOT)
+    sys.exit(main())

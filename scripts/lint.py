@@ -1,121 +1,36 @@
-"""Dependency-free linter (stdlib only — this environment has no
+#!/usr/bin/env python3
+"""Dependency-free style linter (stdlib only — this environment has no
 pycodestyle/pylint/mypy and no package index to fetch them from; the
 reference's tox lint envs, tox.ini:49-85, are mapped onto this script).
 
-Checks: syntax (compile), max line length, tabs in indentation, trailing
-whitespace, unused imports (AST, module scope and function scope),
-leftover debugger hooks.  `# noqa` on a line suppresses its findings.
+Since the graftcheck framework landed this is a thin wrapper: it runs the
+style tier (syntax, max line length, tabs in indentation, trailing
+whitespace, unused imports, leftover debugger hooks) through
+``tensorflowonspark_tpu.analysis`` so style and semantic checks share one
+walker and one suppression syntax (``# noqa`` on a line still works, as
+does ``# graftcheck: disable=RULE``).  The semantic tier is
+``scripts/graftcheck.py``; ``tox -e lint`` runs both.
 
 Usage: python scripts/lint.py [paths...]    (default: the package, tests,
 examples, scripts, and the repo-root entry points)
+
+Exits non-zero on findings, and with status 2 when an explicitly named
+path does not exist (the old walker silently skipped typos).
 """
-import ast
-import sys
 import os
+import sys
 
-MAX_LINE = 160
-DEFAULT_PATHS = ["tensorflowonspark_tpu", "tests", "examples", "scripts",
-                 "bench.py", "__graft_entry__.py"]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def iter_py(paths):
-    for p in paths:
-        if os.path.isfile(p):
-            yield p
-        else:
-            for root, dirs, files in os.walk(p):
-                dirs[:] = [d for d in dirs if not d.startswith((".", "__pycache"))]
-                for f in sorted(files):
-                    if f.endswith(".py"):
-                        yield os.path.join(root, f)
-
-
-class ImportUsage(ast.NodeVisitor):
-    """Collect imported names and every name/attribute-root usage."""
-
-    def __init__(self):
-        self.imports = []       # (name, lineno)
-        self.used = set()
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imports.append((name, node.lineno))
-
-    def visit_ImportFrom(self, node):
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imports.append((a.asname or a.name, node.lineno))
-
-    def visit_Name(self, node):
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-
-
-def check_file(path):
-    problems = []
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    lines = src.splitlines()
-
-    def ok(lineno):
-        return "noqa" not in (lines[lineno - 1] if lineno <= len(lines)
-                              else "")
-
-    try:
-        tree = ast.parse(src, path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-
-    for i, line in enumerate(lines, 1):
-        if "noqa" in line:
-            continue
-        if len(line) > MAX_LINE:
-            problems.append((i, f"line too long ({len(line)} > {MAX_LINE})"))
-        if line.rstrip() != line:
-            problems.append((i, "trailing whitespace"))
-        indent = line[:len(line) - len(line.lstrip())]
-        if "\t" in indent:
-            problems.append((i, "tab in indentation"))
-
-    # unused imports + debugger leftovers, module and def scope
-    v = ImportUsage()
-    v.visit(tree)
-    # names used anywhere count (a coarse, zero-false-positive-ish rule:
-    # we only flag a name that appears NOWHERE else in the file source)
-    for name, lineno in v.imports:
-        if name == "_" or name.startswith("_sideeffect"):
-            continue
-        if name not in v.used and src.count(name) <= 1 and ok(lineno):
-            problems.append((lineno, f"unused import '{name}'"))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            fn = node.func
-            if (isinstance(fn, ast.Name) and fn.id == "breakpoint") or (
-                    isinstance(fn, ast.Attribute) and fn.attr == "set_trace"):
-                if ok(node.lineno):
-                    problems.append((node.lineno, "debugger call left in"))
-    return problems
-
-
-def main(argv):
-    # --strict is accepted as a no-op passthrough: TYPE checking is not
-    # this stdlib linter's job — it lives in `tox -e typecheck` (mypy,
-    # gated on installability like real-spark; config in pyproject.toml)
-    argv = [a for a in argv if a != "--strict"]
-    paths = argv or DEFAULT_PATHS
-    total = 0
-    for path in iter_py(paths):
-        for lineno, msg in check_file(path):
-            print(f"{path}:{lineno}: {msg}")
-            total += 1
-    if total:
-        print(f"\n{total} problem(s)")
-        return 1
-    print("lint clean")
-    return 0
+from tensorflowonspark_tpu.analysis import main  # noqa: E402
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1:]))
+    # --strict is accepted as a no-op passthrough: TYPE checking is not
+    # this stdlib linter's job — it lives in `tox -e typecheck` (mypy,
+    # gated on installability like real-spark; config in pyproject.toml)
+    argv = ["--style-only", "--no-baseline"] + sys.argv[1:]
+    rc = main(argv)
+    if rc == 0:
+        print("lint clean")
+    sys.exit(rc)

@@ -5,8 +5,16 @@
 #
 #   scripts/run_tests.sh            # full suite (>20 min on a 1-core box)
 #   scripts/run_tests.sh --fast     # core-runtime tier (~100 s)
+#   scripts/run_tests.sh --analyze  # style lint + graftcheck semantic
+#                                   # analysis first, then the suite
+#                                   # (combines with --fast)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [ "${1:-}" = "--analyze" ]; then
+    shift
+    python scripts/lint.py
+    python scripts/graftcheck.py
+fi
 make -C native
 if [ "${1:-}" = "--fast" ]; then
     shift

@@ -1,0 +1,12 @@
+"""graftcheck — JAX/TPU-aware stdlib static analysis.
+
+Rule framework + four semantic analyzers (tracer hazards, sharding lint,
+Pallas tile checks, lock discipline) + the style tier scripts/lint.py
+delegates to.  Run as ``python scripts/graftcheck.py`` or
+``python -m tensorflowonspark_tpu.analysis``; see docs/source/analysis.rst.
+"""
+from .core import (Finding, Project, Rule, REGISTRY, analyze_source,  # noqa: F401
+                   main, register, run_rules)
+
+__all__ = ["Finding", "Project", "Rule", "REGISTRY", "analyze_source",
+           "main", "register", "run_rules"]

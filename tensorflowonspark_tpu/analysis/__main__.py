@@ -1,0 +1,7 @@
+"""``python -m tensorflowonspark_tpu.analysis`` — graftcheck CLI."""
+import sys
+
+from .core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

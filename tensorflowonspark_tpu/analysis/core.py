@@ -1,0 +1,452 @@
+"""graftcheck core: rule registry, file walker, suppressions, baseline, CLI.
+
+Stdlib-only (``ast`` + ``argparse`` + ``json``) so the semantic lint tier
+runs in environments with no package index — the same constraint that made
+``scripts/lint.py`` a from-scratch style linter instead of pycodestyle.
+This module owns everything rule-agnostic:
+
+- the ``Rule`` registry (``@register``) that style and semantic analyzers
+  plug into,
+- one shared walker that reads + parses every file exactly once and hands
+  each rule a ``FileContext``,
+- a ``Project`` view for cross-file facts (mesh axes declared in
+  ``parallel/mesh.py``, the repo-wide set of Pallas kernel entry points),
+- suppression comments (``# graftcheck: disable=RULE[,RULE...]`` on the
+  offending line, ``disable-next-line`` on the line above, or
+  ``disable-file`` anywhere in the file; style rules also honor the legacy
+  ``# noqa``),
+- a baseline file of grandfathered finding fingerprints (new findings fail,
+  fixed findings are reported as stale so the baseline only shrinks),
+- text/JSON reporters and the argparse ``main`` used by both
+  ``scripts/graftcheck.py`` and ``python -m tensorflowonspark_tpu.analysis``.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+
+# Paths scanned when the CLI is invoked with no arguments (mirrors the old
+# scripts/lint.py default surface).  Semantic rules additionally restrict
+# themselves to the package — test/example files build ad-hoc meshes and
+# deliberately-broken fixtures that would drown the signal.
+DEFAULT_PATHS = [
+    "tensorflowonspark_tpu", "tests", "examples", "scripts",
+    "bench.py", "__graft_entry__.py",
+]
+DEFAULT_BASELINE = os.path.join("scripts", "graftcheck_baseline.json")
+
+PACKAGE_DIR = "tensorflowonspark_tpu"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*(disable(?:-next-line|-file)?)\s*=\s*"
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def fingerprint(self, lines):
+        """Stable identity for the baseline: path + rule + the stripped
+        source line, so findings survive unrelated line-number drift."""
+        text = ""
+        if 1 <= self.line <= len(lines):
+            text = lines[self.line - 1].strip()
+        return f"{_posix(self.path)}::{self.rule}::{text}"
+
+    def as_dict(self):
+        return {"path": _posix(self.path), "line": self.line,
+                "rule": self.rule, "message": self.message}
+
+
+def _posix(path):
+    return path.replace(os.sep, "/")
+
+
+class Rule:
+    """One named check.  Subclasses set ``name``/``description`` and yield
+    ``Finding``s from ``check(ctx)``.  ``scope`` is ``"all"`` (every scanned
+    file) or ``"package"`` (only files under ``tensorflowonspark_tpu/``);
+    ``kind`` is ``"style"`` or ``"semantic"`` (style rules honor ``# noqa``
+    and are what ``scripts/lint.py`` runs)."""
+
+    name = ""
+    description = ""
+    scope = "package"
+    kind = "semantic"
+
+    def applies(self, ctx):
+        if self.scope == "all":
+            return True
+        parts = _posix(ctx.path).split("/")
+        return PACKAGE_DIR in parts or ctx.path in ("bench.py", "__graft_entry__.py")
+
+    def check(self, ctx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the global registry."""
+    rule = cls()
+    if not rule.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    REGISTRY[rule.name] = rule
+    return cls
+
+
+@dataclasses.dataclass
+class FileContext:
+    path: str
+    src: str
+    lines: list
+    tree: object          # ast.Module, or None when the file failed to parse
+    project: object = None
+    # line -> set of rule names disabled on that line ("all" disables all)
+    suppressions: dict = dataclasses.field(default_factory=dict)
+    file_suppressions: set = dataclasses.field(default_factory=set)
+    noqa_lines: set = dataclasses.field(default_factory=set)
+
+    @classmethod
+    def from_source(cls, src, path="<string>", project=None):
+        lines = src.splitlines()
+        try:
+            tree = ast.parse(src)
+            err = None
+        except SyntaxError as e:
+            tree, err = None, e
+        ctx = cls(path=path, src=src, lines=lines, tree=tree, project=project)
+        ctx.syntax_error = err
+        ctx._scan_suppressions()
+        return ctx
+
+    def _scan_suppressions(self):
+        for i, ln in enumerate(self.lines, start=1):
+            if "# noqa" in ln:
+                self.noqa_lines.add(i)
+            m = _SUPPRESS_RE.search(ln)
+            if not m:
+                continue
+            mode, rules = m.group(1), {r.strip() for r in m.group(2).split(",")}
+            if mode == "disable":
+                self.suppressions.setdefault(i, set()).update(rules)
+            elif mode == "disable-next-line":
+                self.suppressions.setdefault(i + 1, set()).update(rules)
+            else:  # disable-file
+                self.file_suppressions.update(rules)
+
+    def suppressed(self, finding, rule):
+        dis = self.suppressions.get(finding.line, ())
+        if finding.rule in dis or "all" in dis:
+            return True
+        if finding.rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        if rule is not None and rule.kind == "style" and finding.line in self.noqa_lines:
+            return True
+        return False
+
+
+class Project:
+    """Cross-file facts shared by the semantic rules.
+
+    ``mesh_axes`` — the physical mesh axis names.  Parsed lazily from the
+    scanned file ending in ``parallel/mesh.py`` (module-level ``AXIS_* =
+    "name"`` constants), falling back to that path on disk relative to the
+    scan root; tests inject a set directly.
+
+    ``pallas_entries`` — every top-level function name defined in a scanned
+    module whose source contains a ``pallas_call``.  Deliberately coarse:
+    a sharded-jit wrapper anywhere in the repo that calls one of these by
+    name reaches a custom call GSPMD cannot partition.
+    """
+
+    def __init__(self, files=None, root=".", mesh_axes=None):
+        self.files = files if files is not None else []
+        self.root = root
+        self._mesh_axes = mesh_axes
+        self._pallas_entries = None
+
+    @property
+    def mesh_axes(self):
+        if self._mesh_axes is None:
+            self._mesh_axes = self._find_mesh_axes()
+        return self._mesh_axes
+
+    def _find_mesh_axes(self):
+        for ctx in self.files:
+            if _posix(ctx.path).endswith("parallel/mesh.py") and ctx.tree is not None:
+                return _parse_mesh_axes(ctx.tree)
+        fallback = os.path.join(self.root, PACKAGE_DIR, "parallel", "mesh.py")
+        if os.path.isfile(fallback):
+            try:
+                with open(fallback, encoding="utf-8") as f:
+                    return _parse_mesh_axes(ast.parse(f.read()))
+            except (OSError, SyntaxError):
+                pass
+        return set()
+
+    @property
+    def pallas_entries(self):
+        if self._pallas_entries is None:
+            names = set()
+            for ctx in self.files:
+                if ctx.tree is None or "pallas_call" not in ctx.src:
+                    continue
+                if not _module_has_pallas_call(ctx.tree):
+                    continue
+                for node in ctx.tree.body:
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        names.add(node.name)
+            self._pallas_entries = names
+        return self._pallas_entries
+
+
+def _parse_mesh_axes(tree):
+    axes = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Name) and tgt.id.startswith("AXIS_")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    axes.add(node.value.value)
+    return axes
+
+
+def _module_has_pallas_call(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Name) and fn.id == "pallas_call") or \
+               (isinstance(fn, ast.Attribute) and fn.attr == "pallas_call"):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# walker
+
+
+def iter_py(paths, *, missing="error"):
+    """Yield .py files under ``paths``.  An explicitly named path that does
+    not exist raises ``FileNotFoundError`` (``missing="error"``) instead of
+    being silently skipped — the old lint.py walked past typos and reported
+    a clean run."""
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in {"__pycache__", ".git", ".tox",
+                                              "build", "dist"})
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif missing == "error":
+            raise FileNotFoundError(f"no such file or directory: {p}")
+
+
+def load_project(paths, root="."):
+    project = Project(root=root)
+    for path in iter_py(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            raise FileNotFoundError(f"cannot read {path}: {e}") from e
+        project.files.append(FileContext.from_source(src, path=path,
+                                                     project=project))
+    return project
+
+
+def run_rules(project, rules):
+    """Run ``rules`` over every file in ``project``; returns the unsuppressed
+    findings sorted by (path, line, rule)."""
+    findings = []
+    for ctx in project.files:
+        if ctx.tree is None:
+            e = ctx.syntax_error
+            f = Finding(ctx.path, e.lineno or 1, "syntax-error",
+                        f"syntax error: {e.msg}")
+            findings.append(f)
+            continue
+        for rule in rules:
+            if not rule.applies(ctx):
+                continue
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f, rule):
+                    findings.append(f)
+    findings.sort(key=lambda f: (_posix(f.path), f.line, f.rule))
+    return findings
+
+
+def analyze_source(src, path="mod.py", rules=None, mesh_axes=None):
+    """Test/embedding helper: run rules over one in-memory source string."""
+    project = Project(mesh_axes=mesh_axes)
+    ctx = FileContext.from_source(src, path=path, project=project)
+    project.files.append(ctx)
+    if rules is None:
+        selected = [r for r in REGISTRY.values()]
+    else:
+        selected = [REGISTRY[name] for name in rules]
+    return run_rules(project, selected)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path):
+    if not path or not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts = {}
+    for fp in data.get("findings", []):
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def save_baseline(path, findings, line_map):
+    fps = sorted(f.fingerprint(line_map.get(f.path, [])) for f in findings)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": fps}, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(findings, baseline, line_map):
+    """Split findings into (new, grandfathered) against baseline counts and
+    return the stale baseline fingerprints (fixed findings the baseline
+    still lists — the only allowed baseline edit is deleting those)."""
+    remaining = dict(baseline)
+    new, old = [], []
+    for f in findings:
+        fp = f.fingerprint(line_map.get(f.path, []))
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(fp for fp, n in remaining.items() if n > 0)
+    return new, old, stale
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def _select_rules(select, skip, style_only):
+    rules = list(REGISTRY.values())
+    if style_only:
+        rules = [r for r in rules if r.kind == "style"]
+    if select:
+        wanted = {s.strip() for s in select.split(",") if s.strip()}
+        unknown = wanted - set(REGISTRY)
+        if unknown:
+            raise SystemExit(f"graftcheck: unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.name in wanted]
+    if skip:
+        dropped = {s.strip() for s in skip.split(",") if s.strip()}
+        rules = [r for r in rules if r.name not in dropped]
+    return rules
+
+
+def main(argv=None):
+    # Importing the rule modules populates REGISTRY; done here so embedding
+    # code can import core without pulling every analyzer.
+    from tensorflowonspark_tpu.analysis import (  # noqa
+        locks, pallas_tiles, shardlint, style, tracer)
+
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="JAX/TPU-aware stdlib static analysis (tracer hazards, "
+                    "sharding lint, Pallas tile checks, lock discipline, style).")
+    ap.add_argument("paths", nargs="*", help="files or directories "
+                    f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} if present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with the current findings")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule names to run")
+    ap.add_argument("--skip", default=None, metavar="RULES",
+                    help="comma-separated rule names to skip")
+    ap.add_argument("--style-only", action="store_true",
+                    help="run only the style tier (what scripts/lint.py runs)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--strict", action="store_true",
+                    help="accepted for scripts/lint.py compatibility (no-op)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            r = REGISTRY[name]
+            print(f"{name:28s} [{r.kind}/{r.scope}] {r.description}")
+        return 0
+
+    rules = _select_rules(args.select, args.skip, args.style_only)
+
+    paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
+    try:
+        project = load_project(paths)
+    except FileNotFoundError as e:
+        print(f"graftcheck: error: {e}", file=sys.stderr)
+        return 2
+
+    findings = run_rules(project, rules)
+    line_map = {ctx.path: ctx.lines for ctx in project.files}
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        baseline_path = DEFAULT_BASELINE if os.path.isfile(DEFAULT_BASELINE) else None
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.update_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        save_baseline(target, findings, line_map)
+        print(f"graftcheck: wrote {len(findings)} finding(s) to {target}")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    new, old, stale = apply_baseline(findings, baseline, line_map)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": [f.as_dict() for f in old],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f"{_posix(f.path)}:{f.line}: [{f.rule}] {f.message}")
+        if stale:
+            print(f"graftcheck: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (finding fixed — "
+                  "delete from the baseline):")
+            for fp in stale:
+                print(f"  {fp}")
+        if new:
+            n_files = len({f.path for f in new})
+            print(f"graftcheck: {len(new)} finding(s) in {n_files} file(s)"
+                  + (f" ({len(old)} baselined)" if old else ""))
+        else:
+            print("graftcheck clean"
+                  + (f" ({len(old)} baselined finding(s))" if old else ""))
+    return 1 if new else 0

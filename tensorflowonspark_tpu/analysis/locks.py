@@ -1,0 +1,205 @@
+"""Lock-discipline analyzer for the host-side orchestration plane
+(fleet.py / serve.py / reservation.py / manager.py and anything else in the
+package that mixes ``threading.Lock`` with mutable shared state).
+
+The rule: in a class whose ``__init__`` creates a lock
+(``self._lock = threading.Lock()/RLock()``) *and* mutable container
+attributes (``{}``/``[]``/``set()``/``deque()``/...), every **content
+access** of a container that is guarded anywhere must be guarded
+everywhere.  A content access is a subscript, a container-method call
+(``.get/.append/.pop/.items/...``), iteration, or passing the container to
+``len()``/``list()``/``sorted()``-style consumers — the operations that can
+interleave with a concurrent resize.  Bare attribute *reads* of the
+reference (``banks = self._banks``) are deliberately not flagged: CPython
+attribute rebind is atomic and the repo leans on that (serve.py's LoRA bank
+swap publishes a new list object under the lock; readers grab the
+reference lock-free).
+
+Only attributes accessed BOTH inside and outside ``with self.<lock>``
+blocks are reported: a container touched exclusively by one thread (the
+driver-thread free lists in serve.py) never meets a lock and stays silent;
+one that is always guarded is correct; the mixed ones are the bug class
+PR 1 fixed once by hand (``_lora_lock``).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .tracer import _call_name
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+_CONTENT_METHODS = {
+    "get", "items", "keys", "values", "setdefault", "update", "pop",
+    "popitem", "append", "extend", "insert", "remove", "clear", "add",
+    "discard", "popleft", "appendleft", "index", "count", "copy",
+}
+_MUTATOR_METHODS = {
+    "setdefault", "update", "pop", "popitem", "append", "extend", "insert",
+    "remove", "clear", "add", "discard", "popleft", "appendleft",
+}
+_CONSUMER_FNS = {"len", "list", "tuple", "sorted", "set", "dict", "sum",
+                 "min", "max", "any", "all", "iter", "enumerate"}
+
+
+def _is_lock_ctor(value):
+    if not isinstance(value, ast.Call):
+        return False
+    name = _call_name(value.func)
+    return name is not None and name.split(".")[-1] in _LOCK_CTORS
+
+
+def _is_container_init(value):
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        name = _call_name(value.func)
+        return name is not None and name.split(".")[-1] in _CONTAINER_CTORS
+    return False
+
+
+def _self_attr(node):
+    """'x' for ``self.x``, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _AccessCollector(ast.NodeVisitor):
+    """Walks one method recording (attr, lineno, kind, guarded) content
+    accesses of self.<container> and tracking ``with self.<lock>:`` depth."""
+
+    def __init__(self, containers, lock_attrs):
+        self.containers = containers
+        self.lock_attrs = lock_attrs
+        self.depth = 0
+        self.accesses = []  # (attr, lineno, description, guarded, mutating)
+
+    def _note(self, attr, node, what, mutating=False):
+        if attr in self.containers:
+            self.accesses.append(
+                (attr, node.lineno, what, self.depth > 0, mutating))
+
+    def visit_With(self, node):
+        guards = False
+        for item in node.items:
+            expr = item.context_expr
+            attr = _self_attr(expr)
+            if attr is None and isinstance(expr, ast.Call):
+                attr = _self_attr(expr.func)  # self._lock.acquire()-ish
+            if attr in self.lock_attrs:
+                guards = True
+        for item in node.items:
+            self.visit(item.context_expr)
+        if guards:
+            self.depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if guards:
+            self.depth -= 1
+
+    def visit_Subscript(self, node):
+        attr = _self_attr(node.value)
+        if attr is not None:
+            self._note(attr, node, "subscript",
+                       mutating=isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Attribute):
+            attr = _self_attr(node.func.value)
+            if attr is not None and node.func.attr in _CONTENT_METHODS:
+                self._note(attr, node, f".{node.func.attr}()",
+                           mutating=node.func.attr in _MUTATOR_METHODS)
+        name = _call_name(node.func)
+        if name in _CONSUMER_FNS:
+            for a in node.args:
+                attr = _self_attr(a)
+                if attr is not None:
+                    self._note(attr, node, f"{name}()")
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        attr = _self_attr(node.iter)
+        if attr is not None:
+            self._note(attr, node, "iteration")
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):
+        attr = _self_attr(node.iter)
+        if attr is not None:
+            # comprehensions have no lineno; borrow the iter expression's
+            self._note(attr, node.iter, "iteration")
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("mutable container guarded by a lock in some methods but "
+                   "content-accessed without it in others")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx, cls):
+        init = None
+        for stmt in cls.body:
+            if isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__":
+                init = stmt
+                break
+        if init is None:
+            return
+
+        lock_attrs, containers = set(), set()
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    if _is_lock_ctor(node.value):
+                        lock_attrs.add(attr)
+                    elif _is_container_init(node.value):
+                        containers.add(attr)
+        if not lock_attrs or not containers:
+            return
+
+        # attr -> guarded / unguarded accesses + whether it is ever mutated
+        # after __init__ (a container only ever read once construction is
+        # done is immutable-in-practice and safe without the lock).
+        by_attr = {}
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if stmt.name == "__init__":
+                continue  # construction happens-before sharing
+            col = _AccessCollector(containers, lock_attrs)
+            for s in stmt.body:
+                col.visit(s)
+            for attr, lineno, what, guarded, mutating in col.accesses:
+                rec = by_attr.setdefault(attr, {"g": [], "u": [], "mut": False})
+                rec["g" if guarded else "u"].append((lineno, what, stmt.name))
+                rec["mut"] = rec["mut"] or mutating
+
+        for attr in sorted(by_attr):
+            rec = by_attr[attr]
+            if not rec["g"] or not rec["u"] or not rec["mut"]:
+                continue
+            locks = "/".join(sorted(lock_attrs))
+            for lineno, what, meth in sorted(rec["u"]):
+                yield Finding(
+                    ctx.path, lineno, self.name,
+                    f"{cls.name}.{meth}: {what} on self.{attr} outside "
+                    f"`with self.{locks}` — the same container is "
+                    "lock-guarded elsewhere in the class, so this access "
+                    "races a concurrent resize")

@@ -1,0 +1,104 @@
+"""Pallas tile lint: BlockSpec tile-shape alignment and interpret fallbacks.
+
+``pallas-tile``
+    TPU vector memory moves (sublane × lane) tiles: the minor dimension in
+    units of 128 lanes and the second-minor in dtype-dependent sublanes
+    (8 for f32, 16 for bf16, 32 for int8).  A ``BlockSpec`` block shape
+    whose literal minor dim is not a multiple of 128 (or second-minor not a
+    multiple of 8, the f32 floor) compiles — Mosaic pads — but every block
+    load/store wastes the pad fraction and can force relayouts.  Only
+    literal ints are checked (symbolic dims pass); a literal ``1``
+    second-minor is allowed (scalar rows); specs with an explicit
+    ``memory_space`` (SMEM scalar specs) are exempt.
+
+``pallas-interpret``
+    Every ``pl.pallas_call`` must thread an ``interpret=`` flag.  The repo
+    convention (``ops.default_interpret()``) runs kernels in interpret mode
+    off-TPU so the CPU test harness exercises them; a pallas_call without
+    the flag hard-fails on every machine without a TPU.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .tracer import _call_name
+
+_LANE = 128
+_SUBLANE = 8  # f32 floor; bf16 wants 16, int8 wants 32
+
+
+def _literal_dims(arg):
+    """Block-shape tuple -> list of (value_or_None, lineno)."""
+    if not isinstance(arg, (ast.Tuple, ast.List)):
+        return None
+    dims = []
+    for e in arg.elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            dims.append((e.value, e.lineno))
+        else:
+            dims.append((None, getattr(e, "lineno", arg.lineno)))
+    return dims
+
+
+@register
+class PallasTileRule(Rule):
+    name = "pallas-tile"
+    description = ("BlockSpec literal block shape not a multiple of the "
+                   "dtype tile (8x128 f32 / 16x128 bf16 / 32x128 int8)")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name is None or name.split(".")[-1] != "BlockSpec":
+                continue
+            if any(kw.arg == "memory_space" for kw in node.keywords):
+                continue  # SMEM/ANY scalar specs are not vector-tiled
+            if not node.args:
+                continue
+            dims = _literal_dims(node.args[0])
+            if not dims:
+                continue
+            minor, minor_line = dims[-1]
+            if minor is not None and minor % _LANE != 0:
+                yield Finding(
+                    ctx.path, minor_line, self.name,
+                    f"BlockSpec minor dim {minor} is not a multiple of "
+                    f"{_LANE} (TPU lane width); Mosaic pads every block "
+                    "load/store to the full tile")
+            if len(dims) >= 2:
+                sub, sub_line = dims[-2]
+                if sub is not None and sub != 1 and sub % _SUBLANE != 0:
+                    yield Finding(
+                        ctx.path, sub_line, self.name,
+                        f"BlockSpec second-minor dim {sub} is not a multiple "
+                        f"of {_SUBLANE} (f32 sublane; bf16 needs 16, int8 "
+                        "needs 32)")
+
+
+@register
+class PallasInterpretRule(Rule):
+    name = "pallas-interpret"
+    description = ("pallas_call without an interpret= fallback flag — "
+                   "kernel cannot run on the CPU test harness")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name is None or name.split(".")[-1] != "pallas_call":
+                continue
+            if any(kw.arg == "interpret" for kw in node.keywords):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, self.name,
+                "pallas_call without interpret=; thread "
+                "ops.default_interpret() so the kernel runs (interpreted) "
+                "off-TPU — otherwise it fails on every non-TPU host")

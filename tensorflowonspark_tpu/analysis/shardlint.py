@@ -1,0 +1,154 @@
+"""Sharding lint: mesh-axis vocabulary and Pallas out-sharding pinning.
+
+Two rules, both encoding GSPMD failure modes that are silent at runtime:
+
+``shard-axis``
+    Every string literal passed to ``PartitionSpec(...)`` / ``P(...)`` /
+    ``NamedSharding(...)`` must be a mesh axis declared in
+    ``parallel/mesh.py`` (``AXIS_* = "..."`` constants).  A typo'd axis
+    name raises only when the spec first meets a real mesh — i.e. on the
+    TPU pod, not under the CPU test harness's 8 fake devices, and logical
+    axis names from sharding *rules* pass through translation maps that
+    can silently drop them.
+
+``shard-pallas-out-shardings``
+    A ``jax.jit`` call that pins ``in_shardings`` but not ``out_shardings``
+    while (transitively, within the module, plus repo-wide Pallas entry
+    points) calling a ``pallas_call`` kernel is exactly the bug PR 1 fixed
+    by hand in ``parallel/train.py``: ``pallas_call`` lowers to a custom
+    call GSPMD cannot partition, so the output sharding silently falls back
+    to replicated and every step pays an all-gather.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Rule, register
+from .tracer import _call_name
+
+_SPEC_NAMES = {"PartitionSpec", "P", "NamedSharding"}
+
+
+def _axis_literals(call):
+    """Yield (string, lineno) axis-name literals in a spec constructor call,
+    looking through tuple arguments (PartitionSpec(("dp", "fsdp"), None))."""
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield arg.value, arg.lineno
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            for e in arg.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    yield e.value, e.lineno
+
+
+@register
+class ShardAxisRule(Rule):
+    name = "shard-axis"
+    description = ("PartitionSpec/NamedSharding axis-name literal not "
+                   "declared in parallel/mesh.py")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        axes = ctx.project.mesh_axes if ctx.project is not None else set()
+        if not axes:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name is None or name.split(".")[-1] not in _SPEC_NAMES:
+                continue
+            for axis, lineno in _axis_literals(node):
+                if axis not in axes:
+                    yield Finding(
+                        ctx.path, lineno, self.name,
+                        f"unknown mesh axis {axis!r} in "
+                        f"{name.split('.')[-1]}(...) — parallel/mesh.py "
+                        f"declares {', '.join(sorted(axes))}")
+
+
+def _jit_applications(tree):
+    """Yield (FunctionDef, keywords, lineno) for every jit/pjit application
+    in the module whose target function is resolvable: decorator forms
+    (@jax.jit, @partial(jax.jit, ...)) and wrapping calls (jax.jit(f, ...))."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    name = _call_name(dec.func)
+                    if name and name.split(".")[-1] in ("jit", "pjit"):
+                        yield node, dec.keywords, dec.lineno
+                    elif (name and name.split(".")[-1] == "partial"
+                          and dec.args
+                          and (_call_name(dec.args[0]) or "").split(".")[-1]
+                          in ("jit", "pjit")):
+                        yield node, dec.keywords, dec.lineno
+        elif isinstance(node, ast.Call):
+            name = _call_name(node.func)
+            if (name and name.split(".")[-1] in ("jit", "pjit")
+                    and node.args and isinstance(node.args[0], ast.Name)):
+                target = defs.get(node.args[0].id)
+                if target is not None:
+                    yield target, node.keywords, node.lineno
+
+
+def _reaches_pallas(fn, defs, pallas_entries, _seen=None):
+    """Module-local transitive reachability from ``fn`` to a pallas_call or
+    to a repo-wide Pallas entry-point name; returns the callee name hit."""
+    if _seen is None:
+        _seen = set()
+    if fn.name in _seen:
+        return None
+    _seen.add(fn.name)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name is None:
+            continue
+        base = name.split(".")[-1]
+        if base == "pallas_call":
+            return "pallas_call"
+        if base in pallas_entries:
+            return base
+        if base in defs and defs[base] is not fn:
+            hit = _reaches_pallas(defs[base], defs, pallas_entries, _seen)
+            if hit:
+                return hit
+    return None
+
+
+@register
+class ShardPallasOutShardingsRule(Rule):
+    name = "shard-pallas-out-shardings"
+    description = ("sharded jit (in_shardings set) reaching a Pallas kernel "
+                   "without out_shardings — GSPMD cannot partition the "
+                   "custom call (PR 1 pinning lesson)")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        entries = ctx.project.pallas_entries if ctx.project is not None else set()
+        defs = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        for fn, keywords, lineno in _jit_applications(ctx.tree):
+            kws = {kw.arg for kw in keywords if kw.arg}
+            if "in_shardings" not in kws or "out_shardings" in kws:
+                continue
+            hit = _reaches_pallas(fn, defs, entries)
+            if hit:
+                yield Finding(
+                    ctx.path, lineno, self.name,
+                    f"jit of '{fn.name}' pins in_shardings but not "
+                    f"out_shardings while calling Pallas kernel '{hit}'; "
+                    "pallas_call is a custom call GSPMD cannot partition — "
+                    "pin the outputs (out_shardings=...) or the result "
+                    "silently falls back to replicated")

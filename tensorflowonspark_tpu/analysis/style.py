@@ -1,0 +1,175 @@
+"""Style tier: the checks scripts/lint.py used to own, re-homed on the
+shared graftcheck walker.
+
+The unused-import rule is the one with real logic: the old linter's
+"name appears at most once in the raw source" heuristic both missed
+genuinely dead imports (any textual mention — a docstring, a comment —
+kept them alive) and flagged names used only through ``__all__`` or string
+annotations.  This version tracks actual ``Name`` loads plus the two
+string-shaped usage channels: entries in ``__all__`` and identifiers
+inside string (forward-reference) annotations.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Rule, register
+
+MAX_LINE = 160
+
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+@register
+class LineLengthRule(Rule):
+    name = "line-too-long"
+    description = f"line exceeds {MAX_LINE} characters"
+    scope = "all"
+    kind = "style"
+
+    def check(self, ctx):
+        for i, ln in enumerate(ctx.lines, start=1):
+            if len(ln) > MAX_LINE:
+                yield Finding(ctx.path, i, self.name,
+                              f"line too long ({len(ln)} > {MAX_LINE})")
+
+
+@register
+class TrailingWhitespaceRule(Rule):
+    name = "trailing-whitespace"
+    description = "line ends with whitespace"
+    scope = "all"
+    kind = "style"
+
+    def check(self, ctx):
+        for i, ln in enumerate(ctx.lines, start=1):
+            if ln != ln.rstrip():
+                yield Finding(ctx.path, i, self.name, "trailing whitespace")
+
+
+@register
+class TabIndentRule(Rule):
+    name = "tab-indent"
+    description = "indentation uses tab characters"
+    scope = "all"
+    kind = "style"
+
+    def check(self, ctx):
+        for i, ln in enumerate(ctx.lines, start=1):
+            if ln.startswith("\t"):
+                yield Finding(ctx.path, i, self.name, "tab indentation")
+
+
+@register
+class DebuggerCallRule(Rule):
+    name = "debugger-call"
+    description = "breakpoint()/pdb.set_trace() left in code"
+    scope = "all"
+    kind = "style"
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "breakpoint":
+                yield Finding(ctx.path, node.lineno, self.name,
+                              "breakpoint() call")
+            elif (isinstance(fn, ast.Attribute) and fn.attr == "set_trace"
+                  and isinstance(fn.value, ast.Name)
+                  and fn.value.id in ("pdb", "ipdb")):
+                yield Finding(ctx.path, node.lineno, self.name,
+                              f"{fn.value.id}.set_trace() call")
+
+
+class _UsageVisitor(ast.NodeVisitor):
+    """Collects imported names, loaded names, ``__all__`` entries, and
+    identifiers appearing inside string annotations."""
+
+    def __init__(self):
+        self.imports = []      # (name, lineno, statement)
+        self.used = set()
+        self.exported = set()  # names in __all__
+        self.string_ann = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports.append((name, node.lineno, f"import {a.name}"))
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            name = a.asname or a.name
+            self.imports.append(
+                (name, node.lineno,
+                 f"from {'.' * node.level}{node.module or ''} import {a.name}"))
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                self.exported.update(self._str_elts(node.value))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name) and node.target.id == "__all__":
+            self.exported.update(self._str_elts(node.value))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _str_elts(value):
+        if isinstance(value, (ast.List, ast.Tuple)):
+            for e in value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    yield e.value
+
+    def _string_annotation(self, ann):
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            self.string_ann.update(_IDENT_RE.findall(ann.value))
+
+    def visit_AnnAssign(self, node):
+        self._string_annotation(node.annotation)
+        self.generic_visit(node)
+
+    def visit_arg(self, node):
+        if node.annotation is not None:
+            self._string_annotation(node.annotation)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        if node.returns is not None:
+            self._string_annotation(node.returns)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@register
+class UnusedImportRule(Rule):
+    name = "unused-import"
+    description = "imported name never loaded (checks __all__ and string annotations)"
+    scope = "all"
+    kind = "style"
+
+    def check(self, ctx):
+        v = _UsageVisitor()
+        v.visit(ctx.tree)
+        alive = v.used | v.exported | v.string_ann
+        for name, lineno, stmt in v.imports:
+            if name.startswith("_"):
+                continue
+            if name not in alive:
+                yield Finding(ctx.path, lineno, self.name,
+                              f"unused import: {stmt!r} binds {name!r}")

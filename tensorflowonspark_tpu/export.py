@@ -19,7 +19,6 @@ triple that pipeline.TFModel and the native batch-inference runner consume.
 import importlib
 import json
 import logging
-import os
 
 logger = logging.getLogger(__name__)
 

@@ -56,7 +56,7 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import reservation, util
+from . import reservation
 from .metrics import Counters
 
 logger = logging.getLogger(__name__)
@@ -319,7 +319,10 @@ class Gateway:
         request falls back to least-loaded and the replica 400s it)."""
         try:
             prompt = body["inputs"][0]
-            n = self._prefix_tokens or 64
+            # _admit rewrites _prefix_tokens under _lock when the first
+            # replica registers; routing threads must not read a torn value
+            with self._lock:
+                n = self._prefix_tokens or 64
             key = tuple(prompt[:n])
             return key if key else None
         except (KeyError, IndexError, TypeError):
@@ -430,10 +433,12 @@ class Gateway:
                         gstats.get("prefix_pages_cached") or 0)
                 except (OSError, ValueError) as e:
                     desc["probe_error"] = str(e)
+        with self._lock:
+            prefix_tokens = self._prefix_tokens
         return {"replicas": {rid: desc for rid, (_, desc) in snap.items()},
                 "totals": totals,
                 "counters": self.counters.snapshot(),
-                "gateway": {"prefix_tokens": self._prefix_tokens,
+                "gateway": {"prefix_tokens": prefix_tokens,
                             "heartbeat_timeout_s": self.heartbeat_timeout_s,
                             "queue_depth_factor": self.queue_depth_factor,
                             "breaker_threshold": self.breaker_threshold,

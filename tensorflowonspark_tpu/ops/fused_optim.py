@@ -204,6 +204,9 @@ def _run_leaf(kernel, scalars, arrays, out_dtypes, block_rows, interpret):
     if _SMEM is not None:
         sspec = pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=_SMEM)
     else:  # pragma: no cover - CPU-only jaxlib
+        # interpret-mode only (no TPU ext -> no SMEM): a (1, 4) scalar
+        # block is never vector-tiled here
+        # graftcheck: disable-next-line=pallas-tile
         sspec = pl.BlockSpec((1, 4), lambda i: (0, 0))
     outs = pl.pallas_call(
         kernel,

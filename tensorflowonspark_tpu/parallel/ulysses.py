@@ -18,7 +18,6 @@ ring when S alone exceeds HBM.
 """
 import functools
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -1019,7 +1019,11 @@ class ContinuousBatcher:
         a different tenant — they just age out via LRU)."""
         if not self.lora_rank or not aidx:
             return ()
-        return ("lora", self._adapter_token.get(aidx, -1))
+        # registration threads rewrite the token map under _lora_lock
+        # (register_adapter); take it for the read too — dict.get during a
+        # concurrent insert is not guaranteed safe across interpreters
+        with self._lora_lock:
+            return ("lora", self._adapter_token.get(aidx, -1))
 
     def _prefix_lookup(self, prompt, root=()):
         """(shared_pages, keys_for_all_full_pages): the longest cached

@@ -1,0 +1,483 @@
+"""graftcheck framework + analyzer tests (stdlib only — no JAX import).
+
+Every rule gets at least one positive and one negative inline-source
+fixture; the framework tests cover suppression comments, the baseline
+workflow, the missing-path error, and a repo-wide smoke run through the
+real CLI proving zero non-baseline findings (the acceptance bar: the
+checked-in baseline is empty, so the whole tree is finding-free or
+inline-annotated).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tensorflowonspark_tpu.analysis import core  # noqa: E402
+from tensorflowonspark_tpu.analysis import (  # noqa: E402,F401  (registers rules)
+    locks, pallas_tiles, shardlint, style, tracer)
+
+MESH_AXES = {"dp", "fsdp", "pp", "tp"}
+
+
+def run(src, rules, path="tensorflowonspark_tpu/mod.py", mesh_axes=None):
+    findings = core.analyze_source(textwrap.dedent(src), path=path,
+                                   rules=rules, mesh_axes=mesh_axes)
+    return [(f.rule, f.line) for f in findings], findings
+
+
+# --------------------------------------------------------------- tracer ----
+
+def test_tracer_host_cast_positive():
+    hits, fs = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            return float(y)
+    """, ["tracer-host-cast"])
+    assert hits == [("tracer-host-cast", 7)]
+    assert "host round-trip" in fs[0].message
+
+
+def test_tracer_host_cast_item_and_numpy():
+    hits, _ = run("""
+        import functools, jax
+        import numpy as np
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def f(x, y):
+            a = x.sum().item()
+            b = np.asarray(y)
+            return a, b
+    """, ["tracer-host-cast"])
+    assert [r for r, _ in hits] == ["tracer-host-cast", "tracer-host-cast"]
+
+
+def test_tracer_host_cast_negative_static_and_shape():
+    # static_argnames exempts n; .shape is static even on a tracer
+    hits, _ = run("""
+        import functools, jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            m = int(n) + int(x.shape[0])
+            return x * m
+    """, ["tracer-host-cast"])
+    assert hits == []
+
+
+def test_tracer_branch_positive_wrapped_jit():
+    # jit applied as a wrapping call, not a decorator
+    hits, _ = run("""
+        import jax
+
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+
+        fast_step = jax.jit(step)
+    """, ["tracer-python-branch"])
+    assert hits == [("tracer-python-branch", 5)]
+
+
+def test_tracer_branch_assert_and_while():
+    hits, _ = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            assert x.sum() > 0
+            while x < 3:
+                x = x + 1
+            return x
+    """, ["tracer-python-branch"])
+    assert [r for r, _ in hits] == ["tracer-python-branch"] * 2
+
+
+def test_tracer_branch_negative_presence_check():
+    # `x is not None` is the PRESENCE-static optional-arg idiom: fine
+    hits, _ = run("""
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is not None:
+                x = x * mask
+            return x
+    """, ["tracer-python-branch"])
+    assert hits == []
+
+
+def test_tracer_branch_negative_closure_config():
+    # branching on a closure/config value is static, not a tracer hazard
+    hits, _ = run("""
+        import jax
+
+        def make(n_steps):
+            @jax.jit
+            def f(x):
+                if n_steps > 1:
+                    x = x * n_steps
+                return x
+            return f
+    """, ["tracer-python-branch"])
+    assert hits == []
+
+
+def test_tracer_side_effect_print():
+    hits, _ = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            print("tracing", x)
+            return x
+    """, ["tracer-side-effect"])
+    assert hits == [("tracer-side-effect", 6)]
+
+
+def test_tracer_no_flag_outside_staged_function():
+    hits, _ = run("""
+        def f(x):
+            print(x)
+            return float(x)
+    """, ["tracer-side-effect", "tracer-host-cast", "tracer-python-branch"])
+    assert hits == []
+
+
+# ------------------------------------------------------------- sharding ----
+
+def test_shard_axis_positive():
+    hits, fs = run("""
+        from jax.sharding import PartitionSpec as P
+
+        spec = P("dp", "model")
+    """, ["shard-axis"], mesh_axes=MESH_AXES)
+    assert hits == [("shard-axis", 4)]
+    assert "'model'" in fs[0].message and "dp" in fs[0].message
+
+
+def test_shard_axis_tuple_and_negative():
+    hits, _ = run("""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        good = PartitionSpec(("dp", "fsdp"), None, "tp")
+        bad = PartitionSpec(("dp", "sp"))
+    """, ["shard-axis"], mesh_axes=MESH_AXES)
+    assert hits == [("shard-axis", 5)]
+
+
+def test_shard_axis_ignores_variables():
+    hits, _ = run("""
+        from jax.sharding import PartitionSpec as P
+
+        axis = compute_axis_name()
+        spec = P(axis, None)
+    """, ["shard-axis"], mesh_axes=MESH_AXES)
+    assert hits == []
+
+
+def test_shard_pallas_out_shardings_positive():
+    hits, fs = run("""
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def op(x):
+            return pl.pallas_call(kernel, out_shape=x, interpret=True)(x)
+
+        def step(x):
+            return op(x) * 2
+
+        fast = jax.jit(step, in_shardings=(None,))
+    """, ["shard-pallas-out-shardings"])
+    assert hits == [("shard-pallas-out-shardings", 14)]
+    assert "out_shardings" in fs[0].message
+
+
+def test_shard_pallas_out_shardings_negative_when_pinned():
+    hits, _ = run("""
+        import jax
+        from jax.experimental import pallas as pl
+
+        def op(x):
+            return pl.pallas_call(lambda i, o: None, interpret=True)(x)
+
+        def step(x):
+            return op(x)
+
+        fast = jax.jit(step, in_shardings=(None,), out_shardings=(None,))
+        plain = jax.jit(step)  # unsharded jit: nothing to pin
+    """, ["shard-pallas-out-shardings"])
+    assert hits == []
+
+
+# ---------------------------------------------------------------- tiles ----
+
+def test_pallas_tile_positive_minor_and_sublane():
+    hits, _ = run("""
+        from jax.experimental import pallas as pl
+
+        bad_minor = pl.BlockSpec((8, 96), lambda i: (i, 0))
+        bad_sublane = pl.BlockSpec((12, 128), lambda i: (i, 0))
+    """, ["pallas-tile"])
+    assert hits == [("pallas-tile", 4), ("pallas-tile", 5)]
+
+
+def test_pallas_tile_negative_aligned_smem_symbolic():
+    hits, _ = run("""
+        from jax.experimental import pallas as pl
+
+        ok = pl.BlockSpec((16, 256), lambda i: (i, 0))
+        scalar_row = pl.BlockSpec((1, 128), lambda i: (0, 0))
+        smem = pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=SMEM)
+        symbolic = pl.BlockSpec((bm, LANE), lambda i: (i, 0))
+    """, ["pallas-tile"])
+    assert hits == []
+
+
+def test_pallas_interpret_positive_negative():
+    hits, _ = run("""
+        from jax.experimental import pallas as pl
+
+        def bad(x):
+            return pl.pallas_call(k, out_shape=x)(x)
+
+        def good(x, interp):
+            return pl.pallas_call(k, out_shape=x, interpret=interp)(x)
+    """, ["pallas-interpret"])
+    assert hits == [("pallas-interpret", 5)]
+
+
+# ---------------------------------------------------------------- locks ----
+
+LOCKED_CLASS = """
+    import threading
+
+    class Batcher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._table = {}
+            self._dims = {"q": 1}
+
+        def put(self, k, v):
+            with self._lock:
+                self._table[k] = v
+
+        def get(self, k):
+            %s
+
+        def dims(self, k):
+            return self._dims[k]
+"""
+
+
+def test_lock_discipline_positive():
+    hits, fs = run(LOCKED_CLASS % "return self._table.get(k)",
+                   ["lock-discipline"])
+    assert hits == [("lock-discipline", 15)]
+    assert "_table" in fs[0].message and "races" in fs[0].message
+
+
+def test_lock_discipline_negative_guarded_everywhere():
+    hits, _ = run(LOCKED_CLASS % (
+        "with self._lock:\n                return self._table.get(k)"),
+        ["lock-discipline"])
+    assert hits == []
+
+
+def test_lock_discipline_ignores_read_only_and_single_thread():
+    # _dims is never mutated after __init__ -> immutable-in-practice;
+    # a class without both-sides access never fires
+    hits, _ = run("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._driver_only = []
+
+            def step(self):
+                self._driver_only.append(1)  # never guarded anywhere
+    """, ["lock-discipline"])
+    assert hits == []
+
+
+def test_lock_discipline_bare_reference_read_ok():
+    # atomic-rebind publication: writer swaps the whole object under the
+    # lock, reader grabs the reference lock-free — must NOT be flagged
+    hits, _ = run("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._banks = {}
+
+            def swap(self, new):
+                with self._lock:
+                    self._banks = new
+                    self._banks["ready"] = True
+
+            def read(self):
+                banks = self._banks
+                return banks
+    """, ["lock-discipline"])
+    assert hits == []
+
+
+# ---------------------------------------------------------------- style ----
+
+def test_unused_import_positive():
+    hits, _ = run("import os\n\n\nX = 1\n", ["unused-import"], path="t.py")
+    assert hits == [("unused-import", 1)]
+
+
+def test_unused_import_all_and_string_annotations():
+    src = (
+        "import os\n"
+        "import socket\n"
+        "from typing import TYPE_CHECKING\n"
+        "if TYPE_CHECKING:\n"
+        "    import array\n"
+        "\n"
+        "__all__ = [\"os\"]\n"
+        "\n"
+        "def f(s: \"socket.socket\") -> \"array.array\":\n"
+        "    return s\n"
+    )
+    hits, _ = run(src, ["unused-import"], path="t.py")
+    assert hits == []
+
+
+def test_style_rules_and_noqa():
+    src = "x = 1 \nY = '" + "a" * 200 + "'  # noqa\n"
+    hits, _ = run(src, ["trailing-whitespace", "line-too-long"], path="t.py")
+    assert hits == [("trailing-whitespace", 1)]  # long line is noqa'd
+
+    hits, _ = run("def f():\n\treturn 1\n", ["tab-indent"], path="t.py")
+    assert hits == [("tab-indent", 2)]
+
+
+def test_debugger_call():
+    hits, _ = run("import pdb\npdb.set_trace()\nbreakpoint()\n",
+                  ["debugger-call"], path="t.py")
+    assert [r for r, _ in hits] == ["debugger-call", "debugger-call"]
+
+
+# ------------------------------------------------------------ framework ----
+
+def test_suppression_same_line_next_line_and_file():
+    base = "import jax\n\n@jax.jit\ndef f(x):\n"
+    src1 = base + "    return float(x)  # graftcheck: disable=tracer-host-cast\n"
+    src2 = base + "    # graftcheck: disable-next-line=tracer-host-cast\n    return float(x)\n"
+    src3 = "# graftcheck: disable-file=tracer-host-cast\n" + base + "    return float(x)\n"
+    for src in (src1, src2, src3):
+        assert core.analyze_source(src, path="tensorflowonspark_tpu/m.py",
+                                   rules=["tracer-host-cast"]) == []
+    # and without the comment it fires
+    assert core.analyze_source(base + "    return float(x)\n",
+                               path="tensorflowonspark_tpu/m.py",
+                               rules=["tracer-host-cast"])
+
+
+def test_semantic_rules_skip_non_package_paths():
+    src = "import jax\n\n@jax.jit\ndef f(x):\n    return float(x)\n"
+    assert core.analyze_source(src, path="examples/demo.py",
+                               rules=["tracer-host-cast"]) == []
+
+
+def test_syntax_error_is_a_finding():
+    findings = core.analyze_source("def f(:\n", path="t.py", rules=[])
+    assert [f.rule for f in findings] == ["syntax-error"]
+
+
+def test_iter_py_missing_path_raises():
+    with pytest.raises(FileNotFoundError):
+        list(core.iter_py(["no/such/path_xyz.py"]))
+
+
+def test_baseline_grandfathers_and_reports_stale(tmp_path):
+    src = "import os\nX = 1\n"
+    project = core.Project()
+    ctx = core.FileContext.from_source(src, path="t.py", project=project)
+    project.files.append(ctx)
+    findings = core.run_rules(project, [core.REGISTRY["unused-import"]])
+    assert len(findings) == 1
+    line_map = {"t.py": ctx.lines}
+
+    bl = tmp_path / "baseline.json"
+    core.save_baseline(str(bl), findings, line_map)
+    baseline = core.load_baseline(str(bl))
+    new, old, stale = core.apply_baseline(findings, baseline, line_map)
+    assert new == [] and len(old) == 1 and stale == []
+
+    # finding fixed -> its baseline entry is stale
+    new, old, stale = core.apply_baseline([], baseline, line_map)
+    assert new == [] and old == [] and len(stale) == 1
+
+    # a second identical finding exceeds the baselined count -> new
+    new, _, _ = core.apply_baseline(findings * 2, baseline, line_map)
+    assert len(new) == 1
+
+
+def test_checked_in_baseline_is_empty():
+    with open(os.path.join(REPO, "scripts", "graftcheck_baseline.json")) as f:
+        data = json.load(f)
+    assert data["findings"] == []
+
+
+# ------------------------------------------------------------ smoke/CLI ----
+
+def test_repo_wide_graftcheck_clean():
+    """Acceptance bar: the CLI exits 0 over the whole repo (empty baseline,
+    so the tree is genuinely finding-free or inline-annotated)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftcheck.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftcheck clean" in proc.stdout
+
+
+def test_lint_wrapper_clean_and_bad_path():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "lint clean" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+         "definitely/not/a/path.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "no such file" in proc.stderr
+
+
+def test_cli_json_and_list_rules():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftcheck.py"),
+         "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for rule in ("tracer-host-cast", "shard-axis", "pallas-tile",
+                 "lock-discipline", "unused-import"):
+        assert rule in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftcheck.py"),
+         "--json", "tensorflowonspark_tpu/analysis"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["findings"] == []

@@ -1,7 +1,6 @@
 """Distributed integration tests on the local multi-process backend
 (models reference tests/test_TFCluster.py:1-95 — including the
 sum-of-squares round trip and both fault-injection cases)."""
-import time
 
 import pytest
 

@@ -1,5 +1,4 @@
 """Optimizer/schedule factory + host-side Dataset.prefetch."""
-import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")

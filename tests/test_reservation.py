@@ -1,5 +1,4 @@
 """Rendezvous protocol tests (models reference tests/test_reservation.py:1-132)."""
-import os
 import threading
 import time
 

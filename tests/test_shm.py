@@ -5,7 +5,6 @@ DataFeed semantics) at the transport layer below them: payload bytes ride
 /dev/shm, refs ride the queue.
 """
 import multiprocessing as mp
-import os
 import time
 
 import numpy as np

@@ -1,7 +1,6 @@
 """TFRecord codec tests, with TensorFlow as the interop oracle
 (the reference's equivalent surface is dfutil + the tensorflow-hadoop jar,
 tested in tests/test_dfutil.py:30-73)."""
-import struct
 
 import numpy as np
 import pytest
