@@ -25,8 +25,12 @@ Round 6 also adds an `opt_ms` aux segment: the flagship step re-timed
 with a zero-lr momentum-less SGD update ("sgd0" — the cheapest possible
 optimizer) and `opt_ms = step_ms - step_ms_sgd0`, isolating what the
 optimizer update costs per step so the fused kernel's win stays visible
-in the trajectory.  `bench.py --segments` runs ONLY that comparison
-(and exits 0 with a "skipped" line off-TPU, so CI can smoke the path).
+in the trajectory.  `bench.py --segments` runs ONLY the segment
+comparisons (SEGMENTS registry; one JSON line each, and exits 0 with a
+"skipped" line per segment off-TPU, so CI can smoke the path).  Round 7
+adds the `decode_ms` segment: the steady-state paged slot-decode step
+(benchmarks.make_decode_step) timed with the flash-decode kernel vs the
+einsum full-gather reference (TransformerConfig.paged_attn_impl).
 
 On a device whose bf16 peak is unknown (not in benchmarks.PEAK_BF16) the
 metric falls back to tokens/sec — an MFU percent against a guessed peak
@@ -132,30 +136,83 @@ def bench_opt_segment(steps=10, windows=3):
     return full_ms, sgd0_ms, full_ms - sgd0_ms
 
 
+def bench_decode_segment(steps=32, windows=3):
+    """The serving-decode segment: steady-state paged slot-decode step
+    time on the flagship dims (benchmarks.make_decode_step /
+    FLAGSHIP_DECODE — max_seq 4096, rows filled to 2000 tokens, the
+    gather path's worst case), flash-decode kernel vs the einsum
+    full-gather reference.  Returns (kernel_ms, einsum_ms)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.benchmarks import make_decode_step
+
+    def timed(impl):
+        step, params, cache, (toks, temps, seeds, ords) = \
+            make_decode_step(impl)
+        toks, cache, ords = step(params, cache, toks, temps, seeds, ords)
+        np.asarray(toks)                           # compile + sync
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                toks, cache, ords = step(params, cache, toks, temps,
+                                         seeds, ords)
+            np.asarray(toks)                       # host readback barrier
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best * 1000
+
+    return timed("kernel"), timed("einsum")
+
+
+def _opt_segment_result():
+    full_ms, sgd0_ms, opt_ms = bench_opt_segment()
+    return {"metric": "opt_ms", "value": round(opt_ms, 1),
+            "unit": "ms/step",
+            "aux": {"lm_step_ms": round(full_ms, 1),
+                    "lm_step_ms_sgd0": round(sgd0_ms, 1)}}
+
+
+def _decode_segment_result():
+    kernel_ms, einsum_ms = bench_decode_segment()
+    return {"metric": "decode_ms", "value": round(kernel_ms, 2),
+            "unit": "ms/step",
+            "aux": {"decode_ms_einsum": round(einsum_ms, 2),
+                    "speedup_vs_einsum": round(einsum_ms / kernel_ms, 2)}}
+
+
+# segment registry: every entry shares the off-TPU skip + one-JSON-line-
+# per-segment protocol, so growing a segment is one function + one row
+# (the old hardcoded opt_ms plumbing could not be reused)
+SEGMENTS = {
+    "opt_ms": _opt_segment_result,
+    "decode_ms": _decode_segment_result,
+}
+
+
 def segments_main():
-    """`bench.py --segments`: the opt_ms comparison alone.  Off-TPU it
-    exits 0 with a skipped line BEFORE building the 0.87B model — the CI
-    smoke path (scripts/run_tests.sh boxes have no accelerator)."""
+    """`bench.py --segments`: the segment comparisons alone (SEGMENTS
+    registry — one JSON line each).  Off-TPU it exits 0 with a skipped
+    line PER SEGMENT before building any 0.87B model — the CI smoke path
+    (scripts/run_tests.sh boxes have no accelerator)."""
     import jax
 
     if jax.default_backend() != "tpu":
-        print(json.dumps({"metric": "opt_ms", "skipped":
-                          "segment bench needs TPU (backend is "
-                          f"{jax.default_backend()})"}))
+        for name in SEGMENTS:
+            print(json.dumps({"metric": name, "skipped":
+                              "segment bench needs TPU (backend is "
+                              f"{jax.default_backend()})"}))
         return 0
-    full_ms, sgd0_ms, opt_ms = bench_opt_segment()
-    print(json.dumps({"metric": "opt_ms", "value": round(opt_ms, 1),
-                      "unit": "ms/step",
-                      "aux": {"lm_step_ms": round(full_ms, 1),
-                              "lm_step_ms_sgd0": round(sgd0_ms, 1)}}))
+    for fn in SEGMENTS.values():
+        print(json.dumps(fn()))
     return 0
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--segments", action="store_true",
-                    help="run only the opt_ms segment comparison "
-                         "(exits 0 with a skipped line off-TPU)")
+                    help="run only the segment comparisons (opt_ms, "
+                         "decode_ms — one JSON line each; exits 0 with "
+                         "skipped lines off-TPU)")
     args = ap.parse_args(argv)
     if args.segments:
         return segments_main()

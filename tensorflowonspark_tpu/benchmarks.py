@@ -46,6 +46,74 @@ FLAGSHIP_MU_DTYPE = "bfloat16"
 FLAGSHIP_OPTIMIZER = "adamw_fused"
 ROUND1_LM_MFU = 47.0  # BASELINE.md round-1 flagship-LM row (vs_baseline denom)
 
+# The decode_ms segment workload (bench.py --segments): steady-state
+# paged slot decode on the flagship dims, sized for the gather path's
+# worst case — long max_seq, rows only partially filled — where the
+# flash-decode kernel's per-row length bound pays most.  Frozen like
+# FLAGSHIP_LM: changing any value invalidates decode_ms comparability.
+FLAGSHIP_DECODE = dict(n_slots=16, page_size=64, max_seq=4096, fill=2000)
+
+
+def make_decode_step(impl="kernel", n_slots=None, page_size=None,
+                     max_seq=None, fill=None):
+    """Build the steady-state paged slot-decode step for the decode_ms
+    segment: flagship-LM dims (FLAGSHIP_LM_V2) at ``max_seq``, every row
+    fully page-mapped and pre-filled to ``fill`` tokens, so each timed
+    step is one mid-stream decode token for all ``n_slots`` rows.
+    ``impl`` picks the paged READ path ("kernel" = the Pallas
+    flash-decode kernel, "einsum" = the full-gather reference —
+    TransformerConfig.paged_attn_impl).  Returns
+    ``(step, params, cache, (toks, temps, seeds, ords))``; the cache is
+    donated — advance with
+    ``toks, cache, ords = step(params, cache, toks, temps, seeds, ords)``.
+    The kv content is untrained garbage (zeros): decode cost is
+    shape/length-bound, not value-bound, so timing is unaffected."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import decode as decode_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_DECODE
+    n_slots = n_slots or d["n_slots"]
+    page = page_size or d["page_size"]
+    max_seq = max_seq or d["max_seq"]
+    fill = d["fill"] if fill is None else fill
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    # params don't depend on seq length: init with a short trace
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    max_pages = max_seq // page
+    # every row fully mapped (pages are row-contiguous; +1 = the sink,
+    # unused here but init_paged_slot_cache's caller contract): steps
+    # can never write past an allocated page, and the KERNEL's work is
+    # still bounded by `fill` (its per-row length bound), while the
+    # einsum body gathers the whole max_seq view — the contrast the
+    # segment measures
+    slot_model, cache = decode_mod.init_paged_slot_cache(
+        model, n_slots, page, n_slots * max_pages + 1,
+        paged_attn_impl=impl)
+    set_table = decode_mod._jitted_set_row_page_table(slot_model)
+    for row in range(n_slots):
+        entries = jnp.arange(row * max_pages, (row + 1) * max_pages,
+                             dtype=jnp.int32)
+        cache = set_table(cache, jnp.asarray(row, jnp.int32), entries)
+
+    def _fill_leaf(path, leaf):
+        if decode_mod._leaf_name(path) in ("cache_index", "pos_index"):
+            return jnp.full(leaf.shape, fill, jnp.int32)
+        return leaf
+
+    cache = jax.tree_util.tree_map_with_path(_fill_leaf, cache)
+    step = decode_mod._jitted_slot_step(slot_model)
+    toks = jnp.zeros((n_slots,), jnp.int32)
+    temps = jnp.zeros((n_slots,), jnp.float32)   # greedy
+    seeds = jnp.zeros((n_slots,), jnp.int32)
+    ords = jnp.zeros((n_slots,), jnp.int32)
+    return step, params, cache, (toks, temps, seeds, ords)
+
 
 def make_flagship_step(batch_size=None, seq_len=None, config="v2",
                        optimizer=None):

@@ -412,7 +412,9 @@ class Gateway:
             snap = {rid: (r, r.describe())
                     for rid, r in self._replicas.items()}
         totals = {"slots": 0, "slots_busy": 0, "queue_depth": 0,
-                  "prefill_tokens_shared": 0, "prefix_pages_cached": 0}
+                  "prefill_tokens_shared": 0, "prefix_pages_cached": 0,
+                  "kv_pages_used": 0, "kv_pages_free": 0,
+                  "kv_sink_writes": 0}
         for rid, (r, desc) in snap.items():
             if rid in beats:
                 desc["last_beat_age_s"] = round(now - beats[rid], 3)
@@ -431,6 +433,11 @@ class Gateway:
                         gstats.get("prefill_tokens_shared") or 0)
                     totals["prefix_pages_cached"] += int(
                         gstats.get("prefix_pages_cached") or 0)
+                    # kv-pool occupancy across the fleet (paged replicas
+                    # report these; dense ones contribute 0)
+                    for key in ("kv_pages_used", "kv_pages_free",
+                                "kv_sink_writes"):
+                        totals[key] += int(gstats.get(key) or 0)
                 except (OSError, ValueError) as e:
                     desc["probe_error"] = str(e)
         with self._lock:

@@ -83,6 +83,13 @@ class TransformerConfig:
     # dequant-on-read fused into the attention reads) — ~2x less
     # resident kv vs bf16 (~4x vs f32), the same trade as weight-only
     # int8 but for the cache, composing with slots and paging
+    paged_attn_impl: str = "kernel"  # paged decode READ path: "kernel"
+    # = the Pallas flash-decode kernel (ops/paged_attention.py — walks
+    # the page table in place via scalar prefetch, visits only occupied
+    # pages, online softmax + split-K LSE combine, int8 dequant fused
+    # into the page read); "einsum" = the reference full-gather body
+    # (kept for parity tests and as the fallback under an active mesh,
+    # where an unpartitionable pallas custom call cannot run)
 
 
 def apply_rope(x, positions, theta=10000.0):
@@ -271,6 +278,10 @@ class Attention(nn.Module):
                     f"kv_page_size={cfg.kv_page_size}")
             if cfg.kv_pages < 1:
                 raise ValueError("kv_page_size > 0 requires kv_pages >= 1")
+            if cfg.paged_attn_impl not in ("kernel", "einsum"):
+                raise ValueError(
+                    f"paged_attn_impl={cfg.paged_attn_impl!r} not in "
+                    "('kernel', 'einsum')")
             return _paged_attention_body(self, q, k, v)
         quant = cfg.kv_dtype == "int8"
         store = jnp.int8 if quant else dtype
@@ -391,10 +402,17 @@ def _paged_attention_body(attn_self, q, k, v):
     ``page_table [B, max_seq/page]`` names (the serving layer allocates
     them from a free list at admission and returns them at retirement —
     serve.ContinuousBatcher).  Writes follow the measured slot-cache
-    rule (one-hot masked blend, never a scatter: BASELINE.md round 4);
-    reads gather each row's pages back into the logical [B, L, n_kv,
-    Dh] view, which costs the same HBM read attention performs anyway —
-    the pool saves RESIDENT memory, not step bandwidth.
+    rule (one-hot masked blend, never a scatter: BASELINE.md round 4).
+    Reads go through ``cfg.paged_attn_impl``: "kernel" (the default)
+    runs the Pallas flash-decode kernel, which walks each row's page
+    table in place and touches only its OCCUPIED pages — per-token read
+    bytes scale with the row's true length, not max_seq (see
+    docs/source/performance.rst for the bytes-per-token math);
+    "einsum" gathers each row's pages back into the logical
+    [B, L, n_kv, Dh] view and runs a full-length masked softmax —
+    O(max_seq)/token, kept as the parity reference and as the fallback
+    under an active mesh (pallas is a custom call GSPMD cannot
+    partition — the _flash_dispatch/_single_device discipline).
 
     CONTRACT: a row's table must name valid pool pages for every
     position it will touch before those positions are written (admission
@@ -466,7 +484,19 @@ def _paged_attention_body(attn_self, q, k, v):
             "bsn,bso,bsh->noh", oh_p.astype(jnp.float32),
             oh_o.astype(jnp.float32), v_sc), pvs.value)
     ci.value = idx + S
-    # read: each row's logical kv view, gathered from its pages
+    # submodule-path import: the bare package attribute is the
+    # re-exported FUNCTION (ops/__init__), not this module
+    from tensorflowonspark_tpu.ops.paged_attention import (
+        paged_attention, paged_attention_available)
+    if (cfg.paged_attn_impl == "kernel" and paged_attention_available()
+            and _ambient_mesh() is None):
+        # in-place page walk: lengths = the post-write cache_index (the
+        # kernel derives the visibility rule j <= idx + s from it)
+        return paged_attention(
+            q, pk.value, pv.value, table.value, idx + S,
+            key_scales=pks.value if quant else None,
+            value_scales=pvs.value if quant else None)
+    # reference read: each row's logical kv view, gathered from its pages
     kb = jnp.take(pk.value, table.value, axis=0)  # [B, mp, P, n_kv, Dh]
     vb = jnp.take(pv.value, table.value, axis=0)
     if quant:

@@ -14,14 +14,20 @@ interpret-mode path so the same kernels are testable on the CPU mesh.
 - adamw_fused / lion_fused : single-pass optimizer updates — read
   grad/param/moments once, write param/moments once, clip scale inlined
   (see ops/fused_optim.py; surfaced via optim.make_optimizer)
+- paged_attention : flash-decode over the paged serving kv pool — page
+  table scalar-prefetched, only occupied pages read (in place, no
+  logical-view gather), online softmax + split-K LSE combine, int8
+  dequant fused into the page read (see ops/paged_attention.py;
+  the default paged read path, TransformerConfig.paged_attn_impl)
 """
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 from tensorflowonspark_tpu.ops.fused_optim import adamw_fused, lion_fused
 from tensorflowonspark_tpu.ops.layernorm import fused_layernorm
+from tensorflowonspark_tpu.ops.paged_attention import paged_attention
 from tensorflowonspark_tpu.ops.xent import fused_unembed_xent
 
 __all__ = ["flash_attention", "fused_layernorm", "fused_unembed_xent",
-           "adamw_fused", "lion_fused"]
+           "adamw_fused", "lion_fused", "paged_attention"]
 
 
 def default_interpret():

@@ -88,6 +88,14 @@ def build_argparser():
                         "each (requires --generate_kv_pages)")
     p.add_argument("--generate_kv_pages", type=int, default=0,
                    help="pool size (pages) for --generate_kv_page_size")
+    p.add_argument("--generate_paged_attn", choices=["kernel", "einsum"],
+                   default=None,
+                   help="paged kv READ path: \"kernel\" (default) = the "
+                        "Pallas flash-decode kernel (page table walked in "
+                        "place, only occupied pages read); \"einsum\" = "
+                        "the full-gather reference body (parity / "
+                        "debugging).  Only meaningful with "
+                        "--generate_kv_page_size")
     p.add_argument("--generate_kv_dtype", choices=["auto", "int8"],
                    default="auto",
                    help="int8 = quantized slot kv cache (int8 payload + "
@@ -290,6 +298,7 @@ class ModelService:
         self._gen_kv_pages = getattr(args, "generate_kv_pages", 0)
         self._gen_kv_dtype = getattr(args, "generate_kv_dtype",
                                      "auto") or "auto"
+        self._gen_paged_attn = getattr(args, "generate_paged_attn", None)
         self._gen_quantize = getattr(args, "generate_quantize",
                                      "none") or "none"
         self._gen_lora_rank = getattr(args, "generate_lora_rank", 0) or 0
@@ -343,7 +352,8 @@ class ModelService:
                         lora_rank=self._gen_lora_rank,
                         lora_capacity=self._gen_lora_capacity,
                         lora_adapters=self._gen_lora,
-                        kv_dtype=self._gen_kv_dtype)
+                        kv_dtype=self._gen_kv_dtype,
+                        paged_attn_impl=self._gen_paged_attn)
                 except TypeError as e:
                     # genuinely not a decoder LM: the documented 404
                     logger.info(":generate unavailable: %s", e)
@@ -507,15 +517,21 @@ class ContinuousBatcher:
     def __init__(self, model, params, n_slots=8, max_pending=1024,
                  read_chunk=8, prefill_chunk=512, draft_model=None,
                  draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
-                 lora_rank=0, lora_capacity=8, kv_dtype=None):
+                 lora_rank=0, lora_capacity=8, kv_dtype=None,
+                 paged_attn_impl=None):
         import itertools
         import queue as queue_mod
 
         import jax.numpy as jnp
 
+        from .metrics import Counters
         from .models import decode as decode_mod
 
         self.model, self.params = model, params
+        # host-side event counters (sink-write accounting below);
+        # stats() folds snapshot() in, so the fleet gateway and
+        # GET /v1/metadata see every counter without extra plumbing
+        self.counters = Counters()
         # "int8" stores the slot kv cache quantized (int8 payload +
         # per-(token, head) f32 scales — TransformerConfig.kv_dtype):
         # ~2x less resident kv vs bf16, composing with paging (pool
@@ -548,7 +564,7 @@ class ContinuousBatcher:
             self._total_pages = int(kv_pages)
             self.slot_model, self._cache = decode_mod.init_paged_slot_cache(
                 model, n_slots, self.kv_page_size, int(kv_pages) + 1,
-                kv_dtype=kv_dtype)
+                kv_dtype=kv_dtype, paged_attn_impl=paged_attn_impl)
             self._set_table = decode_mod._jitted_set_row_page_table(
                 self.slot_model)
             self._free_pages = list(range(int(kv_pages)))
@@ -699,12 +715,18 @@ class ContinuousBatcher:
             "spec_rounds": self._spec_rounds,
         }
         if self.kv_page_size:
-            out["kv_pages_free"] = len(self._free_pages)
+            free = len(self._free_pages)
+            out["kv_pages_free"] = free
             out["kv_pages_total"] = self._total_pages
+            out["kv_pages_used"] = self._total_pages - free
             out["kv_page_size"] = self.kv_page_size
+            out["paged_attn_impl"] = self.slot_model.cfg.paged_attn_impl
             out["admission_waiting_for_pages"] = self._parked is not None
             out["prefix_pages_cached"] = len(self._prefix)
             out["prefill_tokens_shared"] = self.prefill_tokens_shared
+            # explicit (not just via the counter fold): present-at-zero
+            # so dashboards see the gauge before the first sink write
+            out["kv_sink_writes"] = self.counters.get("kv_sink_writes")
         if self.lora_rank:
             out["lora_rank"] = self.lora_rank
             # the one mutable-container read: snapshot under _lora_lock so
@@ -717,6 +739,8 @@ class ContinuousBatcher:
             out["lora_capacity_free"] = free
         if self.kv_dtype:
             out["kv_dtype"] = self.kv_dtype
+        # event counters (kv_sink_writes, ...) ride along by name
+        out.update(self.counters.snapshot())
         return out
 
     # ---- multi-adapter LoRA registry ------------------------------------
@@ -1057,6 +1081,18 @@ class ContinuousBatcher:
             freed += 1
         return freed
 
+    def _assert_no_sink(self, pages):
+        """The sink page absorbs garbage writes from EVERY free row and
+        every bucket-padded prefill overshoot: handing it to a request
+        would let that garbage corrupt live kv (decode.init_paged_slot_
+        cache caller contract).  Every allocation passes through here;
+        a trip means the free list / prefix cache was corrupted."""
+        assert self._sink not in pages, (
+            f"page allocator handed out the reserved sink page "
+            f"{self._sink} (allocated {pages}); the free list or prefix "
+            f"cache is corrupted — the sink must never be owned by a row")
+        return pages
+
     def _try_allocate(self, row, item):
         """Reserve `item`'s page need for `row` — reusing cached prefix
         pages where the prompt matches — or False when the pool (after
@@ -1082,7 +1118,7 @@ class ContinuousBatcher:
                 self._page_rc[page] -= 1
             return False
         fresh = [self._free_pages.pop() for _ in range(fresh_need)]
-        pages = shared + fresh
+        pages = self._assert_no_sink(shared + fresh)
         self._row_pages[row] = pages
         self._row_shared_n[row] = len(shared)
         self._row_prefix_keys[row] = keys        # for post-prefill registration
@@ -1217,6 +1253,10 @@ class ContinuousBatcher:
         chunk = prompt[off:off + size]
         bucket = min(max(8, 1 << (len(chunk) - 1).bit_length()),
                      self.prefill_chunk)
+        if self.kv_page_size and bucket > len(chunk):
+            # bucket-padding overshoot lands in the row's tail table
+            # entries — the sink when past its allocation
+            self.counters.inc("kv_sink_writes", bucket - len(chunk))
         padded = chunk + [0] * (bucket - len(chunk))
         args = (jnp.asarray([padded], jnp.int32),
                 jnp.asarray(row, jnp.int32), jnp.asarray(off, jnp.int32),
@@ -1360,6 +1400,12 @@ class ContinuousBatcher:
         """One decode advance for all active slots: a fused speculative
         round when a draft is loaded and every active row is greedy, else
         one plain step.  Returns the readback entry."""
+        if self.kv_page_size:
+            # every dispatch steps ALL rows; the unoccupied ones write
+            # their junk token into the sink page (the reason it exists)
+            idle = sum(s is None for s in self._slots)
+            if idle:
+                self.counters.inc("kv_sink_writes", idle)
         use_spec = (self.draft_model is not None
                     and all(s is None or (s["temp"] == 0
                                           and not s.get("pen"))
@@ -1549,7 +1595,7 @@ class GenerateService:
                  prefill_chunk=512, request_timeout_s=None,
                  kv_page_size=0, kv_pages=0, quantize_mode="none",
                  lora_rank=0, lora_capacity=8, lora_adapters=None,
-                 kv_dtype="auto"):
+                 kv_dtype="auto", paged_attn_impl=None):
         import itertools
 
         self.quantize_mode = quantize_mode or "none"
@@ -1571,7 +1617,8 @@ class GenerateService:
             draft_model=draft_model, draft_params=draft_params,
             draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages,
             lora_rank=lora_rank, lora_capacity=lora_capacity,
-            kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype))
+            kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype),
+            paged_attn_impl=paged_attn_impl)
         try:
             for name, path in (lora_adapters or {}).items():
                 # adapter files written by lora.save_adapters; a bad file
@@ -1908,6 +1955,8 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
         # aligning routing keys with the replica prefix-cache page unit
         features["kv_page_size"] = args.generate_kv_page_size
         features["kv_pages"] = args.generate_kv_pages
+        features["paged_attn_impl"] = (
+            getattr(args, "generate_paged_attn", None) or "kernel")
     if getattr(args, "draft_export_dir", None):
         features["speculative"] = True
     if getattr(args, "generate_quantize", "none") != "none":

@@ -59,7 +59,7 @@ SLOW_FILES = {
     "test_lora.py",             # 25 s
     "test_lora_serving.py",     # ~60 s — multi-adapter slot engines
     "test_optim8bit.py",        # 14 s (round 5 grew it: layout parity)
-    "test_paged.py",            # 40 s — paged-kv batcher compiles
+    "test_paged.py",            # 55 s — paged-kv batcher compiles
     "test_metrics_vit.py",      # 82 s
     "test_minispark.py",        # 60 s — spawn-started executor pools
     "test_models.py",           # 88 s

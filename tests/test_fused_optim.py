@@ -231,9 +231,9 @@ def test_sharded_params_place_fused_state():
 
 
 def test_bench_segments_smoke_exits_zero_off_tpu(tmp_path):
-    """`bench.py --segments` is the CI smoke for the opt_ms segment: on a
-    CPU box it must exit 0 with a skipped line BEFORE building the 0.87B
-    flagship model."""
+    """`bench.py --segments` is the CI smoke for the segment registry
+    (opt_ms + decode_ms): on a CPU box it must exit 0 with one skipped
+    JSON line PER segment BEFORE building any 0.87B flagship model."""
     import json
     import os
     import subprocess
@@ -245,5 +245,6 @@ def test_bench_segments_smoke_exits_zero_off_tpu(tmp_path):
         [sys.executable, os.path.join(repo, "bench.py"), "--segments"],
         capture_output=True, text=True, timeout=300, env=env, cwd=repo)
     assert out.returncode == 0, out.stderr[-2000:]
-    line = json.loads(out.stdout.strip().splitlines()[-1])
-    assert line["metric"] == "opt_ms" and "skipped" in line
+    lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert {ln["metric"] for ln in lines} == {"opt_ms", "decode_ms"}
+    assert all("skipped" in ln for ln in lines)

@@ -339,3 +339,50 @@ def test_paged_config_validation(model_and_params):
             batcher.submit([1] * 10, 10)    # needs 3 pages, pool has 2
     finally:
         batcher.stop()
+
+
+def test_sink_guard_helper_and_allocation(model_and_params):
+    # ISSUE-4 guard: the reserved garbage-sink page (index kv_pages)
+    # must never be handed to a request — _assert_no_sink is the
+    # enforced form of init_paged_slot_cache's caller contract
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      kv_page_size=8, kv_pages=4)
+    try:
+        batcher.stop()     # drive allocation directly, no driver races
+        assert batcher._sink == 4
+        assert batcher._assert_no_sink([0, 3]) == [0, 3]
+        with pytest.raises(AssertionError, match="sink"):
+            batcher._assert_no_sink([0, batcher._sink])
+        item = {"prompt": [1, 2, 3], "max_new": 4, "temp": 0.0,
+                "aidx": 0}
+        assert batcher._try_allocate(0, item)
+        assert batcher._sink not in batcher._row_pages[0]
+        batcher._free_row(0)
+        # poisoned free list (simulated allocator corruption): the next
+        # allocation would pop the sink — the guard must trip, never
+        # hand it out silently
+        batcher._free_pages.append(batcher._sink)
+        with pytest.raises(AssertionError, match="sink"):
+            batcher._try_allocate(0, item)
+    finally:
+        batcher.stop()
+
+
+def test_kv_pool_occupancy_and_sink_write_stats(model_and_params):
+    # ISSUE-4 observability: pool occupancy + sink-write accounting in
+    # stats() (what GET /v1/fleet aggregates per replica)
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      kv_page_size=8, kv_pages=4)
+    try:
+        batcher.submit([1, 2, 3], 4).result(timeout=120)
+        s = batcher.stats()
+        assert s["kv_pages_used"] == s["kv_pages_total"] - s["kv_pages_free"]
+        assert s["paged_attn_impl"] in ("kernel", "einsum")
+        # 2 slots with 1 occupied: every dispatch wrote one junk token
+        # per idle row into the sink; prefill bucket padding (3-token
+        # prompt padded to 8) adds more
+        assert s["kv_sink_writes"] > 0
+    finally:
+        batcher.stop()
