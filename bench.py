@@ -31,6 +31,10 @@ comparisons (SEGMENTS registry; one JSON line each, and exits 0 with a
 adds the `decode_ms` segment: the steady-state paged slot-decode step
 (benchmarks.make_decode_step) timed with the flash-decode kernel vs the
 einsum full-gather reference (TransformerConfig.paged_attn_impl).
+Round 8 adds the `ttft_ms` segment: burst time-to-first-token through
+the batched admission pipeline (benchmarks.make_prefill_burst,
+prefill_rows=4) vs the sequential baseline (prefill_rows=1), plus
+`--list-segments` so CI can discover the registry without a TPU.
 
 On a device whose bf16 peak is unknown (not in benchmarks.PEAK_BF16) the
 metric falls back to tokens/sec — an MFU percent against a guessed peak
@@ -164,12 +168,69 @@ def bench_decode_segment(steps=32, windows=3):
     return timed("kernel"), timed("einsum")
 
 
+def bench_ttft_segment(reps=3, result_timeout=600):
+    """The admission segment: steady-state time-to-first-token for a
+    burst of queued prompts through the continuous batcher
+    (benchmarks.make_prefill_burst / FLAGSHIP_PREFILL), batched
+    multi-row prefill vs the sequential admission baseline
+    (prefill_rows=1).  Per config: one warmup burst pays the compiles,
+    then best mean-TTFT of the remaining bursts, read from the
+    batcher's own ttft counters (stats() deltas — the same numbers
+    operators see).  Returns (batched_ms, sequential_ms)."""
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_PREFILL,
+                                                  make_prefill_burst)
+
+    def timed(rows):
+        batcher, prompts, max_new = make_prefill_burst(prefill_rows=rows)
+        try:
+            best = float("inf")
+            for rep in range(max(2, reps)):
+                s0 = batcher.stats()
+                handles = [batcher.submit(p, max_new) for p in prompts]
+                for h in handles:
+                    h.result(timeout=result_timeout)
+                s1 = batcher.stats()
+                n = max(1, s1["ttft_count"] - s0["ttft_count"])
+                avg = (s1["ttft_ms_sum"] - s0["ttft_ms_sum"]) / n
+                if rep:              # burst 0 is the compile warmup
+                    best = min(best, avg)
+        finally:
+            batcher.stop()
+        return best
+
+    return timed(FLAGSHIP_PREFILL["prefill_rows"]), timed(1)
+
+
+def _opt_segment_setup():
+    """Cheap, CPU-safe registry smoke: the segment's builders and frozen
+    config resolve without building the 0.87B model or touching a
+    device (tests/test_bench_segments.py dry-runs every setup)."""
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_LM_V2,
+                                                  FLAGSHIP_OPTIMIZER,
+                                                  make_flagship_step)
+
+    assert callable(make_flagship_step)
+    assert FLAGSHIP_LM_V2["d_model"] > 0
+    return {"config": dict(FLAGSHIP_LM_V2),
+            "optimizer": FLAGSHIP_OPTIMIZER}
+
+
 def _opt_segment_result():
     full_ms, sgd0_ms, opt_ms = bench_opt_segment()
     return {"metric": "opt_ms", "value": round(opt_ms, 1),
             "unit": "ms/step",
             "aux": {"lm_step_ms": round(full_ms, 1),
                     "lm_step_ms_sgd0": round(sgd0_ms, 1)}}
+
+
+def _decode_segment_setup():
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_DECODE,
+                                                  make_decode_step)
+
+    assert callable(make_decode_step)
+    d = FLAGSHIP_DECODE
+    assert d["fill"] <= d["max_seq"] and d["max_seq"] % d["page_size"] == 0
+    return {"config": dict(d)}
 
 
 def _decode_segment_result():
@@ -180,13 +241,60 @@ def _decode_segment_result():
                     "speedup_vs_einsum": round(einsum_ms / kernel_ms, 2)}}
 
 
+def _ttft_segment_setup():
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_PREFILL,
+                                                  make_prefill_burst)
+
+    assert callable(make_prefill_burst)
+    d = FLAGSHIP_PREFILL
+    assert d["prompt_len"] + d["max_new"] <= d["max_seq"]
+    assert d["prefill_rows"] >= 1 and d["prompts"] >= d["prefill_rows"]
+    return {"config": dict(d)}
+
+
+def _ttft_segment_result():
+    batched_ms, sequential_ms = bench_ttft_segment()
+    return {"metric": "ttft_ms", "value": round(batched_ms, 1),
+            "unit": "ms/request",
+            "aux": {"ttft_ms_sequential": round(sequential_ms, 1),
+                    "speedup_vs_sequential": round(
+                        sequential_ms / batched_ms, 2)}}
+
+
 # segment registry: every entry shares the off-TPU skip + one-JSON-line-
-# per-segment protocol, so growing a segment is one function + one row
-# (the old hardcoded opt_ms plumbing could not be reused)
+# per-segment protocol, so growing a segment is one row (the old
+# hardcoded opt_ms plumbing could not be reused).  Each entry carries:
+#   run   — the TPU measurement, returns the segment's JSON dict
+#   setup — cheap CPU-safe resolution of the segment's builders/config
+#           (dry-run by the tier-1 smoke test, so a broken import or
+#           frozen-config drift is caught off-TPU, not on the bench box)
+#   help  — one line for --list-segments
 SEGMENTS = {
-    "opt_ms": _opt_segment_result,
-    "decode_ms": _decode_segment_result,
+    "opt_ms": {
+        "run": _opt_segment_result,
+        "setup": _opt_segment_setup,
+        "help": "optimizer-update cost per flagship train step "
+                "(fused adamw vs zero-lr sgd floor)"},
+    "decode_ms": {
+        "run": _decode_segment_result,
+        "setup": _decode_segment_setup,
+        "help": "steady-state paged slot-decode step "
+                "(flash-decode kernel vs einsum full-gather)"},
+    "ttft_ms": {
+        "run": _ttft_segment_result,
+        "setup": _ttft_segment_setup,
+        "help": "burst time-to-first-token through the admission "
+                "pipeline (batched multi-row prefill vs sequential)"},
 }
+
+
+def list_segments_main():
+    """`bench.py --list-segments`: one JSON line per registry entry —
+    no jax import, runnable anywhere (CI discovers the segment set
+    without an accelerator runtime)."""
+    for name, entry in SEGMENTS.items():
+        print(json.dumps({"segment": name, "help": entry["help"]}))
+    return 0
 
 
 def segments_main():
@@ -202,18 +310,24 @@ def segments_main():
                               "segment bench needs TPU (backend is "
                               f"{jax.default_backend()})"}))
         return 0
-    for fn in SEGMENTS.values():
-        print(json.dumps(fn()))
+    for entry in SEGMENTS.values():
+        print(json.dumps(entry["run"]()))
     return 0
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--segments", action="store_true",
-                    help="run only the segment comparisons (opt_ms, "
-                         "decode_ms — one JSON line each; exits 0 with "
-                         "skipped lines off-TPU)")
+                    help="run only the segment comparisons (one JSON "
+                         "line each; exits 0 with skipped lines "
+                         "off-TPU)")
+    ap.add_argument("--list-segments", action="store_true",
+                    help="print the segment registry (one JSON line per "
+                         "segment: name + help) and exit; needs no "
+                         "accelerator")
     args = ap.parse_args(argv)
+    if args.list_segments:
+        return list_segments_main()
     if args.segments:
         return segments_main()
 

@@ -115,6 +115,61 @@ def make_decode_step(impl="kernel", n_slots=None, page_size=None,
     return step, params, cache, (toks, temps, seeds, ords)
 
 
+# The ttft_ms segment workload (bench.py --segments): a burst of queued
+# prompts admitted through the continuous batcher's prefill engine —
+# time-to-first-token with batched multi-row prefill (prefill_rows=4)
+# vs the sequential admission baseline (prefill_rows=1).  Dense slot
+# cache: the segment isolates admission batching, not page residency.
+# Frozen like FLAGSHIP_LM: changing any value invalidates ttft_ms
+# comparability.
+FLAGSHIP_PREFILL = dict(n_slots=8, prompts=8, prompt_len=768, max_new=2,
+                        prefill_chunk=256, prefill_rows=4, max_seq=1024)
+
+
+def make_prefill_burst(prefill_rows=None, n_slots=None, prompts=None,
+                       prompt_len=None, max_new=None, prefill_chunk=None,
+                       max_seq=None):
+    """Build the ttft_ms segment workload: a ContinuousBatcher on the
+    flagship-LM dims (FLAGSHIP_LM_V2 at ``max_seq``) plus the burst of
+    distinct random prompts to submit.  Returns
+    ``(batcher, prompts_list, max_new)``; the caller submits the burst,
+    drains every handle, and reads TTFT from ``batcher.stats()``
+    (ttft_ms_sum / ttft_count deltas).  Caller must ``batcher.stop()``.
+    Prompt content is random garbage: prefill cost is shape-bound, not
+    value-bound, so timing is unaffected; prompts are DISTINCT so the
+    prefix cache cannot short-circuit the work being measured."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_PREFILL
+    rows = d["prefill_rows"] if prefill_rows is None else prefill_rows
+    n_slots = n_slots or d["n_slots"]
+    n_prompts = prompts or d["prompts"]
+    prompt_len = prompt_len or d["prompt_len"]
+    max_new = max_new or d["max_new"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    max_seq = max_seq or d["max_seq"]
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    # params don't depend on seq length: init with a short trace
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    batcher = serve_mod.ContinuousBatcher(
+        model, params, n_slots=n_slots, read_chunk=4, prefill_chunk=chunk,
+        prefill_rows=rows)
+    rs = np.random.RandomState(0)
+    prompts_list = [rs.randint(1, cfg.vocab_size,
+                               prompt_len).astype("int32").tolist()
+                    for _ in range(n_prompts)]
+    return batcher, prompts_list, max_new
+
+
 def make_flagship_step(batch_size=None, seq_len=None, config="v2",
                        optimizer=None):
     """Build the flagship-LM training step exactly as the driver metric

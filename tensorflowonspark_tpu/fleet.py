@@ -414,7 +414,8 @@ class Gateway:
         totals = {"slots": 0, "slots_busy": 0, "queue_depth": 0,
                   "prefill_tokens_shared": 0, "prefix_pages_cached": 0,
                   "kv_pages_used": 0, "kv_pages_free": 0,
-                  "kv_sink_writes": 0}
+                  "kv_sink_writes": 0,
+                  "ttft_count": 0, "ttft_ms_sum": 0.0}
         for rid, (r, desc) in snap.items():
             if rid in beats:
                 desc["last_beat_age_s"] = round(now - beats[rid], 3)
@@ -438,8 +439,19 @@ class Gateway:
                     for key in ("kv_pages_used", "kv_pages_free",
                                 "kv_sink_writes"):
                         totals[key] += int(gstats.get(key) or 0)
+                    # TTFT: only count/sum are summable across replicas
+                    # (percentiles aren't — each replica keeps its own
+                    # p50/p95 in its stats snapshot)
+                    totals["ttft_count"] += int(
+                        gstats.get("ttft_count") or 0)
+                    totals["ttft_ms_sum"] += float(
+                        gstats.get("ttft_ms_sum") or 0.0)
                 except (OSError, ValueError) as e:
                     desc["probe_error"] = str(e)
+        totals["ttft_ms_sum"] = round(totals["ttft_ms_sum"], 3)
+        totals["ttft_avg_ms"] = (
+            round(totals["ttft_ms_sum"] / totals["ttft_count"], 3)
+            if totals["ttft_count"] else 0.0)
         with self._lock:
             prefix_tokens = self._prefix_tokens
         return {"replicas": {rid: desc for rid, (_, desc) in snap.items()},

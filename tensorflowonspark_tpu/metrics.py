@@ -14,7 +14,9 @@ duplicated rows so they don't bias the metric).
 
 Also here: :class:`Counters`, host-side thread-safe monotone counters for
 the serving/orchestration plane (the fleet gateway's ejection/retry/429
-accounting).  JAX is imported lazily inside the eval functions so
+accounting), and :class:`LatencyWindow`, the serving-latency tracker
+(TTFT percentiles + fleet-summable count/sum).  JAX is imported lazily
+inside the eval functions so
 importing this module from a pure control-plane process (the gateway)
 never pays accelerator-runtime startup — the same discipline as `util`.
 """
@@ -194,4 +196,53 @@ class Counters:
         """{name: count} copy, safe to serialize."""
         with self._lock:
             return dict(self._counts)
+
+
+class LatencyWindow:
+    """Thread-safe latency tracker for the serving plane (no JAX): a
+    bounded window of recent samples for percentiles plus MONOTONE
+    count/sum that never resets — the fleet gateway aggregates the
+    monotone pair across replicas (percentiles don't sum; averages of
+    sums do).  Used for admission->first-token (TTFT) in the
+    continuous batcher.  Reads before the first sample return zeros so
+    dashboards can reference the keys unconditionally."""
+
+    def __init__(self, window=512):
+        self._lock = threading.Lock()
+        self._recent = []          # bounded ring of recent samples (ms)
+        self._window = max(1, int(window))
+        self._count = 0            # monotone, fleet-aggregable
+        self._sum_ms = 0.0
+
+    def record(self, seconds):
+        ms = float(seconds) * 1000.0
+        with self._lock:
+            self._count += 1
+            self._sum_ms += ms
+            self._recent.append(ms)
+            if len(self._recent) > self._window:
+                del self._recent[:len(self._recent) - self._window]
+
+    @staticmethod
+    def _percentile(sorted_ms, q):
+        if not sorted_ms:
+            return 0.0
+        # nearest-rank on the window: exact for the small-N serving case,
+        # no interpolation surprises at the extremes
+        i = int(round(q * (len(sorted_ms) - 1)))
+        return sorted_ms[min(len(sorted_ms) - 1, i)]
+
+    def stats(self, prefix):
+        """{prefix}_count / _ms_sum (monotone, summable across replicas)
+        + _avg_ms / _p50_ms / _p95_ms (window-local)."""
+        with self._lock:
+            count, total = self._count, self._sum_ms
+            recent = sorted(self._recent)
+        return {
+            f"{prefix}_count": count,
+            f"{prefix}_ms_sum": round(total, 3),
+            f"{prefix}_avg_ms": round(total / count, 3) if count else 0.0,
+            f"{prefix}_p50_ms": round(self._percentile(recent, 0.50), 3),
+            f"{prefix}_p95_ms": round(self._percentile(recent, 0.95), 3),
+        }
 

@@ -204,6 +204,20 @@ def _leaf_name(path):
     return getattr(last, "key", getattr(last, "name", None))
 
 
+def _first_named_leaf(tree, name):
+    """First leaf whose path ends in `name` (every layer agrees on the
+    per-row index shapes, so one representative leaf is enough)."""
+    found = []
+
+    def look(path, leaf):
+        if _leaf_name(path) == name and not found:
+            found.append(leaf)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(look, tree)
+    return found[0]
+
+
 _POOL_LEAVES = ("pages_key", "pages_value",   # dim 0 = pool, not rows
                 "pages_key_scale", "pages_value_scale")  # int8 kv scales
 
@@ -406,6 +420,122 @@ def _jitted_slot_prefill_lora(slot_model):
     return prefill
 
 
+def _slot_prefill_many_body(slot_model, variables, cache, chunks, rows,
+                            starts, n_valids, sink):
+    """Batched multi-row prefill core: ONE dispatch writes one
+    bucket-padded chunk for up to P rows (serve.ContinuousBatcher's
+    admission pipeline batches waiting requests' chunks here instead of
+    dispatching width-1 prefills that leave the MXU idle).
+
+    ``chunks`` [P, bucket] int32; ``rows``/``starts``/``n_valids`` [P]
+    int32 give each row's slot index, cache write offset (prefix-cache
+    skip), and true token count inside its padded chunk.  The
+    decode_slots attention already computes per-row positions from the
+    per-row index leaves, so rows at DIFFERENT offsets batch into one
+    apply.  PAD rows carry row index == n_slots: out of bounds by
+    construction, so their per-row gathers CLIP to the last real row
+    (read-only, harmless) and their writebacks scatter-DROP (JAX
+    out-of-bounds semantics), while their page tables are overridden
+    with the ``sink`` page — the paged pool write SUMS over batch rows,
+    so a pad row writing through a clipped table would corrupt a live
+    row's pages.  Valid rows must be DISTINCT for the same reason (a
+    duplicated row would double-write its pool pages).  Returns
+    (last-valid-position logits [P, V], updated batch cache).
+    """
+    rows = rows.astype(jnp.int32)
+    n_slots = _first_named_leaf(cache, "cache_index").shape[0]
+    valid = rows < n_slots
+
+    def _gather(path, a):
+        if _leaf_name(path) in _POOL_LEAVES:
+            return a                          # shared pool: pass whole
+        g = a[rows]                           # OOB (pad rows) clips
+        if _leaf_name(path) == "page_table":
+            g = jnp.where(valid[:, None], g, jnp.asarray(sink, jnp.int32))
+        return g
+
+    sub = jax.tree_util.tree_map_with_path(_gather, cache)
+    sub = _set_row_indices_vec(sub, starts)
+    logits, mut = slot_model.apply(dict(variables, cache=sub), chunks,
+                                   mutable=["cache"])
+    new_sub = _set_row_indices_vec(mut["cache"], starts + n_valids)
+
+    def _write(path, full, upd):
+        if _leaf_name(path) in _POOL_LEAVES:
+            return upd                        # updated in place by apply
+        return full.at[rows].set(upd)         # OOB (pad rows) drops
+
+    cache = jax.tree_util.tree_map_with_path(_write, cache, new_sub)
+    pick = jnp.clip(n_valids - 1, 0, chunks.shape[1] - 1)
+    last = jnp.take_along_axis(logits, pick[:, None, None], axis=1)[:, 0]
+    return last, cache                        # [P, V], updated cache
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_prefill_many(slot_model):
+    """Batched multi-row prefill: one chunk for up to P rows per
+    dispatch (`_slot_prefill_many_body`).  Chunk width and row count are
+    static shapes — the serving layer pads both to power-of-2 buckets
+    (`build_prefill_batch`), so compile count stays bounded by
+    O(log(prefill_chunk) * log(prefill_rows)) variants."""
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill(params, cache, chunks, rows, starts, n_valids, sink):
+        return _slot_prefill_many_body(
+            slot_model, {"params": _params_view(params)}, cache, chunks,
+            rows, starts, n_valids, sink)
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_slot_prefill_many_lora(slot_model):
+    """`_jitted_slot_prefill_many` with per-row LoRA adapter identities:
+    each admitting row prefills under ITS adapter (``adapter_ids`` [P];
+    pad rows use the null adapter 0, whose delta is exactly zero)."""
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def prefill(params, lora, cache, chunks, rows, starts, n_valids,
+                sink, adapter_ids):
+        return _slot_prefill_many_body(
+            slot_model,
+            {"params": _params_view(params),
+             "lora": _lora_with_ids(lora, adapter_ids.astype(jnp.int32))},
+            cache, chunks, rows, starts, n_valids, sink)
+
+    return prefill
+
+
+def build_prefill_batch(entries, width, bucket, n_slots):
+    """Host-side slot builder for one batched prefill dispatch.
+
+    ``entries`` is [(row, chunk_tokens, start)] for up to ``width``
+    admitting rows; the result pads to the STATIC (width, bucket)
+    dispatch shape.  Pad rows take row index ``n_slots`` — out of
+    bounds by construction, so their writebacks scatter-drop and the
+    jit substitutes the sink page table (`_slot_prefill_many_body`).
+    Returns (chunks, rows, starts, n_valids) device-ready for
+    `_jitted_slot_prefill_many`."""
+    import numpy as np
+
+    assert len(entries) <= width, (len(entries), width)
+    assert len({row for row, _, _ in entries}) == len(entries), \
+        "duplicate rows in one prefill dispatch would double-write " \
+        "their pool pages (the paged cache write sums over batch rows)"
+    chunks = np.zeros((width, bucket), np.int32)
+    rows = np.full((width,), n_slots, np.int32)
+    starts = np.zeros((width,), np.int32)
+    n_valids = np.ones((width,), np.int32)
+    for i, (row, toks, start) in enumerate(entries):
+        assert 0 < len(toks) <= bucket, (len(toks), bucket)
+        chunks[i, :len(toks)] = toks
+        rows[i] = row
+        starts[i] = start
+        n_valids[i] = len(toks)
+    return (jnp.asarray(chunks), jnp.asarray(rows), jnp.asarray(starts),
+            jnp.asarray(n_valids))
+
+
 @functools.lru_cache(maxsize=32)
 def _jitted_set_row(slot_model):
     """Tiny device update used at slot joins: place the joining request's
@@ -455,25 +585,13 @@ def _jitted_slot_spec_round(t_model, d_model, k):
     cache writes land beyond any live region and rewind with everyone
     else."""
 
-    def _first_index_leaf(cache):
-        found = []
-
-        def look(path, leaf):
-            name = getattr(path[-1], "key", getattr(path[-1], "name", None))
-            if name == "cache_index":
-                found.append(leaf)
-            return leaf
-
-        jax.tree_util.tree_map_with_path(look, cache)
-        return found[0]
-
     @functools.partial(jax.jit, donate_argnums=(2, 3))
     def spec_round(t_params, d_params, t_cache, d_cache, toks):
         t_params = _params_view(t_params)
         d_params = _params_view(d_params)
         # per-row committed length = cache_index before this round (all
         # layers agree; read one leaf)
-        idx = _first_index_leaf(t_cache)
+        idx = _first_named_leaf(t_cache, "cache_index")
         props = []
         d_tok = toks
         for _ in range(k):                      # unrolled: k static

@@ -77,7 +77,20 @@ def build_argparser():
     p.add_argument("--generate_prefill_chunk", type=int, default=512,
                    help="admission prefill chunk (tokens): long prompts "
                         "prefill in chunks interleaved with decode steps "
-                        "so in-flight streams stall at most one chunk")
+                        "so in-flight streams stall at most one chunk "
+                        "(paged mode rounds this UP to a kv page "
+                        "multiple so chunks never straddle a page)")
+    p.add_argument("--generate_prefill_rows", type=int, default=4,
+                   help="admission pipeline width: up to this many "
+                        "waiting requests prefill one chunk each PER "
+                        "BATCHED DISPATCH (the prefill engine; 1 = the "
+                        "sequential one-request-at-a-time admission)")
+    p.add_argument("--generate_prefill_budget", type=int, default=0,
+                   help="prefill token budget per scheduler round "
+                        "(Sarathi-style stall-free scheduling): the "
+                        "chunks dispatched between two decode steps "
+                        "never exceed this many tokens (0 = "
+                        "prefill_rows * prefill_chunk)")
     p.add_argument("--generate_timeout_s", type=float, default=None,
                    help="wall-time bound on one :generate request "
                         "(default: max(600, 2*max_new_tokens_limit))")
@@ -147,6 +160,37 @@ def _is_int(x):
     ints by inheritance — `{"top_k": true}` would otherwise sail through
     int validation as top_k=1 instead of 400ing."""
     return isinstance(x, int) and not isinstance(x, bool)
+
+
+def _bucket_len(n, cap):
+    """Padded length for a prefill chunk of `n` tokens: the next power
+    of two (floor 8), capped at the configured chunk size — the jit
+    compiles per BUCKET, not per prompt length, so compile variants
+    stay O(log(cap)) while pad waste stays under 2x."""
+    return min(max(8, 1 << (n - 1).bit_length()), cap)
+
+
+def _pow2_width(n):
+    """Padded row count for a batched prefill dispatch: next power of
+    two — same bounded-compile-variants reasoning as `_bucket_len`."""
+    return 1 << (n - 1).bit_length()
+
+
+def _aligned_prefill_chunk(prefill_chunk, kv_page_size):
+    """Effective prefill chunk size: floor 8, and in paged mode rounded
+    UP to a kv_page_size multiple.  A chunk straddling a page boundary
+    still writes correctly (positions map through the table), but it
+    breaks the prefix cache's page-granular accounting and wastes a
+    partial page of every bucket — so misalignment is corrected loudly
+    at startup, not silently clamped."""
+    chunk = max(8, prefill_chunk)
+    if kv_page_size and chunk % kv_page_size:
+        aligned = -(-chunk // kv_page_size) * kv_page_size
+        logger.warning(
+            "prefill_chunk %d is not a multiple of kv_page_size %d; "
+            "rounding up to %d", chunk, kv_page_size, aligned)
+        return aligned
+    return chunk
 
 
 def _instances_to_columns(instances, input_names=None):
@@ -293,6 +337,10 @@ class ModelService:
         self._gen_read_chunk = getattr(args, "generate_read_chunk", 8) or 8
         self._gen_prefill_chunk = getattr(args, "generate_prefill_chunk",
                                           512) or 512
+        self._gen_prefill_rows = getattr(args, "generate_prefill_rows",
+                                         4) or 4
+        self._gen_prefill_budget = getattr(args, "generate_prefill_budget",
+                                           0) or 0
         self._gen_timeout_s = getattr(args, "generate_timeout_s", None)
         self._gen_kv_page_size = getattr(args, "generate_kv_page_size", 0)
         self._gen_kv_pages = getattr(args, "generate_kv_pages", 0)
@@ -345,6 +393,8 @@ class ModelService:
                         draft_k=self._draft_k, slots=self._gen_slots,
                         read_chunk=self._gen_read_chunk,
                         prefill_chunk=self._gen_prefill_chunk,
+                        prefill_rows=self._gen_prefill_rows,
+                        prefill_budget=self._gen_prefill_budget,
                         request_timeout_s=self._gen_timeout_s,
                         kv_page_size=self._gen_kv_page_size,
                         kv_pages=self._gen_kv_pages,
@@ -515,7 +565,8 @@ class ContinuousBatcher:
     """
 
     def __init__(self, model, params, n_slots=8, max_pending=1024,
-                 read_chunk=8, prefill_chunk=512, draft_model=None,
+                 read_chunk=8, prefill_chunk=512, prefill_rows=4,
+                 prefill_budget=0, draft_model=None,
                  draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
                  lora_rank=0, lora_capacity=8, kv_dtype=None,
                  paged_attn_impl=None):
@@ -524,7 +575,7 @@ class ContinuousBatcher:
 
         import jax.numpy as jnp
 
-        from .metrics import Counters
+        from .metrics import Counters, LatencyWindow
         from .models import decode as decode_mod
 
         self.model, self.params = model, params
@@ -635,11 +686,12 @@ class ContinuousBatcher:
             self._adapter_token = {0: 0}  # bank index -> registration token
             self._token_counter = itertools.count(1)
             self._lora_lock = threading.Lock()
-            self._prefill = decode_mod._jitted_slot_prefill_lora(
+            self._prefill_many = decode_mod._jitted_slot_prefill_many_lora(
                 self.slot_model)
             self._step = decode_mod._jitted_slot_step_lora(self.slot_model)
         else:
-            self._prefill = decode_mod._jitted_slot_prefill(self.slot_model)
+            self._prefill_many = decode_mod._jitted_slot_prefill_many(
+                self.slot_model)
             self._step = decode_mod._jitted_slot_step(self.slot_model)
         self._set_row = decode_mod._jitted_set_row(self.slot_model)
         self.draft_model = self.draft_params = None
@@ -652,7 +704,7 @@ class ContinuousBatcher:
             self.draft_model, self.draft_params = draft_model, draft_params
             self.d_slot_model, self._d_cache = decode_mod.init_slot_cache(
                 draft_model, n_slots, kv_dtype=kv_dtype)
-            self._d_prefill = decode_mod._jitted_slot_prefill(
+            self._d_prefill_many = decode_mod._jitted_slot_prefill_many(
                 self.d_slot_model)
             self._spec_round = decode_mod._jitted_slot_spec_round(
                 self.slot_model, self.d_slot_model, draft_k)
@@ -665,12 +717,26 @@ class ContinuousBatcher:
             # rows never speculate) — per-request in submit()
             self.max_seq = min(self.max_seq, draft_model.cfg.max_seq_len)
         self.read_chunk = max(1, read_chunk)
-        self.prefill_chunk = max(8, prefill_chunk)
+        self.prefill_chunk = _aligned_prefill_chunk(prefill_chunk,
+                                                    self.kv_page_size)
+        # admission pipeline width: up to this many waiting requests
+        # prefill one chunk each per batched dispatch (1 = the strict
+        # sequential admission path, the parity baseline)
+        self.prefill_rows = max(1, int(prefill_rows or 1))
+        # Sarathi-style stall-free budget: prefill tokens dispatched
+        # between two decode steps never exceed this (the head admission
+        # always runs, so a single over-budget chunk cannot wedge)
+        self.prefill_budget = (int(prefill_budget or 0)
+                               or self.prefill_rows * self.prefill_chunk)
         self._pending = queue_mod.Queue(max_pending)
         self._slots = [None] * n_slots
         self._gen = [0] * n_slots      # occupant generation per row: tokens
         # decoded for a previous occupant must never reach a new one
-        self._admitting = None         # chunked-prefill state machine
+        self._admissions = []          # in-flight chunked admissions (the
+        # prefill engine's queue; each entry is one request mid-prefill)
+        # admission->first-token latency (TTFT): percentile window +
+        # monotone count/sum that GET /v1/fleet aggregates
+        self._ttft = LatencyWindow()
         # device-resident chains: ONE dispatch per decoded token
         self._toks = jnp.zeros((n_slots,), jnp.int32)
         self._temps = jnp.zeros((n_slots,), jnp.float32)
@@ -709,11 +775,17 @@ class ContinuousBatcher:
         out = {
             "slots_busy": sum(s is not None for s in self._slots),
             "pending": self._pending.qsize(),
-            "admitting": self._admitting is not None,
+            "admitting": bool(self._admissions),
+            "admissions_inflight": len(self._admissions),
+            "prefill_rows": self.prefill_rows,
+            "prefill_budget": self.prefill_budget,
             "requests_served": self.requests,
             "decode_steps": self._steps,
             "spec_rounds": self._spec_rounds,
         }
+        # admission->first-token latency: count/sum (monotone, fleet-
+        # aggregable) + p50/p95 over the recent window
+        out.update(self._ttft.stats("ttft"))
         if self.kv_page_size:
             free = len(self._free_pages)
             out["kv_pages_free"] = free
@@ -841,8 +913,8 @@ class ContinuousBatcher:
         self._thread.join(timeout)
         err = RuntimeError("batcher stopped")
         self._dead = self._dead or err
-        adm, self._admitting = self._admitting, None
-        if adm is not None:
+        adms, self._admissions = self._admissions, []
+        for adm in adms:
             adm["item"]["h"]._fail(err)
         parked, self._parked = self._parked, None
         if parked is not None:
@@ -933,7 +1005,8 @@ class ContinuousBatcher:
             "temp": float(temperature), "eos": eos_id, "seed": int(seed),
             "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
             "minp": float(min_p), "stops": stops,
-            "rep": float(repetition_penalty)})
+            "rep": float(repetition_penalty),
+            "t_submit": time.monotonic()})  # TTFT clock starts at submit
         if self._dead is not None:
             # the loop may have died between the check above and the put
             # (its death-drain already ran): fail whatever is queued,
@@ -1200,90 +1273,180 @@ class ContinuousBatcher:
         # last page).  The DRAFT's dense per-row cache shares nothing:
         # it must still see positions [0, shared) or speculation
         # proposes from garbage context — those catch-up chunks run
-        # through the SAME one-chunk-per-loop-iteration state machine
-        # (d_off below), preserving the at-most-one-chunk stall bound.
+        # through the same one-chunk-per-round cadence (d_off below),
+        # preserving the at-most-one-chunk stall bound.
         shared_tokens = (self._row_shared_n[row] * self.kv_page_size
                          if self.kv_page_size else 0)
-        self._admitting = {
-            "row": row, "item": item, "offset": shared_tokens,
+        self._admissions.append({
+            "row": row, "item": item, "offset": shared_tokens, "i": 0,
             "sizes": self._prefill_chunk_sizes(len(prompt) - shared_tokens),
             "d_off": 0, "di": 0,
             "d_sizes": (self._prefill_chunk_sizes(shared_tokens)
                         if shared_tokens and self.draft_model is not None
-                        else [])}
-        self._continue_admission()
+                        else [])})
 
-    def _continue_admission(self):
-        """Run ONE prefill chunk of the admitting prompt (target + draft
-        caches); on the final chunk, pick the first token and occupy the
-        slot.  Between calls the loop keeps stepping in-flight slots, so
-        a long prompt stalls them by at most one chunk's latency."""
+    # ---- batched prefill engine ------------------------------------------
+    # Admission is a PIPELINE, not a one-at-a-time state machine: up to
+    # `prefill_rows` waiting requests each contribute their next chunk to
+    # ONE batched dispatch per round (decode.build_prefill_batch — per-row
+    # row indices / offsets / lengths, bucket-padded to a shared shape so
+    # compile count stays O(log chunk x log rows)).  Rounds interleave
+    # with decode steps under `prefill_budget` tokens (Sarathi-style
+    # stall-free scheduling): the head admission ALWAYS runs so one
+    # over-budget chunk cannot wedge the queue, and decode slots stall by
+    # at most one round's worth of prefill between steps.  Token parity
+    # with the sequential path is exact: chunk boundaries, bucket sizes,
+    # the per-row skip offsets, and the first-token pick are all
+    # unchanged — only the batch width of the prefill dispatch differs.
+
+    def _next_chunk_len(self, adm):
+        """Length of the chunk this admission would run next (draft
+        catch-up chunks count against the budget like any other)."""
+        if adm["di"] < len(adm["d_sizes"]):
+            return adm["d_sizes"][adm["di"]]
+        return adm["sizes"][adm["i"]]
+
+    def _select_prefill(self):
+        """FIFO slice of the admission queue for this round: at most
+        `prefill_rows` entries whose summed next-chunk lengths fit the
+        token budget.  The HEAD is always selected (stall-free rule —
+        budget caps batching, it never blocks progress)."""
+        selected, spent = [], 0
+        for adm in self._admissions:
+            size = self._next_chunk_len(adm)
+            if selected and (len(selected) >= self.prefill_rows
+                             or spent + size > self.prefill_budget):
+                break
+            selected.append(adm)
+            spent += size
+        return selected
+
+    def _sink_page(self):
+        # dense mode has no sink; pad rows are dropped by index anyway,
+        # so any in-range page value works for the batched jit signature
+        return self._sink if self.kv_page_size else 0
+
+    def _prefill_args(self, entries, count_sink=False):
+        """Pad a round's (row, chunk, start) entries to shared bucket
+        shapes and build the device arrays.  Bucket = power-of-2 over the
+        LONGEST chunk (capped at prefill_chunk), width = power-of-2 over
+        the entry count: compile variants stay bounded while short
+        chunks ride along with long ones."""
+        from .models import decode as decode_mod
+
+        longest = max(len(c) for _, c, _ in entries)
+        bucket = _bucket_len(longest, self.prefill_chunk)
+        width = _pow2_width(len(entries))
+        if count_sink and self.kv_page_size:
+            # bucket-padding overshoot of real rows lands in their tail
+            # table entries (the sink past their allocation); pad rows
+            # write their whole bucket through the sink-only table
+            pad = sum(bucket - len(c) for _, c, _ in entries)
+            pad += (width - len(entries)) * bucket
+            if pad:
+                self.counters.inc("kv_sink_writes", pad)
+        return decode_mod.build_prefill_batch(entries, width, bucket,
+                                              self.n_slots)
+
+    def _run_prefill_round(self):
+        """One batched prefill dispatch over the admission queue; on each
+        finishing row, pick the first token and occupy the slot.  Decode
+        keeps stepping between rounds, so in-flight slots stall by at
+        most one budget's worth of prefill latency."""
         import jax.numpy as jnp
 
-        adm = self._admitting
-        item = adm["item"]
+        # cancellation sweep first: a client gone mid-admission must not
+        # occupy a batch lane (or its pages) for the rest of its prompt
+        live = []
+        for adm in self._admissions:
+            item = adm["item"]
+            if item["h"].cancelled.is_set():
+                self._free_row(adm["row"])   # release pages, sink table
+                item["h"]._finish(list(item["prompt"]))
+            else:
+                live.append(adm)
+        self._admissions = live
+        selected = self._select_prefill()
+        if not selected:
+            return
+        # draft catch-up rounds batch separately from main rounds: they
+        # advance the DRAFT cache over prefix-shared positions the target
+        # never re-computes, so the two groups take different jits
+        catchup = [a for a in selected if a["di"] < len(a["d_sizes"])]
+        if catchup:
+            entries = []
+            for adm in catchup:
+                size = adm["d_sizes"][adm["di"]]
+                d_off = adm["d_off"]
+                chunk = adm["item"]["prompt"][d_off:d_off + size]
+                entries.append((adm["row"], chunk, d_off))
+                adm["d_off"] = d_off + size
+                adm["di"] += 1
+            chunks, rows, starts, n_valids = self._prefill_args(entries)
+            _, self._d_cache = self._d_prefill_many(
+                self.draft_params, self._d_cache, chunks, rows, starts,
+                n_valids, jnp.asarray(0, jnp.int32))
+            self.counters.inc("prefill_dispatches")
+            return
+        entries, finishing = [], []
+        for adm in selected:
+            item, off = adm["item"], adm["offset"]
+            size = adm["sizes"][adm["i"]]
+            chunk = item["prompt"][off:off + size]
+            entries.append((adm["row"], chunk, off))
+            adm["offset"] = off + len(chunk)
+            adm["i"] += 1
+            if adm["offset"] >= len(item["prompt"]):
+                finishing.append(adm)
+        chunks, rows, starts, n_valids = self._prefill_args(
+            entries, count_sink=True)
+        sink = jnp.asarray(self._sink_page(), jnp.int32)
+        if self.lora_rank:
+            aidxs = [adm["item"]["aidx"] for adm in selected]
+            aidxs += [0] * (int(rows.shape[0]) - len(aidxs))
+            logits, self._cache = self._prefill_many(
+                self.params, self._lora_banks, self._cache, chunks, rows,
+                starts, n_valids, sink, jnp.asarray(aidxs, jnp.int32))
+        else:
+            logits, self._cache = self._prefill_many(
+                self.params, self._cache, chunks, rows, starts, n_valids,
+                sink)
+        if self.draft_model is not None:
+            # the draft's dense cache mirrors every target chunk (same
+            # rows/offsets; its writes mask at the row's true length)
+            _, self._d_cache = self._d_prefill_many(
+                self.draft_params, self._d_cache, chunks, rows, starts,
+                n_valids, jnp.asarray(0, jnp.int32))
+        self.counters.inc("prefill_dispatches")
+        for i, adm in enumerate(selected):
+            if adm not in finishing:
+                continue
+            self._admissions.remove(adm)
+            self._finish_admission(adm, logits[i])
+
+    def _finish_admission(self, adm, logits_row):
+        """Final chunk done: pick the first token (exact solo parity),
+        record TTFT, and occupy the row for decode."""
+        import jax.numpy as jnp
+
+        item, row = adm["item"], adm["row"]
         h, prompt, max_new = item["h"], item["prompt"], item["max_new"]
         temp, eos_id, seed = item["temp"], item["eos"], item["seed"]
         aidx = item["aidx"]
-        row, off = adm["row"], adm["offset"]
-        if h.cancelled.is_set():
-            self._admitting = None
-            self._free_row(row)     # mid-admission cancel: release pages
-            h._finish(list(prompt))
-            return
-        if adm["di"] < len(adm["d_sizes"]):
-            # draft catch-up over the prefix-shared region: one chunk
-            # per loop iteration, like every other admission step
-            size = adm["d_sizes"][adm["di"]]
-            d_off = adm["d_off"]
-            chunk = prompt[d_off:d_off + size]
-            bucket = min(max(8, 1 << (len(chunk) - 1).bit_length()),
-                         self.prefill_chunk)
-            padded = chunk + [0] * (bucket - len(chunk))
-            _, self._d_cache = self._d_prefill(
-                self.draft_params, self._d_cache,
-                jnp.asarray([padded], jnp.int32),
-                jnp.asarray(row, jnp.int32),
-                jnp.asarray(d_off, jnp.int32),
-                jnp.asarray(len(chunk), jnp.int32))
-            adm["d_off"] = d_off + size
-            adm["di"] += 1
-            return
-        size = adm["sizes"][adm.get("i", 0)]
-        chunk = prompt[off:off + size]
-        bucket = min(max(8, 1 << (len(chunk) - 1).bit_length()),
-                     self.prefill_chunk)
-        if self.kv_page_size and bucket > len(chunk):
-            # bucket-padding overshoot lands in the row's tail table
-            # entries — the sink when past its allocation
-            self.counters.inc("kv_sink_writes", bucket - len(chunk))
-        padded = chunk + [0] * (bucket - len(chunk))
-        args = (jnp.asarray([padded], jnp.int32),
-                jnp.asarray(row, jnp.int32), jnp.asarray(off, jnp.int32),
-                jnp.asarray(len(chunk), jnp.int32))
-        if self.lora_rank:
-            logits, self._cache = self._prefill(
-                self.params, self._lora_banks, self._cache, *args,
-                jnp.asarray(aidx, jnp.int32))
-        else:
-            logits, self._cache = self._prefill(self.params, self._cache,
-                                                *args)
-        if self.draft_model is not None:
-            _, self._d_cache = self._d_prefill(self.draft_params,
-                                               self._d_cache, *args)
-        adm["offset"] = off + len(chunk)
-        adm["i"] = adm.get("i", 0) + 1
-        if adm["offset"] < len(prompt):
-            return                       # more chunks to go
-        self._admitting = None
         if self.kv_page_size:
             # this row's full-prefix pages now hold computed kv: publish
             # them so later identical prompts skip their prefill
             self._register_prefix_pages(row)
         topk, topp, minp = item["topk"], item["topp"], item["minp"]
         stops, rep = item["stops"], item["rep"]
-        tok = self._pick_first(logits[0], temp, seed, topk, topp, minp,
+        tok = self._pick_first(logits_row, temp, seed, topk, topp, minp,
                                rep, prompt)
+        # TTFT: clock runs from submit() to the instant the first token
+        # becomes pullable (picked on the driver thread, so the record
+        # needs no lock beyond LatencyWindow's own)
+        t0 = item.get("t_submit")
+        if t0 is not None:
+            self._ttft.record(time.monotonic() - t0)
         h.tokens.put(tok)
         seq = prompt + [tok]
         if (max_new <= 1 or (eos_id is not None and tok == eos_id)
@@ -1319,35 +1482,45 @@ class ContinuousBatcher:
                             "pen": penalized}
 
     def _admit(self, block=False):
+        """Pull waiting requests into the admission pipeline until it is
+        `prefill_rows` wide (or rows/requests run out).  Mid-prefill
+        admissions hold their row via `claimed` — a row is free only
+        when no slot occupies it AND no admission is prefilling it."""
         import queue as queue_mod
 
-        if self._admitting is not None:
-            self._continue_admission()
-            return
+        claimed = {adm["row"] for adm in self._admissions}
+
+        def _free_row_index():
+            return next((r for r in range(self.n_slots)
+                         if self._slots[r] is None and r not in claimed),
+                        None)
+
         if self._parked is not None:
             # a pool-starved admission waits at the head of the line;
             # retirement may have freed its pages by now
             row, item = self._parked
             self._parked = None
-            if self._slots[row] is not None:   # row was never occupied,
-                row = next((r for r in range(self.n_slots)   # but be safe
-                            if self._slots[r] is None), None)
+            if self._slots[row] is not None or row in claimed:
+                row = _free_row_index()    # original row got taken
                 if row is None:
                     self._parked = (0, item)
                     return
             self._start_admission(row, item)
-            if self._admitting is not None or self._parked is not None:
+            if self._parked is not None:
+                return      # still starved: FIFO — nothing else admits
+            claimed.add(row)
+        while len(self._admissions) < self.prefill_rows:
+            row = _free_row_index()
+            if row is None:
                 return
-        for row in range(self.n_slots):
-            if self._slots[row] is not None:
-                continue
             try:
                 item = self._pending.get(timeout=0.05 if block else 0)
             except queue_mod.Empty:
                 return
             self._start_admission(row, item)
-            if self._admitting is not None or self._parked is not None:
-                return    # chunked admission in progress: one at a time
+            if self._parked is not None:
+                return      # pool starved: later arrivals wait (FIFO)
+            claimed.add(row)
             block = False    # only the first admit may block (idle wake)
 
     def _process_batch(self, batch):
@@ -1472,10 +1645,14 @@ class ContinuousBatcher:
             inflight = None  # previous chunk, host copy in progress
             while not self._stop.is_set():
                 idle = (all(s is None for s in self._slots)
-                        and self._admitting is None
+                        and not self._admissions
                         and self._parked is None
                         and not reads and inflight is None)
                 self._admit(block=idle)
+                # one batched prefill round per loop iteration: up to
+                # prefill_rows admissions advance one chunk each, then
+                # decode steps below — the budget bounds the stall
+                self._run_prefill_round()
                 active = any(s is not None for s in self._slots)
                 if active:
                     reads.append(self._dispatch())
@@ -1510,8 +1687,8 @@ class ContinuousBatcher:
         except BaseException as e:     # device failure: fail everything
             logger.exception("continuous batcher died")
             self._dead = e
-            adm, self._admitting = self._admitting, None
-            if adm is not None:
+            adms, self._admissions = self._admissions, []
+            for adm in adms:
                 adm["item"]["h"]._fail(e)
             parked, self._parked = self._parked, None
             if parked is not None:
@@ -1592,7 +1769,8 @@ class GenerateService:
 
     def __init__(self, export_dir, max_new_tokens_limit=512,
                  draft_export_dir=None, draft_k=4, slots=8, read_chunk=8,
-                 prefill_chunk=512, request_timeout_s=None,
+                 prefill_chunk=512, prefill_rows=4, prefill_budget=0,
+                 request_timeout_s=None,
                  kv_page_size=0, kv_pages=0, quantize_mode="none",
                  lora_rank=0, lora_capacity=8, lora_adapters=None,
                  kv_dtype="auto", paged_attn_impl=None):
@@ -1614,6 +1792,7 @@ class GenerateService:
         self.batcher = ContinuousBatcher(
             self.model, self.params, n_slots=slots or 8,
             read_chunk=read_chunk, prefill_chunk=prefill_chunk,
+            prefill_rows=prefill_rows, prefill_budget=prefill_budget,
             draft_model=draft_model, draft_params=draft_params,
             draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages,
             lora_rank=lora_rank, lora_capacity=lora_capacity,
@@ -1923,6 +2102,12 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
         raise ValueError("--generate_lora_rank does not compose with "
                          "--draft_export_dir (speculative verify has no "
                          "per-row adapters yet)")
+    if getattr(args, "generate_prefill_rows", 4) < 1:
+        raise ValueError("--generate_prefill_rows must be >= 1 "
+                         "(1 = sequential admission)")
+    if getattr(args, "generate_prefill_budget", 0) < 0:
+        raise ValueError("--generate_prefill_budget must be >= 0 "
+                         "(0 = prefill_rows * prefill_chunk)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
 
@@ -1963,6 +2148,9 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
         features["quantize"] = args.generate_quantize
     if getattr(args, "generate_lora_rank", 0):
         features["lora_rank"] = args.generate_lora_rank
+    # admission pipeline width: fleet dashboards read it next to slots
+    features["prefill_rows"] = getattr(args, "generate_prefill_rows",
+                                       4) or 4
     return fleet_client.register_replica(
         (ghost, int(gport)),
         args.advertise_host or args.host,

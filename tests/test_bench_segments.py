@@ -1,0 +1,61 @@
+"""bench.py segment registry: --list-segments and setup dry-runs.
+
+Tier-1 guard for the benchmark harness itself: every SEGMENTS entry
+must import, expose a well-formed registry row, and dry-run its setup
+on CPU (catching renamed symbols or broken configs long before a TPU
+run).  The off-TPU ``--segments`` path must stay a clean skip (exit 0)
+so CI can always invoke the harness.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", ROOT / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_segment_registry_shape_and_setup_dry_run():
+    bench = _load_bench()
+    assert bench.SEGMENTS, "segment registry must not be empty"
+    assert "ttft_ms" in bench.SEGMENTS
+    for name, entry in bench.SEGMENTS.items():
+        assert set(entry) == {"run", "setup", "help"}, name
+        assert callable(entry["run"]), name
+        assert callable(entry["setup"]), name
+        assert isinstance(entry["help"], str) and entry["help"], name
+        # the dry-run: imports the segment's symbols and validates its
+        # frozen config without touching an accelerator
+        info = entry["setup"]()
+        assert isinstance(info, dict) and info, name
+
+
+def test_list_segments_subprocess_matches_registry():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py"), "--list-segments"],
+        capture_output=True, text=True, env=env, timeout=120, check=True)
+    lines = [json.loads(line) for line in out.stdout.splitlines() if line]
+    bench = _load_bench()
+    assert {row["segment"] for row in lines} == set(bench.SEGMENTS)
+    for row in lines:
+        assert row["help"] == bench.SEGMENTS[row["segment"]]["help"]
+
+
+def test_segments_main_skips_cleanly_off_tpu(capsys):
+    bench = _load_bench()
+    rc = bench.segments_main()
+    assert rc == 0
+    lines = [json.loads(line)
+             for line in capsys.readouterr().out.splitlines() if line]
+    assert {row["metric"] for row in lines} == set(bench.SEGMENTS)
+    # CPU run: every segment reports a skip, none attempts a benchmark
+    assert all(row.get("skipped") for row in lines)

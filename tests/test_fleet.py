@@ -75,7 +75,9 @@ class StubReplica:
                                       "slots_busy": stub.in_flight,
                                       "pending": 0,
                                       "prefill_tokens_shared": 7,
-                                      "prefix_pages_cached": 3}}})
+                                      "prefix_pages_cached": 3,
+                                      "ttft_count": 4,
+                                      "ttft_ms_sum": 100.0}}})
                 else:
                     self._send(404, {"error": self.path})
 
@@ -194,6 +196,11 @@ def test_registration_fleet_stats_and_bye(gateway):
     assert body["totals"]["slots"] == 4
     assert body["totals"]["prefill_tokens_shared"] == 14
     assert body["totals"]["prefix_pages_cached"] == 6
+    # TTFT: count/sum SUM across replicas; the average is recomputed
+    # from the fleet-wide sums (per-replica percentiles never sum)
+    assert body["totals"]["ttft_count"] == 8
+    assert body["totals"]["ttft_ms_sum"] == pytest.approx(200.0)
+    assert body["totals"]["ttft_avg_ms"] == pytest.approx(25.0)
     assert body["counters"]["registrations"] == 2
     assert body["gateway"]["prefix_tokens"] == 4   # adopted kv_page_size
     # BYE drops the replica immediately (no heartbeat wait)

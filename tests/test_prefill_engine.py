@@ -1,0 +1,311 @@
+"""Batched multi-row prefill engine (admission pipeline).
+
+The continuous batcher admits up to ``prefill_rows`` waiting requests
+per batched prefill dispatch, interleaved with decode steps under a
+Sarathi-style token budget.  The correctness bar is EXACT token parity
+with the sequential (prefill_rows=1) admission path — and with solo
+``decode.generate`` — across paged/dense caches, greedy/sampled
+requests, and prefix-cache hits.
+
+Fast tier: scheduler/bucketing unit tests on plain namespaces (no
+model builds).  Slow tier (``@pytest.mark.slow``): burst parity and
+accounting over real engines.
+"""
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import metrics, serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, temperature=0.0, seed=0):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None))
+    return np.asarray(out)[0].tolist()
+
+
+# ---------------------------------------------------------------- fast --
+
+
+def test_bucket_len_and_width_are_bounded_powers_of_two():
+    for n in range(1, 513):
+        b = serve._bucket_len(n, 512)
+        assert n <= b <= 512
+        assert b & (b - 1) == 0                 # power of two
+        assert b == 8 or b < 2 * n              # pad waste under 2x
+    assert serve._bucket_len(3, 512) == 8       # floor
+    assert serve._bucket_len(512, 512) == 512   # cap
+    for n in range(1, 65):
+        w = serve._pow2_width(n)
+        assert n <= w < 2 * n or (n == 1 and w == 1)
+        assert w & (w - 1) == 0
+
+
+def test_prefill_chunk_sizes_cover_prompt_exactly():
+    ns = types.SimpleNamespace(prefill_chunk=16)
+    split = serve.ContinuousBatcher._prefill_chunk_sizes
+    for length in range(1, 100):
+        sizes = split(ns, length)
+        assert sum(sizes) == length             # every token exactly once
+        assert all(0 < s <= 16 for s in sizes)  # no chunk over the cap
+        assert all(s == 16 for s in sizes[:-1])  # full chunks, then tail
+        # the tail dispatches into a power-of-2 bucket within the cap
+        tail = serve._bucket_len(sizes[-1], 16)
+        assert tail >= sizes[-1] and tail & (tail - 1) == 0
+
+
+def test_aligned_prefill_chunk_rounds_up_to_page_multiple():
+    assert serve._aligned_prefill_chunk(12, 8) == 16    # misaligned: up
+    assert serve._aligned_prefill_chunk(12, 0) == 12    # dense: as-is
+    assert serve._aligned_prefill_chunk(8, 8) == 8      # aligned: as-is
+    assert serve._aligned_prefill_chunk(512, 8) == 512
+    assert serve._aligned_prefill_chunk(2, 0) == 8      # floor 8
+    assert serve._aligned_prefill_chunk(9, 8) == 16
+
+
+def _adm(row, sizes, d_sizes=()):
+    return {"row": row, "item": None, "offset": 0, "i": 0,
+            "sizes": list(sizes), "d_off": 0, "di": 0,
+            "d_sizes": list(d_sizes)}
+
+
+def _scheduler(rows, budget, admissions):
+    ns = types.SimpleNamespace(prefill_rows=rows, prefill_budget=budget,
+                               _admissions=admissions)
+    ns._next_chunk_len = types.MethodType(
+        serve.ContinuousBatcher._next_chunk_len, ns)
+    return types.MethodType(serve.ContinuousBatcher._select_prefill, ns)
+
+
+def test_select_prefill_budget_and_head_rule():
+    # the HEAD always runs, even when its chunk alone exceeds the budget
+    # (stall-free rule: the budget caps batching, never blocks progress)
+    select = _scheduler(4, 16, [_adm(0, [64]), _adm(1, [8])])
+    assert [a["row"] for a in select()] == [0]
+    # FIFO fill until the budget would be exceeded
+    select = _scheduler(4, 16, [_adm(0, [8]), _adm(1, [8]), _adm(2, [8])])
+    assert [a["row"] for a in select()] == [0, 1]
+    # prefill_rows caps the batch even under a huge budget
+    select = _scheduler(4, 10**6, [_adm(r, [4]) for r in range(6)])
+    assert [a["row"] for a in select()] == [0, 1, 2, 3]
+    # draft catch-up chunks charge the budget like any other
+    select = _scheduler(4, 16, [_adm(0, [8], d_sizes=[16]), _adm(1, [8])])
+    assert [a["row"] for a in select()] == [0]
+
+
+def test_build_prefill_batch_pads_and_rejects_duplicates():
+    chunks, rows, starts, n_valids = decode.build_prefill_batch(
+        [(2, [5, 6, 7], 4), (0, [9], 0)], width=4, bucket=8, n_slots=8)
+    assert chunks.shape == (4, 8)
+    # pad rows take index n_slots: OOB by construction, so their
+    # writebacks scatter-drop and the jit swaps in the sink page table
+    assert rows.tolist() == [2, 0, 8, 8]
+    assert starts.tolist() == [4, 0, 0, 0]
+    assert n_valids.tolist() == [3, 1, 1, 1]
+    assert chunks[0].tolist() == [5, 6, 7, 0, 0, 0, 0, 0]
+    with pytest.raises(AssertionError, match="duplicate"):
+        # the paged pool write SUMS over batch rows: a duplicated row
+        # would double-write its pages
+        decode.build_prefill_batch([(1, [1], 0), (1, [2], 0)], 2, 8, 8)
+
+
+def test_latency_window_percentiles_and_monotone_sums():
+    w = metrics.LatencyWindow(window=4)
+    zero = w.stats("ttft")
+    assert zero == {"ttft_count": 0, "ttft_ms_sum": 0.0,
+                    "ttft_avg_ms": 0.0, "ttft_p50_ms": 0.0,
+                    "ttft_p95_ms": 0.0}
+    for ms in (10, 20, 30, 40, 50):
+        w.record(ms / 1000.0)
+    s = w.stats("ttft")
+    # count/sum stay monotone over ALL samples (fleet-summable) ...
+    assert s["ttft_count"] == 5
+    assert s["ttft_ms_sum"] == pytest.approx(150.0)
+    assert s["ttft_avg_ms"] == pytest.approx(30.0)
+    # ... while percentiles read the bounded window (last 4 samples)
+    assert s["ttft_p50_ms"] == pytest.approx(40.0)
+    assert s["ttft_p95_ms"] == pytest.approx(50.0)
+
+
+def test_stats_exposes_pipeline_and_ttft_keys(model_and_params):
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, prefill_rows=3,
+                                prefill_budget=64)
+    try:
+        s = b.stats()
+        assert s["prefill_rows"] == 3
+        assert s["prefill_budget"] == 64
+        assert s["admitting"] is False
+        assert s["admissions_inflight"] == 0
+        for key in ("ttft_count", "ttft_ms_sum", "ttft_avg_ms",
+                    "ttft_p50_ms", "ttft_p95_ms"):
+            assert key in s
+        assert s["ttft_count"] == 0
+    finally:
+        b.stop()
+
+
+def test_prefill_budget_defaults_to_rows_times_chunk(model_and_params):
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, prefill_rows=2,
+                                prefill_chunk=16)
+    try:
+        assert b.prefill_budget == 2 * 16
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------- slow --
+
+# the acceptance burst: >= 6 mixed prompts — greedy + sampled-seeded,
+# varied lengths, and (paged) a prefix-cache hit via the warm prompt
+_WARM = list(range(1, 19))                       # 18 tokens = 2 full pages
+_BURST = [
+    (_WARM, 3, 0.0, 0),                          # prefix hit when paged
+    ([1, 2, 3, 4, 5], 4, 0.0, 0),
+    ([9, 8, 7], 4, 0.9, 13),                     # sampled, seeded
+    ([5, 4, 3, 2, 1, 6, 7], 3, 0.0, 0),
+    ([2, 3, 2, 3], 4, 0.7, 5),                   # sampled, seeded
+    (list(range(10, 19)), 3, 0.0, 0),
+    ([4, 5], 5, 0.0, 0),
+]
+
+
+def _run_burst(model, params, rows, **kwargs):
+    b = serve.ContinuousBatcher(model, params, n_slots=4, read_chunk=2,
+                                prefill_rows=rows, **kwargs)
+    try:
+        assert b.submit(_WARM, 3).result(timeout=300)  # warm prefix cache
+        handles = [b.submit(p, n, temperature=t, seed=s)
+                   for p, n, t, s in _BURST]            # one true burst
+        outs = [h.result(timeout=300) for h in handles]
+        stats = b.stats()
+    finally:
+        b.stop()
+    return outs, stats
+
+
+@pytest.mark.slow
+def test_burst_parity_batched_vs_sequential_paged(model_and_params):
+    model, params = model_and_params
+    # prefill_chunk=12 is page-misaligned on purpose: startup rounds it
+    # to 16 and the whole burst runs on the corrected chunk
+    paged = dict(prefill_chunk=12, kv_page_size=8, kv_pages=20)
+    outs4, s4 = _run_burst(model, params, 4, **paged)
+    outs1, s1 = _run_burst(model, params, 1, **paged)
+    assert outs4 == outs1                        # byte-identical streams
+    for (p, n, t, s), got in zip(_BURST, outs4):
+        assert got == _solo(model, params, p, n, temperature=t, seed=s)
+    # every request's TTFT was recorded (warm + burst), in both modes
+    assert s4["ttft_count"] == len(_BURST) + 1
+    assert s1["ttft_count"] == len(_BURST) + 1
+    assert s4["ttft_ms_sum"] > 0
+    assert s4["prefill_dispatches"] >= 1
+    # batched admission needs no more dispatches than one-per-chunk
+    assert s4["prefill_dispatches"] <= s1["prefill_dispatches"]
+
+
+@pytest.mark.slow
+def test_burst_parity_batched_vs_sequential_dense(model_and_params):
+    model, params = model_and_params
+    dense = dict(prefill_chunk=8)
+    outs4, s4 = _run_burst(model, params, 4, **dense)
+    outs1, _ = _run_burst(model, params, 1, **dense)
+    assert outs4 == outs1
+    for (p, n, t, s), got in zip(_BURST, outs4):
+        assert got == _solo(model, params, p, n, temperature=t, seed=s)
+    assert s4["ttft_count"] == len(_BURST) + 1
+
+
+@pytest.mark.slow
+def test_chunk_alignment_applied_at_startup(model_and_params):
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, prefill_chunk=12,
+                                kv_page_size=8, kv_pages=8)
+    try:
+        assert b.prefill_chunk == 16             # rounded UP to a page
+        assert b.prefill_budget == b.prefill_rows * 16
+    finally:
+        b.stop()
+    b = serve.ContinuousBatcher(model, params, n_slots=2, prefill_chunk=12)
+    try:
+        assert b.prefill_chunk == 12             # dense: no page to align
+    finally:
+        b.stop()
+
+
+@pytest.mark.slow
+def test_prefix_accounting_exact_under_batched_admission(model_and_params):
+    # satellite: prefill_tokens_shared stays EXACT under the batched
+    # path — the repeated 18-token prompt shares exactly its 2 full
+    # pages (16 tokens; the last page must re-run for first-token
+    # logits)
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=2,
+                                prefill_rows=4, kv_page_size=8,
+                                kv_pages=8)
+    try:
+        prompt = list(range(1, 19))
+        want = _solo(model, params, prompt, 5)
+        assert b.submit(prompt, 5).result(timeout=300) == want
+        assert b.stats()["prefix_pages_cached"] == 2
+        shared_before = b.prefill_tokens_shared
+        assert b.submit(prompt, 5).result(timeout=300) == want
+        assert b.prefill_tokens_shared == shared_before + 16
+    finally:
+        b.stop()
+
+
+@pytest.mark.slow
+def test_pipeline_admits_multiple_rows_concurrently(model_and_params):
+    # the pipeline actually overlaps admissions: with long prompts and a
+    # small chunk, a simultaneous burst must pass through a state where
+    # more than one admission is in flight
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=4, read_chunk=2,
+                                prefill_chunk=8, prefill_rows=4)
+    try:
+        prompts = [[(i + j) % 60 + 1 for j in range(20)] for i in range(4)]
+        peak = [0]
+        stop = threading.Event()
+
+        def sample():
+            while not stop.is_set():
+                peak[0] = max(peak[0], b.stats()["admissions_inflight"])
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+        try:
+            handles = [b.submit(p, 2) for p in prompts]
+            outs = [h.result(timeout=300) for h in handles]
+        finally:
+            stop.set()
+            sampler.join(timeout=10)
+        for p, got in zip(prompts, outs):
+            assert got == _solo(model, params, p, 2)
+        assert peak[0] >= 2
+    finally:
+        b.stop()
