@@ -35,6 +35,10 @@ Round 8 adds the `ttft_ms` segment: burst time-to-first-token through
 the batched admission pipeline (benchmarks.make_prefill_burst,
 prefill_rows=4) vs the sequential baseline (prefill_rows=1), plus
 `--list-segments` so CI can discover the registry without a TPU.
+Round 9 adds the `engine_tps` segment: sustained decode tokens/s
+through the full continuous batcher (benchmarks.make_engine_burst) —
+the async double-buffered engine vs the serialized single-thread loop,
+with the device-idle fraction and pipeline-depth peak in aux.
 
 On a device whose bf16 peak is unknown (not in benchmarks.PEAK_BF16) the
 metric falls back to tokens/sec — an MFU percent against a guessed peak
@@ -201,6 +205,39 @@ def bench_ttft_segment(reps=3, result_timeout=600):
     return timed(FLAGSHIP_PREFILL["prefill_rows"]), timed(1)
 
 
+def bench_engine_segment(reps=3, result_timeout=600):
+    """The engine segment: sustained decode tokens/s through the FULL
+    ContinuousBatcher (benchmarks.make_engine_burst / FLAGSHIP_ENGINE)
+    — admission, dispatch, readback, stream delivery — async
+    double-buffered pipeline vs the serialized single-thread baseline.
+    Per engine: one warmup burst pays the compiles, then best
+    tokens/s of the remaining bursts from wall clock (generated tokens
+    only).  Returns (async_tps, serial_tps, stats) where ``stats`` holds
+    the async engine's device_idle_fraction and pipeline_depth_peak."""
+    from tensorflowonspark_tpu.benchmarks import make_engine_burst
+
+    def timed(engine):
+        batcher, prompts, max_new = make_engine_burst(engine=engine)
+        try:
+            best = 0.0
+            for rep in range(max(2, reps)):
+                t0 = time.perf_counter()
+                handles = [batcher.submit(p, max_new) for p in prompts]
+                total = sum(len(h.result(timeout=result_timeout)) - len(p)
+                            for h, p in zip(handles, prompts))
+                tps = total / (time.perf_counter() - t0)
+                if rep:              # burst 0 is the compile warmup
+                    best = max(best, tps)
+            stats = batcher.stats()
+        finally:
+            batcher.stop()
+        return best, stats
+
+    async_tps, astats = timed("async")
+    serial_tps, _ = timed("serial")
+    return async_tps, serial_tps, astats
+
+
 def _opt_segment_setup():
     """Cheap, CPU-safe registry smoke: the segment's builders and frozen
     config resolve without building the 0.87B model or touching a
@@ -261,6 +298,29 @@ def _ttft_segment_result():
                         sequential_ms / batched_ms, 2)}}
 
 
+def _engine_segment_setup():
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_ENGINE,
+                                                  make_engine_burst)
+
+    assert callable(make_engine_burst)
+    d = FLAGSHIP_ENGINE
+    assert d["prompt_len"] + d["max_new"] <= d["max_seq"]
+    assert d["max_new"] > d["prompt_len"]  # decode-dominated by design
+    return {"config": dict(d)}
+
+
+def _engine_segment_result():
+    async_tps, serial_tps, astats = bench_engine_segment()
+    return {"metric": "engine_tps", "value": round(async_tps, 1),
+            "unit": "tokens/s",
+            "aux": {"engine_tps_serial": round(serial_tps, 1),
+                    "speedup_vs_serial": round(async_tps / serial_tps, 2),
+                    "device_idle_fraction":
+                        astats.get("device_idle_fraction", 0.0),
+                    "pipeline_depth_peak":
+                        astats.get("pipeline_depth_peak", 0)}}
+
+
 # segment registry: every entry shares the off-TPU skip + one-JSON-line-
 # per-segment protocol, so growing a segment is one row (the old
 # hardcoded opt_ms plumbing could not be reused).  Each entry carries:
@@ -285,6 +345,11 @@ SEGMENTS = {
         "setup": _ttft_segment_setup,
         "help": "burst time-to-first-token through the admission "
                 "pipeline (batched multi-row prefill vs sequential)"},
+    "engine_tps": {
+        "run": _engine_segment_result,
+        "setup": _engine_segment_setup,
+        "help": "sustained decode tokens/s through the full continuous "
+                "batcher (async double-buffered engine vs serialized loop)"},
 }
 
 

@@ -367,12 +367,13 @@ def main(argv=None):
     # Importing the rule modules populates REGISTRY; done here so embedding
     # code can import core without pulling every analyzer.
     from tensorflowonspark_tpu.analysis import (  # noqa
-        locks, pallas_tiles, shardlint, style, tracer)
+        hostsync, locks, pallas_tiles, shardlint, style, tracer)
 
     ap = argparse.ArgumentParser(
         prog="graftcheck",
         description="JAX/TPU-aware stdlib static analysis (tracer hazards, "
-                    "sharding lint, Pallas tile checks, lock discipline, style).")
+                    "sharding lint, Pallas tile checks, lock discipline, "
+                    "hot-path host-sync checks, style).")
     ap.add_argument("paths", nargs="*", help="files or directories "
                     f"(default: {' '.join(DEFAULT_PATHS)})")
     ap.add_argument("--json", action="store_true", dest="as_json",

@@ -1,0 +1,156 @@
+"""Host-sync analyzer: device->host synchronization inside annotated
+serving hot paths.
+
+The async decode engine (``serve.ContinuousBatcher``) splits work across
+a device thread (dispatch, keeps >=2 steps in flight) and a host thread
+(drains readback chunks).  The whole point of the split is that the
+device thread NEVER blocks on device values: a stray
+``block_until_ready()``, ``.item()``, ``float(x)`` or ``np.asarray(x)``
+in the dispatch path serializes the pipeline back into the single-thread
+engine this PR replaced — silently, with no test failure, just a
+throughput regression.  This rule machine-enforces the invariant.
+
+Unlike the tracer rules (which find jit-staged functions by decorator),
+the hot path is *host* code: there is nothing syntactic to key off, so
+functions opt in with a marker comment on (or directly above) the
+``def`` line::
+
+    def _dispatch(self):  # graftcheck: hotpath
+        ...
+
+Inside a marked function the rule flags
+
+- ``.block_until_ready()`` / ``.item()`` / ``.tolist()`` / ``.numpy()``
+  / ``.to_py()`` method calls (explicit host syncs),
+- ``np.asarray(...)`` and friends (implicit ``__array__`` sync),
+- ``float()`` / ``int()`` / ``bool()`` / ``complex()`` on anything not
+  provably static (shape/dtype/len chains and literals are exempt —
+  ``int(rows.shape[0])`` is metadata, not a readback).
+
+``copy_to_host_async`` is deliberately NOT flagged: it is the
+non-blocking transfer the engine is built around.  Nested functions
+inherit the enclosing marker (a closure defined in the hot path runs in
+the hot path).  Escape hatch for a justified sync: the standard
+``# graftcheck: disable=hostsync`` suppression on the offending line.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Rule, register
+from .tracer import _CAST_FNS, _HOST_METHODS, _NUMPY_FORCERS, _NUMPY_ROOTS, _call_name
+
+_HOTPATH_RE = re.compile(r"#\s*graftcheck:\s*hotpath\b")
+
+# Blocking syncs beyond tracer.py's _HOST_METHODS; copy_to_host_async is
+# the sanctioned non-blocking cousin and stays legal.
+_SYNC_METHODS = _HOST_METHODS | {"block_until_ready"}
+
+# Attribute chains that read array *metadata* (host-resident already, no
+# device sync) — int(x.shape[0]) and friends are exempt.
+_META_ATTRS = {"shape", "ndim", "size", "dtype"}
+# Builtins whose result is a plain Python value regardless of argument.
+_STATIC_FNS = {"len", "range", "min", "max", "sum", "round", "ord", "id"}
+
+
+def _is_static(node):
+    """True when ``node`` provably evaluates to a host-side Python value
+    (so casting it is free).  Conservative: a bare name could hold
+    anything, so it is NOT static — in a marked hot path the burden of
+    proof is on the code."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _META_ATTRS or _is_static(node.value)
+    if isinstance(node, ast.Subscript):
+        return _is_static(node.value)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_static(node.left) and _is_static(node.right)
+    if isinstance(node, ast.Call):
+        name = _call_name(node.func)
+        base = name.split(".")[-1] if name else None
+        return base in _STATIC_FNS
+    return False
+
+
+class _HotpathWalker(ast.NodeVisitor):
+    def __init__(self, ctx, fn):
+        self.ctx = ctx
+        self.fn = fn
+        self.findings = []
+
+    def _flag(self, node, msg):
+        self.findings.append(Finding(self.ctx.path, node.lineno,
+                                     "hostsync", msg))
+
+    def visit_Call(self, node):
+        name = _call_name(node.func)
+        base = name.split(".")[-1] if name else None
+
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+            self._flag(node,
+                       f".{node.func.attr}() blocks on a device value inside "
+                       f"hot path '{self.fn.name}'; move the sync to the host "
+                       "thread (or use copy_to_host_async)")
+        elif (name is not None and "." in name
+              and name.split(".")[0] in _NUMPY_ROOTS
+              and base in _NUMPY_FORCERS):
+            self._flag(node,
+                       f"{name}() forces a synchronous device->host copy "
+                       f"inside hot path '{self.fn.name}'; keep the array on "
+                       "device and convert in the host thread")
+        elif (base in _CAST_FNS and name == base and node.args
+              and not all(_is_static(a) for a in node.args)):
+            self._flag(node,
+                       f"{base}() on a possibly-device value inside hot path "
+                       f"'{self.fn.name}' forces a blocking readback; shape/"
+                       "dtype metadata is exempt, device values are not")
+        self.generic_visit(node)
+
+    # Closures defined inside a hot path run inside the hot path.
+    def visit_FunctionDef(self, node):
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _is_marked(ctx, fn):
+    """Marker on the ``def`` line itself or the line directly above
+    (which may also be a decorator line — both read naturally)."""
+    for lineno in (fn.lineno, fn.lineno - 1):
+        if 1 <= lineno <= len(ctx.lines) and _HOTPATH_RE.search(ctx.lines[lineno - 1]):
+            return True
+    return False
+
+
+@register
+class HostSyncRule(Rule):
+    name = "hostsync"
+    description = ("blocking device sync (block_until_ready/.item()/float()/"
+                   "np.asarray) inside a '# graftcheck: hotpath' function")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        marked = [node for node in ast.walk(ctx.tree)
+                  if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and _is_marked(ctx, node)]
+        # A function nested inside a marked function is already covered by
+        # the closure walk — walking it again would double-report.
+        nested = set()
+        for fn in marked:
+            for sub in ast.walk(fn):
+                if sub is not fn and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested.add(id(sub))
+        for fn in marked:
+            if id(fn) in nested:
+                continue
+            w = _HotpathWalker(ctx, fn)
+            for stmt in fn.body:
+                w.visit(stmt)
+            yield from w.findings

@@ -170,6 +170,61 @@ def make_prefill_burst(prefill_rows=None, n_slots=None, prompts=None,
     return batcher, prompts_list, max_new
 
 
+# The engine_tps segment workload (bench.py --segments): sustained decode
+# through the FULL ContinuousBatcher — admission, dispatch, readback,
+# stream delivery — not a bare step microbench.  Short prompts + long
+# generations so steady-state decode dominates and the segment measures
+# the engine's host/device overlap (async double-buffered loop vs the
+# serialized baseline), the exact path decode_ms cannot see.  Frozen like
+# FLAGSHIP_PREFILL: changing any value invalidates engine_tps
+# comparability.
+FLAGSHIP_ENGINE = dict(n_slots=8, prompts=16, prompt_len=64, max_new=96,
+                       prefill_chunk=256, prefill_rows=4, max_seq=256)
+
+
+def make_engine_burst(engine="async", n_slots=None, prompts=None,
+                      prompt_len=None, max_new=None, prefill_chunk=None,
+                      prefill_rows=None, max_seq=None, pipeline_depth=2):
+    """Build the engine_tps segment workload: a ContinuousBatcher on the
+    flagship-LM dims running the requested ``engine`` ("async" = the
+    double-buffered producer/consumer pipeline, "serial" = the
+    single-thread dispatch/process baseline) plus the prompt burst to
+    submit.  Returns ``(batcher, prompts_list, max_new)``; the caller
+    submits the burst, drains every handle, and computes tokens/s from
+    wall clock (device-idle fraction comes from ``batcher.stats()``).
+    Caller must ``batcher.stop()``.  Prompts are distinct random garbage
+    for the same reasons as :func:`make_prefill_burst`."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_ENGINE
+    n_slots = n_slots or d["n_slots"]
+    n_prompts = prompts or d["prompts"]
+    prompt_len = prompt_len or d["prompt_len"]
+    max_new = max_new or d["max_new"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    rows = d["prefill_rows"] if prefill_rows is None else prefill_rows
+    max_seq = max_seq or d["max_seq"]
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    batcher = serve_mod.ContinuousBatcher(
+        model, params, n_slots=n_slots, read_chunk=4, prefill_chunk=chunk,
+        prefill_rows=rows, engine=engine, pipeline_depth=pipeline_depth)
+    rs = np.random.RandomState(0)
+    prompts_list = [rs.randint(1, cfg.vocab_size,
+                               prompt_len).astype("int32").tolist()
+                    for _ in range(n_prompts)]
+    return batcher, prompts_list, max_new
+
+
 def make_flagship_step(batch_size=None, seq_len=None, config="v2",
                        optimizer=None):
     """Build the flagship-LM training step exactly as the driver metric

@@ -415,7 +415,8 @@ class Gateway:
                   "prefill_tokens_shared": 0, "prefix_pages_cached": 0,
                   "kv_pages_used": 0, "kv_pages_free": 0,
                   "kv_sink_writes": 0,
-                  "ttft_count": 0, "ttft_ms_sum": 0.0}
+                  "ttft_count": 0, "ttft_ms_sum": 0.0,
+                  "decode_steps": 0, "pipeline_depth_peak": 0}
         for rid, (r, desc) in snap.items():
             if rid in beats:
                 desc["last_beat_age_s"] = round(now - beats[rid], 3)
@@ -446,6 +447,14 @@ class Gateway:
                         gstats.get("ttft_count") or 0)
                     totals["ttft_ms_sum"] += float(
                         gstats.get("ttft_ms_sum") or 0.0)
+                    # decode-engine pipeline health: total steps sum;
+                    # depth peak is a high-water mark, so MAX across
+                    # replicas (a sum would be meaningless)
+                    totals["decode_steps"] += int(
+                        gstats.get("decode_steps") or 0)
+                    totals["pipeline_depth_peak"] = max(
+                        totals["pipeline_depth_peak"],
+                        int(gstats.get("pipeline_depth_peak") or 0))
                 except (OSError, ValueError) as e:
                     desc["probe_error"] = str(e)
         totals["ttft_ms_sum"] = round(totals["ttft_ms_sum"], 3)

@@ -14,7 +14,9 @@ duplicated rows so they don't bias the metric).
 
 Also here: :class:`Counters`, host-side thread-safe monotone counters for
 the serving/orchestration plane (the fleet gateway's ejection/retry/429
-accounting), and :class:`LatencyWindow`, the serving-latency tracker
+accounting), :class:`Gauge`, a level gauge with a high-water mark (the
+async decode engine's pipeline depth), and :class:`LatencyWindow`, the
+serving-latency tracker
 (TTFT percentiles + fleet-summable count/sum).  JAX is imported lazily
 inside the eval functions so
 importing this module from a pure control-plane process (the gateway)
@@ -196,6 +198,43 @@ class Counters:
         """{name: count} copy, safe to serialize."""
         with self._lock:
             return dict(self._counts)
+
+
+class Gauge:
+    """Thread-safe level gauge with a high-water mark (no JAX): tracks a
+    current value that goes up AND down (unlike :class:`Counters`) plus
+    the peak it ever reached.  The async decode engine uses one for
+    pipeline depth — steps dispatched but not yet host-processed — where
+    ``peak`` is the observable proof the double buffer actually kept >1
+    step in flight."""
+
+    def __init__(self, value=0):
+        self._lock = threading.Lock()
+        self._value = value
+        self._peak = value
+
+    def add(self, n=1):
+        with self._lock:
+            self._value += n
+            if self._value > self._peak:
+                self._peak = self._value
+            return self._value
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+            if value > self._peak:
+                self._peak = value
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    @property
+    def peak(self):
+        with self._lock:
+            return self._peak
 
 
 class LatencyWindow:

@@ -304,7 +304,7 @@ def _jitted_slot_prefill(slot_model):
 
 def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
                     topks=None, topps=None, minps=None, seen=None,
-                    reps=None):
+                    reps=None, rems=None, eoss=None, eos_on=None):
     """Shared decode-step core: feed each row its current token, per-row
     greedy/sampled pick (`temps[b] == 0` = greedy).
 
@@ -325,7 +325,16 @@ def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
     statically present) apply per-row repetition penalty to the RAW
     logits first (`apply_repetition_penalty`; the fed token joins `seen`
     before the penalty, and the updated mask is returned as an extra
-    output)."""
+    output).
+
+    ``rems``/``eoss``/``eos_on`` (statically present, like the sampling
+    extras) move the per-step STOP decision on-device: row b's remaining
+    budget decrements and ``done[b]`` is raised when the budget hits zero
+    or the picked token equals its eos id (``eos_on`` masks rows with no
+    eos configured).  The async serving engine reads ``done`` from the
+    readback chunk instead of inspecting tokens on the host, so the
+    device thread never blocks on token values to decide whether to keep
+    dispatching."""
     logits, mut = slot_model.apply(variables, toks[:, None],
                                    mutable=["cache"])
     logits = logits[:, -1]
@@ -340,23 +349,31 @@ def _slot_step_body(slot_model, variables, toks, temps, seeds, ords,
     if topks is not None:
         scaled = filter_top_k_p(scaled, topks, topps, minps)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-    out = (jnp.where(temps > 0, sampled, greedy), mut["cache"], ords + 1)
-    return out + (seen,) if seen is not None else out
+    pick = jnp.where(temps > 0, sampled, greedy)
+    out = (pick, mut["cache"], ords + 1)
+    if seen is not None:
+        out = out + (seen,)
+    if rems is not None:
+        rems2 = rems - 1
+        done = (rems2 <= 0) | (eos_on & (pick == eoss))
+        out = out + (rems2, done)
+    return out
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_slot_step(slot_model):
     """One decode step over ALL slots (see `_slot_step_body`)."""
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
+    @functools.partial(jax.jit, donate_argnums=(1,),
+                       donate_argnames=("seen", "rems"))
     def step(params, cache, toks, temps, seeds, ords,
              topks=None, topps=None, minps=None, seen=None,
-             reps=None):
+             reps=None, rems=None, eoss=None, eos_on=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache},
             toks, temps, seeds, ords, topks, topps, minps, seen,
-            reps)
+            reps, rems, eoss, eos_on)
 
     return step
 
@@ -386,16 +403,17 @@ def _jitted_slot_step_lora(slot_model):
     transformer.Attention._proj for the math and the null-adapter-0
     convention)."""
 
-    @functools.partial(jax.jit, donate_argnums=(2,))
+    @functools.partial(jax.jit, donate_argnums=(2,),
+                       donate_argnames=("seen", "rems"))
     def step(params, lora, cache, toks, temps, seeds, ords, ids,
              topks=None, topps=None, minps=None, seen=None,
-             reps=None):
+             reps=None, rems=None, eoss=None, eos_on=None):
         return _slot_step_body(
             slot_model,
             {"params": _params_view(params), "cache": cache,
              "lora": _lora_with_ids(lora, ids)},
             toks, temps, seeds, ords, topks, topps, minps, seen,
-            reps)
+            reps, rems, eoss, eos_on)
 
     return step
 
@@ -539,16 +557,19 @@ def build_prefill_batch(entries, width, bucket, n_slots):
 @functools.lru_cache(maxsize=32)
 def _jitted_set_row(slot_model):
     """Tiny device update used at slot joins: place the joining request's
-    first token / temperature / sampling chain into row `row` of the
-    resident arrays."""
+    first token / temperature / sampling chain / stop bookkeeping into
+    row `row` of the resident arrays.  NOT donated: the serving loop may
+    still hold readback chunks aliasing the old buffers."""
 
     @jax.jit
-    def set_row(toks, temps, seeds, ords, topks, topps, minps, row, tok,
-                temp, seed, ordinal, topk, topp, minp):
+    def set_row(toks, temps, seeds, ords, topks, topps, minps, rems,
+                eoss, eos_on, row, tok, temp, seed, ordinal, topk, topp,
+                minp, rem, eos, eon):
         return (toks.at[row].set(tok), temps.at[row].set(temp),
                 seeds.at[row].set(seed), ords.at[row].set(ordinal),
                 topks.at[row].set(topk), topps.at[row].set(topp),
-                minps.at[row].set(minp))
+                minps.at[row].set(minp), rems.at[row].set(rem),
+                eoss.at[row].set(eos), eos_on.at[row].set(eon))
 
     return set_row
 
@@ -583,10 +604,22 @@ def _jitted_slot_spec_round(t_model, d_model, k):
     PER ROW: each slot advances at its own agreement rate.  Inactive rows
     decode garbage the serving loop's generation filter drops; their
     cache writes land beyond any live region and rewind with everyone
-    else."""
+    else.
 
-    @functools.partial(jax.jit, donate_argnums=(2, 3))
-    def spec_round(t_params, d_params, t_cache, d_cache, toks):
+    With ``rems``/``eoss``/``eos_on`` (statically present) the per-row
+    stop decision joins the round on-device: ``n_del[r]`` is how many of
+    the committed tokens are DELIVERABLE — committed, within the row's
+    remaining budget, and not past its first eos — and ``done[r]`` is
+    raised when the budget is exhausted or an eos landed among the
+    delivered tokens.  Mirrors exactly the host loop's
+    per-token remaining/eos walk over ``t_next[r, :commit[r]]``.
+    Returns ``(new_toks, t_next, commit, n_del, done, rems_new,
+    t_cache, d_cache)`` in that mode."""
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3),
+                       donate_argnames=("rems",))
+    def spec_round(t_params, d_params, t_cache, d_cache, toks,
+                   rems=None, eoss=None, eos_on=None):
         t_params = _params_view(t_params)
         d_params = _params_view(d_params)
         # per-row committed length = cache_index before this round (all
@@ -616,7 +649,20 @@ def _jitted_slot_spec_round(t_model, d_model, k):
         new_idx = idx + commit
         t_cache = _set_row_indices_vec(t_cache, new_idx)
         d_cache = _set_row_indices_vec(d_cache, new_idx)
-        return new_toks, t_next, commit, t_cache, d_cache
+        if rems is None:
+            return new_toks, t_next, commit, t_cache, d_cache
+        # deliverable = committed AND within budget AND not past the
+        # first eos (inclusive) — the host loop's per-token walk, batched
+        mask = jnp.arange(k)[None, :] < commit[:, None]
+        is_eos = eos_on[:, None] & (t_next == eoss[:, None]) & mask
+        j_eos = jnp.where(is_eos.any(axis=1), jnp.argmax(is_eos, axis=1),
+                          k)                                 # [n], k = none
+        n_del = jnp.minimum(commit,
+                            jnp.minimum(jnp.maximum(rems, 0), j_eos + 1))
+        rems_new = rems - n_del
+        done = (rems_new <= 0) | (j_eos < n_del)
+        return (new_toks, t_next, commit, n_del, done, rems_new,
+                t_cache, d_cache)
 
     return spec_round
 

@@ -91,6 +91,19 @@ def build_argparser():
                         "chunks dispatched between two decode steps "
                         "never exceed this many tokens (0 = "
                         "prefill_rows * prefill_chunk)")
+    p.add_argument("--generate_engine", choices=["async", "serial"],
+                   default="async",
+                   help="decode engine structure: \"async\" (default) = "
+                        "double-buffered pipeline — a device thread keeps "
+                        "up to --generate_pipeline_depth flushed chunks "
+                        "in flight while a host thread drains readbacks, "
+                        "commits tokens, and delivers stream batches; "
+                        "\"serial\" = the single-thread reference loop "
+                        "(byte-identical tokens; parity/debugging)")
+    p.add_argument("--generate_pipeline_depth", type=int, default=2,
+                   help="async engine: flushed readback chunks allowed "
+                        "in flight between device and host threads "
+                        "(the double buffer; >= 1)")
     p.add_argument("--generate_timeout_s", type=float, default=None,
                    help="wall-time bound on one :generate request "
                         "(default: max(600, 2*max_new_tokens_limit))")
@@ -341,6 +354,10 @@ class ModelService:
                                          4) or 4
         self._gen_prefill_budget = getattr(args, "generate_prefill_budget",
                                            0) or 0
+        self._gen_engine = getattr(args, "generate_engine",
+                                   "async") or "async"
+        self._gen_pipeline_depth = getattr(args, "generate_pipeline_depth",
+                                           2) or 2
         self._gen_timeout_s = getattr(args, "generate_timeout_s", None)
         self._gen_kv_page_size = getattr(args, "generate_kv_page_size", 0)
         self._gen_kv_pages = getattr(args, "generate_kv_pages", 0)
@@ -403,7 +420,9 @@ class ModelService:
                         lora_capacity=self._gen_lora_capacity,
                         lora_adapters=self._gen_lora,
                         kv_dtype=self._gen_kv_dtype,
-                        paged_attn_impl=self._gen_paged_attn)
+                        paged_attn_impl=self._gen_paged_attn,
+                        engine=self._gen_engine,
+                        pipeline_depth=self._gen_pipeline_depth)
                 except TypeError as e:
                     # genuinely not a decoder LM: the documented 404
                     logger.info(":generate unavailable: %s", e)
@@ -499,9 +518,14 @@ class SlotHandle:
         import queue as queue_mod
 
         self.prompt = list(prompt)
-        self.tokens = queue_mod.Queue()   # ints, then None sentinel
+        # BATCHES of ints (one list per host tick — the engine delivers
+        # every token a tick committed for this request in one put, not
+        # one queue round-trip per token), then the None sentinel
+        self.tokens = queue_mod.Queue()
         self.cancelled = threading.Event()
         self._done = threading.Event()
+        self._outcome_lock = threading.Lock()   # finish/fail are
+        # first-wins and may race across engine threads
         self._seq = None
         self._err = None
         self._on_done = None   # fired exactly once at finish/fail (the
@@ -523,16 +547,25 @@ class SlotHandle:
                                exc_info=True)
 
     def _finish(self, seq):
-        self._settle()
-        self._seq = seq
-        self._done.set()
-        self.tokens.put(None)
+        # first outcome wins: with the async engine the host thread
+        # finishes handles while stop()/death-drain may fail them — a
+        # late second settle must not overwrite the recorded result
+        with self._outcome_lock:
+            if self._done.is_set():
+                return
+            self._settle()
+            self._seq = seq
+            self._done.set()
+            self.tokens.put(None)
 
     def _fail(self, err):
-        self._settle()
-        self._err = err
-        self._done.set()
-        self.tokens.put(None)
+        with self._outcome_lock:
+            if self._done.is_set():
+                return
+            self._settle()
+            self._err = err
+            self._done.set()
+            self.tokens.put(None)
 
     def result(self, timeout=None):
         if not self._done.wait(timeout):
@@ -569,14 +602,28 @@ class ContinuousBatcher:
                  prefill_budget=0, draft_model=None,
                  draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
                  lora_rank=0, lora_capacity=8, kv_dtype=None,
-                 paged_attn_impl=None):
+                 paged_attn_impl=None, engine="async", pipeline_depth=2):
         import itertools
         import queue as queue_mod
 
         import jax.numpy as jnp
 
-        from .metrics import Counters, LatencyWindow
+        from .metrics import Counters, Gauge, LatencyWindow
         from .models import decode as decode_mod
+
+        # "async" (the default) splits the engine into a DEVICE thread
+        # (dispatch + admission; owns every device buffer) feeding a
+        # HOST thread (readback, stop conditions, stream delivery)
+        # through a bounded chunk queue — up to `pipeline_depth` flushed
+        # chunks stay in flight, so the device keeps stepping while the
+        # host works.  "serial" is the single-thread reference engine
+        # (byte-identical tokens; the parity baseline and the
+        # engine_tps bench's comparison arm).
+        if engine not in ("async", "serial"):
+            raise ValueError(f"engine={engine!r} not in "
+                             "('async', 'serial')")
+        self.engine = engine
+        self.pipeline_depth = max(1, int(pipeline_depth))
 
         self.model, self.params = model, params
         # host-side event counters (sink-write accounting below);
@@ -756,13 +803,38 @@ class ContinuousBatcher:
                                jnp.int8)
         self._reps = jnp.ones((n_slots,), jnp.float32)
         self._n_penalized = 0
+        # per-row on-device stop bookkeeping: remaining token budget,
+        # eos id, and whether an eos is configured.  The step decrements
+        # rems and ships a `done` flag down with each token block, so
+        # the host never inspects token VALUES to decide whether the
+        # device may keep dispatching (the async engine's enabling
+        # invariant; the serial engine runs the same program so the two
+        # stay byte-identical)
+        self._rems = jnp.zeros((n_slots,), jnp.int32)
+        self._eoss = jnp.zeros((n_slots,), jnp.int32)
+        self._eos_on = jnp.zeros((n_slots,), jnp.bool_)
         self._steps = 0
         self._spec_rounds = 0
+        # device->host handoff: flushed chunks ride here; the bound IS
+        # the pipeline depth (backpressure when the host falls behind)
+        self._ready = queue_mod.Queue(self.pipeline_depth)
+        # host->device retirement requests (row, gen, ack): _free_row
+        # mutates pool/table device state, so only the device thread
+        # applies it; the host blocks on the ack so a finished handle
+        # always observes consistent pool accounting
+        self._retire_q = queue_mod.Queue()
+        self._depth = Gauge()   # steps dispatched but not host-processed
+        self._t0 = time.monotonic()   # device_idle_fraction time base
         self._dead = None     # set to the fatal exception if the loop dies
         self._stop = threading.Event()
         self.requests = 0
         self._thread = threading.Thread(target=self._loop,
                                         name="slot-batcher", daemon=True)
+        self._host_thread = None
+        if engine == "async":
+            self._host_thread = threading.Thread(
+                target=self._host_loop, name="slot-host", daemon=True)
+            self._host_thread.start()
         self._thread.start()
 
     def stats(self):
@@ -782,7 +854,27 @@ class ContinuousBatcher:
             "requests_served": self.requests,
             "decode_steps": self._steps,
             "spec_rounds": self._spec_rounds,
+            "engine": self.engine,
+            "pipeline_depth": self.pipeline_depth,
+            # high-water mark of dispatched-but-unprocessed steps: > 1
+            # is the observable proof the double buffer overlapped host
+            # work with device steps
+            "pipeline_depth_peak": self._depth.peak,
+            # explicit at zero (like kv_sink_writes): a non-zero value
+            # means copy_to_host_async is unsupported here and readback
+            # degraded to the synchronous path
+            "copy_to_host_fallbacks": self.counters.get(
+                "copy_to_host_fallbacks"),
         }
+        # fraction of wall time the DEVICE thread spent blocked on host
+        # work (serial: processing chunks inline; async: waiting for the
+        # host to drain the full pipeline) — the quantity the async
+        # engine exists to shrink
+        elapsed_ms = (time.monotonic() - self._t0) * 1000.0
+        wait_ms = self.counters.get("device_wait_ms")
+        out["device_idle_fraction"] = (
+            round(min(1.0, wait_ms / elapsed_ms), 4) if elapsed_ms > 0
+            else 0.0)
         # admission->first-token latency: count/sum (monotone, fleet-
         # aggregable) + p50/p95 over the recent window
         out.update(self._ttft.stats("ttft"))
@@ -906,11 +998,13 @@ class ContinuousBatcher:
                 0, self._adapter_refs.get(idx, 0) - 1)
 
     def stop(self, timeout=30):
-        """Shut the driver loop down cleanly (benches/tests teardown): the
-        loop exits at its next iteration boundary; queued, in-flight, AND
-        mid-admission requests fail with RuntimeError."""
+        """Shut the engine threads down cleanly (benches/tests teardown):
+        both loops exit at their next iteration boundary; queued,
+        in-flight, AND mid-admission requests fail with RuntimeError."""
         self._stop.set()
         self._thread.join(timeout)
+        if self._host_thread is not None:
+            self._host_thread.join(timeout)
         err = RuntimeError("batcher stopped")
         self._dead = self._dead or err
         adms, self._admissions = self._admissions, []
@@ -924,6 +1018,20 @@ class ContinuousBatcher:
                 s["handle"]._fail(err)
         self._slots = [None] * self.n_slots
         self._drain_pending(err)
+        self._ack_retire_waiters()
+
+    def _ack_retire_waiters(self):
+        """Release any host-side `_retire` waiter after the device thread
+        is gone (stop/death): their rows are already failed; leaving the
+        acks unset would hang the host thread forever."""
+        import queue as queue_mod
+
+        while True:
+            try:
+                _, _, ev = self._retire_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            ev.set()
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
                adapter=None, top_k=0, top_p=1.0, min_p=0.0, stop=None,
@@ -1447,7 +1555,7 @@ class ContinuousBatcher:
         t0 = item.get("t_submit")
         if t0 is not None:
             self._ttft.record(time.monotonic() - t0)
-        h.tokens.put(tok)
+        h.tokens.put([tok])
         seq = prompt + [tok]
         if (max_new <= 1 or (eos_id is not None and tok == eos_id)
                 or self._hit_stop(seq, stops, len(prompt))):
@@ -1457,13 +1565,18 @@ class ContinuousBatcher:
             return
         self._gen[row] += 1
         (self._toks, self._temps, self._seeds, self._ords,
-         self._topks, self._topps, self._minps) = self._set_row(
+         self._topks, self._topps, self._minps, self._rems,
+         self._eoss, self._eos_on) = self._set_row(
             self._toks, self._temps, self._seeds, self._ords,
-            self._topks, self._topps, self._minps,
+            self._topks, self._topps, self._minps, self._rems,
+            self._eoss, self._eos_on,
             jnp.asarray(row, jnp.int32), jnp.asarray(tok, jnp.int32),
             jnp.asarray(temp, jnp.float32), jnp.asarray(seed, jnp.int32),
             jnp.asarray(1, jnp.int32), jnp.asarray(topk, jnp.int32),
-            jnp.asarray(topp, jnp.float32), jnp.asarray(minp, jnp.float32))
+            jnp.asarray(topp, jnp.float32), jnp.asarray(minp, jnp.float32),
+            jnp.asarray(max_new - 1, jnp.int32),
+            jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32),
+            jnp.asarray(eos_id is not None, jnp.bool_))
         if self.lora_rank:
             self._lora_ids = self._lora_ids.at[row].set(aidx)
         filtered = bool(topk or topp < 1.0 or minp > 0.0)
@@ -1523,56 +1636,128 @@ class ContinuousBatcher:
             claimed.add(row)
             block = False    # only the first admit may block (idle wake)
 
+    def _retire(self, row, gen):
+        """Retire `row` (occupant generation `gen`).  `_free_row` mutates
+        DEVICE state (page pool, table writes, resident arrays), so only
+        the device thread applies it; from the host thread this posts a
+        retirement request and BLOCKS on the ack — after it returns,
+        `_slots[row]` is None, so later readback entries for the old
+        occupant are dropped and a waiter woken by the handle observes
+        consistent pool accounting."""
+        if threading.current_thread() is self._thread:
+            if self._slots[row] is not None and self._gen[row] == gen:
+                self._free_row(row)
+            return
+        ev = threading.Event()
+        self._retire_q.put((row, gen, ev))
+        while not ev.wait(0.05):
+            if self._stop.is_set() or self._dead is not None:
+                return      # device thread gone: stop()/death drains acks
+
+    def _apply_retirements(self, timeout=0.0):  # graftcheck: hotpath
+        """Device thread: drain pending host-requested retirements and
+        ack each.  With `timeout`, waits up to that long for the first
+        one (the nothing-to-dispatch idle path)."""
+        import queue as queue_mod
+
+        while True:
+            try:
+                row, gen, ev = (self._retire_q.get(timeout=timeout)
+                                if timeout else self._retire_q.get_nowait())
+            except queue_mod.Empty:
+                return
+            timeout = 0
+            if self._slots[row] is not None and self._gen[row] == gen:
+                self._free_row(row)
+            ev.set()
+
     def _process_batch(self, batch):
-        """One arrived token block -> emissions/retires, in dispatch
-        order.  `batch` is (toks_dev [k, n], counts [k, n] or None,
+        """One arrived chunk -> emissions/retires, in dispatch order
+        (host side of the pipeline).  `batch` is (toks_dev [k, n] or
+        [k, n, draft_k], counts [k, n] or None, done [k, n],
         [gen_snapshot per entry]); counts (speculative rounds) say how
-        many of each row's k tokens were committed.  The host copy was
-        started earlier (copy_to_host_async), so the np.asarray here is
-        usually free."""
+        many of each row's draft_k tokens are DELIVERABLE, and `done`
+        carries the device-computed stop verdict (budget exhausted or
+        eos among the delivered tokens) — the host never inspects token
+        values to decide whether the device may continue; only the
+        client-supplied stop SEQUENCES still need the host's substring
+        check.  Tokens are delivered to each stream batched per tick
+        (one queue put per handle per chunk, not per token).  The host
+        copy was started at flush (copy_to_host_async), so the
+        np.asarray here is usually free."""
         import numpy as np
 
-        stacked, counts, gens_list = batch
+        stacked, counts, done, gens_list = batch
         block = np.asarray(stacked)
         counts = None if counts is None else np.asarray(counts)
+        done = np.asarray(done)
+        pend = {}     # row -> tokens accumulated this tick
+
+        def emit(r, s):
+            toks = pend.pop(r, None)
+            if toks:
+                s["handle"].tokens.put(toks)
+
         for i, (gens, row_toks) in enumerate(zip(gens_list, block)):
             for r, s in enumerate(self._slots):
                 if s is None or self._gen[r] != gens[r]:
                     continue      # freed or re-occupied since dispatch
                 if s["handle"].cancelled.is_set():
                     # client gone: stop burning device time on this slot.
-                    # retire BEFORE finishing the handle: a waiter woken
-                    # by result() must observe consistent pool accounting
-                    self._free_row(r)
+                    # retire BEFORE finishing the handle (see _retire)
+                    emit(r, s)
+                    self._retire(r, gens[r])
                     s["handle"]._finish(s["seq"])
                     self.requests += 1
                     continue
                 if counts is None:
                     toks = [int(row_toks[r])]
-                else:             # speculative round: commit[r] tokens
+                else:             # speculative round: n_del[r] tokens
                     toks = [int(t) for t in
                             np.atleast_1d(row_toks[r])[:counts[i][r]]]
+                ended = False
                 for tok in toks:
                     s["seq"].append(tok)
                     s["remaining"] -= 1
-                    s["handle"].tokens.put(tok)
-                    if (s["remaining"] <= 0
-                            or (s["eos"] is not None and tok == s["eos"])
-                            or self._hit_stop(s["seq"], s["stops"],
-                                              s["plen"])):
-                        # retire BEFORE finishing: a waiter woken by
-                        # result() must observe consistent pool
-                        # accounting; in-flight steps decode garbage
-                        # that the _gen filter drops
-                        self._free_row(r)
-                        s["handle"]._finish(s["seq"])
-                        self.requests += 1
+                    pend.setdefault(r, []).append(tok)
+                    if self._hit_stop(s["seq"], s["stops"], s["plen"]):
+                        ended = True
                         break
+                if ended or bool(done[i][r]):
+                    emit(r, s)
+                    self._retire(r, gens[r])
+                    s["handle"]._finish(s["seq"])
+                    self.requests += 1
+        # per-tick delivery for every stream that did NOT finish this
+        # chunk: all its tokens in one put
+        for r, s in enumerate(self._slots):
+            if s is not None and r in pend:
+                emit(r, s)
+        self.counters.inc("host_ticks")
 
-    def _dispatch(self):
+    def _host_loop(self):
+        """Host side of the async pipeline: drain flushed chunks, commit
+        tokens, deliver to streams, retire finished rows (via the
+        device thread)."""
+        import queue as queue_mod
+
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = self._ready.get(timeout=0.05)
+                except queue_mod.Empty:
+                    continue
+                self._process_batch(batch)
+                self._depth.add(-len(batch[3]))
+        except BaseException as e:
+            self._die(e, "continuous batcher host thread died")
+
+    def _dispatch(self):  # graftcheck: hotpath
         """One decode advance for all active slots: a fused speculative
         round when a draft is loaded and every active row is greedy, else
-        one plain step.  Returns the readback entry."""
+        one plain step.  Returns the readback entry (toks, counts, done,
+        gens) — everything the host needs, shipped down in one copy; no
+        host sync happens here."""
         if self.kv_page_size:
             # every dispatch steps ALL rows; the unoccupied ones write
             # their junk token into the sink page (the reason it exists)
@@ -1584,17 +1769,20 @@ class ContinuousBatcher:
                                           and not s.get("pen"))
                             for s in self._slots))
         if use_spec:
-            (nxt, t_next, commit, self._cache,
+            (nxt, t_next, _commit, n_del, sdone, self._rems, self._cache,
              self._d_cache) = self._spec_round(
                 self.params, self.draft_params, self._cache, self._d_cache,
-                self._toks)
+                self._toks, rems=self._rems, eoss=self._eoss,
+                eos_on=self._eos_on)
             self._toks = nxt
             self._spec_rounds += 1
-            return (t_next, commit, tuple(self._gen))
+            return (t_next, n_del, sdone, tuple(self._gen))
         # filter/penalty arrays are passed only while such a row is
         # active: their PRESENCE is static under jit, so plain workloads
-        # run the exact pre-feature program (no per-step sort / mask)
-        kw = {}
+        # run the exact pre-feature program (no per-step sort / mask);
+        # the stop arrays are ALWAYS passed — both engines share one
+        # program, which is what keeps them byte-identical
+        kw = dict(rems=self._rems, eoss=self._eoss, eos_on=self._eos_on)
         if self._n_filtered:
             kw.update(topks=self._topks, topps=self._topps,
                       minps=self._minps)
@@ -1610,26 +1798,27 @@ class ContinuousBatcher:
                 self.params, self._cache, self._toks, self._temps,
                 self._seeds, self._ords, **kw)
         if self._n_penalized:
-            nxt, self._cache, self._ords, self._seen = ret
+            nxt, self._cache, self._ords, self._seen, self._rems, done = ret
         else:
-            nxt, self._cache, self._ords = ret
+            nxt, self._cache, self._ords, self._rems, done = ret
         self._toks = nxt
         self._steps += 1
-        return (nxt, None, tuple(self._gen))
+        return (nxt, None, done, tuple(self._gen))
 
-    def _flush_entries(self, reads):
+    def _flush_entries(self, reads):  # graftcheck: hotpath
         """Stack this chunk's entries for one async host copy.  Plain
         steps stack to [k, n]; speculative rounds to [k, n, draft_k] with
         a [k, n] counts plane.  Mixed chunks pad plain entries to width
-        draft_k with count 1."""
+        draft_k with count 1.  The done plane stacks to [k, n] always."""
         import jax.numpy as jnp
 
+        done = jnp.stack([e[2] for e in reads])
         if all(e[1] is None for e in reads):
-            return jnp.stack([e[0] for e in reads]), None
+            return jnp.stack([e[0] for e in reads]), None, done
         k = self.draft_k
 
         def widen(e):
-            toks, counts, _ = e
+            toks, counts, _, _ = e
             if counts is None:
                 return (jnp.pad(toks[:, None], ((0, 0), (0, k - 1))),
                         jnp.ones(toks.shape[0], jnp.int32))
@@ -1637,12 +1826,59 @@ class ContinuousBatcher:
 
         wide = [widen(e) for e in reads]
         return (jnp.stack([w[0] for w in wide]),
-                jnp.stack([w[1] for w in wide]))
+                jnp.stack([w[1] for w in wide]), done)
+
+    def _flush(self, reads):  # graftcheck: hotpath
+        """Stack a chunk and START its host copies asynchronously; the
+        np.asarray in `_process_batch` then usually finds the bytes
+        already landed.  Backends without copy_to_host_async degrade to
+        the synchronous copy — counted, so the regression shows in
+        stats() instead of silently eating the pipeline's win."""
+        stacked, counts, done = self._flush_entries(reads)
+        arrays = ((stacked, done) if counts is None
+                  else (stacked, counts, done))
+        for arr in arrays:
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                # the backend-unsupported cases; anything else (device
+                # failure mid-copy) must kill the engine, not pass
+                self.counters.inc("copy_to_host_fallbacks")
+                break
+        return (stacked, counts, done, [e[3] for e in reads])
+
+    def _flush_due(self, n_reads, active):  # graftcheck: hotpath
+        """Whether the accumulated reads should flush now: a full chunk,
+        nothing left to dispatch, or a LIVE slot is within `n_reads`
+        tokens of finishing (flushing early bounds its retirement
+        latency).  Rows whose budget already hit zero are only waiting
+        for retirement — they cannot need more tokens, so they must not
+        shrink the chunk (a single such straggler used to force
+        per-step flushes via the min(..., default=0) path)."""
+        if not n_reads:
+            return False
+        if n_reads >= self.read_chunk or not active:
+            return True
+        near = min((s["remaining"] for s in self._slots
+                    if s is not None and s["remaining"] > 0),
+                   default=None)
+        return near is not None and near <= n_reads
 
     def _loop(self):
+        if self.engine == "async":
+            self._loop_async()
+        else:
+            self._loop_serial()
+
+    def _loop_serial(self):  # graftcheck: hotpath
+        """The single-thread reference engine: dispatch, flush, process
+        the PREVIOUS chunk inline (double-buffered readback — the copy
+        rides under the next chunk's compute).  Byte-identical tokens to
+        the async engine; kept as the parity baseline and the
+        engine_tps bench's comparison arm."""
         try:
-            reads = []       # dispatched this chunk: [(toks, counts, gens)]
-            inflight = None  # previous chunk, host copy in progress
+            reads = []       # dispatched this chunk: [(toks, counts,
+            inflight = None  # done, gens)]; previous chunk in host copy
             while not self._stop.is_set():
                 idle = (all(s is None for s in self._slots)
                         and not self._admissions
@@ -1656,6 +1892,7 @@ class ContinuousBatcher:
                 active = any(s is not None for s in self._slots)
                 if active:
                     reads.append(self._dispatch())
+                    self._depth.add(1)
                 # Readback protocol (measured on the tunneled runtime:
                 # per-token sync d2h ~200 ms regardless of size): stack a
                 # chunk, START its host copy asynchronously, and process
@@ -1664,40 +1901,93 @@ class ContinuousBatcher:
                 # may overshoot a retiring slot by up to ~2 chunks; the
                 # generation filter drops those tokens and the masked
                 # cache write makes out-of-range positions no-ops.
-                flush = reads and (
-                    len(reads) >= self.read_chunk
-                    or not active
-                    or min((s["remaining"] for s in self._slots
-                            if s is not None), default=0) <= len(reads))
-                if flush:
-                    stacked, counts = self._flush_entries(reads)
-                    gens = [r[2] for r in reads]
-                    try:
-                        stacked.copy_to_host_async()
-                    except Exception:
-                        pass             # not all backends support it
-                    prev, inflight = inflight, (stacked, counts, gens)
+                if self._flush_due(len(reads), active):
+                    prev, inflight = inflight, self._flush(reads)
                     reads = []
                     if prev is not None:
+                        # host work runs INLINE here — the serial
+                        # engine's defining cost, counted as device wait
+                        t0 = time.monotonic()
                         self._process_batch(prev)
+                        self._depth.add(-len(prev[3]))
+                        self.counters.inc(
+                            "device_wait_ms",
+                            (time.monotonic() - t0) * 1000.0)
                 elif inflight is not None and not active and not reads:
                     # nothing more to dispatch: drain the in-flight chunk
                     self._process_batch(inflight)
+                    self._depth.add(-len(inflight[3]))
                     inflight = None
         except BaseException as e:     # device failure: fail everything
-            logger.exception("continuous batcher died")
-            self._dead = e
-            adms, self._admissions = self._admissions, []
-            for adm in adms:
-                adm["item"]["h"]._fail(e)
-            parked, self._parked = self._parked, None
-            if parked is not None:
-                parked[1]["h"]._fail(e)
-            for s in self._slots:
-                if s is not None:
-                    s["handle"]._fail(e)
-            self._slots = [None] * self.n_slots
-            self._drain_pending(e)
+            self._die(e, "continuous batcher died")
+
+    def _loop_async(self):  # graftcheck: hotpath
+        """Device side of the async pipeline: admission + dispatch only.
+        Flushed chunks go to the host thread through the bounded
+        `_ready` queue (its bound IS the pipeline depth); the only time
+        this thread waits on host progress is when that queue is full —
+        counted as device wait, the quantity stats() reports as
+        device_idle_fraction."""
+        import queue as queue_mod
+
+        try:
+            reads = []   # dispatched this chunk: [(toks, counts, done,
+            while not self._stop.is_set():          # gens)]
+                self._apply_retirements()
+                idle = (all(s is None for s in self._slots)
+                        and not self._admissions
+                        and self._parked is None
+                        and not reads
+                        and self._depth.value == 0)
+                self._admit(block=idle)
+                self._run_prefill_round()
+                active = any(s is not None for s in self._slots)
+                if active:
+                    reads.append(self._dispatch())
+                    self._depth.add(1)
+                if self._flush_due(len(reads), active):
+                    chunk = self._flush(reads)
+                    reads = []
+                    t0 = time.monotonic()
+                    waited = False
+                    while not self._stop.is_set():
+                        try:
+                            self._ready.put(chunk, timeout=0.05)
+                            break
+                        except queue_mod.Full:
+                            # host is behind: keep acks flowing (the
+                            # host may be blocked on a retirement)
+                            waited = True
+                            self._apply_retirements()
+                    if waited:
+                        self.counters.inc(
+                            "device_wait_ms",
+                            (time.monotonic() - t0) * 1000.0)
+                elif not active and not reads:
+                    # nothing to dispatch: let retirements land promptly
+                    self._apply_retirements(timeout=0.002)
+        except BaseException as e:     # device failure: fail everything
+            self._die(e, "continuous batcher died")
+
+    def _die(self, e, msg):
+        """Terminal failure of either engine thread: record the cause,
+        stop the other thread, fail every queued / in-flight /
+        mid-admission request, and release retire-ack waiters."""
+        logger.exception(msg)
+        self._dead = e
+        self._stop.set()
+        adms, self._admissions = self._admissions, []
+        for adm in adms:
+            adm["item"]["h"]._fail(e)
+        parked, self._parked = self._parked, None
+        if parked is not None:
+            parked[1]["h"]._fail(e)
+        for s in self._slots:
+            if s is not None:
+                s["handle"]._fail(e)
+        self._slots = [None] * self.n_slots
+        self._drain_pending(e)
+        self._ack_retire_waiters()
 
 
 class GenerateService:
@@ -1773,7 +2063,8 @@ class GenerateService:
                  request_timeout_s=None,
                  kv_page_size=0, kv_pages=0, quantize_mode="none",
                  lora_rank=0, lora_capacity=8, lora_adapters=None,
-                 kv_dtype="auto", paged_attn_impl=None):
+                 kv_dtype="auto", paged_attn_impl=None, engine="async",
+                 pipeline_depth=2):
         import itertools
 
         self.quantize_mode = quantize_mode or "none"
@@ -1797,7 +2088,8 @@ class GenerateService:
             draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages,
             lora_rank=lora_rank, lora_capacity=lora_capacity,
             kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype),
-            paged_attn_impl=paged_attn_impl)
+            paged_attn_impl=paged_attn_impl, engine=engine or "async",
+            pipeline_depth=pipeline_depth)
         try:
             for name, path in (lora_adapters or {}).items():
                 # adapter files written by lora.save_adapters; a bad file
@@ -1926,10 +2218,13 @@ class GenerateService:
         def slot_events():
             try:
                 while True:
-                    tok = h.tokens.get()
-                    if tok is None:
+                    batch = h.tokens.get()
+                    if batch is None:
                         break
-                    yield {"token": tok}
+                    # the engine delivers token BATCHES (one per host
+                    # tick); the event protocol stays per-token
+                    for tok in batch:
+                        yield {"token": tok}
                 yield {"done": True, "output": h.result()}
             finally:
                 # consumer died/finished: free the slot instead of
@@ -2108,6 +2403,11 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
     if getattr(args, "generate_prefill_budget", 0) < 0:
         raise ValueError("--generate_prefill_budget must be >= 0 "
                          "(0 = prefill_rows * prefill_chunk)")
+    if getattr(args, "generate_engine", "async") not in ("async", "serial"):
+        raise ValueError("--generate_engine must be 'async' or 'serial'")
+    if getattr(args, "generate_pipeline_depth", 2) < 1:
+        raise ValueError("--generate_pipeline_depth must be >= 1 "
+                         "(flushed chunks in flight device->host)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
 
@@ -2151,6 +2451,7 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
     # admission pipeline width: fleet dashboards read it next to slots
     features["prefill_rows"] = getattr(args, "generate_prefill_rows",
                                        4) or 4
+    features["engine"] = getattr(args, "generate_engine", "async") or "async"
     return fleet_client.register_replica(
         (ghost, int(gport)),
         args.advertise_host or args.host,

@@ -20,7 +20,7 @@ sys.path.insert(0, REPO)
 
 from tensorflowonspark_tpu.analysis import core  # noqa: E402
 from tensorflowonspark_tpu.analysis import (  # noqa: E402,F401  (registers rules)
-    locks, pallas_tiles, shardlint, style, tracer)
+    hostsync, locks, pallas_tiles, shardlint, style, tracer)
 
 MESH_AXES = {"dp", "fsdp", "pp", "tp"}
 
@@ -336,6 +336,81 @@ def test_lock_discipline_bare_reference_read_ok():
     assert hits == []
 
 
+# ------------------------------------------------------------- hostsync ----
+
+def test_hostsync_positive_sync_calls():
+    hits, fs = run("""
+        import numpy as np
+
+        def _dispatch(self):  # graftcheck: hotpath
+            nxt = self._step(self._toks)
+            nxt.block_until_ready()
+            v = nxt.item()
+            a = np.asarray(nxt)
+            f = float(nxt)
+    """, ["hostsync"])
+    assert [r for r, _ in hits] == ["hostsync"] * 4
+    assert "block" in fs[0].message and "_dispatch" in fs[0].message
+
+
+def test_hostsync_marker_on_line_above():
+    hits, _ = run("""
+        # graftcheck: hotpath
+        def _loop(self):
+            return int(self._depth)
+    """, ["hostsync"])
+    assert [r for r, _ in hits] == ["hostsync"]
+
+
+def test_hostsync_negative_unmarked_function():
+    # the same syncs OUTSIDE a marked hot path are the host thread's job
+    hits, _ = run("""
+        import numpy as np
+
+        def _process_batch(self, batch):
+            block = np.asarray(batch[0])
+            return int(block[0])
+    """, ["hostsync"])
+    assert hits == []
+
+
+def test_hostsync_negative_metadata_and_async():
+    # shape/len metadata casts and the non-blocking copy stay legal
+    hits, _ = run("""
+        def _flush(self, reads):  # graftcheck: hotpath
+            n = int(reads[0].shape[0])
+            m = int(len(reads))
+            reads[0].copy_to_host_async()
+            return n + m
+    """, ["hostsync"])
+    assert hits == []
+
+
+def test_hostsync_closure_inherits_marker_and_suppression():
+    hits, _ = run("""
+        def _loop(self):  # graftcheck: hotpath
+            def tick():
+                return self._toks.item()
+            return tick
+    """, ["hostsync"])
+    assert [r for r, _ in hits] == ["hostsync"]
+
+    hits, _ = run("""
+        def _loop(self):  # graftcheck: hotpath
+            return self._toks.item()  # graftcheck: disable=hostsync
+    """, ["hostsync"])
+    assert hits == []
+
+
+def test_hostsync_serve_hot_path_is_annotated():
+    """The invariant this rule enforces actually covers the engine: the
+    async batcher's device-thread loop carries the marker in serve.py."""
+    with open(os.path.join(REPO, "tensorflowonspark_tpu", "serve.py")) as f:
+        src = f.read()
+    assert "def _loop_async(self):  # graftcheck: hotpath" in src
+    assert "def _dispatch(self):  # graftcheck: hotpath" in src
+
+
 # ---------------------------------------------------------------- style ----
 
 def test_unused_import_positive():
@@ -471,7 +546,7 @@ def test_cli_json_and_list_rules():
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
     for rule in ("tracer-host-cast", "shard-axis", "pallas-tile",
-                 "lock-discipline", "unused-import"):
+                 "lock-discipline", "hostsync", "unused-import"):
         assert rule in proc.stdout
 
     proc = subprocess.run(
