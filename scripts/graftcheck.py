@@ -3,13 +3,20 @@
 
 Stdlib-only.  Runs the style tier (what scripts/lint.py runs) plus the
 semantic analyzers: tracer hazards inside jit/shard_map, mesh-axis and
-Pallas out-sharding lint, BlockSpec tile checks, and lock discipline for
-the fleet/serve/reservation plane.  Exit 0 = clean (modulo the checked-in
-baseline, scripts/graftcheck_baseline.json, which may only shrink).
+Pallas out-sharding lint, BlockSpec tile checks, lock discipline,
+thread-role race analysis + lock-order cycles, jit-recompile (cache
+blowup) lint, and hot-path host-sync checks for the fleet/serve plane.
+Exit 0 = clean (modulo the checked-in baseline,
+scripts/graftcheck_baseline.json, which may only shrink), 1 = new
+findings, 2 = usage/path errors (including a shrink-only baseline
+violation under --update-baseline).
 
     python scripts/graftcheck.py                  # whole repo
     python scripts/graftcheck.py --list-rules
     python scripts/graftcheck.py path/to/file.py --json
+    python scripts/graftcheck.py --changed-only   # git-diff file filter
+    python scripts/graftcheck.py --format sarif   # SARIF 2.1.0 to stdout
+    python scripts/graftcheck.py --sarif-output build/graftcheck.sarif
     python scripts/graftcheck.py --update-baseline
 """
 import os
@@ -20,9 +27,27 @@ sys.path.insert(0, _ROOT)
 
 from tensorflowonspark_tpu.analysis import main  # noqa: E402
 
+# Options that consume the NEXT argv entry (their value is not a path).
+_VALUE_OPTS = {"--baseline", "--select", "--skip", "--format",
+               "--sarif-output"}
+
+
+def _has_path_args(argv):
+    skip_next = False
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a.startswith("-"):
+            skip_next = a in _VALUE_OPTS
+            continue
+        return True
+    return False
+
+
 if __name__ == "__main__":
     # With no explicit paths the default scan set is repo-relative; anchor it
-    # (and the default baseline path) so the CLI works from any cwd.
-    if not any(not a.startswith("-") for a in sys.argv[1:]):
+    # (and the default baseline/SARIF paths) so the CLI works from any cwd.
+    if not _has_path_args(sys.argv[1:]):
         os.chdir(_ROOT)
     sys.exit(main())

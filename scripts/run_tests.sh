@@ -13,7 +13,8 @@ cd "$(dirname "$0")/.."
 if [ "${1:-}" = "--analyze" ]; then
     shift
     python scripts/lint.py
-    python scripts/graftcheck.py
+    # SARIF side-channel so CI can annotate findings per line
+    python scripts/graftcheck.py --sarif-output build/graftcheck.sarif
 fi
 make -C native
 if [ "${1:-}" = "--fast" ]; then

@@ -1,0 +1,299 @@
+"""Module-graph + call-graph builder for the interprocedural tier.
+
+graftcheck's original rules reason one function at a time; PR 6 made the
+serving plane genuinely concurrent (device thread, host drain thread,
+HTTP handler threads), and its invariants routinely cross a function
+boundary: a taint enters a helper, a lock is taken two frames up, a
+thread role is decided by ``Thread(target=...)`` in ``__init__`` and
+consumed in a method five calls away.  This module builds the shared
+substrate those analyses need, stdlib-``ast`` only:
+
+- a **module graph**: every scanned package file keyed by its dotted
+  module name, with its import table (``import x.y as z``, ``from .m
+  import f as g``, relative imports resolved against the importing
+  module's package);
+- per-module **definition indexes**: module-level functions, classes
+  with their method tables and (project-resolvable) base classes, and
+  nested/closure functions chained to their lexical parent;
+- a **call resolver**: given a ``Call`` node and the scope it occurs
+  in, find the ``FunctionInfo`` it targets — ``self.method(...)``
+  (through project-local base classes), bare names (closure chain →
+  module level → ``from``-import), and ``mod.func(...)`` through the
+  import table.
+
+Resolution is deliberately *syntactic and best-effort*: a target built
+dynamically (``getattr``, dicts of callables, functools.partial chains)
+resolves to ``None`` and downstream analyses treat the call as opaque.
+That is the right failure mode for a linter — missed edges cost recall,
+never precision.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .core import PACKAGE_DIR, _posix
+
+
+def module_name(path):
+    """Dotted module name for a scanned file path, or None for files
+    outside the package (semantic rules only analyze the package)."""
+    parts = _posix(path).split("/")
+    if PACKAGE_DIR not in parts:
+        return None
+    parts = parts[parts.index(PACKAGE_DIR):]
+    if not parts[-1].endswith(".py"):
+        return None
+    parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function or method definition."""
+    name: str
+    qualname: str              # e.g. serve.ContinuousBatcher._dispatch
+    node: object               # ast.FunctionDef / ast.AsyncFunctionDef
+    module: object             # ModuleInfo
+    cls: object = None         # ClassInfo when a method
+    parent: object = None      # lexical parent FunctionInfo (closures)
+    # name -> FunctionInfo for functions defined directly in this body
+    nested: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def params(self):
+        a = self.node.args
+        out = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        return out
+
+    def __hash__(self):
+        return id(self.node)
+
+    def __eq__(self, other):
+        return isinstance(other, FunctionInfo) and other.node is self.node
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    node: object
+    module: object
+    methods: dict = dataclasses.field(default_factory=dict)
+    base_names: list = dataclasses.field(default_factory=list)
+
+    def method(self, name, graph=None, _seen=None):
+        """Look `name` up on this class, then project-resolvable bases."""
+        m = self.methods.get(name)
+        if m is not None or graph is None:
+            return m
+        _seen = _seen or set()
+        if id(self.node) in _seen:          # inheritance cycle guard
+            return None
+        _seen.add(id(self.node))
+        for base in self.base_names:
+            bci = graph.resolve_class(base, self.module)
+            if bci is not None:
+                m = bci.method(name, graph, _seen)
+                if m is not None:
+                    return m
+        return None
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    modname: str
+    ctx: object                # core.FileContext
+    functions: dict = dataclasses.field(default_factory=dict)
+    classes: dict = dataclasses.field(default_factory=dict)
+    # import alias -> dotted module name ("np" -> "numpy")
+    imports: dict = dataclasses.field(default_factory=dict)
+    # local name -> (dotted module name, original name) for from-imports
+    from_imports: dict = dataclasses.field(default_factory=dict)
+
+
+def _resolve_relative(base_modname, level, module):
+    """Absolute dotted name for a `from ...module import x` in
+    `base_modname` (level dots).  A file module's package is its parent."""
+    parts = base_modname.split(".")
+    # level 1 = current package (drop the file component), each extra
+    # level drops one more package
+    parts = parts[:len(parts) - level]
+    if module:
+        parts += module.split(".")
+    return ".".join(parts)
+
+
+class CallGraph:
+    """Project-wide definition index + call resolver.
+
+    Build once per run (``CallGraph(project)``); rules share it through
+    ``project.callgraph`` (see :func:`for_project`).
+    """
+
+    def __init__(self, project):
+        self.modules = {}          # modname -> ModuleInfo
+        self.by_path = {}          # posix path -> ModuleInfo
+        # id(def node) -> FunctionInfo, for scope lookups by node
+        self.info_by_node = {}
+        for ctx in getattr(project, "files", []):
+            if ctx.tree is None:
+                continue
+            modname = module_name(ctx.path)
+            if modname is None:
+                continue
+            mi = ModuleInfo(path=ctx.path, modname=modname, ctx=ctx)
+            self._index_module(mi)
+            self.modules[modname] = mi
+            self.by_path[_posix(ctx.path)] = mi
+
+    # ---- indexing --------------------------------------------------------
+
+    def _index_module(self, mi):
+        for node in mi.ctx.tree.body:
+            self._index_stmt(node, mi, cls=None, parent=None)
+        for node in ast.walk(mi.ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mi.imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom):
+                target = (node.module or "")
+                if node.level:
+                    target = _resolve_relative(mi.modname, node.level,
+                                               node.module or "")
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    mi.from_imports[alias.asname or alias.name] = \
+                        (target, alias.name)
+
+    def _index_stmt(self, node, mi, cls, parent):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = ".".join(
+                p for p in (mi.modname.rsplit(".", 1)[-1],
+                            cls.name if cls else None,
+                            (parent.name + ".<locals>") if parent else None,
+                            node.name) if p)
+            fi = FunctionInfo(name=node.name, qualname=qual, node=node,
+                              module=mi, cls=cls, parent=parent)
+            self.info_by_node[id(node)] = fi
+            if parent is not None:
+                parent.nested[node.name] = fi
+            elif cls is not None:
+                cls.methods[node.name] = fi
+            else:
+                mi.functions.setdefault(node.name, fi)
+            for sub in node.body:
+                self._index_stmt(sub, mi, cls=None, parent=fi)
+        elif isinstance(node, ast.ClassDef):
+            ci = ClassInfo(name=node.name, node=node, module=mi,
+                           base_names=[_dotted(b) for b in node.bases])
+            mi.classes.setdefault(node.name, ci)
+            for sub in node.body:
+                self._index_stmt(sub, mi, cls=ci, parent=None)
+        elif isinstance(node, (ast.If, ast.Try, ast.With)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.stmt):
+                    self._index_stmt(sub, mi, cls, parent)
+
+    # ---- lookups ---------------------------------------------------------
+
+    def function_info(self, def_node):
+        return self.info_by_node.get(id(def_node))
+
+    def resolve_class(self, dotted, mi):
+        """ClassInfo for a (possibly dotted/imported) class name as seen
+        from module `mi`."""
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest and head in mi.classes:
+            return mi.classes[head]
+        if head in mi.from_imports:
+            target_mod, orig = mi.from_imports[head]
+            tmi = self.modules.get(target_mod)
+            if tmi is not None:
+                if not rest:
+                    return tmi.classes.get(orig)
+            # `from . import serve` then serve.Class
+            tmi = self.modules.get(f"{target_mod}.{orig}"
+                                   if target_mod else orig)
+            if tmi is not None and rest and "." not in rest:
+                return tmi.classes.get(rest)
+        if head in mi.imports and rest and "." not in rest:
+            tmi = self.modules.get(mi.imports[head])
+            if tmi is not None:
+                return tmi.classes.get(rest)
+        return None
+
+    def resolve_call(self, func_expr, scope):
+        """FunctionInfo targeted by calling `func_expr` from `scope`
+        (a FunctionInfo, or a ModuleInfo for module-level code); None
+        when the target is dynamic or outside the project."""
+        mi = scope.module if isinstance(scope, FunctionInfo) else scope
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            # closure chain first (lexical scoping)
+            fn = scope if isinstance(scope, FunctionInfo) else None
+            while fn is not None:
+                if name in fn.nested:
+                    return fn.nested[name]
+                fn = fn.parent
+            if name in mi.functions:
+                return mi.functions[name]
+            if name in mi.from_imports:
+                target_mod, orig = mi.from_imports[name]
+                tmi = self.modules.get(target_mod)
+                if tmi is not None:
+                    return tmi.functions.get(orig)
+            return None
+        if isinstance(func_expr, ast.Attribute):
+            base = func_expr.value
+            # self.method(...) inside a class
+            if (isinstance(base, ast.Name) and base.id == "self"
+                    and isinstance(scope, FunctionInfo)
+                    and scope.cls is not None):
+                return scope.cls.method(func_expr.attr, self)
+            # cls.method(...) via classname
+            if isinstance(base, ast.Name):
+                ci = self.resolve_class(base.id, mi)
+                if ci is not None:
+                    return ci.method(func_expr.attr, self)
+                # imported_module.func(...)
+                tm = None
+                if base.id in mi.imports:
+                    tm = self.modules.get(mi.imports[base.id])
+                elif base.id in mi.from_imports:
+                    target_mod, orig = mi.from_imports[base.id]
+                    tm = self.modules.get(
+                        f"{target_mod}.{orig}" if target_mod else orig)
+                    if tm is None and target_mod:
+                        # `from . import x` where x is a name IN target_mod
+                        tm = None
+                if tm is not None:
+                    return tm.functions.get(func_expr.attr)
+        return None
+
+
+def _dotted(expr):
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def for_project(project):
+    """Build (or reuse) the project's CallGraph.  Cached on the project
+    object so every interprocedural rule shares one index per run."""
+    cg = getattr(project, "_callgraph", None)
+    if cg is None:
+        cg = CallGraph(project)
+        project._callgraph = cg
+    return cg

@@ -363,27 +363,100 @@ def _select_rules(select, skip, style_only):
     return rules
 
 
+def sarif_report(findings, rules=None):
+    """SARIF 2.1.0 document for `findings` (CI annotates these per line;
+    GitHub/VS Code both ingest this shape natively)."""
+    rules = rules if rules is not None else list(REGISTRY.values())
+    seen_rules = {f.rule for f in findings}
+    rule_objs = [{
+        "id": r.name,
+        "shortDescription": {"text": r.description or r.name},
+        "properties": {"kind": r.kind, "scope": r.scope},
+    } for r in sorted(rules, key=lambda r: r.name)
+        if r.name in seen_rules or not findings]
+    results = [{
+        "ruleId": f.rule,
+        "level": "warning",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": _posix(f.path),
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": f.line},
+            },
+        }],
+    } for f in findings]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftcheck",
+                "informationUri":
+                    "docs/source/analysis.rst",
+                "rules": rule_objs,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///./"}},
+            "results": results,
+        }],
+    }
+
+
+def changed_files(root="."):
+    """Posix-relative paths with uncommitted changes (worktree + index)
+    plus untracked files, or None when git is unavailable / not a repo."""
+    import subprocess
+    out = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, check=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        out.update(ln.strip() for ln in res.stdout.splitlines()
+                   if ln.strip())
+    return out
+
+
 def main(argv=None):
     # Importing the rule modules populates REGISTRY; done here so embedding
     # code can import core without pulling every analyzer.
     from tensorflowonspark_tpu.analysis import (  # noqa
-        hostsync, locks, pallas_tiles, shardlint, style, tracer)
+        hostsync, locks, pallas_tiles, recompile, shardlint, style, threads,
+        tracer)
 
     ap = argparse.ArgumentParser(
         prog="graftcheck",
         description="JAX/TPU-aware stdlib static analysis (tracer hazards, "
                     "sharding lint, Pallas tile checks, lock discipline, "
+                    "thread-role race analysis, jit-recompile lint, "
                     "hot-path host-sync checks, style).")
     ap.add_argument("paths", nargs="*", help="files or directories "
                     f"(default: {' '.join(DEFAULT_PATHS)})")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as JSON")
+                    help="emit findings as JSON (same as --format json)")
+    ap.add_argument("--format", default=None, dest="fmt",
+                    choices=("text", "json", "sarif"),
+                    help="report format on stdout (default text)")
+    ap.add_argument("--sarif-output", default=None, metavar="FILE",
+                    help="additionally write a SARIF 2.1.0 report to FILE "
+                    "(whatever --format is; CI annotation side channel)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only for files git sees as "
+                    "changed/untracked (full project still loads, so "
+                    "cross-file rules keep their context)")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: {DEFAULT_BASELINE} if present)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore any baseline file")
     ap.add_argument("--update-baseline", action="store_true",
-                    help="rewrite the baseline with the current findings")
+                    help="rewrite the baseline with the current findings "
+                    "(shrink-only: refuses to ADD fingerprints unless "
+                    "--grow-baseline is also given)")
+    ap.add_argument("--grow-baseline", action="store_true",
+                    help="with --update-baseline: allow the baseline to "
+                    "gain fingerprints (bootstrap/grandfathering only)")
     ap.add_argument("--select", default=None, metavar="RULES",
                     help="comma-separated rule names to run")
     ap.add_argument("--skip", default=None, metavar="RULES",
@@ -394,6 +467,7 @@ def main(argv=None):
     ap.add_argument("--strict", action="store_true",
                     help="accepted for scripts/lint.py compatibility (no-op)")
     args = ap.parse_args(argv)
+    fmt = args.fmt or ("json" if args.as_json else "text")
 
     if args.list_rules:
         for name in sorted(REGISTRY):
@@ -413,6 +487,14 @@ def main(argv=None):
     findings = run_rules(project, rules)
     line_map = {ctx.path: ctx.lines for ctx in project.files}
 
+    if args.changed_only:
+        changed = changed_files()
+        if changed is None:
+            print("graftcheck: error: --changed-only needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        findings = [f for f in findings if _posix(f.path) in changed]
+
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline:
         baseline_path = DEFAULT_BASELINE if os.path.isfile(DEFAULT_BASELINE) else None
@@ -421,6 +503,26 @@ def main(argv=None):
 
     if args.update_baseline:
         target = args.baseline or DEFAULT_BASELINE
+        # shrink-only contract: grandfathering NEW findings into the
+        # baseline is a reviewed, explicit act (--grow-baseline), never a
+        # side effect of refreshing it
+        current = load_baseline(target)
+        added = []
+        pool = dict(current)
+        for f in findings:
+            fp = f.fingerprint(line_map.get(f.path, []))
+            if pool.get(fp, 0) > 0:
+                pool[fp] -= 1
+            else:
+                added.append(fp)
+        if added and not args.grow_baseline:
+            print(f"graftcheck: error: refusing to ADD {len(added)} "
+                  f"fingerprint(s) to {target} (shrink-only baseline; "
+                  "fix the findings or pass --grow-baseline):",
+                  file=sys.stderr)
+            for fp in sorted(added):
+                print(f"  {fp}", file=sys.stderr)
+            return 2
         save_baseline(target, findings, line_map)
         print(f"graftcheck: wrote {len(findings)} finding(s) to {target}")
         return 0
@@ -428,7 +530,17 @@ def main(argv=None):
     baseline = load_baseline(baseline_path)
     new, old, stale = apply_baseline(findings, baseline, line_map)
 
-    if args.as_json:
+    if args.sarif_output:
+        sarif_dir = os.path.dirname(args.sarif_output)
+        if sarif_dir:
+            os.makedirs(sarif_dir, exist_ok=True)
+        with open(args.sarif_output, "w", encoding="utf-8") as fh:
+            json.dump(sarif_report(new, rules), fh, indent=2)
+            fh.write("\n")
+
+    if fmt == "sarif":
+        print(json.dumps(sarif_report(new, rules), indent=2))
+    elif fmt == "json":
         print(json.dumps({
             "findings": [f.as_dict() for f in new],
             "baselined": [f.as_dict() for f in old],

@@ -1,0 +1,309 @@
+"""Forward dataflow engine: origin-set taint with memoized per-function
+summaries along call-graph edges.
+
+The intra-function rules (tracer, hostsync) walk one body and ask "is
+this expression derived from a tainted parameter?".  This module answers
+the same question *across* a call: each function gets a **summary** —
+which of its parameters flow into which hazards, and which parameters
+its return value derives from — computed once and memoized, so a caller
+can instantiate the summary against its own taint state at every call
+site in O(1).
+
+Design points:
+
+- **Origin sets, not booleans.**  Taint is tracked as the set of
+  parameter names an expression derives from.  A hazard inside a helper
+  records its origin set; at the call site it fires only if one of the
+  *actual* arguments bound to those origins is tainted in the caller.
+  A hazard with an EMPTY origin set is unconditional (``print`` under
+  trace, a blocking sync in a hot path) and fires at every call site.
+- **Depth bound.**  Summaries chase calls ``max_depth`` levels deep
+  (default 2 — "taint survives one level of helper calls" plus one for
+  trivial forwarding wrappers).  At the bound, calls go opaque: result
+  taint is the union of argument taints (conservative), no hazards.
+- **Cycle safe.**  A function currently being summarized (direct or
+  mutual recursion) is treated as opaque at the recursive edge; the
+  completed summary is memoized, so cycles terminate with the same
+  conservative default the depth bound uses.
+
+The walker here is the superset of tracer.py's boolean walker (same
+laundering rules: ``.shape``/``.dtype`` metadata, ``is None`` presence
+checks, shape builtins); rule modules subclass :class:`OriginWalker`
+to add their hazard hooks and plug it into a :class:`SummaryEngine`.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+# Shared with tracer.py (kept here so dataflow has no rule imports; the
+# rule modules re-use these same sets).
+STATIC_ATTRS = {"shape", "dtype", "ndim", "weak_type", "sharding", "aval"}
+SHAPE_FNS = {"len", "isinstance", "type", "id", "repr", "str", "format"}
+
+
+def call_name(fn):
+    """Dotted name of a call target ('jax.jit', 'jit'); None when the
+    target is not a plain name/attribute chain."""
+    parts = []
+    while isinstance(fn, ast.Attribute):
+        parts.append(fn.attr)
+        fn = fn.value
+    if isinstance(fn, ast.Name):
+        parts.append(fn.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+EMPTY = frozenset()
+
+
+@dataclasses.dataclass
+class Hazard:
+    """One potential finding inside a summarized function.  ``origins``
+    names the parameters whose taint triggers it (empty = fires
+    unconditionally); ``line`` is where it sits in the CALLEE (the
+    caller reports at its own call-site line, mentioning this one)."""
+    origins: frozenset
+    rule: str
+    message: str
+    line: int
+
+
+@dataclasses.dataclass
+class Summary:
+    hazards: list
+    ret_origins: frozenset
+
+    @classmethod
+    def opaque(cls, params=()):
+        # conservative default: result derives from every parameter,
+        # nothing observable inside
+        return cls(hazards=[], ret_origins=frozenset(params))
+
+
+class OriginWalker(ast.NodeVisitor):
+    """Taint propagation with origin sets.
+
+    ``env`` maps local names to frozensets of origin labels (the
+    summarized function's parameter names).  Subclasses override
+    ``on_call(node, origins_of_args)`` and the statement hooks to record
+    hazards into ``self.hazards``.
+    """
+
+    def __init__(self, engine=None, scope=None, depth=0):
+        self.env = {}
+        self.engine = engine        # SummaryEngine or None
+        self.scope = scope          # FunctionInfo for call resolution
+        self.depth = depth
+        self.hazards = []
+        self.ret_origins = EMPTY
+
+    # ---- origin query ----------------------------------------------------
+
+    def origins(self, node):
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, EMPTY)
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return EMPTY
+            return self.origins(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.origins(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.origins(node.left) | self.origins(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.origins(node.operand)
+        if isinstance(node, ast.Compare):
+            # identity (presence) checks are static under trace
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return EMPTY
+            out = self.origins(node.left)
+            for c in node.comparators:
+                out |= self.origins(c)
+            return out
+        if isinstance(node, ast.BoolOp):
+            out = EMPTY
+            for v in node.values:
+                out |= self.origins(v)
+            return out
+        if isinstance(node, ast.IfExp):
+            return (self.origins(node.body) | self.origins(node.orelse)
+                    | self.origins(node.test))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = EMPTY
+            for e in node.elts:
+                out |= self.origins(e)
+            return out
+        if isinstance(node, ast.Starred):
+            return self.origins(node.value)
+        if isinstance(node, ast.Call):
+            return self.call_origins(node)
+        return EMPTY
+
+    def call_origins(self, node):
+        """Origin set of a call's result.  Resolvable callees answer via
+        their summary (a helper that drops its tainted argument launders
+        the taint); unresolvable ones get the conservative union."""
+        name = call_name(node.func)
+        base = name.split(".")[-1] if name else None
+        if base in SHAPE_FNS:
+            return EMPTY
+        arg_origins = EMPTY
+        for a in node.args:
+            arg_origins |= self.origins(a)
+        for k in node.keywords:
+            arg_origins |= self.origins(k.value)
+        if isinstance(node.func, ast.Attribute):
+            arg_origins |= self.origins(node.func.value)
+        summary, binding = self.callee_summary(node)
+        if summary is not None:
+            out = EMPTY
+            for origin in summary.ret_origins:
+                out |= binding.get(origin, EMPTY)
+            return out
+        return arg_origins
+
+    def callee_summary(self, node):
+        """(Summary, {callee param -> actual-arg origin set}) for a
+        resolvable call within depth, else (None, None)."""
+        if self.engine is None or self.scope is None or self.depth <= 0:
+            return None, None
+        fi = self.engine.callgraph.resolve_call(node.func, self.scope)
+        if fi is None:
+            return None, None
+        summary = self.engine.summary(fi, self.depth - 1)
+        if summary is None:
+            return None, None
+        params = fi.params
+        # drop the bound receiver for self.method(...) calls
+        if params and params[0] == "self" and isinstance(
+                node.func, ast.Attribute):
+            params = params[1:]
+        binding = {}
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i < len(params):
+                binding[params[i]] = self.origins(a)
+        for kw in node.keywords:
+            if kw.arg is not None:
+                binding[kw.arg] = self.origins(kw.value)
+        return summary, binding
+
+    # ---- propagation -----------------------------------------------------
+
+    def _bind(self, target, origins):
+        if isinstance(target, ast.Name):
+            if origins:
+                self.env[target.id] = origins
+            else:
+                self.env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, origins)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, origins)
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        o = self.origins(node.value)
+        for tgt in node.targets:
+            self._bind(tgt, o)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        o = self.origins(node.value)
+        if o and isinstance(node.target, ast.Name):
+            self.env[node.target.id] = self.env.get(node.target.id,
+                                                    EMPTY) | o
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            self._bind(node.target, self.origins(node.value))
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        self._bind(node.target, self.origins(node.iter))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_Return(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+            self.ret_origins |= self.origins(node.value)
+
+    def visit_Call(self, node):
+        self.on_call(node)
+        self.generic_visit(node)
+
+    def on_call(self, node):  # hazard hook — subclasses override
+        pass
+
+    def instantiate_callee_hazards(self, node):
+        """Fold a resolvable callee's hazards into this summary: each
+        hazard re-anchors at this call site with its origin set mapped
+        through the argument binding (a hazard whose origins bind to
+        concrete actuals is dead at this site and dropped)."""
+        summary, binding = self.callee_summary(node)
+        if summary is None:
+            return
+        for hz in summary.hazards:
+            if not hz.origins:
+                self.hazards.append(Hazard(EMPTY, hz.rule, hz.message,
+                                           node.lineno))
+                continue
+            origins = EMPTY
+            for o in hz.origins:
+                origins |= binding.get(o, EMPTY)
+            if origins:
+                self.hazards.append(Hazard(origins, hz.rule, hz.message,
+                                           node.lineno))
+
+    # Closures share the enclosing frame's taint env (they see outer
+    # locals); parameters of the nested def shadow nothing tainted.
+    def visit_FunctionDef(self, node):
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class SummaryEngine:
+    """Memoized per-function summaries for one walker class.
+
+    ``make_walker(engine, fi, depth)`` builds the rule's OriginWalker
+    subclass; the engine seeds the walker's env with each parameter as
+    its own origin, walks the body, and caches the resulting Summary
+    keyed on (function, depth).  Recursion is broken by registering an
+    in-progress marker that resolves to the opaque summary.
+    """
+
+    def __init__(self, callgraph, make_walker, max_depth=2):
+        self.callgraph = callgraph
+        self.make_walker = make_walker
+        self.max_depth = max_depth
+        self._memo = {}
+        self._in_progress = set()
+
+    def summary(self, fi, depth=None):
+        depth = self.max_depth if depth is None else depth
+        if depth <= 0 or id(fi.node) in self._in_progress:
+            return Summary.opaque(p for p in fi.params if p != "self")
+        key = (id(fi.node), depth)
+        if key in self._memo:
+            return self._memo[key]
+        self._in_progress.add(id(fi.node))
+        try:
+            w = self.make_walker(self, fi, depth)
+            for p in fi.params:
+                if p != "self":
+                    w.env[p] = frozenset((p,))
+            for stmt in fi.node.body:
+                w.visit(stmt)
+            s = Summary(hazards=w.hazards, ret_origins=w.ret_origins)
+        finally:
+            self._in_progress.discard(id(fi.node))
+        self._memo[key] = s
+        return s

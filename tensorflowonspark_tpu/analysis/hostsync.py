@@ -1,5 +1,4 @@
-"""Host-sync analyzer: device->host synchronization inside annotated
-serving hot paths.
+"""Host-sync analyzer: device->host synchronization in serving hot paths.
 
 The async decode engine (``serve.ContinuousBatcher``) splits work across
 a device thread (dispatch, keeps >=2 steps in flight) and a host thread
@@ -7,30 +6,43 @@ a device thread (dispatch, keeps >=2 steps in flight) and a host thread
 device thread NEVER blocks on device values: a stray
 ``block_until_ready()``, ``.item()``, ``float(x)`` or ``np.asarray(x)``
 in the dispatch path serializes the pipeline back into the single-thread
-engine this PR replaced — silently, with no test failure, just a
+engine PR 6 replaced — silently, with no test failure, just a
 throughput regression.  This rule machine-enforces the invariant.
 
-Unlike the tracer rules (which find jit-staged functions by decorator),
-the hot path is *host* code: there is nothing syntactic to key off, so
-functions opt in with a marker comment on (or directly above) the
-``def`` line::
+**Which functions are hot paths?**  Two sources, merged:
 
-    def _dispatch(self):  # graftcheck: hotpath
-        ...
+- **Inferred** (the default since graftcheck v2): the thread-role map
+  (:mod:`.threads`) marks a thread role as the *device-dispatch role*
+  when its call closure starts device copies (``copy_to_host_async``);
+  every method reachable ONLY from that role is a hot path — zero
+  annotations.  Methods also reachable from the host/external roles
+  (``_process_batch``, ``_retire``, ...) are shared host-side code and
+  are exempt.
+- **Marked**: the legacy ``# graftcheck: hotpath`` comment on (or
+  directly above) the ``def`` line still works for host code the role
+  inference cannot see (free functions, single-threaded drivers) and
+  runs the STRICTER cast check below.
 
-Inside a marked function the rule flags
+Inside a hot function the rule flags
 
 - ``.block_until_ready()`` / ``.item()`` / ``.tolist()`` / ``.numpy()``
-  / ``.to_py()`` method calls (explicit host syncs),
+  / ``.to_py()`` method calls (explicit host syncs) — including inside
+  project helpers the hot function calls (call-graph summaries via
+  :mod:`.dataflow`: the finding lands at the hot call site and names
+  the helper line),
 - ``np.asarray(...)`` and friends (implicit ``__array__`` sync),
-- ``float()`` / ``int()`` / ``bool()`` / ``complex()`` on anything not
-  provably static (shape/dtype/len chains and literals are exempt —
-  ``int(rows.shape[0])`` is metadata, not a readback).
+- ``float()`` / ``int()`` / ``bool()`` / ``complex()`` on non-static
+  arguments.  Marked functions use the strict test (a bare name could
+  hold anything — the marker shifts the burden of proof onto the code);
+  inferred functions relax it so plain host-int locals
+  (``bool(stops)``, ``float(t1 - t0)``) pass, and only expressions
+  containing calls or object attribute loads — the shapes a device
+  array actually arrives in — are flagged.
 
 ``copy_to_host_async`` is deliberately NOT flagged: it is the
 non-blocking transfer the engine is built around.  Nested functions
-inherit the enclosing marker (a closure defined in the hot path runs in
-the hot path).  Escape hatch for a justified sync: the standard
+inherit the enclosing hot status (a closure defined in the hot path
+runs in the hot path).  Escape hatch for a justified sync: the standard
 ``# graftcheck: disable=hostsync`` suppression on the offending line.
 """
 from __future__ import annotations
@@ -38,8 +50,12 @@ from __future__ import annotations
 import ast
 import re
 
+from . import threads
 from .core import Finding, Rule, register
-from .tracer import _CAST_FNS, _HOST_METHODS, _NUMPY_FORCERS, _NUMPY_ROOTS, _call_name
+from .dataflow import EMPTY, Hazard, OriginWalker, SummaryEngine
+from .tracer import (_CAST_FNS, _HOST_METHODS, _NUMPY_FORCERS, _NUMPY_ROOTS,
+                     _call_name)
+from . import callgraph as callgraph_mod
 
 _HOTPATH_RE = re.compile(r"#\s*graftcheck:\s*hotpath\b")
 
@@ -54,21 +70,29 @@ _META_ATTRS = {"shape", "ndim", "size", "dtype"}
 _STATIC_FNS = {"len", "range", "min", "max", "sum", "round", "ord", "id"}
 
 
-def _is_static(node):
+def _is_static(node, relaxed=False):
     """True when ``node`` provably evaluates to a host-side Python value
-    (so casting it is free).  Conservative: a bare name could hold
-    anything, so it is NOT static — in a marked hot path the burden of
-    proof is on the code."""
+    (so casting it is free).  Strict mode: a bare name could hold
+    anything, so it is NOT static.  Relaxed mode (role-inferred hot
+    paths): bare names and boolean combinations pass — only calls and
+    non-metadata attribute loads look like device values."""
     if isinstance(node, ast.Constant):
         return True
+    if isinstance(node, ast.Name):
+        return relaxed
     if isinstance(node, ast.Attribute):
-        return node.attr in _META_ATTRS or _is_static(node.value)
+        return node.attr in _META_ATTRS or _is_static(node.value, relaxed)
     if isinstance(node, ast.Subscript):
-        return _is_static(node.value)
+        return _is_static(node.value, relaxed)
     if isinstance(node, ast.UnaryOp):
-        return _is_static(node.operand)
+        return _is_static(node.operand, relaxed)
     if isinstance(node, ast.BinOp):
-        return _is_static(node.left) and _is_static(node.right)
+        return (_is_static(node.left, relaxed)
+                and _is_static(node.right, relaxed))
+    if isinstance(node, (ast.BoolOp, ast.Compare)) and relaxed:
+        parts = (node.values if isinstance(node, ast.BoolOp)
+                 else [node.left] + node.comparators)
+        return all(_is_static(p, relaxed) for p in parts)
     if isinstance(node, ast.Call):
         name = _call_name(node.func)
         base = name.split(".")[-1] if name else None
@@ -76,10 +100,45 @@ def _is_static(node):
     return False
 
 
+class _SyncOriginWalker(OriginWalker):
+    """Summary walker for helper functions: records the unconditionally
+    blocking operations (explicit sync methods) so hot callers report
+    them at the call site.  Casts/np.asarray stay intra-function — in a
+    helper they are usually legitimate host-side conversions."""
+
+    def on_call(self, node):
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS:
+            self.hazards.append(Hazard(
+                EMPTY, "hostsync",
+                f".{node.func.attr}() blocks on a device value",
+                node.lineno))
+        else:
+            self.instantiate_callee_hazards(node)
+
+
+def _sync_engine(ctx):
+    project = ctx.project
+    if project is None or not getattr(project, "files", None):
+        return None
+    engine = getattr(project, "_hostsync_engine", None)
+    if engine is None:
+        cg = callgraph_mod.for_project(project)
+        if not cg.modules:
+            return None
+        engine = SummaryEngine(
+            cg, lambda e, fi, depth: _SyncOriginWalker(e, fi, depth))
+        project._hostsync_engine = engine
+    return engine
+
+
 class _HotpathWalker(ast.NodeVisitor):
-    def __init__(self, ctx, fn):
+    def __init__(self, ctx, fn, strict, engine=None, hot_ids=()):
         self.ctx = ctx
         self.fn = fn
+        self.strict = strict
+        self.engine = engine
+        self.hot_ids = hot_ids
         self.findings = []
 
     def _flag(self, node, msg):
@@ -103,12 +162,29 @@ class _HotpathWalker(ast.NodeVisitor):
                        f"inside hot path '{self.fn.name}'; keep the array on "
                        "device and convert in the host thread")
         elif (base in _CAST_FNS and name == base and node.args
-              and not all(_is_static(a) for a in node.args)):
+              and not all(_is_static(a, relaxed=not self.strict)
+                          for a in node.args)):
             self._flag(node,
                        f"{base}() on a possibly-device value inside hot path "
                        f"'{self.fn.name}' forces a blocking readback; shape/"
                        "dtype metadata is exempt, device values are not")
+        elif self.engine is not None:
+            self._check_callee(node)
         self.generic_visit(node)
+
+    def _check_callee(self, node):
+        cg = self.engine.callgraph
+        scope = cg.function_info(self.fn)
+        if scope is None:
+            return
+        fi = cg.resolve_call(node.func, scope)
+        if fi is None or id(fi.node) in self.hot_ids:
+            return      # hot callees are checked directly at their def
+        for hz in self.engine.summary(fi).hazards:
+            self._flag(node,
+                       f"{hz.message} in helper '{fi.name}' (line {hz.line})"
+                       f" called from hot path '{self.fn.name}'; move the "
+                       "sync to the host thread")
 
     # Closures defined inside a hot path run inside the hot path.
     def visit_FunctionDef(self, node):
@@ -131,7 +207,8 @@ def _is_marked(ctx, fn):
 class HostSyncRule(Rule):
     name = "hostsync"
     description = ("blocking device sync (block_until_ready/.item()/float()/"
-                   "np.asarray) inside a '# graftcheck: hotpath' function")
+                   "np.asarray) inside a device-role-inferred or "
+                   "'# graftcheck: hotpath'-marked function")
     kind = "semantic"
     scope = "package"
 
@@ -139,18 +216,26 @@ class HostSyncRule(Rule):
         marked = [node for node in ast.walk(ctx.tree)
                   if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
                   and _is_marked(ctx, node)]
-        # A function nested inside a marked function is already covered by
+        marked_ids = {id(fn) for fn in marked}
+        inferred = [fn for fid, fn in
+                    sorted(threads.inferred_hotpaths(ctx).items())
+                    if fid not in marked_ids]
+        hot = [(fn, True) for fn in marked] + [(fn, False) for fn in inferred]
+        # A function nested inside a hot function is already covered by
         # the closure walk — walking it again would double-report.
         nested = set()
-        for fn in marked:
+        for fn, _strict in hot:
             for sub in ast.walk(fn):
                 if sub is not fn and isinstance(
                         sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     nested.add(id(sub))
-        for fn in marked:
+        engine = _sync_engine(ctx)
+        hot_ids = {id(fn) for fn, _strict in hot}
+        for fn, strict in hot:
             if id(fn) in nested:
                 continue
-            w = _HotpathWalker(ctx, fn)
+            w = _HotpathWalker(ctx, fn, strict, engine=engine,
+                               hot_ids=hot_ids)
             for stmt in fn.body:
                 w.visit(stmt)
             yield from w.findings

@@ -1,0 +1,206 @@
+"""jit-cache lint: varying shapes/statics fed to traced callables.
+
+XLA compiles one program per (shape, dtype, static-args) signature.  A
+slice with a data-dependent bound — ``toks[:n]`` where ``n`` is the
+request's prompt length — gives every distinct length its own
+compilation, which is the compile-blowup class PR 5 hand-fixed in the
+prefill engine: serving code must round such bounds through the
+established bucketing idioms (``_pow2_width``/``_bucket_len``-style
+helpers, padding to a config constant) so the cache stays O(log n).
+The same applies to ``static_argnums``/``static_argnames`` positions:
+a varying Python value there is a retrace per value by definition.
+
+What counts as a **traced callable** at a call site (per file, syntactic):
+
+- a function staged in this module (``@jax.jit``, ``@partial(jax.jit,
+  ...)``, ``g = jit(f, ...)``) — statics are read off the ``jit`` call;
+- an attribute assigned from a ``_jitted*`` factory (the repo's
+  ``self._step = decode._jitted_slot_step(model)`` idiom) or from a
+  direct ``jit(...)`` call.
+
+What counts as **bucketed** (stable cache key): constants, ``self.*``
+config attributes, values produced by a call whose name contains
+``pow2``/``bucket``/``align``/``round``/``ceil``/``pad``, and
+arithmetic/min/max over those.  Function parameters, ``len(...)``
+results, and subscript loads (per-request dict fields) vary per call.
+Assignments are chased through local names within the function.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Rule, register
+from .dataflow import call_name
+from .tracer import _staged_functions, _static_filter
+
+_BUCKET_RE = re.compile(r"pow2|bucket|align|round|ceil|pad", re.IGNORECASE)
+_FACTORY_RE = re.compile(r"(^|_)jitted", re.IGNORECASE)
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit_call(node):
+    """(static_argnums, static_argnames) when `node` is a jit(...) /
+    partial(jax.jit, ...) call expression, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node.func)
+    base = name.split(".")[-1] if name else None
+    if base in _JIT_NAMES:
+        return _static_filter(node.keywords)
+    if base == "partial" and node.args:
+        inner = call_name(node.args[0].func
+                          if isinstance(node.args[0], ast.Call)
+                          else node.args[0])
+        if inner and inner.split(".")[-1] in _JIT_NAMES:
+            return _static_filter(node.keywords)
+    return None
+
+
+def _is_factory_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node.func)
+    return bool(name and _FACTORY_RE.search(name.split(".")[-1]))
+
+
+class _Stability:
+    """Classify expressions as cache-stable (bucketed) or varying,
+    chasing local single-assignments inside one function."""
+
+    def __init__(self, assigns):
+        self.assigns = assigns      # name -> value expr (last wins)
+        self._busy = set()
+
+    def stable(self, node):
+        if node is None or isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Attribute):
+            return True             # self.cfg-style config constants
+        if isinstance(node, ast.Name):
+            if node.id in self._busy:
+                return False
+            src = self.assigns.get(node.id)
+            if src is None:
+                return False        # parameter / loop var / unknown
+            self._busy.add(node.id)
+            try:
+                return self.stable(src)
+            finally:
+                self._busy.discard(node.id)
+        if isinstance(node, ast.Call):
+            name = call_name(node.func)
+            base = name.split(".")[-1] if name else ""
+            if _BUCKET_RE.search(base):
+                return True         # the bucketing idiom itself
+            if base in ("min", "max", "int"):
+                return all(self.stable(a) for a in node.args)
+            return False            # len(...), request-dependent helpers
+        if isinstance(node, ast.BinOp):
+            return self.stable(node.left) and self.stable(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.stable(node.operand)
+        if isinstance(node, ast.IfExp):
+            return (self.stable(node.body) and self.stable(node.orelse))
+        return False                # subscripts (per-request fields), etc.
+
+
+def _local_assigns(fn):
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            out[node.target.id] = node.value
+    return out
+
+
+def _self_attr(node):
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+@register
+class RecompileRule(Rule):
+    name = "jit-recompile"
+    description = ("traced callable fed a varying slice bound or "
+                   "static_argnums value — one XLA compile per distinct "
+                   "value; bucket with _pow2_width/_bucket_len or pad")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        if ctx.tree is None:
+            return
+        traced = {}        # callable key -> (static nums, static names)
+        for fn, nums, names, _how in _staged_functions(ctx.tree):
+            traced[f"name:{fn.name}"] = (nums, names)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                statics = _is_jit_call(node.value)
+                for tgt in node.targets:
+                    key = None
+                    if isinstance(tgt, ast.Name):
+                        key = f"name:{tgt.id}"
+                    elif _self_attr(tgt) is not None:
+                        key = f"attr:{_self_attr(tgt)}"
+                    if key is None:
+                        continue
+                    if statics is not None:
+                        traced[key] = statics
+                    elif key.startswith("attr:") and \
+                            _is_factory_call(node.value):
+                        traced[key] = (set(), set())
+        if not traced:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, node, traced)
+
+    def _check_fn(self, ctx, fn, traced):
+        stab = _Stability(_local_assigns(fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = None
+            if isinstance(node.func, ast.Name):
+                key = f"name:{node.func.id}"
+            elif _self_attr(node.func) is not None:
+                key = f"attr:{_self_attr(node.func)}"
+            if key not in traced:
+                continue
+            nums, names = traced[key]
+            label = key.split(":", 1)[1]
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Subscript) and \
+                            isinstance(sub.slice, ast.Slice):
+                        for bound in (sub.slice.lower, sub.slice.upper):
+                            if bound is not None and not stab.stable(bound):
+                                yield Finding(
+                                    ctx.path, sub.lineno, self.name,
+                                    f"slice bound fed to traced callable "
+                                    f"'{label}' varies per call — every "
+                                    "distinct length compiles a new XLA "
+                                    "program; round it through a "
+                                    "bucketing helper (_pow2_width/"
+                                    "_bucket_len) or pad to a constant")
+            for i in sorted(nums):
+                if i < len(node.args) and not stab.stable(node.args[i]):
+                    yield Finding(
+                        ctx.path, node.args[i].lineno, self.name,
+                        f"argument {i} of '{label}' is static_argnums but "
+                        "varies per call — each value retraces; bucket it "
+                        "or make it a traced array argument")
+            for kw in node.keywords:
+                if kw.arg in names and not stab.stable(kw.value):
+                    yield Finding(
+                        ctx.path, kw.value.lineno, self.name,
+                        f"keyword '{kw.arg}' of '{label}' is "
+                        "static_argnames but varies per call — each value "
+                        "retraces; bucket it or make it a traced array "
+                        "argument")

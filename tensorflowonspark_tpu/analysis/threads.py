@@ -1,0 +1,629 @@
+"""Thread-role race analyzer for the serving/orchestration plane.
+
+PR 6 split ``serve.ContinuousBatcher`` into a device thread, a host
+drain thread, and the HTTP handler threads that call its public
+methods.  The ``locks`` rule sees lock/container pairing inside one
+class but knows nothing about *which thread runs which method* — so it
+cannot tell a single-thread free list (safe bare) from a counter two
+threads bump (a lost-update race).  This analyzer infers the thread
+topology and checks attribute sharing against it.
+
+**Role inference** (zero annotations):
+
+- every ``threading.Thread(target=self.X, ...)`` / ``Timer(_, self.X)``
+  constructed anywhere in the class starts role ``thread:X``;
+- ``do_GET``/``do_POST``-style methods are HTTP entry points (the
+  stdlib server runs each on its own handler thread);
+- public methods and private methods never referenced inside the class
+  form the ``external`` role — the HTTP plane and test/driver callers.
+
+Each role's **reachable set** is the closure over ``self.method(...)``
+calls, propagating the lock set held across each call edge
+(intersection over paths).  A call (or access) lexically under
+``if threading.current_thread() is self.<t>:`` — the repo's
+thread-identity-pinning idiom (``_retire``) — is attributed to the
+pinned thread's role, not the caller's.
+
+**Reported hazards** (rule ``thread-race``):
+
+- a mutable container content-written in one role and content-accessed
+  in another with no lock held at every one of those accesses
+  (subscript/iteration/``len()``/``.get()`` can interleave with a
+  concurrent resize);
+- a read-modify-write (``self.x += 1``; ``self.x = f(self.x)``)
+  executed from two or more roles without a common lock — the
+  lost-update race.
+
+Plain attribute rebinds cross-role stay silent (CPython rebind is
+atomic; the repo's snapshot-publication idiom depends on it), as do
+``queue.Queue``/``threading.*`` attributes (they ARE the sanctioned
+handoff) and single-role attributes.  Findings anchor at the
+attribute's ``__init__`` assignment so one
+``# graftcheck: disable=thread-race`` documents a deliberately
+unsynchronized attribute exactly once.
+
+Rule ``lock-order`` reports cycles in the "acquired-while-holding"
+digraph (lock-order inversion — deadlock risk), again following call
+edges.
+
+The role map doubles as the ``hostsync`` rule's hot-path oracle: a
+thread role whose closure starts device copies
+(``copy_to_host_async``) is the device-dispatch role, and its
+exclusive methods are hot paths with no marker needed
+(:func:`inferred_hotpaths`).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from . import callgraph as callgraph_mod
+from .core import Finding, Rule, register
+from .dataflow import call_name
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_SYNC_CTORS = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+               "BoundedSemaphore", "Barrier"}
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+_THREAD_CTORS = {"Thread", "Timer"}
+_HTTP_ENTRIES = {"do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD",
+                 "do_PATCH"}
+_MUTATOR_METHODS = {
+    "setdefault", "update", "pop", "popitem", "append", "extend", "insert",
+    "remove", "clear", "add", "discard", "popleft", "appendleft",
+}
+_CONTENT_METHODS = _MUTATOR_METHODS | {
+    "get", "items", "keys", "values", "index", "count", "copy",
+}
+_CONSUMER_FNS = {"len", "list", "tuple", "sorted", "set", "dict", "sum",
+                 "min", "max", "any", "all", "iter", "enumerate"}
+
+# access kinds
+READ, REBIND, RMW, CREAD, CWRITE = ("read", "rebind", "rmw",
+                                    "content-read", "content-write")
+
+
+def _self_attr(node):
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _ctor_base(value):
+    if isinstance(value, ast.Call):
+        name = call_name(value.func)
+        if name is not None:
+            return name.split(".")[-1]
+    return None
+
+
+def _refs_self_attr(expr, attr):
+    for node in ast.walk(expr):
+        if _self_attr(node) == attr:
+            return True
+    return False
+
+
+def _pinned_thread_attr(test):
+    """'X' when `test` is ``threading.current_thread() is self.X`` (either
+    operand order, ``is`` or ``==``) — the thread-identity-pinning idiom."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.Eq))):
+        return None
+    sides = [test.left, test.comparators[0]]
+    attr = next((a for a in map(_self_attr, sides) if a is not None), None)
+    cur = next((s for s in sides if isinstance(s, ast.Call)
+                and (call_name(s.func) or "").split(".")[-1]
+                in ("current_thread", "currentThread")), None)
+    return attr if (attr and cur is not None) else None
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    line: int
+    kind: str
+    locks: frozenset       # lexically-held self.<lock> attrs
+    method: str
+    pin: str = None        # thread-attr this access is pinned to
+
+
+@dataclasses.dataclass
+class MethodFacts:
+    name: str
+    node: object
+    accesses: list
+    calls: list            # (callee name, lexical locks, line, pin)
+    acquisitions: list     # (lock, locks-held-before, line)
+    has_device_copy: bool  # contains a .copy_to_host_async() call
+
+
+@dataclasses.dataclass
+class Role:
+    name: str
+    kind: str              # "thread" | "http" | "external"
+    entries: tuple
+    methods: dict = dataclasses.field(default_factory=dict)
+    # method name -> frozenset of locks held at EVERY call path into it
+    entry_locks: dict = dataclasses.field(default_factory=dict)
+    device: bool = False   # reaches copy_to_host_async => device dispatch
+
+
+@dataclasses.dataclass
+class ClassModel:
+    cls: object                          # callgraph.ClassInfo
+    locks: set
+    queues: set
+    syncs: set
+    containers: set
+    init_lines: dict                     # attr -> __init__ assignment line
+    facts: dict                          # method name -> MethodFacts
+    roles: dict                          # role name -> Role
+    thread_attr_targets: dict            # self-attr holding a Thread -> target
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Collect one method's attribute accesses, intra-class call edges,
+    and lock acquisitions, tracking lexical `with self.<lock>` nesting
+    and thread-identity pins."""
+
+    def __init__(self, model, method_name):
+        self.m = model
+        self.method = method_name
+        self.locks = []          # stack of held lock attrs
+        self.pin = None
+        self.accesses = []
+        self.calls = []
+        self.acquisitions = []
+        self.has_device_copy = False
+        self._skip = set()       # node ids already recorded via a parent
+
+    def _held(self):
+        return frozenset(self.locks)
+
+    def _note(self, attr, node, kind):
+        self.accesses.append(Access(attr, node.lineno, kind, self._held(),
+                                    self.method, self.pin))
+
+    # ---- locks -----------------------------------------------------------
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            expr = item.context_expr
+            attr = _self_attr(expr)
+            if attr is None and isinstance(expr, ast.Call):
+                attr = _self_attr(expr.func)
+            if attr in self.m.locks:
+                acquired.append(attr)
+            self.visit(expr)
+        for lock in acquired:
+            self.acquisitions.append((lock, self._held(), node.lineno))
+            self.locks.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in acquired:
+            self.locks.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_If(self, node):
+        pin = _pinned_thread_attr(node.test)
+        self.visit(node.test)
+        if pin is not None and pin in self.m.thread_attr_targets:
+            prev, self.pin = self.pin, pin
+            for stmt in node.body:
+                self.visit(stmt)
+            self.pin = prev
+            for stmt in node.orelse:
+                self.visit(stmt)
+            return
+        self.generic_visit(node)
+
+    # ---- accesses --------------------------------------------------------
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            for t in ([tgt] if not isinstance(tgt, (ast.Tuple, ast.List))
+                      else tgt.elts):
+                attr = _self_attr(t)
+                if attr is not None:
+                    self._skip.add(id(t))
+                    kind = (RMW if _refs_self_attr(node.value, attr)
+                            else REBIND)
+                    self._note(attr, node, kind)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        attr = _self_attr(node.target)
+        if attr is not None:
+            self._skip.add(id(node.target))
+            self._note(attr, node, RMW)
+        elif isinstance(node.target, ast.Subscript):
+            attr = _self_attr(node.target.value)
+            if attr is not None:
+                self._skip.add(id(node.target))
+                self._skip.add(id(node.target.value))
+                self._note(attr, node, CWRITE)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if id(node) not in self._skip:
+            attr = _self_attr(node.value)
+            if attr is not None:
+                self._skip.add(id(node.value))
+                self._note(attr, node,
+                           CWRITE if isinstance(node.ctx, (ast.Store,
+                                                           ast.Del))
+                           else CREAD)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "copy_to_host_async":
+                self.has_device_copy = True
+            # self.method(...): an intra-class call edge, not a data access
+            meth = _self_attr(node.func)
+            if meth is not None and meth in self.m.cls.methods:
+                self._skip.add(id(node.func))
+                self.calls.append((meth, self._held(), node.lineno,
+                                   self.pin))
+            owner = _self_attr(node.func.value)
+            if owner is not None:
+                self._skip.add(id(node.func.value))
+                if owner in self.m.containers:
+                    self._note(owner, node,
+                               CWRITE if node.func.attr in _MUTATOR_METHODS
+                               else CREAD)
+        name = call_name(node.func)
+        if name in _CONSUMER_FNS:
+            for a in node.args:
+                attr = _self_attr(a)
+                if attr is not None:
+                    self._skip.add(id(a))
+                    self._note(attr, node, CREAD)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        attr = _self_attr(node.iter)
+        if attr is not None:
+            self._skip.add(id(node.iter))
+            self._note(attr, node.iter, CREAD)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):
+        attr = _self_attr(node.iter)
+        if attr is not None:
+            self._skip.add(id(node.iter))
+            self._note(attr, node.iter, CREAD)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def visit_Attribute(self, node):
+        if id(node) not in self._skip:
+            attr = _self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                self._note(attr, node, READ)
+        self.generic_visit(node)
+
+
+def build_class_model(ci):
+    """ClassModel (attribute classes, per-method facts, roles) for one
+    callgraph.ClassInfo, or None when the class spawns no threads."""
+    thread_targets = {}        # role-entry method name -> ctor line
+    thread_attr_targets = {}   # self-attr holding the Thread -> target name
+    for m in ci.methods.values():
+        for node in ast.walk(m.node):
+            if isinstance(node, ast.Call) and \
+                    _ctor_base(node) in _THREAD_CTORS:
+                target = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = _self_attr(kw.value)
+                if target is None and _ctor_base(node) == "Timer" \
+                        and len(node.args) >= 2:
+                    target = _self_attr(node.args[1])
+                if target is not None and target in ci.methods:
+                    thread_targets.setdefault(target, node.lineno)
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call) \
+                    and _ctor_base(node.value) in _THREAD_CTORS:
+                tgt_attr = next((a for a in map(_self_attr, node.targets)
+                                 if a), None)
+                target = next((_self_attr(kw.value)
+                               for kw in node.value.keywords
+                               if kw.arg == "target"), None)
+                if tgt_attr and target:
+                    thread_attr_targets[tgt_attr] = target
+    if not thread_targets:
+        return None
+
+    locks, queues, syncs, containers, init_lines = set(), set(), set(), \
+        set(), {}
+    init = ci.methods.get("__init__")
+    if init is not None:
+        for node in ast.walk(init.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                init_lines.setdefault(attr, node.lineno)
+                base = _ctor_base(node.value)
+                if base in _LOCK_CTORS:
+                    locks.add(attr)
+                if base in _SYNC_CTORS:
+                    syncs.add(attr)
+                elif base in _QUEUE_CTORS:
+                    queues.add(attr)
+                elif base in _CONTAINER_CTORS or isinstance(
+                        node.value, (ast.Dict, ast.List, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp)) or (
+                        isinstance(node.value, ast.BinOp)
+                        and isinstance(node.value.left, (ast.List,
+                                                         ast.Dict))):
+                    containers.add(attr)
+
+    model = ClassModel(cls=ci, locks=locks, queues=queues, syncs=syncs,
+                       containers=containers, init_lines=init_lines,
+                       facts={}, roles={},
+                       thread_attr_targets=thread_attr_targets)
+
+    for name, fi in ci.methods.items():
+        if name == "__init__":
+            continue           # construction happens-before sharing
+        w = _MethodWalker(model, name)
+        for stmt in fi.node.body:
+            w.visit(stmt)
+        model.facts[name] = MethodFacts(
+            name=name, node=fi.node, accesses=w.accesses, calls=w.calls,
+            acquisitions=w.acquisitions, has_device_copy=w.has_device_copy)
+
+    # ---- roles -----------------------------------------------------------
+    referenced = set()
+    for name, fi in ci.methods.items():
+        for node in ast.walk(fi.node):
+            attr = _self_attr(node)
+            if attr is not None and attr in ci.methods and attr != name:
+                referenced.add(attr)
+    roles = {}
+    for target in sorted(thread_targets):
+        roles[f"thread:{target}"] = Role(name=f"thread:{target}",
+                                         kind="thread", entries=(target,))
+    http = tuple(sorted(n for n in ci.methods if n in _HTTP_ENTRIES))
+    if http:
+        roles["http"] = Role(name="http", kind="http", entries=http)
+    external = tuple(sorted(
+        n for n in ci.methods
+        if n != "__init__" and n not in thread_targets
+        and n not in _HTTP_ENTRIES
+        and (not n.startswith("_") or n not in referenced)))
+    if external:
+        roles["external"] = Role(name="external", kind="external",
+                                 entries=external)
+
+    for role in roles.values():
+        _propagate(model, role)
+        role.device = any(model.facts[m].has_device_copy
+                          for m in role.methods)
+    model.roles = roles
+    return model
+
+
+def _propagate(model, role):
+    """Fill `role.methods`/`entry_locks`: reachable closure over intra-
+    class call edges, entry-lock sets merged by intersection across call
+    paths.  Pinned call edges only traverse when the pin names this
+    role's thread — and they SEED this role from any caller, since the
+    identity check guarantees the callee runs on the pinned thread."""
+    pending = {e: frozenset() for e in role.entries if e in model.facts}
+    if role.kind == "thread":
+        tname = role.entries[0] if role.entries else None
+        for facts in model.facts.values():
+            for callee, lex_locks, _line, pin in facts.calls:
+                if (pin is not None and callee in model.facts
+                        and model.thread_attr_targets.get(pin) == tname):
+                    pending[callee] = (pending[callee] & lex_locks
+                                       if callee in pending else lex_locks)
+    while pending:
+        name, held = pending.popitem()
+        if name in role.entry_locks:
+            merged = role.entry_locks[name] & held
+            if merged == role.entry_locks[name]:
+                continue
+            role.entry_locks[name] = merged
+        else:
+            role.entry_locks[name] = held
+        role.methods[name] = model.facts[name]
+        for callee, lex_locks, _line, pin in model.facts[name].calls:
+            if pin is not None:
+                target = model.thread_attr_targets.get(pin)
+                if role.name != f"thread:{target}":
+                    continue
+            if callee in model.facts:
+                pending[callee] = (role.entry_locks[name] | lex_locks) \
+                    if callee not in pending \
+                    else pending[callee] & (role.entry_locks[name]
+                                            | lex_locks)
+
+
+def _role_of_pin(model, pin):
+    target = model.thread_attr_targets.get(pin)
+    return f"thread:{target}" if target else None
+
+
+def iter_attr_accesses(model):
+    """Yield (role_name, Access, effective_locks) over every role, with
+    entry-held locks folded in and pinned accesses re-attributed."""
+    for rname, role in model.roles.items():
+        for mname, facts in role.methods.items():
+            base = role.entry_locks.get(mname, frozenset())
+            for acc in facts.accesses:
+                eff_role = rname
+                if acc.pin is not None:
+                    pinned = _role_of_pin(model, acc.pin)
+                    if pinned is not None and pinned != rname:
+                        if pinned in model.roles:
+                            eff_role = pinned
+                        else:
+                            continue
+                yield eff_role, acc, base | acc.locks
+
+
+def class_model(ctx, cls_node):
+    """Build (and cache on the project) the ClassModel for `cls_node`."""
+    project = ctx.project
+    cache = getattr(project, "_class_models", None)
+    if cache is None:
+        cache = project._class_models = {}
+    key = id(cls_node)
+    if key not in cache:
+        cg = callgraph_mod.for_project(project)
+        mi = cg.by_path.get(callgraph_mod._posix(ctx.path))
+        ci = None
+        if mi is not None:
+            ci = next((c for c in mi.classes.values()
+                       if c.node is cls_node), None)
+        cache[key] = build_class_model(ci) if ci is not None else None
+    return cache[key]
+
+
+def inferred_hotpaths(ctx):
+    """Function nodes covered by hostsync WITHOUT a marker: methods
+    reachable exclusively from a device-dispatch thread role (a thread
+    whose closure calls ``copy_to_host_async``).  Methods also reachable
+    from the host/external roles are shared host-side code and stay
+    uncovered."""
+    out = {}
+    if ctx.tree is None or ctx.project is None:
+        return out
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        model = class_model(ctx, node)
+        if model is None:
+            continue
+        device, other = set(), set()
+        for role in model.roles.values():
+            (device if role.device else other).update(role.methods)
+        for name in device - other:
+            out[id(model.facts[name].node)] = model.facts[name].node
+    return out
+
+
+@register
+class ThreadRaceRule(Rule):
+    name = "thread-race"
+    description = ("attribute shared across inferred thread roles without "
+                   "a common lock (container resize / lost-update races)")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        if ctx.project is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                model = class_model(ctx, node)
+                if model is not None and len(model.roles) >= 2:
+                    yield from self._check_model(ctx, node, model)
+
+    def _check_model(self, ctx, cls, model):
+        by_attr = {}
+        for rname, acc, locks in iter_attr_accesses(model):
+            if acc.attr in model.queues or acc.attr in model.syncs:
+                continue
+            by_attr.setdefault(acc.attr, []).append((rname, acc, locks))
+
+        for attr in sorted(by_attr):
+            entries = by_attr[attr]
+            roles = {r for r, _, _ in entries}
+            if len(roles) < 2:
+                continue
+            anchor = model.init_lines.get(
+                attr, min(a.line for _, a, _ in entries))
+
+            if attr in model.containers:
+                content = [(r, a, lk) for r, a, lk in entries
+                           if a.kind in (CREAD, CWRITE)]
+                cw_roles = {r for r, a, _ in content if a.kind == CWRITE}
+                c_roles = {r for r, _, _ in content}
+                if cw_roles and len(c_roles) > 1:
+                    common = None
+                    for _, _, lk in content:
+                        common = lk if common is None else common & lk
+                    if not common:
+                        ex = next((f"{a.method}:{a.line}"
+                                   for _, a, lk in content if not lk),
+                                  f"{content[0][1].method}")
+                        yield Finding(
+                            ctx.path, anchor, self.name,
+                            f"{cls.name}.{attr}: container content-written "
+                            f"in role(s) {'/'.join(sorted(cw_roles))} and "
+                            f"accessed from {'/'.join(sorted(c_roles))} "
+                            f"with no common lock (e.g. unguarded at "
+                            f"{ex}); a concurrent resize can interleave — "
+                            "guard every content access or hand off "
+                            "through a queue")
+                continue
+
+            rmw = [(r, a, lk) for r, a, lk in entries if a.kind == RMW]
+            rmw_roles = {r for r, _, _ in rmw}
+            if len(rmw_roles) > 1:
+                common = None
+                for _, _, lk in rmw:
+                    common = lk if common is None else common & lk
+                if not common:
+                    sites = sorted({f"{a.method}:{a.line}"
+                                    for _, a, _ in rmw})
+                    yield Finding(
+                        ctx.path, anchor, self.name,
+                        f"{cls.name}.{attr}: read-modify-write from "
+                        f"roles {'/'.join(sorted(rmw_roles))} "
+                        f"({', '.join(sites[:4])}) with no common lock — "
+                        "concurrent increments lose updates; use "
+                        "metrics.Counters or guard with one lock")
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = ("lock acquisition cycles across thread roles "
+                   "(A-then-B in one path, B-then-A in another)")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        if ctx.project is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                model = class_model(ctx, node)
+                if model is not None:
+                    yield from self._check_model(ctx, node, model)
+
+    def _check_model(self, ctx, cls, model):
+        edges = {}        # lock -> {lock2: first line seen}
+        for role in model.roles.values():
+            for mname, facts in role.methods.items():
+                base = role.entry_locks.get(mname, frozenset())
+                for lock, held, line in facts.acquisitions:
+                    for h in base | held:
+                        if h != lock:
+                            edges.setdefault(h, {}).setdefault(lock, line)
+        reported = set()
+        for a in sorted(edges):
+            for b in sorted(edges[a]):
+                if a in edges.get(b, ()) and frozenset((a, b)) not in reported:
+                    reported.add(frozenset((a, b)))
+                    yield Finding(
+                        ctx.path, edges[a][b], "lock-order",
+                        f"{cls.name}: self.{b} acquired while holding "
+                        f"self.{a} (line {edges[a][b]}) and self.{a} while "
+                        f"holding self.{b} (line {edges[b][a]}) — lock-"
+                        "order inversion; pick one order everywhere")

@@ -16,12 +16,19 @@ static-under-trace attributes (``.shape``/``.dtype``/``.ndim``) launder it.
 Jitted functions are found by decorator (``@jax.jit``,
 ``@partial(jax.jit, ...)``, ``@shard_map``-style) and by same-module
 wrapping calls (``f2 = jax.jit(f)``, ``compat.shard_map(f, mesh=...)``).
+
+Interprocedural tier: calls out of a staged function to a resolvable
+project helper consult the helper's dataflow summary
+(:mod:`.dataflow`), so ``float(x)`` buried one or two helper frames
+down still reports — at the staged call site, naming the helper line.
 """
 from __future__ import annotations
 
 import ast
 
+from . import callgraph
 from .core import Finding, Rule, register
+from .dataflow import EMPTY, Hazard, OriginWalker, SummaryEngine, call_name
 
 # Attributes that are static (Python values) even on a tracer.
 _STATIC_ATTRS = {"shape", "dtype", "ndim", "weak_type", "sharding", "aval"}
@@ -105,12 +112,13 @@ def _staged_functions(tree):
 
 
 class _TaintWalker(ast.NodeVisitor):
-    def __init__(self, rule, ctx, fn, tainted, staged_as):
+    def __init__(self, rule, ctx, fn, tainted, staged_as, engine=None):
         self.rule = rule
         self.ctx = ctx
         self.fn = fn
         self.tainted = tainted
         self.staged_as = staged_as
+        self.engine = engine        # dataflow.SummaryEngine (interproc) or None
         self.findings = []
 
     # -- taint query -------------------------------------------------------
@@ -217,7 +225,22 @@ class _TaintWalker(ast.NodeVisitor):
                        f"print() inside {self.staged_as}-staged "
                        f"'{self.fn.name}' runs only at trace time; use "
                        "jax.debug.print()")
+        elif self.engine is not None:
+            self._check_callee(node)
         self.generic_visit(node)
+
+    def _check_callee(self, node):
+        """Interprocedural step: when the callee is a project-local helper,
+        instantiate its hazard summary against the taint of the actual
+        arguments, so a host cast one (or two) helper frames down still
+        reports — at THIS call site, naming the helper line."""
+        hazards = _callee_hazards(self.engine, node, self.fn,
+                                  lambda e: self.is_tainted(e))
+        for fi, hz in hazards:
+            self._flag(node, hz.rule,
+                       f"{hz.message} in helper '{fi.name}' (line {hz.line})"
+                       f" reached with a traced value from {self.staged_as}"
+                       f"-staged '{self.fn.name}'")
 
     def visit_If(self, node):
         if self.is_tainted(node.test):
@@ -253,6 +276,125 @@ class _TaintWalker(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
+class _TracerOriginWalker(OriginWalker):
+    """Origin-set mirror of _TaintWalker used to SUMMARIZE helper
+    functions: same hazard classes, but each records which parameters it
+    fires for, so call sites instantiate them against actual-argument
+    taint.  Messages here are fragments; the reporting walker wraps them
+    with the helper/staged-function context."""
+
+    def on_call(self, node):
+        name = call_name(node.func)
+        base = name.split(".")[-1] if name else None
+        arg_origins = EMPTY
+        for a in node.args:
+            arg_origins |= self.origins(a)
+        if base in _CAST_FNS and name == base and arg_origins:
+            self.hazards.append(Hazard(
+                arg_origins, "tracer-host-cast",
+                f"{base}() forces a host round-trip", node.lineno))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _HOST_METHODS
+              and self.origins(node.func.value)):
+            self.hazards.append(Hazard(
+                self.origins(node.func.value), "tracer-host-cast",
+                f".{node.func.attr}() forces a host round-trip",
+                node.lineno))
+        elif (name is not None and "." in name
+              and name.split(".")[0] in _NUMPY_ROOTS
+              and base in _NUMPY_FORCERS and arg_origins):
+            self.hazards.append(Hazard(
+                arg_origins, "tracer-host-cast",
+                f"{name}() concretizes the value", node.lineno))
+        elif name == "print":
+            self.hazards.append(Hazard(
+                EMPTY, "tracer-side-effect",
+                "print() runs only at trace time", node.lineno))
+        else:
+            self.instantiate_callee_hazards(node)
+
+    def _branch(self, node, what, fix):
+        o = self.origins(node.test)
+        if o:
+            self.hazards.append(Hazard(
+                o, "tracer-python-branch",
+                f"Python `{what}` on the value ({fix})", node.lineno))
+
+    def visit_If(self, node):
+        self._branch(node, "if", "use jnp.where or jax.lax.cond")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._branch(node, "while", "use jax.lax.while_loop")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._branch(node, "assert", "use jax.debug.check or checkify")
+        self.generic_visit(node)
+
+
+def _make_engine(ctx):
+    """Project-shared SummaryEngine for the tracer walkers, or None when
+    the scan has no resolvable package files (single-snippet tests still
+    resolve same-module helpers through their own FileContext)."""
+    project = ctx.project
+    if project is None or not getattr(project, "files", None):
+        return None
+    engine = getattr(project, "_tracer_engine", None)
+    if engine is None:
+        cg = callgraph.for_project(project)
+        if not cg.modules:
+            return None
+        engine = SummaryEngine(
+            cg, lambda e, fi, depth: _TracerOriginWalker(e, fi, depth))
+        engine._staged_ids = None
+        project._tracer_engine = engine
+    return engine
+
+
+def _staged_node_ids(engine):
+    if engine._staged_ids is None:
+        ids = set()
+        for mi in engine.callgraph.modules.values():
+            for fn, _n, _s, _how in _staged_functions(mi.ctx.tree):
+                ids.add(id(fn))
+        engine._staged_ids = ids
+    return engine._staged_ids
+
+
+def _callee_hazards(engine, node, caller_fn, tainted_pred):
+    """(FunctionInfo, Hazard) pairs live at this call site: the callee's
+    summarized hazards whose origin parameters are bound to tainted
+    actuals (plus unconditional ones).  Callees that are themselves
+    staged are skipped — the tracer checks them directly at their own
+    definition."""
+    cg = engine.callgraph
+    scope = cg.function_info(caller_fn)
+    if scope is None:
+        return []
+    fi = cg.resolve_call(node.func, scope)
+    if fi is None or id(fi.node) in _staged_node_ids(engine):
+        return []
+    summary = engine.summary(fi)
+    if not summary.hazards:
+        return []
+    params = fi.params
+    if params and params[0] == "self" and isinstance(node.func,
+                                                     ast.Attribute):
+        params = params[1:]
+    binding = {}
+    for i, a in enumerate(node.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(params):
+            binding[params[i]] = tainted_pred(a)
+    for kw in node.keywords:
+        if kw.arg is not None:
+            binding[kw.arg] = tainted_pred(kw.value)
+    return [(fi, hz) for hz in summary.hazards
+            if not hz.origins or any(binding.get(o) for o in hz.origins)]
+
+
 class _TracerRuleBase(Rule):
     """Shared machinery; three registered names so suppressions and
     `--select` can address each hazard class separately."""
@@ -261,6 +403,7 @@ class _TracerRuleBase(Rule):
     scope = "package"
 
     def check(self, ctx):
+        engine = _make_engine(ctx)
         seen = set()
         for fn, static_nums, static_names, how in _staged_functions(ctx.tree):
             key = (fn.lineno, fn.name)
@@ -278,7 +421,8 @@ class _TracerRuleBase(Rule):
             tainted.update(p.arg for p in a.kwonlyargs
                            if p.arg not in static_names)
             tainted.discard("self")
-            w = _TaintWalker(self, ctx, fn, tainted, how.split(".")[-1])
+            w = _TaintWalker(self, ctx, fn, tainted, how.split(".")[-1],
+                             engine=engine)
             for stmt in fn.body:
                 w.visit(stmt)
             for f in w.findings:

@@ -665,9 +665,14 @@ class ContinuousBatcher:
                 kv_dtype=kv_dtype, paged_attn_impl=paged_attn_impl)
             self._set_table = decode_mod._jitted_set_row_page_table(
                 self.slot_model)
+            # device-thread-owned free list; stats() only takes len() of a
+            # momentary snapshot (monitoring skew is fine)
+            # graftcheck: disable-next-line=thread-race
             self._free_pages = list(range(int(kv_pages)))
             self._row_pages = [None] * n_slots
-            # prefix cache state (see the prefix-cache section below)
+            # prefix cache state (see the prefix-cache section below);
+            # mutated on the device thread only — stats() len() reads
+            # tolerate skew  # graftcheck: disable-next-line=thread-race
             self._prefix = {}        # cumulative-prefix key -> pool page
             self._prefix_lru = {}    # key -> lru tick
             self._page_rc = {}       # page -> live-row refcount (managed)
@@ -685,6 +690,9 @@ class ContinuousBatcher:
         else:
             self.slot_model, self._cache = decode_mod.init_slot_cache(
                 model, n_slots, kv_dtype=kv_dtype)
+        # swap-to-None teardown in stop()/_die() runs after the worker
+        # threads are joined/dead (happens-after, not a live race)
+        # graftcheck: disable-next-line=thread-race
         self._parked = None    # admission waiting for pool pages (FIFO)
         # ---- multi-adapter LoRA bank (lora_rank > 0) --------------------
         # N tenants share the batched step: per-layer stacked A/B banks
@@ -776,9 +784,16 @@ class ContinuousBatcher:
         self.prefill_budget = (int(prefill_budget or 0)
                                or self.prefill_rows * self.prefill_chunk)
         self._pending = queue_mod.Queue(max_pending)
+        # fixed-length lists: cells are rebound (never resized), and the
+        # generation protocol below makes stale host-side reads self-
+        # invalidating — cross-thread cell access is the design
+        # graftcheck: disable-next-line=thread-race
         self._slots = [None] * n_slots
+        # graftcheck: disable-next-line=thread-race
         self._gen = [0] * n_slots      # occupant generation per row: tokens
         # decoded for a previous occupant must never reach a new one
+        # device-thread-owned pipeline; stats() only len()s it
+        # graftcheck: disable-next-line=thread-race
         self._admissions = []          # in-flight chunked admissions (the
         # prefill engine's queue; each entry is one request mid-prefill)
         # admission->first-token latency (TTFT): percentile window +
@@ -827,7 +842,10 @@ class ContinuousBatcher:
         self._t0 = time.monotonic()   # device_idle_fraction time base
         self._dead = None     # set to the fatal exception if the loop dies
         self._stop = threading.Event()
-        self.requests = 0
+        # requests_served lives in self.counters: the device thread counts
+        # admission-time completions and the host thread counts retirement-
+        # time ones, so a bare `self.requests += 1` would lose updates
+        # (graftcheck thread-race caught exactly that)
         self._thread = threading.Thread(target=self._loop,
                                         name="slot-batcher", daemon=True)
         self._host_thread = None
@@ -851,7 +869,7 @@ class ContinuousBatcher:
             "admissions_inflight": len(self._admissions),
             "prefill_rows": self.prefill_rows,
             "prefill_budget": self.prefill_budget,
-            "requests_served": self.requests,
+            "requests_served": self.counters.get("requests_served"),
             "decode_steps": self._steps,
             "spec_rounds": self._spec_rounds,
             "engine": self.engine,
@@ -1154,6 +1172,10 @@ class ContinuousBatcher:
         # first slot token matches a solo generate(rng=key(seed))
         # including its filters
         pick = decode_mod._solo_pick_fn(temperature, top_k, top_p, min_p)
+        # deliberate sync: the admission path needs the first token as a
+        # Python int before the row joins the decode chain (TTFT delivery
+        # + stop-sequence check); one readback per ADMISSION, not per step
+        # graftcheck: disable-next-line=hostsync
         return int(pick(logits_row[None, :],
                         jax.random.fold_in(jax.random.key(seed), 0))[0])
 
@@ -1561,7 +1583,7 @@ class ContinuousBatcher:
                 or self._hit_stop(seq, stops, len(prompt))):
             self._free_row(row)
             h._finish(seq)
-            self.requests += 1
+            self.counters.inc("requests_served")
             return
         self._gen[row] += 1
         (self._toks, self._temps, self._seeds, self._ords,
@@ -1654,7 +1676,7 @@ class ContinuousBatcher:
             if self._stop.is_set() or self._dead is not None:
                 return      # device thread gone: stop()/death drains acks
 
-    def _apply_retirements(self, timeout=0.0):  # graftcheck: hotpath
+    def _apply_retirements(self, timeout=0.0):
         """Device thread: drain pending host-requested retirements and
         ack each.  With `timeout`, waits up to that long for the first
         one (the nothing-to-dispatch idle path)."""
@@ -1708,7 +1730,7 @@ class ContinuousBatcher:
                     emit(r, s)
                     self._retire(r, gens[r])
                     s["handle"]._finish(s["seq"])
-                    self.requests += 1
+                    self.counters.inc("requests_served")
                     continue
                 if counts is None:
                     toks = [int(row_toks[r])]
@@ -1727,7 +1749,7 @@ class ContinuousBatcher:
                     emit(r, s)
                     self._retire(r, gens[r])
                     s["handle"]._finish(s["seq"])
-                    self.requests += 1
+                    self.counters.inc("requests_served")
         # per-tick delivery for every stream that did NOT finish this
         # chunk: all its tokens in one put
         for r, s in enumerate(self._slots):
@@ -1752,7 +1774,7 @@ class ContinuousBatcher:
         except BaseException as e:
             self._die(e, "continuous batcher host thread died")
 
-    def _dispatch(self):  # graftcheck: hotpath
+    def _dispatch(self):
         """One decode advance for all active slots: a fused speculative
         round when a draft is loaded and every active row is greedy, else
         one plain step.  Returns the readback entry (toks, counts, done,
@@ -1805,7 +1827,7 @@ class ContinuousBatcher:
         self._steps += 1
         return (nxt, None, done, tuple(self._gen))
 
-    def _flush_entries(self, reads):  # graftcheck: hotpath
+    def _flush_entries(self, reads):
         """Stack this chunk's entries for one async host copy.  Plain
         steps stack to [k, n]; speculative rounds to [k, n, draft_k] with
         a [k, n] counts plane.  Mixed chunks pad plain entries to width
@@ -1828,7 +1850,7 @@ class ContinuousBatcher:
         return (jnp.stack([w[0] for w in wide]),
                 jnp.stack([w[1] for w in wide]), done)
 
-    def _flush(self, reads):  # graftcheck: hotpath
+    def _flush(self, reads):
         """Stack a chunk and START its host copies asynchronously; the
         np.asarray in `_process_batch` then usually finds the bytes
         already landed.  Backends without copy_to_host_async degrade to
@@ -1847,7 +1869,7 @@ class ContinuousBatcher:
                 break
         return (stacked, counts, done, [e[3] for e in reads])
 
-    def _flush_due(self, n_reads, active):  # graftcheck: hotpath
+    def _flush_due(self, n_reads, active):
         """Whether the accumulated reads should flush now: a full chunk,
         nothing left to dispatch, or a LIVE slot is within `n_reads`
         tokens of finishing (flushing early bounds its retirement
@@ -1870,7 +1892,7 @@ class ContinuousBatcher:
         else:
             self._loop_serial()
 
-    def _loop_serial(self):  # graftcheck: hotpath
+    def _loop_serial(self):
         """The single-thread reference engine: dispatch, flush, process
         the PREVIOUS chunk inline (double-buffered readback — the copy
         rides under the next chunk's compute).  Byte-identical tokens to
@@ -1921,7 +1943,7 @@ class ContinuousBatcher:
         except BaseException as e:     # device failure: fail everything
             self._die(e, "continuous batcher died")
 
-    def _loop_async(self):  # graftcheck: hotpath
+    def _loop_async(self):
         """Device side of the async pipeline: admission + dispatch only.
         Flushed chunks go to the host thread through the bounded
         `_ready` queue (its bound IS the pipeline depth); the only time
