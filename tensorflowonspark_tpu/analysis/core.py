@@ -29,6 +29,7 @@ import json
 import os
 import re
 import sys
+import time
 
 # Paths scanned when the CLI is invoked with no arguments (mirrors the old
 # scripts/lint.py default surface).  Semantic rules additionally restrict
@@ -271,9 +272,12 @@ def load_project(paths, root="."):
     return project
 
 
-def run_rules(project, rules):
+def run_rules(project, rules, stats=None):
     """Run ``rules`` over every file in ``project``; returns the unsuppressed
-    findings sorted by (path, line, rule)."""
+    findings sorted by (path, line, rule).  When ``stats`` is a dict it is
+    filled with ``rule name -> [seconds, finding count]`` accumulated across
+    files (rule families sharing a cached per-file pass charge the shared
+    work to whichever member runs first)."""
     findings = []
     for ctx in project.files:
         if ctx.tree is None:
@@ -285,9 +289,16 @@ def run_rules(project, rules):
         for rule in rules:
             if not rule.applies(ctx):
                 continue
+            t0 = time.perf_counter() if stats is not None else 0.0
+            n = 0
             for f in rule.check(ctx):
                 if not ctx.suppressed(f, rule):
                     findings.append(f)
+                    n += 1
+            if stats is not None:
+                entry = stats.setdefault(rule.name, [0.0, 0])
+                entry[0] += time.perf_counter() - t0
+                entry[1] += n
     findings.sort(key=lambda f: (_posix(f.path), f.line, f.rule))
     return findings
 
@@ -402,13 +413,18 @@ def sarif_report(findings, rules=None):
     }
 
 
-def changed_files(root="."):
+def changed_files(root=".", base=None):
     """Posix-relative paths with uncommitted changes (worktree + index)
-    plus untracked files, or None when git is unavailable / not a repo."""
+    plus untracked files, or None when git is unavailable / not a repo.
+    With ``base``, also includes files changed between the merge-base of
+    ``base`` and HEAD (what a PR diff shows)."""
     import subprocess
     out = set()
-    for cmd in (["git", "diff", "--name-only", "HEAD"],
-                ["git", "ls-files", "--others", "--exclude-standard"]):
+    cmds = [["git", "diff", "--name-only", "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard"]]
+    if base:
+        cmds.append(["git", "diff", "--name-only", f"{base}...HEAD"])
+    for cmd in cmds:
         try:
             res = subprocess.run(cmd, cwd=root, capture_output=True,
                                  text=True, check=True)
@@ -419,12 +435,27 @@ def changed_files(root="."):
     return out
 
 
+def print_stats(stats, file=None):
+    """Per-rule wall-time/finding-count table (sorted slowest first) —
+    makes the <10 s repo-scan budget attributable per analyzer."""
+    file = file or sys.stdout
+    total_s = sum(s for s, _ in stats.values())
+    total_n = sum(n for _, n in stats.values())
+    print("graftcheck rule stats", file=file)
+    print(f"{'rule':30s} {'time':>9s} {'findings':>9s}", file=file)
+    for name, (secs, n) in sorted(stats.items(),
+                                  key=lambda kv: -kv[1][0]):
+        print(f"{name:30s} {secs * 1000.0:7.1f}ms {n:9d}", file=file)
+    print(f"{'total':30s} {total_s * 1000.0:7.1f}ms {total_n:9d}",
+          file=file)
+
+
 def main(argv=None):
     # Importing the rule modules populates REGISTRY; done here so embedding
     # code can import core without pulling every analyzer.
     from tensorflowonspark_tpu.analysis import (  # noqa
-        hostsync, locks, pallas_tiles, recompile, shardlint, style, threads,
-        tracer)
+        hostsync, lifecycle, locks, pallas_tiles, recompile, shardlint,
+        style, threads, tracer)
 
     ap = argparse.ArgumentParser(
         prog="graftcheck",
@@ -446,6 +477,14 @@ def main(argv=None):
                     help="report findings only for files git sees as "
                     "changed/untracked (full project still loads, so "
                     "cross-file rules keep their context)")
+    ap.add_argument("--changed-base", default=None, metavar="REF",
+                    help="with --changed-only: also treat files changed "
+                    "since merge-base(REF, HEAD) as changed (PR diffs; "
+                    "e.g. --changed-base origin/main)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print a per-rule wall-time and finding-count "
+                    "table after the report (rule families sharing one "
+                    "cached pass charge it to the member that runs first)")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: {DEFAULT_BASELINE} if present)")
     ap.add_argument("--no-baseline", action="store_true",
@@ -484,11 +523,12 @@ def main(argv=None):
         print(f"graftcheck: error: {e}", file=sys.stderr)
         return 2
 
-    findings = run_rules(project, rules)
+    stats = {} if args.stats else None
+    findings = run_rules(project, rules, stats=stats)
     line_map = {ctx.path: ctx.lines for ctx in project.files}
 
     if args.changed_only:
-        changed = changed_files()
+        changed = changed_files(base=args.changed_base)
         if changed is None:
             print("graftcheck: error: --changed-only needs a git checkout",
                   file=sys.stderr)
@@ -562,4 +602,6 @@ def main(argv=None):
         else:
             print("graftcheck clean"
                   + (f" ({len(old)} baselined finding(s))" if old else ""))
+    if stats is not None:
+        print_stats(stats)
     return 1 if new else 0

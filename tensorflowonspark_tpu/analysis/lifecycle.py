@@ -1,0 +1,1154 @@
+"""graftcheck lifecycle: interprocedural typestate analysis for the
+resources declared in ``analysis/resources.py``.
+
+Every spec'd resource is tracked through an abstract state machine —
+
+    ALLOCATED --release--> RELEASED     (again: double-free)
+    SHARED    --release--> free-while-shared (un-share via the rc map first)
+    DONATED   --read-----> use-after-donate
+    RELEASED  --use------> use-after-free
+
+— per function, flow-sensitively, reporting only DEFINITE bad states
+(branches that disagree stop tracking), which is what keeps the repo
+scan clean on an EMPTY baseline.  The interprocedural parts ride on the
+PR 7 substrate:
+
+- ``callgraph`` resolves helper calls, so a helper that releases its
+  parameter (``self._cleanup(sock)``) releases at the call site, a
+  helper that RETURNS a fresh resource (``fleet.Gateway._request``
+  returning a live connection) makes the caller the owner, and the
+  ``models/decode.py`` ``_jitted_*`` factory idiom is chased to the
+  nested ``@jax.jit(donate_argnums=...)`` def so ``self._step = decode.
+  _jitted_...()`` call sites donate the right positional/keyword args.
+- ``threads`` class models attribute releases to thread roles, so a
+  ``device_only`` pool (KV pages) released from a non-device role is a
+  wrong-thread-role release, honoring the thread-identity-pin idiom.
+
+Leak analysis (``lifecycle-leak``): an acquire is *covered* when it
+happens under a ``with``, inside a ``try`` whose handler/finally
+releases it, or when a deferred release is registered on a handle
+(``h._on_done = lambda: ...release...``).  An uncovered resource leaks
+when (a) a statement that can raise runs while it is live and it is
+later released/escapes (the exception path skips the release), (b) an
+explicit ``raise`` or ``return`` leaves it live, or (c) the function
+falls off the end with it live.  Calls to ``logger``/shape builtins are
+assumed non-raising; generators are exempt (the frame outlives the
+walk).  Ownership transfer — returning the resource, storing it into a
+``self`` container, passing it to an opaque call — ends tracking.
+"""
+from __future__ import annotations
+
+import ast
+
+from tensorflowonspark_tpu.analysis import callgraph as callgraph_mod
+from tensorflowonspark_tpu.analysis import threads as threads_mod
+from tensorflowonspark_tpu.analysis.core import Finding, Rule, register
+from tensorflowonspark_tpu.analysis.dataflow import SHAPE_FNS, call_name
+from tensorflowonspark_tpu.analysis.resources import SPECS
+
+ALLOC = "allocated"
+SHARED = "shared"
+RELEASED = "released"
+DONATED = "donated"
+
+_DONATED_SPEC = next(s for s in SPECS if s.name == "donated-buffer")
+# prefixes/names whose calls are assumed not to raise mid-lifecycle
+_NONRAISING_PREFIXES = ("logger.", "logging.", "time.", "warnings.")
+_NONRAISING_NAMES = SHAPE_FNS | {"print", "sorted", "min", "max", "range",
+                                 "enumerate", "zip", "tuple", "list",
+                                 "dict", "set", "frozenset"}
+# container methods that cannot raise (dict.pop is only safe with a
+# default — handled separately); they still transfer ownership of
+# tracked arguments, so they are exempt from raise bookkeeping only
+_SAFE_CONTAINER_METHODS = {"get", "setdefault", "keys", "values", "items",
+                           "append", "extend", "add", "discard", "clear",
+                           "update", "copy"}
+
+
+def _posix(path):
+    return path.replace("\\", "/")
+
+
+def _self_attr(node):
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _key_of(expr):
+    """Abstract location for `expr`: locals and self-attributes are the
+    only bindings precise enough to track."""
+    if isinstance(expr, ast.Name):
+        return ("local", expr.id)
+    attr = _self_attr(expr)
+    if attr is not None:
+        return ("attr", attr)
+    return None
+
+
+def _key_str(key):
+    return key[1] if key[0] == "local" else f"self.{key[1]}"
+
+
+def _name_matches(name, pattern):
+    """Dotted-suffix pattern match: `http.client.HTTPConnection` also
+    matches a from-imported bare `HTTPConnection` and vice versa."""
+    if name is None:
+        return False
+    return (name == pattern or name.endswith("." + pattern)
+            or pattern.endswith("." + name))
+
+
+def _op_target(call, pattern):
+    """The resource expression a release/acquire op acts on, or None
+    when `call` does not match `pattern` (see resources.py for the
+    pattern mini-language)."""
+    if pattern.startswith("@."):
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == pattern[2:]:
+            return f.value
+        return None
+    if _name_matches(call_name(call.func), pattern):
+        return call.args[0] if call.args else None
+    return None
+
+
+class _Res:
+    """Shared (across branch copies) record for one tracked resource."""
+
+    __slots__ = ("spec", "line", "protected", "escaped", "raising",
+                 "release_line")
+
+    def __init__(self, spec, line, protected=False):
+        self.spec = spec
+        self.line = line
+        self.protected = protected
+        self.escaped = False
+        self.raising = []       # lines that can raise while it was live
+        self.release_line = None
+
+
+# ---------------------------------------------------------------------------
+# interprocedural summaries (cached on the project callgraph)
+
+
+def _release_summary(cg, fi, depth=0, seen=None):
+    """{param index: spec} for parameters `fi` definitely releases —
+    directly or by forwarding to a resolvable releasing helper."""
+    cache = getattr(cg, "_lifecycle_rel", None)
+    if cache is None:
+        cache = cg._lifecycle_rel = {}
+    key = id(fi.node)
+    if key in cache:
+        return cache[key]
+    seen = seen or set()
+    if key in seen or depth > 2:
+        return {}
+    seen.add(key)
+    params = fi.params
+    out = {}
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        for spec in SPECS:
+            for pat in spec.release:
+                tgt = _op_target(node, pat)
+                if (isinstance(tgt, ast.Name) and tgt.id in params):
+                    out[params.index(tgt.id)] = spec
+        callee = cg.resolve_call(node.func, fi)
+        if callee is not None and callee.node is not fi.node:
+            sub = _release_summary(cg, callee, depth + 1, seen)
+            if sub:
+                off = 1 if (callee.params and callee.params[0] == "self"
+                            and isinstance(node.func, ast.Attribute)) else 0
+                for idx, spec in sub.items():
+                    pos = idx - off
+                    if 0 <= pos < len(node.args) and \
+                            isinstance(node.args[pos], ast.Name) and \
+                            node.args[pos].id in params:
+                        out[params.index(node.args[pos].id)] = spec
+    cache[key] = out
+    return out
+
+
+def _match_acquire(call):
+    """(spec, shared) when `call` produces a fresh resource."""
+    name = call_name(call.func)
+    for spec in SPECS:
+        for pat in spec.acquire:
+            if pat.startswith("@."):
+                f = call.func
+                if isinstance(f, ast.Attribute) and f.attr == pat[2:]:
+                    return spec, False
+            elif _name_matches(name, pat):
+                return spec, False
+        for pat in spec.acquire_shared:
+            if _name_matches(name, pat):
+                return spec, True
+    return None, False
+
+
+def _return_summary(cg, fi, depth=0, seen=None):
+    """{tuple position: spec} for resources `fi` returns to its caller
+    (position 0 = a bare non-tuple return value).  Only reported when
+    every resource-bearing return agrees — disagreement goes opaque."""
+    cache = getattr(cg, "_lifecycle_ret", None)
+    if cache is None:
+        cache = cg._lifecycle_ret = {}
+    key = id(fi.node)
+    if key in cache:
+        return cache[key]
+    seen = seen or set()
+    if key in seen or depth > 2:
+        return {}
+    seen.add(key)
+    acquired = {}              # local name -> spec
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            spec, _sh = _match_acquire(node.value)
+            if spec is None:
+                callee = cg.resolve_call(node.value.func, fi)
+                if callee is not None and callee.node is not fi.node:
+                    sub = _return_summary(cg, callee, depth + 1, seen)
+                    spec = sub.get(0)
+            if spec is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        acquired[tgt.id] = spec
+    maps = []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        m = {}
+        if isinstance(node.value, ast.Name):
+            if node.value.id in acquired:
+                m[0] = acquired[node.value.id]
+        elif isinstance(node.value, ast.Tuple):
+            for i, elt in enumerate(node.value.elts):
+                if isinstance(elt, ast.Name) and elt.id in acquired:
+                    m[i] = acquired[elt.id]
+        elif isinstance(node.value, ast.Call):
+            callee = cg.resolve_call(node.value.func, fi)
+            if callee is not None and callee.node is not fi.node:
+                m = dict(_return_summary(cg, callee, depth + 1, seen))
+        if m:
+            maps.append(m)
+    out = maps[0] if maps and all(m == maps[0] for m in maps) else {}
+    cache[key] = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation environment
+
+
+def _literal_int_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _literal_str_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _donate_kwargs(call):
+    """(argnums, argnames) literals from a jit(...) call, or None when
+    the call carries no (statically-known) donation."""
+    nums, names = None, None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = _literal_int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            names = _literal_str_tuple(kw.value)
+    if nums is None and names is None:
+        return None
+    return nums or (), names or ()
+
+
+def _jit_call_donation(call):
+    """Donation kwargs when `call` IS a jit wrapping: `jax.jit(f, ...)`
+    or `functools.partial(jax.jit, ...)` (decorator form)."""
+    name = call_name(call.func)
+    if name is not None and (name == "jit" or name.endswith(".jit")):
+        return _donate_kwargs(call)
+    if name is not None and name.endswith("partial") and call.args:
+        inner = call_name(call.args[0])
+        if inner is not None and (inner == "jit" or inner.endswith(".jit")):
+            return _donate_kwargs(call)
+    return None
+
+
+def _resolve_donation(kwargs, fn_node):
+    """(positions, kwnames, params) with argnames folded into positions
+    via the jitted function's signature."""
+    nums, names = kwargs
+    params = tuple(a.arg for a in fn_node.args.args) if fn_node else ()
+    positions = set(nums)
+    for nm in names:
+        if nm in params:
+            positions.add(params.index(nm))
+    return frozenset(positions), frozenset(names), params
+
+
+def _donation_of_value(cg, scope, value):
+    """Donation info for the callable produced by `value` (an Assign
+    RHS): a direct `jax.jit(f, donate_*)` call, or a call resolving to
+    a `_jitted_*` factory whose nested def is jit-decorated with
+    donations.  None when there is no (unambiguous) donation."""
+    if not isinstance(value, ast.Call) or cg is None or scope is None:
+        return None
+    kwargs = _jit_call_donation(value)
+    if kwargs is not None:
+        fn_node = None
+        if value.args:
+            fi = cg.resolve_call(value.args[0], scope)
+            fn_node = fi.node if fi is not None else None
+        return _resolve_donation(kwargs, fn_node)
+    factory = cg.resolve_call(value.func, scope)
+    if factory is None:
+        return None
+    infos = set()
+    for node in ast.walk(factory.node):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                kwargs = _jit_call_donation(dec)
+                if kwargs is not None:
+                    infos.add(_resolve_donation(kwargs, node))
+    if len(infos) == 1:
+        return infos.pop()
+    return None            # no donation, or ambiguous nested defs
+
+
+def _class_donations(ctx, cg, cls_node):
+    """attr name -> donation info for `self.X = <donating callable>`
+    assignments anywhere in the class; an attr bound to factories with
+    DIFFERENT donation signatures (e.g. the lora/non-lora `_step`
+    variants) maps to None and is skipped — precision over recall."""
+    out = {}
+    if cg is None:
+        return out
+    for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        attrs = [a for a in map(_self_attr, node.targets) if a is not None]
+        if not attrs or not isinstance(node.value, ast.Call):
+            continue
+        scope = _enclosing_scope(cg, ctx, cls_node, node)
+        if scope is None:
+            continue
+        d = _donation_of_value(cg, scope, node.value)
+        for attr in attrs:
+            if attr in out:
+                if out[attr] is not None and out[attr] != d:
+                    out[attr] = None
+            else:
+                out[attr] = d
+    return {a: d for a, d in out.items() if d is not None}
+
+
+def _enclosing_scope(cg, ctx, cls_node, stmt):
+    """FunctionInfo of the method lexically containing `stmt`."""
+    for node in ast.walk(cls_node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(n is stmt for n in ast.walk(node)):
+                fi = cg.function_info(node)
+                if fi is not None:
+                    return fi
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-function typestate executor
+
+
+class _FnAnalysis:
+
+    def __init__(self, ctx, cg, cls_node, fn, donate_attrs, out):
+        self.ctx = ctx
+        self.cg = cg
+        self.cls = cls_node
+        self.fn = fn
+        self.donate_attrs = donate_attrs
+        self.out = out
+        self.scope = cg.function_info(fn) if cg is not None else None
+        self.local_donate = {}
+        self.lock_attrs = []          # lexical stack of held self.<lock>s
+        self.pin_stack = []           # lexical thread-identity pins
+        self.protect_stack = []       # sets of keys released by try
+                                      # handlers/finally around us
+        self.device_sites = []        # (spec, line, pin) release sites
+        self.reported = set()
+        self._consumed = set()
+        self.is_gen = any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                          for n in ast.walk(fn)
+                          if not isinstance(n, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))
+                          or n is fn)
+
+    # -- reporting ----------------------------------------------------------
+
+    def _emit(self, rule, line, key, msg):
+        dk = (rule, line, key)
+        if dk in self.reported:
+            return
+        self.reported.add(dk)
+        self.out.append(Finding(self.ctx.path, line, rule, msg))
+
+    # -- env primitives -----------------------------------------------------
+
+    def _protected_now(self, key):
+        return any(key in frame for frame in self.protect_stack)
+
+    def _bind(self, env, key, state, spec, line):
+        res = _Res(spec, line, protected=self._protected_now(key))
+        env[key] = (state, res)
+        return res
+
+    def _escape(self, env, key, line=None):
+        ent = env.pop(key, None)
+        if ent is None:
+            return
+        state, res = ent
+        res.escaped = True
+        if (state in (ALLOC, SHARED) and res.raising and not res.protected
+                and res.spec.leak_check):
+            self._emit(
+                "lifecycle-leak", res.line, key,
+                f"{res.spec.name} {_key_str(key)} (acquired here) leaks "
+                f"if line {res.raising[0]} raises before ownership "
+                f"transfers at line {line or res.raising[-1]}; release it "
+                "in an except/finally")
+
+    def _check_read(self, env, key, line):
+        ent = env.get(key)
+        if ent is None:
+            return
+        state, res = ent
+        if state == RELEASED and not res.spec.track_from_release:
+            self._emit(
+                "lifecycle-use-after-free", line, key,
+                f"{res.spec.name} {_key_str(key)} used after its release "
+                f"at line {res.release_line}")
+        elif state == DONATED:
+            self._emit(
+                "lifecycle-use-after-donate", line, key,
+                f"{_key_str(key)} read after being donated to a jitted "
+                f"call at line {res.line}; the buffer is invalidated — "
+                "rebind the call's result first")
+
+    # -- call classification ------------------------------------------------
+
+    def _do_release(self, env, spec, key, line):
+        if spec.lock and spec.lock not in self.lock_attrs:
+            self._emit(
+                "lifecycle-lock", line, key,
+                f"{spec.name} released without holding self.{spec.lock} "
+                "(the free list and refcounts it guards would race)")
+        if spec.device_only:
+            pin = self.pin_stack[-1] if self.pin_stack else None
+            self.device_sites.append((spec, line, pin))
+        if key is None:
+            return
+        ent = env.get(key)
+        if ent is None:
+            if spec.track_from_release and key[0] == "local":
+                res = self._bind(env, key, RELEASED, spec, line)
+                res.release_line = line
+            return
+        state, res = ent
+        if state == RELEASED:
+            if not spec.release_idempotent:
+                self._emit(
+                    "lifecycle-double-free", line, key,
+                    f"{spec.name} {_key_str(key)} released again (first "
+                    f"released at line {res.release_line})")
+            return
+        if state == SHARED:
+            self._emit(
+                "lifecycle-free-shared", line, key,
+                f"{spec.name} {_key_str(key)} returned to the pool while "
+                f"still shared (refcounted in self.{spec.share_map}); "
+                "drop the refcount mapping first or the page will be "
+                "handed out twice")
+        if (state in (ALLOC, SHARED) and res.raising and not res.protected
+                and res.spec.leak_check):
+            self._emit(
+                "lifecycle-leak", res.line, key,
+                f"{res.spec.name} {_key_str(key)} (acquired here) leaks "
+                f"if line {res.raising[0]} raises before the release at "
+                f"line {line}; move the release into a finally/except")
+        res.release_line = line
+        env[key] = (RELEASED, res)
+
+    def _donation_of_callee(self, call):
+        f = call.func
+        attr = _self_attr(f)
+        if attr is not None:
+            return self.donate_attrs.get(attr)
+        if isinstance(f, ast.Name):
+            return self.local_donate.get(f.id)
+        return None
+
+    def _apply_donation(self, env, dinfo, call):
+        positions, kwnames, params = dinfo
+        donated = []
+        for i, a in enumerate(call.args):
+            if i in positions:
+                donated.append(a)
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue            # **kwargs: names invisible, skip
+            if kw.arg in kwnames or (kw.arg in params
+                                     and params.index(kw.arg) in positions):
+                donated.append(kw.value)
+        for expr in donated:
+            key = _key_of(expr)
+            if key is None:
+                continue
+            self._check_read(env, key, expr.lineno)   # double donation
+            self._bind(env, key, DONATED, _DONATED_SPEC, call.lineno)
+            # the argument read itself precedes the donation: exempt it
+            # (and `x = step(x)` rebinds) from this statement's read scan
+            self._consumed.update(id(n) for n in ast.walk(expr))
+
+    def _apply_call(self, env, call):
+        """Apply one call's lifecycle effects; returns True when the
+        call is exempt from may-raise bookkeeping."""
+        name = call_name(call.func)
+        # share-map transitions: self.<rc>.pop(r) / .get handled in guards
+        for spec in SPECS:
+            if not spec.share_map:
+                continue
+            if _name_matches(name, f"self.{spec.share_map}.pop") and \
+                    call.args and isinstance(call.args[0], ast.Name):
+                key = ("local", call.args[0].id)
+                ent = env.get(key)
+                if ent is not None and ent[1].spec is spec and \
+                        ent[0] == SHARED:
+                    env[key] = (ALLOC, ent[1])
+                return True
+        for spec in SPECS:
+            for pat in spec.release:
+                tgt = _op_target(call, pat)
+                if tgt is None:
+                    continue
+                self._do_release(env, spec, _key_of(tgt), call.lineno)
+                return True
+        dinfo = self._donation_of_callee(call)
+        if dinfo is not None:
+            self._apply_donation(env, dinfo, call)
+            return False
+        if name is not None:
+            if name in _NONRAISING_NAMES or \
+                    any(name.startswith(p) for p in _NONRAISING_PREFIXES):
+                return True
+        # helper summaries: releases-param / transfers through the call
+        callee = None
+        if self.cg is not None and self.scope is not None:
+            callee = self.cg.resolve_call(call.func, self.scope)
+        if callee is not None:
+            rel = _release_summary(self.cg, callee)
+            if rel:
+                off = 1 if (callee.params and callee.params[0] == "self"
+                            and isinstance(call.func, ast.Attribute)) else 0
+                for idx, spec in rel.items():
+                    pos = idx - off
+                    if 0 <= pos < len(call.args):
+                        self._do_release(env, spec,
+                                         _key_of(call.args[pos]),
+                                         call.lineno)
+                        self._consumed.update(
+                            id(n) for n in ast.walk(call.args[pos]))
+                return False
+        # opaque call: any tracked value passed as an argument may be
+        # stored by the callee — ownership transfers, tracking stops
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(a):
+                key = _key_of(n)
+                if key is not None and key in env:
+                    self._check_read(env, key, n.lineno)
+                    self._escape(env, key, call.lineno)
+        f = call.func
+        if isinstance(f, ast.Attribute) and (
+                f.attr in _SAFE_CONTAINER_METHODS
+                or (f.attr == "pop" and len(call.args) == 2)):
+            return True
+        return False
+
+    # -- expression scan ----------------------------------------------------
+
+    def _scan(self, env, node, force_raising=False):
+        """Process every call effect and read in `node`, then record a
+        may-raise point against live uncovered resources."""
+        if node is None:
+            return
+        raising = force_raising
+        consumed = self._consumed = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                # a closure capturing a tracked local has unknown
+                # lifetime: stop tracking what it references
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Name):
+                        self._escape(env, ("local", n.id), sub.lineno)
+                continue
+            if isinstance(sub, ast.Call):
+                for spec in SPECS:
+                    for pat in spec.release:
+                        tgt = _op_target(sub, pat)
+                        if tgt is not None:
+                            consumed.update(id(n) for n in ast.walk(tgt))
+                exempt = self._apply_call(env, sub)
+                raising = raising or not exempt
+        for sub in ast.walk(node):
+            if id(sub) in consumed:
+                continue
+            # self.<use_attr>[r]: a read THROUGH a freed handle
+            if isinstance(sub, ast.Subscript) and \
+                    isinstance(sub.ctx, ast.Load):
+                base = _self_attr(sub.value)
+                idx = sub.slice
+                if base is not None and isinstance(idx, ast.Name):
+                    key = ("local", idx.id)
+                    ent = env.get(key)
+                    if ent is not None and ent[0] == RELEASED and \
+                            base in ent[1].spec.use_attrs:
+                        self._emit(
+                            "lifecycle-use-after-free", sub.lineno, key,
+                            f"{ent[1].spec.name} {idx.id} used via "
+                            f"self.{base}[{idx.id}] after its release at "
+                            f"line {ent[1].release_line}")
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                self._check_read(env, ("local", sub.id), sub.lineno)
+            elif isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.ctx, ast.Load):
+                attr = _self_attr(sub)
+                if attr is not None:
+                    self._check_read(env, ("attr", attr), sub.lineno)
+        if raising:
+            for key, (state, res) in env.items():
+                if (key[0] == "local" and state in (ALLOC, SHARED)
+                        and not res.protected and not res.escaped
+                        and res.spec.leak_check):
+                    res.raising.append(node.lineno)
+
+    # -- leak checks --------------------------------------------------------
+
+    def _leak_sweep(self, env, line, why):
+        if self.is_gen:
+            return
+        for key, (state, res) in list(env.items()):
+            if (key[0] == "local" and state in (ALLOC, SHARED)
+                    and not res.protected and not res.escaped
+                    and res.spec.leak_check):
+                self._emit(
+                    "lifecycle-leak", res.line, key,
+                    f"{res.spec.name} {_key_str(key)} (acquired here) is "
+                    f"still live at the {why} on line {line} and is never "
+                    "released on this path")
+
+    # -- statement executor -------------------------------------------------
+
+    def exec_block(self, stmts, env):
+        for st in stmts:
+            env, live = self.exec_stmt(st, env)
+            if not live:
+                return env, False
+        return env, True
+
+    def _merge(self, a, b):
+        out = {}
+        for k, ent in a.items():
+            other = b.get(k)
+            if other is not None and other[0] == ent[0] \
+                    and other[1] is ent[1]:
+                out[k] = ent
+        return out
+
+    def exec_stmt(self, st, env):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            for n in ast.walk(st):
+                if isinstance(n, ast.Name):
+                    self._escape(env, ("local", n.id), st.lineno)
+            return env, True
+        if isinstance(st, ast.Return):
+            self._scan(env, st.value)
+            if st.value is not None:
+                for n in ast.walk(st.value):
+                    key = _key_of(n)
+                    if key is not None:
+                        self._escape(env, key, st.lineno)
+            self._leak_sweep(env, st.lineno, "return")
+            return env, False
+        if isinstance(st, ast.Raise):
+            self._scan(env, st.exc)
+            self._leak_sweep(env, st.lineno, "raise")
+            return env, False
+        if isinstance(st, (ast.Break, ast.Continue)):
+            return env, False
+        if isinstance(st, ast.Assign):
+            return self._do_assign(st, env), True
+        if isinstance(st, ast.AnnAssign):
+            self._scan(env, st.value)
+            if st.value is not None:
+                self._bind_targets([st.target], st.value, env)
+            return env, True
+        if isinstance(st, ast.AugAssign):
+            self._scan(env, st.value)
+            self._check_read(env, _key_of(st.target) or ("local", ""),
+                             st.lineno)
+            return env, True
+        if isinstance(st, ast.Expr):
+            self._scan(env, st.value)
+            return env, True
+        if isinstance(st, ast.Assert):
+            self._scan(env, st.test, force_raising=True)
+            return env, True
+        if isinstance(st, ast.Delete):
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name):
+                    env.pop(("local", tgt.id), None)
+                elif isinstance(tgt, ast.Subscript):
+                    self._del_subscript(tgt, env)
+            return env, True
+        if isinstance(st, ast.If):
+            return self._do_if(st, env)
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            return self._do_for(st, env)
+        if isinstance(st, ast.While):
+            return self._do_while(st, env)
+        if isinstance(st, ast.Try):
+            return self._do_try(st, env)
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            return self._do_with(st, env)
+        return env, True
+
+    def _del_subscript(self, tgt, env):
+        """`del self.<rc_map>[r]` un-shares r."""
+        base = _self_attr(tgt.value)
+        if base is None or not isinstance(tgt.slice, ast.Name):
+            return
+        key = ("local", tgt.slice.id)
+        ent = env.get(key)
+        if ent is not None and ent[0] == SHARED and \
+                ent[1].spec.share_map == base:
+            env[key] = (ALLOC, ent[1])
+
+    # -- assignment ---------------------------------------------------------
+
+    def _do_assign(self, st, env):
+        # deferred-release hook: `h._on_done = lambda: ...release...`
+        # transfers ownership of everything the hook closes over
+        hooks = {h for spec in SPECS for h in spec.register_hooks}
+        for tgt in st.targets:
+            if isinstance(tgt, ast.Attribute) and tgt.attr in hooks:
+                for n in ast.walk(st.value):
+                    key = _key_of(n)
+                    if key is not None:
+                        ent = env.get(key)
+                        if ent is not None:
+                            ent[1].protected = True
+                            self._escape(env, key, st.lineno)
+                return env
+        self._scan(env, st.value)
+        if isinstance(st.value, ast.Call):
+            d = _donation_of_value(self.cg, self.scope, st.value)
+            if d is not None:
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.local_donate[tgt.id] = d
+        self._bind_targets(st.targets, st.value, env)
+        return env
+
+    def _acquire_of_value(self, value):
+        """(spec, shared, summary) produced by an Assign RHS."""
+        calls = []
+        if isinstance(value, ast.Call):
+            calls.append(value)
+        elif isinstance(value, (ast.ListComp, ast.SetComp,
+                                ast.GeneratorExp)):
+            if isinstance(value.elt, ast.Call):
+                calls.append(value.elt)
+        for call in calls:
+            spec, shared = _match_acquire(call)
+            if spec is not None:
+                return spec, shared, None
+            if self.cg is not None and self.scope is not None:
+                callee = self.cg.resolve_call(call.func, self.scope)
+                if callee is not None and callee.node is not self.fn:
+                    ret = _return_summary(self.cg, callee)
+                    if ret:
+                        return None, False, ret
+        return None, False, None
+
+    def _bind_targets(self, targets, value, env):
+        spec, shared, summary = self._acquire_of_value(value)
+        for tgt in targets:
+            if isinstance(tgt, (ast.Name, ast.Attribute)):
+                key = _key_of(tgt)
+                if key is None:
+                    continue
+                env.pop(key, None)          # rebind clears DONATED too
+                if spec is not None:
+                    self._bind(env, key, SHARED if shared else ALLOC,
+                               spec, tgt.lineno)
+                elif summary is not None and 0 in summary:
+                    self._bind(env, key, ALLOC, summary[0], tgt.lineno)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for i, elt in enumerate(tgt.elts):
+                    key = _key_of(elt)
+                    if key is None:
+                        continue
+                    env.pop(key, None)
+                    if summary is not None and i in summary:
+                        self._bind(env, key, ALLOC, summary[i],
+                                   elt.lineno)
+                    elif spec is not None and i == 0:
+                        # `client, _ = listener.accept()` convention
+                        self._bind(env, key,
+                                   SHARED if shared else ALLOC,
+                                   spec, elt.lineno)
+            elif isinstance(tgt, ast.Subscript):
+                # storing into a container escapes the stored value
+                for n in ast.walk(value):
+                    key = _key_of(n)
+                    if key is not None:
+                        self._escape(env, key, tgt.lineno)
+
+    # -- control flow -------------------------------------------------------
+
+    def _share_guard(self, test, env_t, env_f):
+        """Refine SHARED/exclusive across `if r in self.<rc_map>:`-style
+        guards (and rc.get(r, 0) == 0 comparisons)."""
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+            return
+        op, left, right = test.ops[0], test.left, test.comparators[0]
+        key, base, truthy_shared = None, None, None
+        if isinstance(op, (ast.In, ast.NotIn)) and \
+                isinstance(left, ast.Name):
+            base = _self_attr(right)
+            key = ("local", left.id)
+            truthy_shared = isinstance(op, ast.In)
+        elif isinstance(op, (ast.Eq, ast.NotEq, ast.Gt)) and \
+                isinstance(left, ast.Call) and \
+                isinstance(right, ast.Constant) and right.value == 0:
+            name = call_name(left.func)
+            if name is not None and name.endswith(".get") and left.args \
+                    and isinstance(left.args[0], ast.Name) and \
+                    isinstance(left.func, ast.Attribute):
+                base = _self_attr(left.func.value)
+                key = ("local", left.args[0].id)
+                truthy_shared = not isinstance(op, ast.Eq)
+        if key is None or base is None:
+            return
+        ent = env_t.get(key)
+        if ent is None:
+            # untracked (e.g. a parameter): the guard itself proves this
+            # is the spec's resource — start tracking, protected so only
+            # state-transition rules (not leak) apply to it
+            spec = next((s for s in SPECS if s.share_map == base), None)
+            if spec is None:
+                return
+            rt = _Res(spec, test.lineno, protected=True)
+            rf = _Res(spec, test.lineno, protected=True)
+            env_t[key] = ((SHARED if truthy_shared else ALLOC), rt)
+            env_f[key] = ((ALLOC if truthy_shared else SHARED), rf)
+            return
+        if ent[1].spec.share_map != base:
+            return
+        res = ent[1]
+        env_t[key] = ((SHARED if truthy_shared else ALLOC), res)
+        if key in env_f:
+            env_f[key] = ((ALLOC if truthy_shared else SHARED), res)
+
+    def _do_if(self, st, env):
+        self._scan(env, st.test)
+        env_t, env_f = dict(env), dict(env)
+        self._share_guard(st.test, env_t, env_f)
+        pin = threads_mod._pinned_thread_attr(st.test)
+        if pin is not None:
+            self.pin_stack.append(pin)
+        env_t, live_t = self.exec_block(st.body, env_t)
+        if pin is not None:
+            self.pin_stack.pop()
+        env_f, live_f = self.exec_block(st.orelse, env_f) \
+            if st.orelse else (env_f, True)
+        if live_t and live_f:
+            return self._merge(env_t, env_f), True
+        if live_t:
+            return env_t, True
+        if live_f:
+            return env_f, True
+        return env, False
+
+    def _clear_loop_targets(self, tgt, env):
+        for n in ast.walk(tgt):
+            key = _key_of(n)
+            if key is not None:
+                env.pop(key, None)
+
+    def _do_for(self, st, env):
+        self._scan(env, st.iter)
+        body_env = dict(env)
+        self._clear_loop_targets(st.target, body_env)
+        env1, _live = self.exec_block(st.body, body_env)
+        # second pass from the loop-carried state so donations/releases
+        # at the bottom of the body meet the reads at its top
+        env2 = {**env, **env1}
+        self._clear_loop_targets(st.target, env2)
+        env2, _live = self.exec_block(st.body, env2)
+        out = self._merge(env, env1)
+        if st.orelse:
+            out, _ = self.exec_block(st.orelse, out)
+        return out, True
+
+    def _do_while(self, st, env):
+        self._scan(env, st.test)
+        env1, _live = self.exec_block(st.body, dict(env))
+        env2, _live = self.exec_block(st.body, {**env, **env1})
+        out = self._merge(env, env1)
+        if st.orelse:
+            out, _ = self.exec_block(st.orelse, out)
+        return out, True
+
+    def _protected_keys(self, stmts):
+        """Keys a try's handlers/finally release (textual match)."""
+        keys = set()
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                for spec in SPECS:
+                    for pat in spec.release:
+                        tgt = _op_target(node, pat)
+                        if tgt is not None:
+                            key = _key_of(tgt)
+                            if key is not None:
+                                keys.add(key)
+        return keys
+
+    def _do_try(self, st, env):
+        cleanup = []
+        for h in st.handlers:
+            cleanup.extend(h.body)
+        cleanup.extend(st.finalbody)
+        protected = self._protected_keys(cleanup)
+        for key in protected:
+            ent = env.get(key)
+            if ent is not None:
+                ent[1].protected = True
+        self.protect_stack.append(protected)
+        env_b, live_b = self.exec_block(st.body, dict(env))
+        if live_b and st.orelse:
+            env_b, live_b = self.exec_block(st.orelse, env_b)
+        self.protect_stack.pop()
+        outs = [(env_b, live_b)]
+        for h in st.handlers:
+            henv = self._merge(env, env_b)
+            henv, hlive = self.exec_block(h.body, henv)
+            outs.append((henv, hlive))
+        live_outs = [e for e, lv in outs if lv]
+        if live_outs:
+            out = live_outs[0]
+            for e in live_outs[1:]:
+                out = self._merge(out, e)
+            live = True
+        else:
+            out, live = self._merge(env, env_b), False
+        if st.finalbody:
+            out, flive = self.exec_block(st.finalbody, out)
+            live = live and flive
+        return out, live
+
+    def _do_with(self, st, env):
+        acquired, locks = [], 0
+        for item in st.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call):
+                spec, shared = _match_acquire(ce)
+                if spec is not None and \
+                        isinstance(item.optional_vars, ast.Name):
+                    key = ("local", item.optional_vars.id)
+                    res = self._bind(env, key,
+                                     SHARED if shared else ALLOC,
+                                     spec, ce.lineno)
+                    res.protected = True      # __exit__ covers it
+                    acquired.append(key)
+                else:
+                    self._scan(env, ce)
+            else:
+                attr = _self_attr(ce)
+                if attr is None and isinstance(ce, ast.Name):
+                    attr = ce.id
+                if attr is not None:
+                    self.lock_attrs.append(attr)
+                    locks += 1
+        env, live = self.exec_block(st.body, env)
+        for _ in range(locks):
+            self.lock_attrs.pop()
+        for key in acquired:
+            ent = env.get(key)
+            if ent is not None:
+                ent[1].release_line = st.body[-1].lineno
+                env[key] = (RELEASED, ent[1])
+        return env, live
+
+    # -- thread-role attribution --------------------------------------------
+
+    def _check_roles(self):
+        if not self.device_sites or self.cls is None:
+            return
+        model = threads_mod.class_model(self.ctx, self.cls)
+        if model is None:
+            return
+        if not any(r.device for r in model.roles.values()):
+            return
+        facts = model.facts.get(self.fn.name)
+        if facts is None or facts.node is not self.fn:
+            return          # nested def / not a direct method: skip
+        for spec, line, pin in self.device_sites:
+            if pin is not None:
+                rname = threads_mod._role_of_pin(model, pin)
+                role = model.roles.get(rname) if rname else None
+                bad = [rname] if (role is not None
+                                  and not role.device) else []
+            else:
+                bad = sorted(
+                    rn for rn, role in model.roles.items()
+                    if self.fn.name in role.methods and not role.device)
+            if bad:
+                self._emit(
+                    "lifecycle-lock", line, (spec.name, self.fn.name),
+                    f"{spec.name} released in {self.fn.name}() which is "
+                    f"reachable from non-device role(s) "
+                    f"{'/'.join(bad)}; the {spec.name} pool is owned by "
+                    "the device dispatch thread (no lock protects it)")
+
+    # -- entry --------------------------------------------------------------
+
+    def run(self):
+        env, live = self.exec_block(self.fn.body, {})
+        if live and self.fn.body:
+            self._leak_sweep(env, self.fn.body[-1].lineno,
+                             "end of the function")
+        self._check_roles()
+
+
+# ---------------------------------------------------------------------------
+# file driver + registered rules
+
+
+def _iter_defs(tree):
+    def rec(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                yield from rec(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, child)
+            else:
+                yield from rec(child, cls)
+    yield from rec(tree, None)
+
+
+def _file_findings(ctx):
+    cached = getattr(ctx, "_lifecycle_findings", None)
+    if cached is not None:
+        return cached
+    out = []
+    if ctx.tree is not None:
+        cg = None
+        if ctx.project is not None:
+            cg = callgraph_mod.for_project(ctx.project)
+        donations = {}
+        for cls_node, fn in _iter_defs(ctx.tree):
+            dmap = {}
+            if cls_node is not None and cg is not None:
+                if id(cls_node) not in donations:
+                    donations[id(cls_node)] = _class_donations(
+                        ctx, cg, cls_node)
+                dmap = donations[id(cls_node)]
+            _FnAnalysis(ctx, cg, cls_node, fn, dmap, out).run()
+        out.sort(key=lambda f: (f.line, f.rule))
+    ctx._lifecycle_findings = out
+    return out
+
+
+class _LifecycleRule(Rule):
+    """All six rules share one cached typestate pass per file."""
+
+    def check(self, ctx):
+        for f in _file_findings(ctx):
+            if f.rule == self.name:
+                yield f
+
+
+@register
+class DoubleFreeRule(_LifecycleRule):
+    name = "lifecycle-double-free"
+    description = ("a resource (KV page, slot row, adapter index) is "
+                   "released twice on one path")
+
+
+@register
+class UseAfterFreeRule(_LifecycleRule):
+    name = "lifecycle-use-after-free"
+    description = ("a released resource is used again (closed socket "
+                   "I/O, slot-table read through a retired row)")
+
+
+@register
+class UseAfterDonateRule(_LifecycleRule):
+    name = "lifecycle-use-after-donate"
+    description = ("a buffer donated to a jitted call (donate_argnums/"
+                   "argnames, including the _jitted_* factory idiom) is "
+                   "read before being rebound")
+
+
+@register
+class LeakRule(_LifecycleRule):
+    name = "lifecycle-leak"
+    description = ("an acquired resource is not covered by with/finally/"
+                   "a registered release hook on an exception or exit "
+                   "path")
+
+
+@register
+class FreeWhileSharedRule(_LifecycleRule):
+    name = "lifecycle-free-shared"
+    description = ("a refcounted prefix-cache page is returned to the "
+                   "free pool while the rc map still tracks it as "
+                   "shared")
+
+
+@register
+class WrongLockRule(_LifecycleRule):
+    name = "lifecycle-lock"
+    description = ("a resource is released without the lock its spec "
+                   "requires, or from a thread role that does not own "
+                   "the pool")

@@ -1,0 +1,142 @@
+"""Declarative resource-lifecycle specs for graftcheck's typestate pass.
+
+``lifecycle.py`` is a generic acquire→use→release state machine; THIS
+module is the table that tells it what a resource looks like in this
+codebase.  Each :class:`ResourceSpec` names the call patterns that
+produce a resource, the operations that release it, and the invariants
+that hold in between (refcount map, lock, thread role).  New resources
+from future PRs — e.g. in-flight page-migration leases (ROADMAP 1) —
+are one-entry additions here, with no analyzer changes.
+
+Pattern mini-language (shared by ``acquire``/``acquire_shared``/
+``release``):
+
+- ``"self._free_pages.pop"`` — a dotted call name, matched as an exact
+  name or a dotted suffix (so ``http.client.HTTPConnection`` also
+  matches a from-imported bare ``HTTPConnection``).  For ``acquire``
+  the call's RESULT is the resource; for ``release`` the resource is
+  the call's FIRST ARGUMENT (``self._free_pages.append(page)``).
+- ``"@.close"`` — a method ON the resource itself: ``sock.close()``
+  releases ``sock``; ``"@.accept"`` in ``acquire`` produces a resource
+  from any receiver (``listener.accept()``).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    """One tracked resource kind.
+
+    ``acquire``/``acquire_shared`` — call patterns whose result is one
+    freshly-owned / SHARED resource (shared = other owners may hold it;
+    see ``share_map``).  ``release`` — operations returning the
+    resource to its pool.  ``release_idempotent`` — a second release is
+    legal (``socket.close``), so double-free is not reported.
+    ``track_from_release`` — resources with no analyzable acquire site
+    (decode-slot rows come from a table scan): tracking starts at the
+    first release, which still catches double-free and use-after-free.
+    ``share_map`` — a ``self.<attr>`` refcount dict: membership guards
+    (``page in self._page_rc``) split the abstract state into
+    SHARED/exclusive branches and ``.pop``/``del`` un-shares, so
+    releasing while provably SHARED is reported.  ``lock`` — a
+    ``self.<attr>`` lock that must be lexically held at every release
+    site.  ``device_only`` — releases may only run on the device
+    dispatch role inferred by ``threads.py`` (the thread whose closure
+    calls ``copy_to_host_async``).  ``use_attrs`` — ``self.<attr>[r]``
+    READS that count as uses of handle ``r`` (slot tables).
+    ``register_hooks`` — attribute names whose assignment registers a
+    deferred release (``h._on_done = lambda: ...release...``), which
+    transfers ownership for leak purposes.  ``leak_check`` — whether
+    exception-path/exit leaks are reported for this kind.
+    """
+
+    name: str
+    description: str
+    acquire: tuple = ()
+    acquire_shared: tuple = ()
+    release: tuple = ()
+    release_idempotent: bool = False
+    track_from_release: bool = False
+    share_map: str = ""
+    lock: str = ""
+    device_only: bool = False
+    use_attrs: tuple = ()
+    register_hooks: tuple = ()
+    leak_check: bool = True
+
+
+SPECS = (
+    # Paged KV cache pages (serve.py).  The pool is `_free_pages`; the
+    # reserved sink page is excluded by `_assert_no_sink` at allocation.
+    # Prefix-cache pages are SHARED while `_page_rc` still maps them —
+    # `_evict_cached_pages` must un-share (rc pop) before appending a
+    # page back to the pool, and only the device dispatch role may
+    # touch the pool at all (the free list has no lock by design).
+    ResourceSpec(
+        name="kv-page",
+        description="paged KV cache page from the _free_pages pool",
+        acquire=("self._free_pages.pop",),
+        acquire_shared=("self._prefix.pop", "self._prefix.get"),
+        release=("self._free_pages.append", "self._free_pages.extend"),
+        share_map="_page_rc",
+        device_only=True,
+    ),
+    # Continuous-batching slot rows (allocate → prefill → decode →
+    # retire-acked).  Rows have no single acquire call (they come from
+    # a None-scan over the slot table), so tracking starts at the first
+    # `_free_row`: a second release is a double-free, and a later
+    # slot-table read through the freed row is a use-after-free.
+    ResourceSpec(
+        name="decode-slot",
+        description="continuous-batching slot row (retired via _free_row)",
+        release=("self._free_row",),
+        track_from_release=True,
+        use_attrs=("_slots", "_row_pages", "_row_prefix_keys"),
+        leak_check=False,
+    ),
+    # LoRA adapter bank indices.  Acquire pops `_free_lora`, release
+    # appends it back; both the refcounts and the free list are guarded
+    # by `_lora_lock`, and request handles register the deferred
+    # release via `h._on_done = ... _release_adapter(idx)`.
+    ResourceSpec(
+        name="lora-adapter",
+        description="LoRA adapter bank index from _free_lora",
+        acquire=("self._free_lora.pop",),
+        release=("self._free_lora.append",),
+        lock="_lora_lock",
+        register_hooks=("_on_done",),
+    ),
+    # Reservation-plane / fleet sockets and HTTP connections
+    # (reservation.py, fleet.py, util.bind_socket).  close() is
+    # idempotent so double-close is fine; the interesting findings are
+    # use-after-close and close-on-error-path leaks.
+    ResourceSpec(
+        name="socket",
+        description="TCP socket / HTTP connection handle",
+        acquire=("socket.create_connection", "socket.socket",
+                 "http.client.HTTPConnection", "self._dial", "@.accept"),
+        release=("@.close",),
+        release_idempotent=True,
+        register_hooks=("_on_done",),
+    ),
+    # jax.jit donated buffers.  Not acquire/release shaped: donation is
+    # inferred from donate_argnums/donate_argnames on jitted callables
+    # (including the `_jitted_*` factory idiom in models/decode.py) and
+    # any read of the donated binding before its rebind is a
+    # use-after-donate.  Declared here so the spec table is the single
+    # inventory of tracked resources.
+    ResourceSpec(
+        name="donated-buffer",
+        description="jax.jit donated argument (donate_argnums/argnames)",
+        leak_check=False,
+    ),
+)
+
+
+def spec_by_name(name):
+    for spec in SPECS:
+        if spec.name == name:
+            return spec
+    raise KeyError(name)

@@ -179,12 +179,16 @@ class Server(MessageSocket):
                 if s is self._sock:
                     try:
                         client, _ = self._sock.accept()
-                        # A peer that stalls mid-frame must not wedge the
-                        # single serve thread: bound each read so the peer is
-                        # dropped instead (select readiness only guarantees
-                        # >=1 byte, not a whole frame).
-                        client.settimeout(10.0)
-                        conns.append(client)
+                        try:
+                            # A peer that stalls mid-frame must not wedge the
+                            # single serve thread: bound each read so the peer
+                            # is dropped instead (select readiness only
+                            # guarantees >=1 byte, not a whole frame).
+                            client.settimeout(10.0)
+                            conns.append(client)
+                        except OSError:
+                            client.close()
+                            raise
                     except OSError:
                         pass
                 else:
@@ -384,7 +388,11 @@ class Client(MessageSocket):
         not hang the executor forever."""
         s = socket.create_connection(self.server_addr,
                                      timeout=connect_timeout)
-        s.settimeout(rpc_timeout)
+        try:
+            s.settimeout(rpc_timeout)
+        except OSError:
+            s.close()
+            raise
         return s
 
     def _effective_timeouts(self):
@@ -421,8 +429,18 @@ class Client(MessageSocket):
         with self._lock:
             if self._sock is None:
                 self._sock = self._connect()
-            self.send(self._sock, msg)
-            return self.receive(self._sock)
+            try:
+                self.send(self._sock, msg)
+                return self.receive(self._sock)
+            except Exception:
+                # A timed-out or half-sent RPC leaves the framed stream
+                # mid-message: the socket is wedged for every later call.
+                # Close and drop it so the next RPC redials cleanly.
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
 
     def register(self, node_meta):
         return self._request({"type": "REG", "node": node_meta})

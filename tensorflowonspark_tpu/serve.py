@@ -968,22 +968,30 @@ class ContinuousBatcher:
                     f"adapter bank full ({len(self._adapters)} registered; "
                     "raise lora_capacity / --generate_lora_capacity)")
             idx = self._free_lora.pop()
-            banks = self._lora_banks
-            new = {}
-            for layer, sub in banks.items():
-                attn = dict(sub["attn"])
-                for proj in self._lora_dims:
-                    ab = by_slot.get((layer, proj))
-                    if ab is None:       # uncovered: zero this index
-                        attn[f"{proj}_a"] = attn[f"{proj}_a"].at[idx].set(0.0)
-                        attn[f"{proj}_b"] = attn[f"{proj}_b"].at[idx].set(0.0)
-                    else:
-                        a, b = ab
-                        attn[f"{proj}_a"] = attn[f"{proj}_a"].at[idx].set(
-                            jnp.asarray(a, jnp.float32))
-                        attn[f"{proj}_b"] = attn[f"{proj}_b"].at[idx].set(
-                            jnp.asarray(b, jnp.float32) * float(scale))
-                new[layer] = {"attn": attn}
+            try:
+                banks = self._lora_banks
+                new = {}
+                for layer, sub in banks.items():
+                    attn = dict(sub["attn"])
+                    for proj in self._lora_dims:
+                        ab = by_slot.get((layer, proj))
+                        if ab is None:   # uncovered: zero this index
+                            attn[f"{proj}_a"] = \
+                                attn[f"{proj}_a"].at[idx].set(0.0)
+                            attn[f"{proj}_b"] = \
+                                attn[f"{proj}_b"].at[idx].set(0.0)
+                        else:
+                            a, b = ab
+                            attn[f"{proj}_a"] = attn[f"{proj}_a"].at[idx].set(
+                                jnp.asarray(a, jnp.float32))
+                            attn[f"{proj}_b"] = attn[f"{proj}_b"].at[idx].set(
+                                jnp.asarray(b, jnp.float32) * float(scale))
+                    new[layer] = {"attn": attn}
+            except BaseException:
+                # lifecycle-leak: a device OOM (or bad array) mid-build
+                # must not strand the popped bank index outside the pool
+                self._free_lora.append(idx)
+                raise
             self._lora_banks = new       # atomic rebind: the driver thread
             self._adapters[name] = idx   # picks it up at its next dispatch
             self._adapter_refs.setdefault(idx, 0)
@@ -1321,18 +1329,31 @@ class ContinuousBatcher:
                 self._page_rc[page] -= 1
             return False
         fresh = [self._free_pages.pop() for _ in range(fresh_need)]
-        pages = self._assert_no_sink(shared + fresh)
+        try:
+            pages = self._assert_no_sink(shared + fresh)
+            max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
+            # unallocated tail entries alias the SINK (never page 0 — that
+            # may belong to someone)
+            entries = jnp.asarray(
+                pages + [self._sink] * (max_pages - len(pages)), jnp.int32)
+            self._cache = self._set_table(self._cache,
+                                          jnp.asarray(row, jnp.int32),
+                                          entries)
+        except BaseException:
+            # lifecycle-leak: a device OOM (or the sink assert) between
+            # the pops and the table write must not strand the fresh
+            # pages outside the pool or hold phantom refs on the shared
+            # ones — the pool must conserve free+owned+cached+sink
+            self._free_pages.extend(fresh)
+            for page in shared:
+                self._page_rc[page] -= 1
+            raise
+        # row bookkeeping only after the slot table committed, so a
+        # failed allocation leaves no row state behind
         self._row_pages[row] = pages
         self._row_shared_n[row] = len(shared)
         self._row_prefix_keys[row] = keys        # for post-prefill registration
         self.prefill_tokens_shared += len(shared) * self.kv_page_size
-        max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
-        # unallocated tail entries alias the SINK (never page 0 — that
-        # may belong to someone)
-        entries = jnp.asarray(pages + [self._sink] * (max_pages - len(pages)),
-                              jnp.int32)
-        self._cache = self._set_table(self._cache,
-                                      jnp.asarray(row, jnp.int32), entries)
         return True
 
     def _register_prefix_pages(self, row):

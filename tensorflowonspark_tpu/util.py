@@ -63,24 +63,27 @@ def bind_socket(host, ports=None):
     (reference: reservation.py:190-206).  Returns the bound, listening socket.
     """
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    if not ports:
-        sock.bind((host, 0))
-    else:
-        last_err = None
-        for port in ports:
-            try:
-                sock.bind((host, port))
-                last_err = None
-                break
-            except OSError as e:
-                if e.errno != errno.EADDRINUSE:
-                    raise
-                last_err = e
-        if last_err is not None:
-            sock.close()
-            raise last_err
-    sock.listen(64)
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if not ports:
+            sock.bind((host, 0))
+        else:
+            last_err = None
+            for port in ports:
+                try:
+                    sock.bind((host, port))
+                    last_err = None
+                    break
+                except OSError as e:
+                    if e.errno != errno.EADDRINUSE:
+                        raise
+                    last_err = e
+            if last_err is not None:
+                raise last_err
+        sock.listen(64)
+    except BaseException:
+        sock.close()
+        raise
     return sock
 
 

@@ -658,11 +658,18 @@ def test_baseline_shrink_only_guard(tmp_path):
 
 
 def test_repo_wide_scan_under_wall_clock_budget():
-    """Acceptance: the full scan (new interprocedural rules included)
-    stays under the 10 s budget."""
+    """Acceptance: the full scan (interprocedural rules AND the
+    lifecycle typestate pass included) stays under the 10 s budget,
+    and --stats makes the budget attributable per rule."""
     t0 = time.monotonic()
-    proc = _cli(["tensorflowonspark_tpu", "tests", "examples"])
+    proc = _cli(["tensorflowonspark_tpu", "tests", "examples", "--stats"])
     elapsed = time.monotonic() - t0
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "graftcheck clean" in proc.stdout
     assert elapsed < 10.0, f"scan took {elapsed:.1f}s"
+    # per-rule wall-time / finding-count table
+    assert "graftcheck rule stats" in proc.stdout
+    stats_lines = proc.stdout[proc.stdout.index("graftcheck rule stats"):]
+    for rule in ("lifecycle-double-free", "thread-race", "total"):
+        assert rule in stats_lines
+    assert "ms" in stats_lines

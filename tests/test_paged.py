@@ -386,3 +386,121 @@ def test_kv_pool_occupancy_and_sink_write_stats(model_and_params):
         assert s["kv_sink_writes"] > 0
     finally:
         batcher.stop()
+
+def _pool_conserved(batcher, kv_pages):
+    """Every physical page 0..kv_pages-1 is in exactly one place: the
+    free list, the prefix cache, or a row's exclusive ownership; the
+    sink is in none of them and no refcount went negative."""
+    free = list(batcher._free_pages)
+    assert len(free) == len(set(free)), f"free list has duplicates: {free}"
+    assert batcher._sink not in free
+    cached = set(batcher._prefix.values())
+    owned = []
+    for rp in batcher._row_pages:
+        if rp:
+            assert batcher._sink not in rp
+            owned.extend(p for p in rp if p not in batcher._page_rc)
+    assert all(rc >= 0 for rc in batcher._page_rc.values()), \
+        dict(batcher._page_rc)
+    # rc-managed pages always live in the prefix cache
+    assert set(batcher._page_rc) <= cached
+    everywhere = sorted(free + list(cached) + owned)
+    assert everywhere == list(range(kv_pages)), (
+        f"pool not conserved: free={sorted(free)} cached={sorted(cached)} "
+        f"owned={sorted(owned)}")
+
+
+def test_page_conservation_under_fault_injection(model_and_params):
+    # ISSUE-8 satellite: _try_allocate must not lose popped fresh pages
+    # (or hold phantom prefix refs) when slot-table construction raises
+    # mid-way.  100 randomized allocate/cancel/evict/register cycles
+    # with injected _set_table failures: free + owned + cached + sink
+    # always accounts for every page.
+    import random
+
+    model, params = model_and_params
+    kv_pages = 6
+    batcher = serve.ContinuousBatcher(model, params, n_slots=3,
+                                      kv_page_size=8, kv_pages=kv_pages)
+    batcher.stop()                      # direct drive, no driver races
+    rng = random.Random(1234)
+    orig_set_table = batcher._set_table
+    armed = {"fail": False, "fired": 0}
+
+    def flaky_set_table(cache, row, entries):
+        if armed["fail"]:
+            armed["fail"] = False
+            armed["fired"] += 1
+            raise RuntimeError("injected device OOM")
+        return orig_set_table(cache, row, entries)
+
+    batcher._set_table = flaky_set_table
+    prompt_pool = [list(range(1, 11)), list(range(1, 19)),
+                   [7] * 9, [3, 1, 4, 1, 5, 9, 2, 6]]
+    active = set()
+    for cycle in range(100):
+        free_rows = [r for r in range(3) if r not in active]
+        op = rng.choice(["alloc", "alloc", "cancel", "evict", "register"])
+        if op == "alloc" and free_rows:
+            row = rng.choice(free_rows)
+            prompt = rng.choice(prompt_pool)
+            item = {"prompt": prompt, "max_new": rng.randint(1, 4),
+                    "temp": 0.0, "aidx": 0}
+            inject = rng.random() < 0.35
+            armed["fail"] = inject
+            try:
+                ok = batcher._try_allocate(row, item)
+                if ok:
+                    active.add(row)
+            except RuntimeError:
+                assert inject
+                assert batcher._row_pages[row] is None
+            armed["fail"] = False       # never leak a fault into free
+        elif op == "cancel" and active:
+            row = rng.choice(sorted(active))
+            batcher._free_row(row)
+            active.discard(row)
+        elif op == "evict":
+            batcher._evict_cached_pages(rng.randint(1, 3))
+        elif op == "register" and active:
+            batcher._register_prefix_pages(rng.choice(sorted(active)))
+        _pool_conserved(batcher, kv_pages)
+    assert armed["fired"] > 0           # faults actually exercised
+    for row in sorted(active):
+        batcher._free_row(row)
+    _pool_conserved(batcher, kv_pages)
+    # everything drains back: only cached pages may stay out of free
+    assert len(batcher._free_pages) + len(batcher._prefix) == kv_pages
+
+
+def test_evicting_shared_page_is_impossible(model_and_params):
+    # ISSUE-8 satellite: the free-while-shared analyzer fixture,
+    # mirrored at runtime — eviction pressure must never reclaim a
+    # prefix page while a live row still references it (rc > 0)
+    model, params = model_and_params
+    kv_pages = 6
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      kv_page_size=8, kv_pages=kv_pages)
+    batcher.stop()                      # direct drive, no driver races
+    seed = {"prompt": list(range(1, 19)), "max_new": 2, "temp": 0.0,
+            "aidx": 0}                  # 18 tokens = 2 full prefix pages
+    assert batcher._try_allocate(0, seed)
+    batcher._register_prefix_pages(0)   # publish 2 pages into the cache
+    batcher._free_row(0)                # rc -> 0, pages stay cached
+    shared_pages = set(batcher._prefix.values())
+    assert len(shared_pages) == 2
+    # a new row re-shares the cached pages (rc -> 1)
+    assert batcher._try_allocate(1, seed)
+    assert shared_pages <= set(batcher._row_pages[1])
+    assert all(batcher._page_rc[p] == 1 for p in shared_pages)
+    # demand far more than exists: eviction must not touch rc>0 pages
+    freed = batcher._evict_cached_pages(kv_pages)
+    assert freed == 0
+    assert set(batcher._prefix.values()) == shared_pages
+    assert not shared_pages & set(batcher._free_pages)
+    _pool_conserved(batcher, kv_pages)
+    # once the row retires (rc -> 0) the same pages become evictable
+    batcher._free_row(1)
+    assert batcher._evict_cached_pages(kv_pages) == 2
+    assert sorted(batcher._free_pages) == list(range(kv_pages))
+    _pool_conserved(batcher, kv_pages)

@@ -184,3 +184,73 @@ def test_client_rpc_timeout_bounds_wedged_server():
         client.close()
     finally:
         wedged.close()
+
+def test_failed_dial_leaves_no_open_fd(monkeypatch):
+    """ISSUE-8 regression (lifecycle-leak): when the post-connect
+    settimeout fails, _dial must close the fresh socket before the retry
+    loop dials again — a failed connect leaves no open fd behind."""
+    import socket
+
+    server = reservation.Server(1)
+    addr = server.start()
+    created = []
+    real_cc = socket.create_connection
+
+    class _FailsSettimeout:
+        def __init__(self, sock):
+            self._sock = sock
+            self.closed = False
+
+        def settimeout(self, t):
+            raise OSError("simulated setsockopt failure")
+
+        def close(self):
+            self.closed = True
+            self._sock.close()
+
+        def __getattr__(self, name):
+            return getattr(self._sock, name)
+
+    def tracking_cc(address, timeout=None):
+        wrapped = _FailsSettimeout(real_cc(address, timeout=timeout))
+        created.append(wrapped)
+        return wrapped
+
+    monkeypatch.setattr(socket, "create_connection", tracking_cc)
+    try:
+        with pytest.raises(ConnectionError, match="could not reach"):
+            reservation.Client(addr, retries=2, retry_delay=0.01)
+        assert len(created) == 2         # both attempts dialed...
+        assert all(w.closed for w in created)   # ...and both closed
+    finally:
+        monkeypatch.undo()
+        server.stop()
+
+
+def test_rpc_timeout_closes_wedged_socket_and_redials():
+    """ISSUE-8 regression: a timed-out RPC leaves the framed stream
+    mid-message; _request must close+drop the wedged socket so the NEXT
+    call redials instead of reusing a poisoned stream."""
+    import socket
+
+    wedged = socket.socket()
+    wedged.bind(("127.0.0.1", 0))
+    wedged.listen(5)                     # accepts, never responds
+    addr = wedged.getsockname()
+    try:
+        client = reservation.Client(addr, connect_timeout=2.0,
+                                    rpc_timeout=0.3, retries=1)
+        first = client._sock
+        assert first is not None
+        with pytest.raises(OSError):     # socket.timeout is an OSError
+            client.query()
+        assert client._sock is None      # dropped, not reused
+        assert first.fileno() == -1      # and actually closed
+        # the next RPC dials a FRESH socket (and times out the same way,
+        # proving it really went back through connect)
+        with pytest.raises(OSError):
+            client.query()
+        assert client._sock is None
+        client.close()
+    finally:
+        wedged.close()
