@@ -55,6 +55,7 @@ best-of-3 windows against dispatch-latency noise.
 """
 import argparse
 import json
+import queue
 import sys
 import time
 
@@ -238,6 +239,76 @@ def bench_engine_segment(reps=3, result_timeout=600):
     return async_tps, serial_tps, astats
 
 
+def bench_migrate_segment(reps=5, result_timeout=600):
+    """The migrate segment: one live paged session moved mid-decode
+    between two ContinuousBatchers through a real kvtransfer.PageServer
+    socket (benchmarks.make_migrate_pair / FLAGSHIP_MIGRATE) — freeze
+    gather, wire framing, page pull, resume splice, end to end.  Rep 0
+    pays the freeze/scatter compiles and is discarded; the rest report
+    medians.  Returns ``(migrate_ms, stall_ms, pages_per_s, n_pages,
+    nbytes)`` where ``stall_ms`` is the client-visible token gap across
+    the cut (last token streamed by the source to first token streamed
+    by the destination)."""
+    import statistics
+
+    from tensorflowonspark_tpu import kvtransfer
+    from tensorflowonspark_tpu.benchmarks import make_migrate_pair
+
+    src, dst, prompt, max_new = make_migrate_pair()
+    server = kvtransfer.PageServer()
+    migrate_ms, stall_ms = [], []
+    n_pages = nbytes = 0
+    try:
+        for _ in range(max(2, reps)):
+            h = src.submit(prompt, max_new)
+            h.tokens.get(timeout=result_timeout)   # mid-decode
+            t_last = time.perf_counter()
+            frozen = src.freeze_session(h, timeout_s=result_timeout)
+            assert frozen is not None, "session finished before the cut"
+            try:
+                # tokens committed before the cut still drain to the
+                # client
+                while True:
+                    try:
+                        h.tokens.get(timeout=0.05)
+                        t_last = time.perf_counter()
+                    except queue.Empty:
+                        break
+                t0 = time.perf_counter()
+                meta, blocks = kvtransfer.wire_snapshot(
+                    frozen, "bench", page_size=src.kv_page_size)
+                ticket = server.register(meta, blocks)
+                try:
+                    meta2, blocks2 = kvtransfer.pull_snapshot(
+                        server.addr, ticket)
+                    h2, installed = dst.submit_resume(meta2, blocks2)
+                    assert installed.wait(result_timeout), \
+                        "resume timed out"
+                finally:
+                    server.release(ticket)
+                t1 = time.perf_counter()
+                h2.tokens.get(timeout=result_timeout)  # live again
+                t2 = time.perf_counter()
+                src.complete_migration(frozen)
+                frozen = None
+            finally:
+                if frozen is not None:
+                    src.rollback_migration(frozen)
+            h2.result(timeout=result_timeout)      # drain the session
+            migrate_ms.append((t1 - t0) * 1e3)
+            stall_ms.append((t2 - t_last) * 1e3)
+            n_pages = int(meta["n_pages"])
+            nbytes = sum(int(a.nbytes) for a in blocks.values())
+    finally:
+        server.close()
+        src.stop()
+        dst.stop()
+    med = statistics.median(migrate_ms[1:])        # rep 0 = compile warmup
+    med_stall = statistics.median(stall_ms[1:])
+    return (med, med_stall, n_pages / (med / 1e3) if med else 0.0,
+            n_pages, nbytes)
+
+
 def _opt_segment_setup():
     """Cheap, CPU-safe registry smoke: the segment's builders and frozen
     config resolve without building the 0.87B model or touching a
@@ -321,6 +392,32 @@ def _engine_segment_result():
                         astats.get("pipeline_depth_peak", 0)}}
 
 
+def _migrate_segment_setup():
+    from tensorflowonspark_tpu import kvtransfer
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_MIGRATE,
+                                                  make_migrate_pair)
+
+    assert callable(make_migrate_pair)
+    assert kvtransfer.WIRE_VERSION >= 1
+    d = FLAGSHIP_MIGRATE
+    assert d["prompt_len"] + d["max_new"] <= d["max_seq"]
+    assert d["max_seq"] % d["kv_page_size"] == 0
+    # the snapshot must fit both pools with room for the decode tail
+    assert d["kv_pages"] * d["kv_page_size"] >= 2 * d["max_seq"]
+    return {"config": dict(d)}
+
+
+def _migrate_segment_result():
+    migrate_ms, stall_ms, pages_per_s, n_pages, nbytes = \
+        bench_migrate_segment()
+    return {"metric": "migrate_ms", "value": round(migrate_ms, 1),
+            "unit": "ms/migration",
+            "aux": {"stream_stall_ms": round(stall_ms, 1),
+                    "kv_pages_per_s": round(pages_per_s, 1),
+                    "kv_pages": n_pages,
+                    "kv_bytes": nbytes}}
+
+
 # segment registry: every entry shares the off-TPU skip + one-JSON-line-
 # per-segment protocol, so growing a segment is one row (the old
 # hardcoded opt_ms plumbing could not be reused).  Each entry carries:
@@ -350,6 +447,12 @@ SEGMENTS = {
         "setup": _engine_segment_setup,
         "help": "sustained decode tokens/s through the full continuous "
                 "batcher (async double-buffered engine vs serialized loop)"},
+    "migrate_ms": {
+        "run": _migrate_segment_result,
+        "setup": _migrate_segment_setup,
+        "help": "mid-decode kv migration between two batchers over a "
+                "page-server socket (freeze to resume splice, plus the "
+                "client-visible stream stall)"},
 }
 
 

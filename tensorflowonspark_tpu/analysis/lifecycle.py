@@ -886,10 +886,27 @@ class _FnAnalysis:
         if key in env_f:
             env_f[key] = ((ALLOC if truthy_shared else SHARED), res)
 
+    def _none_guard(self, test, env_t, env_f):
+        """`if r is None:` — r holds no resource in the true branch (an
+        acquire that returned None acquired nothing, e.g. freeze_session
+        on a session that finished before the cut); `is not None`
+        mirrors into the false branch."""
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.left, ast.Name)
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None):
+            return
+        op = test.ops[0]
+        if isinstance(op, ast.Is):
+            env_t.pop(("local", test.left.id), None)
+        elif isinstance(op, ast.IsNot):
+            env_f.pop(("local", test.left.id), None)
+
     def _do_if(self, st, env):
         self._scan(env, st.test)
         env_t, env_f = dict(env), dict(env)
         self._share_guard(st.test, env_t, env_f)
+        self._none_guard(st.test, env_t, env_f)
         pin = threads_mod._pinned_thread_attr(st.test)
         if pin is not None:
             self.pin_stack.append(pin)

@@ -121,6 +121,22 @@ SPECS = (
         release_idempotent=True,
         register_hooks=("_on_done",),
     ),
+    # Migrated-page leases (kvtransfer.py / serve.py).  `freeze_session`
+    # returns a frozen-snapshot dict that pins the row's pages on the
+    # source until exactly one of: `complete_migration` (destination
+    # acked the splice — pages retire) or `rollback_migration` (the
+    # session resumes decoding on the source).  Dropping the snapshot
+    # without either call leaks the row AND its pages; calling both is
+    # the cross-replica double-free this spec exists to catch.  Releases
+    # run off the device thread by design (both delegate to the device
+    # loop internally), so device_only stays False.
+    ResourceSpec(
+        name="migration-lease",
+        description="frozen KV snapshot pinning source pages during "
+                    "a cross-replica migration (freeze_session)",
+        acquire=("freeze_session",),
+        release=("complete_migration", "rollback_migration"),
+    ),
     # jax.jit donated buffers.  Not acquire/release shaped: donation is
     # inferred from donate_argnums/donate_argnames on jitted callables
     # (including the `_jitted_*` factory idiom in models/decode.py) and

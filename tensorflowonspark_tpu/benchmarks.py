@@ -225,6 +225,64 @@ def make_engine_burst(engine="async", n_slots=None, prompts=None,
     return batcher, prompts_list, max_new
 
 
+# The migrate_ms segment workload (bench.py --segments): one live paged
+# session frozen mid-decode on a source ContinuousBatcher, shipped page-
+# by-page through a real kvtransfer.PageServer socket on localhost, and
+# resumed on a destination batcher — the disaggregated-serving handoff
+# end to end (freeze gather, wire framing, page upload, table splice).
+# Long prompt so the snapshot carries a realistic page count; the
+# decode keeps running through the cut, so the segment can also report
+# the client-visible stream stall.  Frozen like FLAGSHIP_ENGINE:
+# changing any value invalidates migrate_ms comparability.
+FLAGSHIP_MIGRATE = dict(n_slots=4, prompt_len=192, max_new=48,
+                        prefill_chunk=256, kv_page_size=32, kv_pages=64,
+                        max_seq=256)
+
+
+def make_migrate_pair(n_slots=None, prompt_len=None, max_new=None,
+                      prefill_chunk=None, kv_page_size=None,
+                      kv_pages=None, max_seq=None):
+    """Build the migrate_ms segment workload: source and destination
+    ContinuousBatchers on the flagship-LM dims (both paged — migration
+    ships occupied pages) plus the prompt to move.  Returns
+    ``(src, dst, prompt, max_new)``; the caller submits to ``src``,
+    freezes mid-decode, wires the snapshot across, resumes on ``dst``,
+    and times the handoff.  Caller must stop BOTH batchers.  Prompt
+    content is random garbage for the same reasons as
+    :func:`make_prefill_burst`."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_MIGRATE
+    n_slots = n_slots or d["n_slots"]
+    prompt_len = prompt_len or d["prompt_len"]
+    max_new = max_new or d["max_new"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    page = kv_page_size or d["kv_page_size"]
+    pages = kv_pages or d["kv_pages"]
+    max_seq = max_seq or d["max_seq"]
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    def mk():
+        return serve_mod.ContinuousBatcher(
+            model, params, n_slots=n_slots, read_chunk=1,
+            prefill_chunk=chunk, kv_page_size=page, kv_pages=pages)
+
+    src, dst = mk(), mk()
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(1, cfg.vocab_size,
+                        prompt_len).astype("int32").tolist()
+    return src, dst, prompt, max_new
+
+
 def make_flagship_step(batch_size=None, seq_len=None, config="v2",
                        optimizer=None):
     """Build the flagship-LM training step exactly as the driver metric

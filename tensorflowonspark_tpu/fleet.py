@@ -81,6 +81,9 @@ class Replica:
         self.model_name = str(meta.get("model_name") or "default")
         self.n_slots = int(meta.get("n_slots") or 8)
         self.features = dict(meta.get("features") or {})
+        # disaggregation role: advisory routing hint announced by the
+        # replica (serve.py --role) via REG features; absent → "mixed"
+        self.role = str(self.features.get("role") or "mixed")
         self.state = UP
         self.outstanding = 0     # gateway-proxied requests in flight
         self.requests = 0        # total forwarded (monotone)
@@ -92,7 +95,8 @@ class Replica:
     def describe(self):
         return {"host": self.host, "port": self.port,
                 "model_name": self.model_name, "n_slots": self.n_slots,
-                "features": self.features, "state": self.state,
+                "features": self.features, "role": self.role,
+                "state": self.state,
                 "outstanding": self.outstanding, "requests": self.requests,
                 "errors": self.errors,
                 "breaker_open": self.open_until > time.monotonic()}
@@ -260,13 +264,21 @@ class Gateway:
         return not (r.failures >= self.breaker_threshold
                     and r.open_until > now)
 
-    def _choose(self, prefix_key=None, exclude=()):
+    def _choose(self, prefix_key=None, exclude=(), roles=None):
         """Pick a replica, or raise :class:`NoReplica` /
-        :class:`Saturated`.  `prefix_key` engages affinity routing."""
+        :class:`Saturated`.  `prefix_key` engages affinity routing.
+        `roles` is a soft preference: when at least one routable replica
+        carries one of the named roles, the choice is restricted to
+        those; otherwise every routable replica stays eligible (a
+        prefill-only or decode-only fleet must not go dark)."""
         with self._lock:
             now = time.monotonic()
             routable = [r for r in self._replicas.values()
                         if r.id not in exclude and self._routable(r, now)]
+            if roles is not None:
+                preferred = [r for r in routable if r.role in roles]
+                if preferred:
+                    routable = preferred
             if not routable:
                 if self._replicas:
                     raise Saturated("no routable replica (ejected/"
@@ -313,6 +325,29 @@ class Gateway:
                                        "(%d consecutive failures)",
                                        r.id, r.failures)
 
+    def _decode_target(self, exclude_id=None):
+        """Least-loaded routable decode/mixed replica other than
+        `exclude_id`, or None.  Does NOT bump ``outstanding`` — the
+        migrated stream rides the source replica's proxied connection;
+        the destination's own admission control meters the resume."""
+        with self._lock:
+            now = time.monotonic()
+            cands = [r for r in self._replicas.values()
+                     if r.id != exclude_id and self._routable(r, now)
+                     and r.role in ("decode", "mixed")]
+            if not cands:
+                return None
+            return min(cands, key=lambda r: (r.outstanding, r.id))
+
+    def migrate_target(self, r):
+        """The decode replica a session admitted on `r` should hand off
+        to once first tokens flush, or None when disaggregation is not
+        in play (source isn't prefill-role, or no decode-capable peer
+        exists)."""
+        if r.role != "prefill":
+            return None
+        return self._decode_target(exclude_id=r.id)
+
     def prefix_key(self, body):
         """Affinity key for a :generate body: the first ``prefix_tokens``
         token ids of the first prompt (None when absent/malformed — the
@@ -330,14 +365,17 @@ class Gateway:
 
     # ---- replica I/O -----------------------------------------------------
 
-    def _request(self, r, method, path, body=None, timeout=None):
+    def _request(self, r, method, path, body=None, timeout=None,
+                 headers=None):
         """One HTTP exchange with a replica.  Returns the live
         (connection, response) — the caller relays and closes."""
         conn = http.client.HTTPConnection(
             r.host, r.port, timeout=timeout or self.replica_timeout_s)
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         try:
-            conn.request(method, path, body=body,
-                         headers={"Content-Type": "application/json"})
+            conn.request(method, path, body=body, headers=hdrs)
             return conn, conn.getresponse()
         except Exception:
             conn.close()
@@ -354,12 +392,18 @@ class Gateway:
 
     # ---- drain (rolling restarts) ----------------------------------------
 
-    def drain(self, replica_id, timeout_s=60.0):
+    def drain(self, replica_id, timeout_s=60.0, mode="drain"):
         """Stop new admissions to `replica_id`, wait for in-flight work
         (gateway-proxied requests AND the replica's own slot
         generations, via its drain hook), then deregister.  Returns a
         summary dict; ``drained: False`` when the wait timed out (the
-        replica is then left DRAINING — re-issue or restart it)."""
+        replica is then left DRAINING — re-issue or restart it).
+
+        ``mode="migrate"`` first asks the replica to move its live
+        sessions to decode-capable peers via ``POST /v1/kv:export``
+        (the streams keep flowing through the source's relay threads),
+        then proceeds with the normal drain wait — rolling upgrades
+        without dropping streams."""
         with self._lock:
             r = self._replicas.get(str(replica_id))
             if r is None:
@@ -368,6 +412,30 @@ class Gateway:
         self.counters.inc("drains_started")
         t0 = time.monotonic()
         deadline = t0 + float(timeout_s)
+        migration_report = None
+        if mode == "migrate":
+            with self._lock:
+                now = time.monotonic()
+                dests = [{"host": d.host, "port": d.port}
+                         for d in self._replicas.values()
+                         if d.id != r.id and self._routable(d, now)
+                         and d.role in ("decode", "mixed")]
+            if not dests:
+                migration_report = {
+                    "error": "no decode-capable peer to migrate to"}
+            else:
+                try:
+                    conn, resp = self._request(
+                        r, "POST", "/v1/kv:export",
+                        body=json.dumps({"dests": dests}).encode(),
+                        timeout=max(0.1, deadline - time.monotonic()))
+                    try:
+                        migration_report = json.loads(
+                            resp.read() or b"{}")
+                    finally:
+                        conn.close()
+                except (OSError, ValueError) as e:
+                    migration_report = {"error": str(e)}
         while r.outstanding > 0 and time.monotonic() < deadline:
             time.sleep(0.05)
         replica_report = None
@@ -386,15 +454,21 @@ class Gateway:
                 replica_report = {"error": str(e)}   # dead replica: fine,
                 # deregistering it is exactly what the caller wants
         if r.outstanding > 0:
-            return {"drained": False, "replica": r.id,
-                    "in_flight": r.outstanding,
-                    "waited_s": round(time.monotonic() - t0, 3)}
+            out = {"drained": False, "replica": r.id,
+                   "in_flight": r.outstanding,
+                   "waited_s": round(time.monotonic() - t0, 3)}
+            if migration_report is not None:
+                out["migration"] = migration_report
+            return out
         with self._lock:
             self._replicas.pop(r.id, None)
         self.counters.inc("drains_completed")
-        return {"drained": True, "replica": r.id,
-                "waited_s": round(time.monotonic() - t0, 3),
-                "replica_report": replica_report}
+        out = {"drained": True, "replica": r.id,
+               "waited_s": round(time.monotonic() - t0, 3),
+               "replica_report": replica_report}
+        if migration_report is not None:
+            out["migration"] = migration_report
+        return out
 
     # ---- observability ---------------------------------------------------
 
@@ -416,7 +490,9 @@ class Gateway:
                   "kv_pages_used": 0, "kv_pages_free": 0,
                   "kv_sink_writes": 0,
                   "ttft_count": 0, "ttft_ms_sum": 0.0,
-                  "decode_steps": 0, "pipeline_depth_peak": 0}
+                  "decode_steps": 0, "pipeline_depth_peak": 0,
+                  "migrations_started": 0, "migrations_completed": 0,
+                  "migrations_failed": 0, "kv_pages_exported": 0}
         for rid, (r, desc) in snap.items():
             if rid in beats:
                 desc["last_beat_age_s"] = round(now - beats[rid], 3)
@@ -455,6 +531,15 @@ class Gateway:
                     totals["pipeline_depth_peak"] = max(
                         totals["pipeline_depth_peak"],
                         int(gstats.get("pipeline_depth_peak") or 0))
+                    # kv-migration traffic: counts sum across replicas
+                    # (source counts started/completed/failed + pages
+                    # exported; destinations count their own imports in
+                    # per-replica stats)
+                    for key in ("migrations_started",
+                                "migrations_completed",
+                                "migrations_failed",
+                                "kv_pages_exported"):
+                        totals[key] += int(gstats.get(key) or 0)
                 except (OSError, ValueError) as e:
                     desc["probe_error"] = str(e)
         totals["ttft_ms_sum"] = round(totals["ttft_ms_sum"], 3)
@@ -545,13 +630,14 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         finally:
             conn.close()
 
-    def _forward_once(self, r, path, body):
+    def _forward_once(self, r, path, body, headers=None):
         """One proxied POST to `r`.  Returns (ok, conn, resp);
         ``ok=False`` (connect error or 5xx) has already updated the
         breaker and closed the connection."""
         gw = self.gateway
         try:
-            conn, resp = gw._request(r, "POST", path, body=body)
+            conn, resp = gw._request(r, "POST", path, body=body,
+                                     headers=headers)
         except OSError as e:
             gw._release(r, ok=False)
             return False, None, e
@@ -608,15 +694,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         gw = self.gateway
         split = urllib.parse.urlsplit(self.path)
         path = split.path
-        if path == "/v1/fleet:drain":
+        if path in ("/v1/fleet:drain", "/v1/fleet:migrate"):
             qs = urllib.parse.parse_qs(split.query)
             rid = (qs.get("replica") or [None])[0]
             if not rid:
                 self._send(400, {"error": "missing ?replica=<id>"})
                 return
             timeout_s = float((qs.get("timeout_s") or ["60"])[0])
+            mode = "migrate" if path.endswith(":migrate") else "drain"
             try:
-                out = gw.drain(rid, timeout_s=timeout_s)
+                out = gw.drain(rid, timeout_s=timeout_s, mode=mode)
             except KeyError as e:
                 self._send(404, {"error": str(e)})
                 return
@@ -638,11 +725,24 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             except ValueError:
                 prefix_key = None   # replica will 400 the bad JSON
         try:
-            r = gw._choose(prefix_key=prefix_key)
+            # :generate prefers prefill-capable replicas; when the pick
+            # is a dedicated prefill node, plant the handoff header so
+            # the replica migrates the session to a decode peer once
+            # first tokens flush (the stream keeps riding this proxied
+            # connection via the source's relay thread)
+            roles = ("prefill", "mixed") if is_generate else None
+            r = gw._choose(prefix_key=prefix_key, roles=roles)
         except (NoReplica, Saturated) as e:
             self._reject(e)
             return
-        ok, conn, resp_or_err = self._forward_once(r, self.path, body)
+        headers = None
+        if is_generate:
+            dest = gw.migrate_target(r)
+            if dest is not None:
+                headers = {"X-Fleet-Migrate-To":
+                           f"{dest.host}:{dest.port}"}
+        ok, conn, resp_or_err = self._forward_once(r, self.path, body,
+                                                   headers=headers)
         if ok:
             try:
                 self._relay(conn, resp_or_err)

@@ -172,6 +172,16 @@ class FleetClient:
             "POST", f"/v1/fleet:drain?replica={rid}&timeout_s={timeout_s}",
             timeout=timeout_s + 5.0)
 
+    def migrate(self, replica_id, timeout_s=60.0):
+        """Drain `replica_id`, moving its live sessions to decode-capable
+        peers instead of waiting them out (rolling upgrade without
+        dropping streams)."""
+        rid = replica_id.replace(":", "%3A")
+        return self._call(
+            "POST",
+            f"/v1/fleet:migrate?replica={rid}&timeout_s={timeout_s}",
+            timeout=timeout_s + 5.0)
+
     def ready(self):
         try:
             status, _ = self._call("GET", "/readyz", timeout=2.0)

@@ -1,0 +1,481 @@
+"""Page-granular KV migration between serving replicas.
+
+This is the transport half of disaggregated prefill/decode serving
+(DistServe/Mooncake style): a session is prefilled on one replica,
+decodes its first tokens there, and is then *moved* — occupied KV pages,
+int8 scale blocks, and enough row metadata to recompute every resident
+sampling register — to another replica that continues the stream
+mid-sequence, byte-identical to a non-migrated run.
+
+Three layers live here, smallest first:
+
+``write_snapshot`` / ``read_snapshot``
+    The wire format: one msgpack header frame (meta + block manifest),
+    then each named array as sequential ``block`` frames chunked at
+    ``CHUNK_BYTES``, then an ``end`` frame.  Frames ride the same
+    4-byte length-prefixed msgpack framing as the rendezvous protocol
+    (:class:`reservation.MessageSocket`), with a larger frame cap.
+
+``PageServer`` / ``pull_snapshot``
+    A pull socket.  The source registers a frozen snapshot under a
+    one-time ticket; the destination dials back and pulls it over TCP.
+    Pull (dest-initiated) rather than push keeps the HTTP control
+    channel — ``POST :resume`` carrying the ticket — the single place
+    ordering is decided.
+
+``MigrationEngine``
+    The source-side driver: freeze the session at a host-tick cut
+    (``batcher.freeze_session``), publish the snapshot, POST
+    ``/v1/models/<name>:resume`` to the destination, and treat the
+    first ndjson event of the response as the splice ack.  On ack the
+    source frees the row (``complete_migration``) and a relay thread
+    forwards the destination's token events into the original handle,
+    so the client's stream never breaks.  On timeout or refusal the
+    source reinstalls the row (``rollback_migration``) and the session
+    continues decoding locally — pages are owned by exactly one side
+    at every instant, so a failed migration can never double-free.
+"""
+import json
+import logging
+import socket
+import threading
+import time
+import uuid
+
+import http.client
+
+import numpy as np
+
+from . import util
+from .reservation import MessageSocket
+
+logger = logging.getLogger(__name__)
+
+WIRE_VERSION = 1
+
+# Page blocks are shipped in slices well under the frame cap: each frame
+# is one msgpack bin that must be materialized whole on both sides, so
+# smaller chunks bound peak memory and keep the receiver's read loop
+# responsive to socket timeouts.
+CHUNK_BYTES = 8 * 1024 * 1024
+
+
+class KvSocket(MessageSocket):
+    """Rendezvous framing with a cap sized for KV page payloads."""
+    MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+def _np_dtype(name):
+    """``np.dtype`` from its wire name; resolves bf16 via ml_dtypes."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def write_snapshot(msock, sock, meta, blocks):
+    """Stream ``meta`` + named arrays over an open socket.
+
+    ``blocks`` maps block name -> np.ndarray.  Block order on the wire
+    is sorted by name so both sides agree without shipping indices
+    twice; each block's bytes go out as sequential chunks.
+    """
+    names = sorted(blocks)
+    manifest = [{"name": n, "dtype": str(blocks[n].dtype),
+                 "shape": [int(d) for d in blocks[n].shape],
+                 "nbytes": int(blocks[n].nbytes)} for n in names]
+    msock.send(sock, {"kind": "header", "version": WIRE_VERSION,
+                      "meta": meta, "blocks": manifest})
+    for i, n in enumerate(names):
+        data = np.ascontiguousarray(blocks[n]).tobytes()
+        for off in range(0, len(data), CHUNK_BYTES):
+            msock.send(sock, {"kind": "block", "i": i, "off": off,
+                              "data": data[off:off + CHUNK_BYTES]})
+    msock.send(sock, {"kind": "end", "blocks": len(names)})
+
+
+def read_snapshot(msock, sock):
+    """Inverse of :func:`write_snapshot`: returns ``(meta, blocks)``.
+
+    Raises ``ValueError`` on protocol violations (bad version, missing
+    bytes, out-of-order chunks) and on an ``err`` frame from the peer.
+    """
+    head = msock.receive(sock)
+    if head.get("kind") == "err":
+        raise ValueError(head.get("error") or "kv snapshot refused")
+    if head.get("kind") != "header" or head.get("version") != WIRE_VERSION:
+        raise ValueError("bad kv snapshot header: kind=%r version=%r"
+                         % (head.get("kind"), head.get("version")))
+    manifest = head.get("blocks") or []
+    bufs = [bytearray(int(m["nbytes"])) for m in manifest]
+    fills = [0] * len(manifest)
+    while True:
+        frame = msock.receive(sock)
+        kind = frame.get("kind")
+        if kind == "end":
+            break
+        if kind != "block":
+            raise ValueError("unexpected %r frame in kv stream" % (kind,))
+        i, off, data = int(frame["i"]), int(frame["off"]), frame["data"]
+        if not 0 <= i < len(manifest):
+            raise ValueError("block index %d out of range" % i)
+        if off != fills[i] or off + len(data) > len(bufs[i]):
+            raise ValueError("out-of-order chunk for block %r"
+                             % manifest[i]["name"])
+        bufs[i][off:off + len(data)] = data
+        fills[i] += len(data)
+    blocks = {}
+    for m, buf, fill in zip(manifest, bufs, fills):
+        if fill != int(m["nbytes"]):
+            raise ValueError("short block %r: %d of %d bytes"
+                             % (m["name"], fill, int(m["nbytes"])))
+        arr = np.frombuffer(buf, dtype=_np_dtype(m["dtype"]))
+        blocks[m["name"]] = arr.reshape([int(d) for d in m["shape"]])
+    return head.get("meta") or {}, blocks
+
+
+def wire_snapshot(frozen, model_name, page_size=0):
+    """Flatten a ``freeze_session`` record into ``(meta, blocks)``.
+
+    Device arrays become host numpy here (the freeze already kicked off
+    ``copy_to_host_async``, so these conversions mostly find the bytes
+    waiting); paged blocks are sliced to the occupied page count —
+    the gather padded to a power-of-two width for compile reuse, and
+    the pad rows are garbage the destination must not see.
+    """
+    item = frozen["item"]
+    n_pages = int(frozen.get("n_pages", 0))
+    meta = {"version": WIRE_VERSION, "model": model_name,
+            "kind": frozen["kind"], "page_size": int(page_size),
+            "n_pages": n_pages,
+            "seq": [int(t) for t in frozen["seq"]],
+            "plen": int(frozen["plen"]),
+            "remaining": int(frozen["remaining"]),
+            "max_new": int(item["max_new"]), "temp": float(item["temp"]),
+            "eos": item["eos"], "seed": int(item["seed"]),
+            "topk": int(item["topk"]), "topp": float(item["topp"]),
+            "minp": float(item["minp"]), "stops": item["stops"],
+            "rep": float(item["rep"]), "adapter": item.get("adapter")}
+    blocks = {}
+    for name, arr in frozen["kv"].items():
+        a = np.asarray(arr)
+        if frozen["kind"] == "paged":
+            a = a[:n_pages]
+        blocks[name] = a
+    return meta, blocks
+
+
+def pull_snapshot(addr, ticket, timeout=30.0):
+    """Dial a :class:`PageServer` and pull the snapshot for ``ticket``."""
+    msock = KvSocket()
+    sock = socket.create_connection((addr[0], int(addr[1])),
+                                    timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        msock.send(sock, {"kind": "pull", "ticket": ticket})
+        return read_snapshot(msock, sock)
+    finally:
+        sock.close()
+
+
+class PageServer:
+    """Serves registered KV snapshots to destinations that pull them.
+
+    One per replica, bound lazily on the serving interface.  Tickets
+    stay registered until the engine releases them, so a retried
+    ``:resume`` can re-pull the same frozen bytes.
+    """
+
+    def __init__(self, host="127.0.0.1"):
+        self._sock = util.bind_socket(host)
+        self.addr = self._sock.getsockname()[:2]
+        self._msock = KvSocket()
+        self._tickets = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock.settimeout(0.2)
+        self._thread = threading.Thread(
+            target=self._serve, name="kv-page-server", daemon=True)
+        self._thread.start()
+
+    def register(self, meta, blocks):
+        ticket = uuid.uuid4().hex
+        with self._lock:
+            self._tickets[ticket] = (meta, blocks)
+        return ticket
+
+    def release(self, ticket):
+        with self._lock:
+            self._tickets.pop(ticket, None)
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(sock,),
+                             name="kv-page-pull", daemon=True).start()
+
+    def _serve_one(self, sock):
+        try:
+            sock.settimeout(60.0)
+            req = self._msock.receive(sock)
+            with self._lock:
+                entry = self._tickets.get(req.get("ticket"))
+            if req.get("kind") != "pull" or entry is None:
+                self._msock.send(sock, {"kind": "err",
+                                        "error": "unknown kv ticket"})
+                return
+            write_snapshot(self._msock, sock, *entry)
+        except (OSError, ValueError) as e:
+            logger.debug("kv pull aborted: %s", e)
+        finally:
+            sock.close()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+class MigrationEngine:
+    """Source-side driver for moving live sessions to another replica.
+
+    Owns the replica's :class:`PageServer` and the relay threads that
+    keep clients' token streams alive across the handoff.  All methods
+    are called off the batcher's device thread; the freeze/rollback
+    device work is delegated through the batcher's migration queue.
+    """
+
+    def __init__(self, batcher, model_name="default", host="127.0.0.1",
+                 advertise_host=None, timeout_s=30.0, retries=1):
+        self.batcher = batcher
+        self.model_name = model_name
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self._host = host or "127.0.0.1"
+        self._advertise_host = advertise_host or self._host
+        self._server = None
+        self._server_lock = threading.Lock()
+        self._closed = False
+
+    @property
+    def server(self):
+        with self._server_lock:
+            if self._server is None:
+                if self._closed:
+                    raise RuntimeError("migration engine is closed")
+                self._server = PageServer(self._host)
+            return self._server
+
+    def migrate(self, handle, dest, timeout_s=None, retries=None):
+        """Move one live session to ``dest`` = ``(host, port)``.
+
+        Returns a summary dict; ``{"migrated": False, ...}`` outcomes
+        leave the session decoding on this replica (rollback), so the
+        caller never has to clean up after a failure.
+        """
+        timeout_s = self.timeout_s if timeout_s is None else float(timeout_s)
+        retries = self.retries if retries is None else int(retries)
+        b = self.batcher
+        deadline = time.monotonic() + timeout_s
+        try:
+            frozen = b.freeze_session(handle, timeout_s=timeout_s)
+        except (TimeoutError, ValueError, RuntimeError) as e:
+            return {"migrated": False, "error": str(e)}
+        if frozen is None:
+            # finished (or was cancelled) before the cut landed
+            return {"migrated": False, "completed_locally": True}
+        ticket = None
+        last_err = "migration timed out before the first attempt"
+        try:
+            b.counters.inc("migrations_started")
+            meta, blocks = wire_snapshot(frozen, self.model_name,
+                                         page_size=b.kv_page_size)
+            ticket = self.server.register(meta, blocks)
+            nbytes = sum(int(a.nbytes) for a in blocks.values())
+            n_pages = int(frozen.get("n_pages", 0))
+            for attempt in range(retries + 1):
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    last_err = "migration deadline exhausted"
+                    break
+                try:
+                    conn, resp, first = self._post_resume(
+                        dest, meta, ticket, min(budget, timeout_s))
+                except (OSError, ValueError) as e:
+                    last_err = "attempt %d: %s" % (attempt + 1, e)
+                    logger.warning("kv migrate to %s failed (%s)",
+                                   dest, last_err)
+                    continue
+                if first.get("resumed"):
+                    # the ack: destination owns the pages from here on.
+                    # NEVER roll back past this point — both replicas
+                    # decoding the same row would double-serve (though
+                    # never double-free: each frees only its own pages).
+                    b.complete_migration(frozen)
+                    frozen = None      # handed off; finally must not roll
+                    threading.Thread(
+                        target=self._relay, args=(handle, conn, resp),
+                        name="kv-migrate-relay", daemon=True).start()
+                    return {"migrated": True,
+                            "dest": [dest[0], int(dest[1])],
+                            "pages": n_pages,
+                            "bytes": nbytes}
+                last_err = str(first.get("error")
+                               or "destination refused resume")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        finally:
+            if ticket is not None:
+                self.server.release(ticket)
+            if frozen is not None:
+                # every non-acked exit — give-up, deadline, or an
+                # unexpected raise — resumes decode on this replica;
+                # a frozen row must never be left stranded
+                b.rollback_migration(frozen)
+                b.counters.inc("migrations_failed")
+        return {"migrated": False, "error": last_err}
+
+    def migrate_async(self, handle, dest, timeout_s=None, retries=None):
+        """Fire-and-forget :meth:`migrate` (the prefill-role handoff)."""
+        t = threading.Thread(
+            target=self.migrate, args=(handle, dest),
+            kwargs={"timeout_s": timeout_s, "retries": retries},
+            name="kv-migrate", daemon=True)
+        t.start()
+        return t
+
+    def migrate_all(self, dests, max_sessions=None, timeout_s=None):
+        """Migrate every live session, round-robin across ``dests``.
+
+        The drain-without-dropping-streams path: sessions still in
+        admission finish prefill here and are not moved (the caller's
+        drain wait covers them).
+        """
+        handles = self.batcher.live_handles()
+        if max_sessions is not None:
+            handles = handles[:int(max_sessions)]
+        out = {"sessions": len(handles), "migrated": 0, "failed": 0,
+               "completed_locally": 0, "details": []}
+        for i, h in enumerate(handles):
+            dest = dests[i % len(dests)]
+            res = self.migrate(h, dest, timeout_s=timeout_s)
+            out["details"].append(res)
+            if res.get("migrated"):
+                out["migrated"] += 1
+            elif res.get("completed_locally"):
+                out["completed_locally"] += 1
+            else:
+                out["failed"] += 1
+        return out
+
+    def _post_resume(self, dest, meta, ticket, timeout):
+        """POST ``:resume`` and read the first (ack) event of the
+        ndjson response.  Returns ``(conn, resp, first_event)``."""
+        body = json.dumps({
+            "meta": meta,
+            "pull": {"host": self._advertise_host,
+                     "port": int(self.server.addr[1]),
+                     "ticket": ticket}}).encode()
+        conn = http.client.HTTPConnection(dest[0], int(dest[1]),
+                                          timeout=max(1.0, timeout))
+        try:
+            conn.request("POST", "/v1/models/%s:resume" % self.model_name,
+                         body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                data = resp.read()
+                raise ValueError("resume rejected: HTTP %d %s"
+                                 % (resp.status,
+                                    data.decode("utf-8", "replace")[:200]))
+            line = resp.readline()
+            if not line:
+                raise ValueError("resume stream closed before ack")
+            return conn, resp, json.loads(line)
+        except BaseException:
+            conn.close()
+            raise
+
+    def _relay(self, handle, conn, resp):
+        """Forward the destination's token events into the source
+        handle so the client's stream continues uninterrupted."""
+        b = self.batcher
+        done = threading.Event()
+
+        def _watch_cancel():
+            # client went away mid-relay: shooting the connection makes
+            # the destination's stream writer fail, and its generator
+            # cancels the moved session.  (The reads below must stay
+            # blocking — a read timeout poisons the buffered response
+            # object mid-line, so cancellation is noticed from the side.)
+            while not done.wait(0.25):
+                if handle.cancelled.is_set():
+                    try:
+                        sock = conn.sock
+                        if sock is not None:
+                            sock.close()
+                    except OSError:
+                        pass
+                    return
+
+        threading.Thread(target=_watch_cancel, name="kv-relay-cancel",
+                         daemon=True).start()
+        try:
+            if conn.sock is not None:
+                # the ack read ran under the migrate timeout; token gaps
+                # (destination compiles, long prompts queued ahead) are
+                # unbounded, so the relay reads block
+                conn.sock.settimeout(None)
+            while True:
+                try:
+                    line = resp.readline()
+                except (OSError, ValueError) as e:
+                    if handle.cancelled.is_set():
+                        handle._finish(list(handle.prompt))
+                    else:
+                        handle._fail(RuntimeError(
+                            "migration relay broke: %s" % (e,)))
+                    return
+                if handle.cancelled.is_set():
+                    handle._finish(list(handle.prompt))
+                    return
+                if not line:
+                    handle._fail(RuntimeError(
+                        "destination ended the stream without done"))
+                    return
+                ev = json.loads(line)
+                if "token" in ev:
+                    handle.tokens.put([int(ev["token"])])
+                elif ev.get("done"):
+                    handle._finish([int(t) for t in ev.get("output") or ()])
+                    b.counters.inc("requests_served")
+                    return
+                elif "error" in ev:
+                    handle._fail(RuntimeError(str(ev["error"])))
+                    return
+        except Exception as e:   # json decode, unexpected shapes
+            handle._fail(RuntimeError("migration relay broke: %s" % (e,)))
+        finally:
+            done.set()
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        with self._server_lock:
+            self._closed = True
+            server, self._server = self._server, None
+        if server is not None:
+            server.close()

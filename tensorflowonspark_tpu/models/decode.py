@@ -221,6 +221,18 @@ def _first_named_leaf(tree, name):
 _POOL_LEAVES = ("pages_key", "pages_value",   # dim 0 = pool, not rows
                 "pages_key_scale", "pages_value_scale")  # int8 kv scales
 
+_DENSE_KV_LEAVES = ("cached_key", "cached_value",   # dim 0 = rows
+                    "cached_key_scale", "cached_value_scale")
+
+
+def _path_str(path):
+    """Stable string form of a tree path — the block name the kv
+    migration wire format keys device arrays by.  Source and destination
+    replicas build the same model config, hence the same tree structure,
+    hence identical path strings."""
+    return "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                    for p in path)
+
 
 @functools.lru_cache(maxsize=32)
 def _jitted_set_row_page_table(slot_model):
@@ -239,6 +251,119 @@ def _jitted_set_row_page_table(slot_model):
         return jax.tree_util.tree_map_with_path(set_leaf, cache)
 
     return set_table
+
+
+# ---- kv migration helpers (kvtransfer.MigrationEngine) ------------------
+# A migrating row's occupied kv leaves the device exactly once (gather ->
+# copy_to_host_async on the source) and re-enters exactly once (scatter
+# into freshly allocated pages / the destination row).  Page-id vectors
+# are pow2-padded by the caller — pad entries point at the SINK page, so
+# both the gather's extra reads and the scatter's pad writes are
+# harmless by the same contract prefill overshoot relies on.
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_gather_pages(slot_model):
+    """Snapshot pool pages `ids` ([n] int32) out of every pool leaf:
+    {path: leaf[ids]} — fresh buffers, so the pool can keep stepping
+    while the snapshot rides device->host."""
+
+    @jax.jit
+    def gather(cache, ids):
+        out = {}
+
+        def look(path, leaf):
+            if _leaf_name(path) in _POOL_LEAVES:
+                out[_path_str(path)] = jnp.take(leaf, ids, axis=0)
+            return leaf
+
+        jax.tree_util.tree_map_with_path(look, cache)
+        return out
+
+    return gather
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_scatter_pages(slot_model):
+    """Write migrated page blocks ({path: [n, page, ...]}) into pool
+    pages `ids` ([n] int32; pad entries = sink)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scatter(cache, ids, blocks):
+        # callers (submit_resume validation) guarantee `blocks` carries
+        # one entry per pool leaf, so the branch is purely structural
+        def set_leaf(path, leaf):
+            if _leaf_name(path) not in _POOL_LEAVES:
+                return leaf
+            blk = blocks[_path_str(path)]
+            return leaf.at[ids].set(blk.astype(leaf.dtype))
+
+        return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+    return scatter
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_gather_row_kv(slot_model):
+    """Dense-cache analog of `_jitted_gather_pages`: snapshot row `row`'s
+    full kv window out of every cached_* leaf ({path: [max_seq, ...]}).
+    Positions past the row's cache_index hold garbage the causal mask
+    never exposes — shipping the whole window keeps this one compile."""
+
+    @jax.jit
+    def gather(cache, row):
+        out = {}
+
+        def look(path, leaf):
+            if _leaf_name(path) in _DENSE_KV_LEAVES:
+                out[_path_str(path)] = jax.lax.dynamic_index_in_dim(
+                    leaf, row, 0, keepdims=False)
+            return leaf
+
+        jax.tree_util.tree_map_with_path(look, cache)
+        return out
+
+    return gather
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_scatter_row_kv(slot_model):
+    """Install migrated dense-row blocks at row `row`."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scatter(cache, row, blocks):
+        # as in _jitted_scatter_pages: one block per dense kv leaf is a
+        # caller invariant, so the branch is purely structural
+        def set_leaf(path, leaf):
+            if _leaf_name(path) not in _DENSE_KV_LEAVES:
+                return leaf
+            blk = blocks[_path_str(path)]
+            return jax.lax.dynamic_update_index_in_dim(
+                leaf, blk.astype(leaf.dtype), row, 0)
+
+        return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+    return scatter
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_set_row_index(slot_model):
+    """Set ONE row's cache_index/pos_index (resume-from-pages: the
+    migrated row rejoins decode at its committed position; `_set_cache_
+    index` sets all rows, `_set_row_indices_vec` needs a full vector)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def set_idx(cache, row, value):
+        value32 = jnp.asarray(value, jnp.int32)
+
+        def set_leaf(path, leaf):
+            if _leaf_name(path) in ("cache_index", "pos_index"):
+                return leaf.at[row].set(value32)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(set_leaf, cache)
+
+    return set_idx
 
 
 def _reset_row_indices(row_cache, value):

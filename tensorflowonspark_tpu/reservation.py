@@ -111,7 +111,15 @@ class MessageSocket:
 
     def send(self, sock, msg):
         payload = msgpack.packb(msg, use_bin_type=True)
-        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        header = struct.pack(">I", len(payload))
+        if len(payload) >= (1 << 16):
+            # large frames (kvtransfer page blocks ride this framing):
+            # two sendalls instead of materializing a header+payload copy
+            sock.sendall(header)
+            sock.sendall(payload)
+        else:
+            # small frames (rendezvous RPCs): one write, one segment
+            sock.sendall(header + payload)
 
     @staticmethod
     def _recv_exact(sock, n):

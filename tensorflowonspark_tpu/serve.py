@@ -153,6 +153,16 @@ def build_argparser():
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
                    default="auto")
+    p.add_argument("--role", choices=["mixed", "prefill", "decode"],
+                   default="mixed",
+                   help="disaggregated-serving role advertised to the "
+                        "fleet gateway: \"prefill\" replicas take "
+                        ":generate admissions and hand each session to a "
+                        "decode replica (page-granular kv migration) once "
+                        "its first tokens flush; \"decode\" replicas "
+                        "receive migrated sessions; \"mixed\" (default) "
+                        "does both.  Advisory — every replica still "
+                        "serves every endpoint")
     p.add_argument("--fleet", default=None, metavar="HOST:PORT",
                    help="register this replica with a fleet gateway's "
                         "registry (python -m tensorflowonspark_tpu.fleet) "
@@ -376,6 +386,14 @@ class ModelService:
                 raise ValueError(
                     f"--generate_lora {spec!r} must be NAME=PATH")
             self._gen_lora[name] = path
+        # disaggregated serving: the replica's role is advisory routing
+        # metadata (the gateway prefers prefill/mixed for :generate and
+        # hands sessions to decode/mixed replicas); every replica still
+        # serves every endpoint, so a degraded fleet keeps working
+        self.role = getattr(args, "role", "mixed") or "mixed"
+        self._bind_host = getattr(args, "host", "127.0.0.1") or "127.0.0.1"
+        self._advertise_host = getattr(args, "advertise_host", None)
+        self._migrator = None           # lazy kvtransfer.MigrationEngine
         self._batcher = None
         self._draining = threading.Event()
         wait_ms = getattr(args, "batch_wait_ms", 0) or 0
@@ -438,6 +456,78 @@ class ModelService:
                     self._gen_error = str(e)
             return self._gen or None
 
+    def migration_engine(self):
+        """Lazily-built kvtransfer.MigrationEngine, or None when this
+        export cannot generate (nothing to migrate)."""
+        gen = self.generate_service()
+        if gen is None:
+            return None
+        with self._gen_lock:
+            if self._migrator is None:
+                from . import kvtransfer
+
+                host = self._bind_host
+                if host in ("", "0.0.0.0", "::"):
+                    host = "0.0.0.0"
+                self._migrator = kvtransfer.MigrationEngine(
+                    gen.batcher, model_name=self.model_name,
+                    host=host,
+                    advertise_host=(self._advertise_host
+                                    or ("127.0.0.1"
+                                        if host == "0.0.0.0" else host)))
+            return self._migrator
+
+    def kv_export(self, body):
+        """``POST /v1/kv:export``: move live sessions to the given
+        destination replica(s).  Body: ``{"dest": {"host", "port"}}``
+        or ``{"dests": [...]}``, optional ``timeout_s`` /
+        ``max_sessions``."""
+        eng = self.migration_engine()
+        if eng is None:
+            raise ValueError(
+                ":generate is unavailable on this export — no kv to "
+                "export")
+        raw = body.get("dests") or ([body["dest"]]
+                                    if body.get("dest") else [])
+        dests = []
+        for d in raw:
+            if (not isinstance(d, dict) or not d.get("host")
+                    or not _is_int(d.get("port"))):
+                raise ValueError(
+                    '"dest(s)" entries must be {"host": ..., "port": ...}')
+            dests.append((str(d["host"]), int(d["port"])))
+        if not dests:
+            raise ValueError('kv:export needs "dest" or "dests"')
+        timeout_s = body.get("timeout_s")
+        if timeout_s is not None and not (
+                isinstance(timeout_s, (int, float)) and timeout_s > 0):
+            raise ValueError('"timeout_s" must be a positive number')
+        max_sessions = body.get("max_sessions")
+        if max_sessions is not None and not _is_int(max_sessions):
+            raise ValueError('"max_sessions" must be an int')
+        return eng.migrate_all(dests, max_sessions=max_sessions,
+                               timeout_s=timeout_s)
+
+    def auto_migrate_hook(self, dest_spec):
+        """Per-request handoff callback for ``X-Fleet-Migrate-To``
+        (host:port): the gateway plants the header when it routed a
+        :generate to a prefill-role replica; the session migrates to
+        the named decode replica as soon as its first decode tokens
+        flush.  Returns None (and logs) on a malformed spec — the
+        session just stays here."""
+        host, _, port = str(dest_spec).rpartition(":")
+        if not host or not port.isdigit():
+            logger.warning("ignoring malformed X-Fleet-Migrate-To %r",
+                           dest_spec)
+            return None
+        eng = self.migration_engine()
+        if eng is None:
+            return None
+
+        def kick(handle):
+            eng.migrate_async(handle, (host, int(port)))
+        return kick
+
     @property
     def draining(self):
         return self._draining.is_set()
@@ -478,6 +568,13 @@ class ModelService:
         """Release serving resources: stops the slot batcher's driver
         thread (otherwise it busy-polls forever after server teardown)."""
         with self._gen_lock:
+            if self._migrator is not None:
+                try:
+                    self._migrator.close()
+                except Exception:
+                    logger.warning("migration engine close failed",
+                                   exc_info=True)
+                self._migrator = None
             if self._gen:
                 try:
                     self._gen.batcher.stop()
@@ -488,6 +585,7 @@ class ModelService:
     def metadata(self):
         out = {"model": {"export_dir": self.export_dir,
                          "engine": self.desc,
+                         "role": self.role,
                          "requests_served": self.requests},
                "status": "draining" if self.draining else "ok"}
         if self._batcher is not None:
@@ -531,6 +629,15 @@ class SlotHandle:
         self._on_done = None   # fired exactly once at finish/fail (the
         # batcher releases per-request resources here, e.g. the LoRA
         # adapter's in-flight reference)
+        # --- kv migration (kvtransfer.MigrationEngine) ---
+        # the engine sets migrate_requested; the host thread performs
+        # the freeze cut at its next token commit for this row, parks
+        # the snapshot in `frozen`, and signals freeze_done.  The row
+        # then emits no tokens until complete/rollback decides which
+        # replica owns the continuation.
+        self.migrate_requested = threading.Event()
+        self.freeze_done = threading.Event()
+        self.frozen = None
 
     def cancel(self):
         """Stop decoding for this request (client gone): the batcher
@@ -838,6 +945,24 @@ class ContinuousBatcher:
         # applies it; the host blocks on the ack so a finished handle
         # always observes consistent pool accounting
         self._retire_q = queue_mod.Queue()
+        # host->device migration requests (freeze/rollback).  Same ack
+        # discipline as _retire_q: the device thread applies the device-
+        # state half (gen bump + page gather, or row-state reinstall)
+        # and the requester blocks on the ack event
+        self._freeze_q = queue_mod.Queue()
+        # jitted migration kernels (traced on first migration)
+        if kv_page_size:
+            self._gather_kv = decode_mod._jitted_gather_pages(
+                self.slot_model)
+            self._scatter_kv = decode_mod._jitted_scatter_pages(
+                self.slot_model)
+        else:
+            self._gather_kv = decode_mod._jitted_gather_row_kv(
+                self.slot_model)
+            self._scatter_kv = decode_mod._jitted_scatter_row_kv(
+                self.slot_model)
+        self._set_row_index = decode_mod._jitted_set_row_index(
+            self.slot_model)
         self._depth = Gauge()   # steps dispatched but not host-processed
         self._t0 = time.monotonic()   # device_idle_fraction time base
         self._dead = None     # set to the fatal exception if the loop dies
@@ -921,6 +1046,12 @@ class ContinuousBatcher:
             out["lora_capacity_free"] = free
         if self.kv_dtype:
             out["kv_dtype"] = self.kv_dtype
+        # migration counters: present-at-zero (fleet_stats sums them
+        # across replicas like the TTFT keys, and dashboards should see
+        # the gauges before the first handoff)
+        for key in ("migrations_started", "migrations_completed",
+                    "migrations_failed", "kv_pages_exported"):
+            out[key] = self.counters.get(key)
         # event counters (kv_sink_writes, ...) ride along by name
         out.update(self.counters.snapshot())
         return out
@@ -1056,8 +1187,14 @@ class ContinuousBatcher:
             try:
                 _, _, ev = self._retire_q.get_nowait()
             except queue_mod.Empty:
-                return
+                break
             ev.set()
+        while True:   # freeze/rollback waiters hang the same way
+            try:
+                entry = self._freeze_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            entry[-1].set()
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
                adapter=None, top_k=0, top_p=1.0, min_p=0.0, stop=None,
@@ -1139,7 +1276,7 @@ class ContinuousBatcher:
             "temp": float(temperature), "eos": eos_id, "seed": int(seed),
             "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
             "minp": float(min_p), "stops": stops,
-            "rep": float(repetition_penalty),
+            "rep": float(repetition_penalty), "adapter": adapter,
             "t_submit": time.monotonic()})  # TTFT clock starts at submit
         if self._dead is not None:
             # the loop may have died between the check above and the put
@@ -1416,6 +1553,13 @@ class ContinuousBatcher:
         if h.cancelled.is_set():        # client gone before admission
             h._finish(list(prompt))
             return
+        if "resume" in item:
+            # a migrated-in session: no prefill — upload its kv and
+            # occupy the row mid-sequence (parks like any admission
+            # when the pool is full)
+            if not self._install_resume(row, item):
+                self._parked = (row, item)
+            return
         if self.kv_page_size and not self._try_allocate(row, item):
             self._parked = (row, item)   # wait for pages (FIFO: nothing
             return                       # else admits while parked)
@@ -1635,7 +1779,11 @@ class ContinuousBatcher:
                             "remaining": max_new - 1, "temp": temp,
                             "eos": eos_id, "stops": stops,
                             "plen": len(prompt), "filtered": filtered,
-                            "pen": penalized}
+                            "pen": penalized,
+                            # the full request record: migration rebuilds
+                            # every resident register from it (the device
+                            # arrays alone can't be read back mid-flight)
+                            "item": item}
 
     def _admit(self, block=False):
         """Pull waiting requests into the admission pipeline until it is
@@ -1703,6 +1851,7 @@ class ContinuousBatcher:
         one (the nothing-to-dispatch idle path)."""
         import queue as queue_mod
 
+        self._apply_migrations()
         while True:
             try:
                 row, gen, ev = (self._retire_q.get(timeout=timeout)
@@ -1713,6 +1862,421 @@ class ContinuousBatcher:
             if self._slots[row] is not None and self._gen[row] == gen:
                 self._free_row(row)
             ev.set()
+            self._apply_migrations()
+
+    # ---- kv migration (the kvtransfer.MigrationEngine substrate) ---------
+    # A live session moves replicas in three acts.  FREEZE (source): the
+    # host thread stops committing tokens for the row at a tick boundary
+    # and the device thread bumps the row's generation (in-flight tokens
+    # drop; determinism regenerates them at the destination) and gathers
+    # the occupied pages to host memory.  RESUME (destination): a
+    # prefill-skipping admission allocates fresh pages, uploads the
+    # blocks, splices the page table, and rebuilds every resident
+    # register from the committed sequence.  Then either COMPLETE
+    # (source frees the row once the destination acks) or ROLLBACK
+    # (source reinstalls its own registers and decodes on).  Pages are
+    # owned by exactly one replica at every instant: the source keeps
+    # them until the ack, the destination allocates its own — a failed
+    # or even double-driven migration can never double-free.
+
+    def _freeze_row(self, row, s):
+        """Host-tick side of the freeze cut for `row` (slot dict `s`):
+        delegate the device half, then publish the frozen record on the
+        handle.  The committed ``seq`` at this instant IS the resume
+        point — everything the device ran beyond it is garbage that
+        either side regenerates."""
+        h = s["handle"]
+        box = {}
+        if threading.current_thread() is self._thread:
+            self._apply_freeze(row, box)     # serial engine: inline
+        else:
+            ev = threading.Event()
+            self._freeze_q.put(("freeze", row, box, ev))
+            while not ev.wait(0.05):
+                if self._stop.is_set() or self._dead is not None:
+                    return
+        if not box.get("ok"):
+            return
+        s["frozen"] = True
+        h.frozen = {"row": row, "gen": self._gen[row],
+                    "seq": list(s["seq"]), "plen": s["plen"],
+                    "remaining": s["remaining"], "item": s["item"],
+                    "kind": "paged" if self.kv_page_size else "dense",
+                    "kv": box["kv"], "n_pages": box.get("n_pages", 0)}
+        h.freeze_done.set()
+
+    def _apply_migrations(self):
+        """Device thread: drain pending freeze/rollback requests (the
+        migration analogue of `_apply_retirements`) and ack each."""
+        import queue as queue_mod
+
+        while True:
+            try:
+                entry = self._freeze_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            if entry[0] == "freeze":
+                _, row, box, ev = entry
+                self._apply_freeze(row, box)
+            else:
+                _, row, frozen, box, ev = entry
+                self._apply_rollback(row, frozen, box)
+            ev.set()
+
+    def _apply_freeze(self, row, box):
+        """Device thread: bump `row`'s generation and gather its
+        committed kv into fresh (host-bound) buffers.  The gather is
+        not donated — the pool keeps stepping; the garbage the frozen
+        row keeps writing lands beyond the committed cut (its own
+        pages' tail or the sink), which neither continuation reads
+        before overwriting."""
+        import jax.numpy as jnp
+
+        s = self._slots[row]
+        if s is None:
+            return
+        self._gen[row] += 1
+        n_pos = len(s["seq"]) - 1   # kv positions [0, n_pos) committed;
+        # position n_pos is (re)written by the fed token on resume
+        if self.kv_page_size:
+            owned = self._row_pages[row] or []
+            n_have = min(max(1, -(-n_pos // self.kv_page_size)),
+                         len(owned))
+            width = _pow2_width(n_have)
+            ids = jnp.asarray(
+                list(owned[:n_have]) + [self._sink] * (width - n_have),
+                jnp.int32)
+            kv = self._gather_kv(self._cache, ids)
+            box["n_pages"] = n_have
+        else:
+            kv = self._gather_kv(self._cache, jnp.asarray(row, jnp.int32))
+        for arr in kv.values():
+            try:
+                # start device->host now, riding under decode steps; the
+                # wire serialization's np.asarray then finds bytes ready
+                arr.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                self.counters.inc("copy_to_host_fallbacks")
+                break
+        box["kv"] = kv
+        box["ok"] = True
+
+    def _apply_rollback(self, row, frozen, box):
+        """Device thread: the migration failed pre-ack — reinstall the
+        row's resident registers from the frozen cut and let it decode
+        on.  Pages never left the row, so this is pure register repair;
+        pool conservation is untouched."""
+        s = self._slots[row]
+        if s is None or self._gen[row] != frozen["gen"]:
+            return      # stop()/death already tore the row down
+        self._gen[row] += 1   # drop the frozen period's in-flight junk
+        self._install_row_state(row, frozen["seq"], frozen["plen"],
+                                frozen["remaining"], frozen["item"])
+        s["frozen"] = False
+        box["ok"] = True
+
+    def _install_row_state(self, row, seq, plen, remaining, item):
+        """Rebuild every resident device register for `row` from a
+        committed sequence (shared by rollback on the source and
+        resume-install on the destination): the write cursor points at
+        the next position, the fed token is the last committed one, and
+        ordinal/budget/seen-bits equal what a never-migrated row would
+        hold — the byte-parity invariant."""
+        import jax.numpy as jnp
+
+        eos_id = item["eos"]
+        self._cache = self._set_row_index(
+            self._cache, jnp.asarray(row, jnp.int32),
+            jnp.asarray(len(seq) - 1, jnp.int32))
+        (self._toks, self._temps, self._seeds, self._ords,
+         self._topks, self._topps, self._minps, self._rems,
+         self._eoss, self._eos_on) = self._set_row(
+            self._toks, self._temps, self._seeds, self._ords,
+            self._topks, self._topps, self._minps, self._rems,
+            self._eoss, self._eos_on,
+            jnp.asarray(row, jnp.int32),
+            jnp.asarray(seq[-1], jnp.int32),
+            jnp.asarray(item["temp"], jnp.float32),
+            jnp.asarray(item["seed"], jnp.int32),
+            jnp.asarray(len(seq) - plen, jnp.int32),
+            jnp.asarray(item["topk"], jnp.int32),
+            jnp.asarray(item["topp"], jnp.float32),
+            jnp.asarray(item["minp"], jnp.float32),
+            jnp.asarray(remaining, jnp.int32),
+            jnp.asarray(eos_id if eos_id is not None else 0, jnp.int32),
+            jnp.asarray(eos_id is not None, jnp.bool_))
+        if item["rep"] != 1.0:
+            # seen-bits hold everything EXCEPT the fed token — the step
+            # adds it before picking, exactly like the admission path
+            self._seen = self._seen.at[row].set(0).at[
+                row, jnp.asarray(seq[:-1], jnp.int32)].set(1)
+            self._reps = self._reps.at[row].set(item["rep"])
+
+    def freeze_session(self, h, timeout_s=10.0):
+        """Cut a live session for migration: ask the host thread to
+        stop committing at its next tick for the row, and return the
+        frozen record (seq snapshot + host-bound kv).  Returns None if
+        the session completed before the cut landed; raises
+        TimeoutError when no cut lands in `timeout_s` (an idle/wedged
+        stream), leaving the session running untouched."""
+        if self._dead is not None:
+            raise RuntimeError(f"batcher died: {self._dead}")
+        if self.draft_model is not None:
+            raise ValueError(
+                "kv migration does not compose with speculative "
+                "decoding (the draft model's cache is not shipped)")
+        h.migrate_requested.set()
+        if not h.freeze_done.wait(timeout_s):
+            h.migrate_requested.clear()
+            # the cut may have landed concurrently with the clear
+            if not h.freeze_done.wait(0.2):
+                if h._done.is_set():
+                    return None      # finished first: nothing to move
+                raise TimeoutError(
+                    f"freeze did not land within {timeout_s:.1f}s")
+        frozen = h.frozen
+        if frozen is None:
+            return None
+        return frozen
+
+    def complete_migration(self, frozen):
+        """Destination acked the splice: free the source row.  Pages
+        flow back through the normal retirement path (prefix-shared
+        rc--, exclusive ones to the free list); the destination holds
+        its own fresh copies, so each side frees only its own."""
+        self._retire(frozen["row"], frozen["gen"])
+        self.counters.inc("migrations_completed")
+        self.counters.inc("kv_pages_exported", frozen.get("n_pages", 0))
+
+    def rollback_migration(self, frozen):
+        """Migration failed before the destination acked: reinstall the
+        row's registers from the frozen cut and resume decoding HERE.
+        The client's stream continues as if nothing happened.  Returns
+        False only when the engine is stopping (the handle fails
+        through the normal death path instead)."""
+        h = frozen["item"]["h"]
+        box = {}
+        if threading.current_thread() is self._thread:
+            self._apply_rollback(frozen["row"], frozen, box)
+        else:
+            ev = threading.Event()
+            self._freeze_q.put(("rollback", frozen["row"], frozen, box,
+                                ev))
+            while not ev.wait(0.05):
+                if self._stop.is_set() or self._dead is not None:
+                    return False
+        # clear migrate_requested FIRST: with it down, the host thread
+        # cannot re-enter the freeze branch between the two clears
+        h.migrate_requested.clear()
+        h.freeze_done.clear()
+        h.frozen = None
+        return bool(box.get("ok"))
+
+    def live_handles(self):
+        """Handles of sessions currently occupying rows (the
+        drain-by-migration snapshot).  Racy by design: a row finishing
+        concurrently just yields a handle whose migration reports
+        completed_locally."""
+        # graftcheck: disable-next-line=thread-race
+        return [s["handle"] for s in self._slots
+                if s is not None and not s.get("frozen")]
+
+    def submit_resume(self, meta, blocks):
+        """Admission that SKIPS prefill: occupy a row with a migrated
+        session's committed sequence and uploaded kv blocks.  Validates
+        eagerly (HTTP thread) so malformed snapshots 400 instead of
+        killing the device loop.  Returns ``(handle, installed)``;
+        the event sets once the row is live — the :resume surface's
+        splice ack gate."""
+        import jax
+        import numpy as np
+
+        from .models import decode as decode_mod
+
+        if self._dead is not None:
+            raise RuntimeError(f"batcher died: {self._dead}")
+        if self.draft_model is not None:
+            raise ValueError("this replica runs speculative decoding; "
+                             "it cannot resume migrated sessions")
+        kind = "paged" if self.kv_page_size else "dense"
+        if meta.get("kind") != kind:
+            raise ValueError(
+                f"kv layout mismatch: snapshot is {meta.get('kind')!r}, "
+                f"this replica serves {kind!r} caches")
+        if (self.kv_page_size
+                and int(meta.get("page_size") or 0) != self.kv_page_size):
+            raise ValueError(
+                f"page size mismatch: snapshot uses "
+                f"{meta.get('page_size')}, this replica "
+                f"{self.kv_page_size}")
+        seq = [int(t) for t in (meta.get("seq") or ())]
+        plen = int(meta.get("plen") or 0)
+        max_new = int(meta.get("max_new") or 0)
+        remaining = int(meta.get("remaining") or 0)
+        vocab = self.slot_model.cfg.vocab_size
+        if not (0 < plen < len(seq)):
+            raise ValueError("resume needs a prompt and at least one "
+                             "decoded token")
+        if any(not 0 <= t < vocab for t in seq):
+            raise ValueError(f"sequence token out of vocab range {vocab}")
+        if remaining <= 0 or remaining != max_new - (len(seq) - plen):
+            raise ValueError(
+                f"inconsistent budget: remaining={remaining} with "
+                f"{len(seq) - plen} of max_new={max_new} decoded")
+        if len(seq) + remaining > self.max_seq:
+            raise ValueError(
+                f"resumed sequence needs {len(seq) + remaining} "
+                f"positions; this replica's max_seq_len is "
+                f"{self.max_seq}")
+        temp = float(meta.get("temp") or 0.0)
+        n_pages = int(meta.get("n_pages") or 0)
+        if self.kv_page_size:
+            expect_pages = -(-(len(seq) - 1) // self.kv_page_size)
+            if n_pages != max(1, expect_pages):
+                raise ValueError(
+                    f"snapshot ships {n_pages} pages; "
+                    f"{len(seq) - 1} committed positions need "
+                    f"{max(1, expect_pages)}")
+            if self._pages_needed(plen, max_new,
+                                  temperature=temp) > self._total_pages:
+                raise ValueError(
+                    "resumed request does not fit this replica's kv "
+                    "pool; raise --generate_kv_pages")
+        leaf_names = (decode_mod._POOL_LEAVES if self.kv_page_size
+                      else decode_mod._DENSE_KV_LEAVES)
+        paths = jax.tree_util.tree_flatten_with_path(self._cache)[0]
+        expected = {decode_mod._path_str(p): leaf for p, leaf in paths
+                    if decode_mod._leaf_name(p) in leaf_names}
+        missing = sorted(set(expected) - set(blocks))
+        if missing:
+            raise ValueError(f"snapshot is missing kv blocks {missing}")
+        # normalize + pre-pad HERE (HTTP thread): the device loop must
+        # not pay host-side copies, and the jitted scatter wants pow2-
+        # width blocks whose pad rows land in the sink page
+        kv = {}
+        pad_to = _pow2_width(n_pages) if self.kv_page_size else 0
+        for name, leaf in expected.items():
+            want = ((n_pages,) + tuple(leaf.shape[1:])
+                    if self.kv_page_size else tuple(leaf.shape[1:]))
+            a = np.ascontiguousarray(blocks[name])
+            if tuple(a.shape) != want:
+                raise ValueError(
+                    f"kv block {name!r} has shape {tuple(a.shape)}; "
+                    f"this replica expects {want}")
+            if self.kv_page_size and a.shape[0] < pad_to:
+                pad = np.zeros((pad_to - a.shape[0],) + a.shape[1:],
+                               a.dtype)
+                a = np.concatenate([a, pad], axis=0)
+            kv[name] = a
+        eos = meta.get("eos")
+        stops = [list(map(int, st)) for st in (meta.get("stops") or ())]
+        adapter = meta.get("adapter")
+        aidx = 0
+        if adapter is not None:
+            if not self.lora_rank:
+                raise ValueError(
+                    f"session uses adapter {adapter!r} but this replica "
+                    "has no LoRA bank")
+            with self._lora_lock:
+                if adapter not in self._adapters:
+                    raise ValueError(
+                        f"unknown adapter {adapter!r} on this replica")
+                aidx = self._adapters[adapter]
+                self._adapter_refs[aidx] = self._adapter_refs.get(aidx,
+                                                                  0) + 1
+        h = SlotHandle(seq[:plen])
+        if aidx:
+            h._on_done = lambda idx=aidx: self._release_adapter(idx)
+        installed = threading.Event()
+        self._pending.put({
+            "h": h, "prompt": seq[:plen], "max_new": max_new,
+            "temp": temp, "eos": int(eos) if eos is not None else None,
+            "seed": int(meta.get("seed") or 0), "aidx": aidx,
+            "topk": int(meta.get("topk") or 0),
+            "topp": float(meta.get("topp", 1.0)),
+            "minp": float(meta.get("minp") or 0.0),
+            "stops": stops, "rep": float(meta.get("rep", 1.0)),
+            "adapter": adapter, "t_submit": time.monotonic(),
+            "resume": {"seq": seq, "remaining": remaining,
+                       "n_pages": n_pages, "kv": kv,
+                       "installed": installed}})
+        if self._dead is not None:
+            self._drain_pending(RuntimeError(f"batcher died: {self._dead}"))
+        return h, installed
+
+    def _install_resume(self, row, item):
+        """Device thread: allocate fresh pages, upload migrated kv,
+        splice the page table, and occupy `row` mid-sequence.  Returns
+        False when the pool cannot hold it yet (parks like a normal
+        admission).  No prefix sharing in either direction: the pages
+        were computed on another replica, and the prefix cache only
+        publishes pages whose content this replica computed itself."""
+        import jax.numpy as jnp
+
+        res = item["resume"]
+        h, seq, remaining = item["h"], res["seq"], res["remaining"]
+        if self.kv_page_size:
+            n_have = res["n_pages"]
+            need = max(n_have,
+                       self._pages_needed(len(item["prompt"]),
+                                          item["max_new"],
+                                          temperature=item["temp"]))
+            if len(self._free_pages) < need:
+                self._evict_cached_pages(need - len(self._free_pages))
+            if len(self._free_pages) < need:
+                return False
+            pages = [self._free_pages.pop() for _ in range(need)]
+            try:
+                self._assert_no_sink(pages)
+                max_pages = (self.slot_model.cfg.max_seq_len
+                             // self.kv_page_size)
+                entries = jnp.asarray(
+                    pages + [self._sink] * (max_pages - len(pages)),
+                    jnp.int32)
+                self._cache = self._set_table(
+                    self._cache, jnp.asarray(row, jnp.int32), entries)
+                # kv blocks were normalized and pow2-padded in
+                # submit_resume (host thread); pad rows land in the sink
+                width = _pow2_width(n_have)
+                ids = jnp.asarray(
+                    pages[:n_have] + [self._sink] * (width - n_have),
+                    jnp.int32)
+                self._cache = self._scatter_kv(self._cache, ids,
+                                               res["kv"])
+            except BaseException:
+                # same conservation contract as _try_allocate: a device
+                # failure between the pops and the commit must hand the
+                # pages back
+                self._free_pages.extend(pages)
+                raise
+            self._row_pages[row] = pages
+            self._row_shared_n[row] = 0
+            self._row_prefix_keys[row] = None
+        else:
+            self._cache = self._scatter_kv(
+                self._cache, jnp.asarray(row, jnp.int32), res["kv"])
+        self._gen[row] += 1
+        self._install_row_state(row, seq, len(item["prompt"]),
+                                remaining, item)
+        if self.lora_rank:
+            self._lora_ids = self._lora_ids.at[row].set(item["aidx"])
+        filtered = bool(item["topk"] or item["topp"] < 1.0
+                        or item["minp"] > 0.0)
+        if filtered:
+            self._n_filtered += 1
+        penalized = item["rep"] != 1.0
+        if penalized:
+            self._n_penalized += 1
+        self._slots[row] = {"handle": h, "seq": list(seq),
+                            "remaining": remaining, "temp": item["temp"],
+                            "eos": item["eos"], "stops": item["stops"],
+                            "plen": len(item["prompt"]),
+                            "filtered": filtered, "pen": penalized,
+                            "item": item}
+        self.counters.inc("migrations_resumed")
+        self.counters.inc("kv_pages_imported", res["n_pages"])
+        res["installed"].set()
+        return True
 
     def _process_batch(self, batch):
         """One arrived chunk -> emissions/retires, in dispatch order
@@ -1745,6 +2309,22 @@ class ContinuousBatcher:
             for r, s in enumerate(self._slots):
                 if s is None or self._gen[r] != gens[r]:
                     continue      # freed or re-occupied since dispatch
+                if s.get("frozen"):
+                    # mid-migration: the freeze bumped the row's gen, but
+                    # chunks dispatched AFTER the bump match it again —
+                    # their tokens are garbage continuations of a cut the
+                    # destination (or a rollback) owns.  Cancel is also
+                    # deferred: the relay/rollback path settles the handle
+                    continue
+                if (s["handle"].migrate_requested.is_set()
+                        and not s["handle"].freeze_done.is_set()
+                        and s["remaining"] > 0):
+                    # the freeze cut: deliver what this tick committed,
+                    # then snapshot at a host-tick boundary so the
+                    # committed seq IS the resume point
+                    emit(r, s)
+                    self._freeze_row(r, s)
+                    continue
                 if s["handle"].cancelled.is_set():
                     # client gone: stop burning device time on this slot.
                     # retire BEFORE finishing the handle (see _retire)
@@ -1903,7 +2483,8 @@ class ContinuousBatcher:
         if n_reads >= self.read_chunk or not active:
             return True
         near = min((s["remaining"] for s in self._slots
-                    if s is not None and s["remaining"] > 0),
+                    if s is not None and s["remaining"] > 0
+                    and not s.get("frozen")),
                    default=None)
         return near is not None and near <= n_reads
 
@@ -2240,10 +2821,15 @@ class GenerateService:
             return [next(self._auto_seed) for _ in range(n)]
         return [0] * n
 
-    def stream(self, req):
+    def stream(self, req, on_handle=None):
         """Yield JSON-able events for a single-prompt generation:
         ``{"token": t}`` per decoded token (eos-trimmed), then
-        ``{"done": true, "output": [...full sequence...]}``."""
+        ``{"done": true, "output": [...full sequence...]}``.
+
+        ``on_handle`` (the disaggregation hook) is called with the
+        submitted SlotHandle before any event is produced — the
+        prefill-role handoff arms migration there, so the session
+        moves to a decode replica as soon as its first tokens flush."""
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
         (inputs, max_new, temperature, eos_id, seed, adapter,
@@ -2257,6 +2843,12 @@ class GenerateService:
                                 top_k=top_k, top_p=top_p, min_p=min_p,
                                 stop=stop, repetition_penalty=rep)
         self.requests += 1
+        if on_handle is not None:
+            try:
+                on_handle(h)
+            except Exception:
+                logger.warning("stream on_handle hook failed",
+                               exc_info=True)
 
         def slot_events():
             try:
@@ -2300,6 +2892,69 @@ class GenerateService:
             raise
         self.requests += 1
         return outs
+
+    def resume(self, req):
+        """``POST :resume`` — continue a session migrated from another
+        replica.  Pulls the kv snapshot from the source's page server,
+        submits the prefill-skipping admission, and returns the event
+        generator whose FIRST event (``{"resumed": true}``) is the
+        splice ack: the source frees its pages only after reading it.
+        Validation and the pull both happen eagerly (before any
+        response bytes), so a bad snapshot 400s instead of dying
+        mid-stream."""
+        from . import kvtransfer
+
+        meta, pull = req.get("meta"), req.get("pull")
+        if not isinstance(meta, dict) or not isinstance(pull, dict):
+            raise ValueError(':resume needs "meta" and "pull" objects')
+        if not pull.get("host") or not _is_int(pull.get("port")) \
+                or not pull.get("ticket"):
+            raise ValueError('"pull" must carry host, port and ticket')
+        wire_meta, blocks = kvtransfer.pull_snapshot(
+            (str(pull["host"]), int(pull["port"])), str(pull["ticket"]),
+            timeout=min(60.0, self.timeout_s or 60.0))
+        del wire_meta   # the HTTP meta is canonical; both come from the
+        # same frozen record, the TCP copy just makes snapshots
+        # self-describing for tooling
+        h, installed = self.batcher.submit_resume(meta, blocks)
+        self.requests += 1
+
+        def resume_events():
+            try:
+                deadline = time.monotonic() + min(60.0,
+                                                  self.timeout_s or 60.0)
+                while not installed.wait(0.1):
+                    if h._done.is_set():
+                        # failed/cancelled before the row went live
+                        try:
+                            h.result(timeout=0)
+                            yield {"error": "resume admission ended "
+                                            "before install"}
+                        except Exception as e:
+                            yield {"error": f"{type(e).__name__}: {e}"}
+                        return
+                    if time.monotonic() >= deadline:
+                        h.cancel()
+                        yield {"error": "resume install timed out"}
+                        return
+                yield {"resumed": True}   # the splice ack — the source
+                # frees its copy of the pages on reading this
+                while True:
+                    batch = h.tokens.get()
+                    if batch is None:
+                        break
+                    for tok in batch:
+                        yield {"token": tok}
+                out = h.result()
+                # tokens decoded on the SOURCE (prompt..resume point)
+                # were already streamed from there; the relay appends
+                # only what we produce, but `output` is the full
+                # sequence so non-streaming consumers see one truth
+                yield {"done": True, "output": out}
+            finally:
+                h.cancel()
+
+        return resume_events()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -2348,9 +3003,26 @@ class _Handler(BaseHTTPRequestHandler):
             # its own proxied in-flight count reaches zero)
             self._send(200, self.service.drain())
             return
+        if self.path.rstrip("/") == "/v1/kv:export":
+            # migrate live sessions out (the :migrate drain mode's
+            # replica hook).  Deliberately NOT fenced on draining — a
+            # draining replica is exactly the one exporting its kv
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("request body must be a JSON object")
+                self._send(200, self.service.kv_export(body))
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            except Exception as e:
+                logger.exception("kv:export failed")
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
         is_predict = self.path == f"/v1/models/{name}:predict"
         is_generate = self.path == f"/v1/models/{name}:generate"
-        if not (is_predict or is_generate):
+        is_resume = self.path == f"/v1/models/{name}:resume"
+        if not (is_predict or is_generate or is_resume):
             self._send(404, {"error": f"unknown path {self.path} (serving "
                              f"model {name!r})"})
             return
@@ -2364,7 +3036,7 @@ class _Handler(BaseHTTPRequestHandler):
             req = json.loads(self.rfile.read(length) or b"{}")
             if not isinstance(req, dict):
                 raise ValueError("request body must be a JSON object")
-            if is_generate:
+            if is_generate or is_resume:
                 gen = self.service.generate_service()
                 if gen is None:
                     reason = getattr(self.service, "_gen_error", None)
@@ -2372,8 +3044,21 @@ class _Handler(BaseHTTPRequestHandler):
                                      + (reason or "this export is not a "
                                         "decoder LM")})
                     return
-                if req.get("stream"):
-                    self._stream_events(gen.stream(req))
+                if is_resume:
+                    # always streams: the first ndjson event is the
+                    # migration's splice ack, the rest is the token
+                    # relay back to the source
+                    self._stream_events(gen.resume(req))
+                elif req.get("stream"):
+                    on_handle = None
+                    migrate_to = self.headers.get("X-Fleet-Migrate-To")
+                    if migrate_to:
+                        # gateway-planted disaggregation handoff: this
+                        # replica prefills, the named replica decodes
+                        on_handle = self.service.auto_migrate_hook(
+                            migrate_to)
+                    self._stream_events(gen.stream(req,
+                                                   on_handle=on_handle))
                 else:
                     self._send(200, {"outputs": gen.generate(req)})
             else:
@@ -2451,6 +3136,13 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
     if getattr(args, "generate_pipeline_depth", 2) < 1:
         raise ValueError("--generate_pipeline_depth must be >= 1 "
                          "(flushed chunks in flight device->host)")
+    if getattr(args, "role", "mixed") not in ("mixed", "prefill", "decode"):
+        raise ValueError("--role must be 'mixed', 'prefill' or 'decode'")
+    if getattr(args, "role", "mixed") != "mixed" and \
+            getattr(args, "draft_export_dir", None):
+        raise ValueError("--role prefill/decode does not compose with "
+                         "--draft_export_dir (kv migration cannot ship "
+                         "the draft model's cache)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
 
@@ -2495,6 +3187,9 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
     features["prefill_rows"] = getattr(args, "generate_prefill_rows",
                                        4) or 4
     features["engine"] = getattr(args, "generate_engine", "async") or "async"
+    # disaggregation: the gateway routes :generate admissions by role and
+    # plants the migrate-to header for prefill replicas
+    features["role"] = getattr(args, "role", "mixed") or "mixed"
     return fleet_client.register_replica(
         (ghost, int(gport)),
         args.advertise_host or args.host,

@@ -35,15 +35,61 @@ def run(src, path="tensorflowonspark_tpu/mod.py"):
 
 # ----------------------------------------------------------- spec table ----
 
-def test_spec_registry_covers_the_five_resources():
+def test_spec_registry_covers_the_six_resources():
     names = {s.name for s in resources.SPECS}
     assert names == {"kv-page", "decode-slot", "lora-adapter", "socket",
-                     "donated-buffer"}
+                     "donated-buffer", "migration-lease"}
     kv = resources.spec_by_name("kv-page")
     assert kv.share_map == "_page_rc" and kv.device_only
     assert resources.spec_by_name("socket").release_idempotent
     assert resources.spec_by_name("lora-adapter").lock == "_lora_lock"
     assert resources.spec_by_name("decode-slot").track_from_release
+    lease = resources.spec_by_name("migration-lease")
+    assert lease.acquire == ("freeze_session",)
+    assert set(lease.release) == {"complete_migration",
+                                  "rollback_migration"}
+
+
+def test_migration_lease_leak_and_none_guard():
+    # dropping a frozen snapshot without complete/rollback is a leak ...
+    hits, _ = run("""
+        class S:
+            def f(self, b, h):
+                frozen = b.freeze_session(h)
+                return frozen
+    """)
+    assert ("lifecycle-leak", 4) not in hits   # returned = escapes
+    hits, _ = run("""
+        class S:
+            def f(self, b, h):
+                frozen = b.freeze_session(h)
+                do_something()
+    """)
+    assert any(r == "lifecycle-leak" for r, _ in hits)
+    # ... but the None early-out (session finished before the cut)
+    # acquires nothing, and either release call retires the lease
+    hits, _ = run("""
+        class S:
+            def f(self, b, h):
+                frozen = b.freeze_session(h)
+                if frozen is None:
+                    return {"completed_locally": True}
+                try:
+                    publish(frozen)
+                finally:
+                    b.rollback_migration(frozen)
+    """)
+    assert hits == []
+    hits, _ = run("""
+        class S:
+            def f(self, b, h):
+                frozen = b.freeze_session(h)
+                if frozen is None:
+                    return None
+                b.complete_migration(frozen)
+                b.rollback_migration(frozen)
+    """)
+    assert any(r == "lifecycle-double-free" for r, _ in hits)
 
 
 # ----------------------------------------------------------- double free ---

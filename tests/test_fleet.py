@@ -42,6 +42,8 @@ class StubReplica:
         self.predict_hits = 0
         self.generate_hits = 0
         self.generate_prompts = []
+        self.migrate_headers = []   # X-Fleet-Migrate-To seen per :generate
+        self.kv_export_requests = []
         self.fail_next = 0          # respond 500 to this many POSTs
         self.in_flight = 0
         self.draining = False
@@ -77,7 +79,11 @@ class StubReplica:
                                       "prefill_tokens_shared": 7,
                                       "prefix_pages_cached": 3,
                                       "ttft_count": 4,
-                                      "ttft_ms_sum": 100.0}}})
+                                      "ttft_ms_sum": 100.0,
+                                      "migrations_started": 3,
+                                      "migrations_completed": 2,
+                                      "migrations_failed": 1,
+                                      "kv_pages_exported": 5}}})
                 else:
                     self._send(404, {"error": self.path})
 
@@ -89,6 +95,12 @@ class StubReplica:
                     _wait_until(lambda: stub.in_flight == 0, timeout=10)
                     self._send(200, {"drained": stub.in_flight == 0,
                                      "draining": True})
+                    return
+                if self.path.rstrip("/") == "/v1/kv:export":
+                    with stub._lock:
+                        stub.kv_export_requests.append(req.get("dests"))
+                    self._send(200, {"sessions": 2, "migrated": 2,
+                                     "failed": 0, "completed_locally": 0})
                     return
                 with stub._lock:
                     if stub.fail_next > 0:
@@ -105,6 +117,8 @@ class StubReplica:
                         stub.generate_hits += 1
                         stub.generate_prompts.append(
                             list(req.get("inputs", [[]])[0]))
+                        stub.migrate_headers.append(
+                            self.headers.get("X-Fleet-Migrate-To"))
                         stub.in_flight += 1
                     try:
                         if stub.generate_delay_s:
@@ -153,20 +167,24 @@ def gateway():
 
 
 def _spawn(gw, stubs, regs, n=2, n_slots=2, generate_delay_s=0.0,
-           heartbeat_s=0.15):
+           heartbeat_s=0.15, role=None):
     """Start `n` stub replicas and register each with the gateway."""
     out = []
     for _ in range(n):
         s = StubReplica(generate_delay_s=generate_delay_s)
+        features = {"kv_page_size": 4}
+        if role is not None:
+            features["role"] = role
         reg = fleet_client.register_replica(
             gw.registry_addr, s.host, s.port, n_slots=n_slots,
-            features={"kv_page_size": 4},
+            features=features,
             heartbeat_interval_s=heartbeat_s)
         stubs.append(s)
         regs.append(reg)
         out.append((s, reg))
     assert _wait_until(
-        lambda: len(gw.fleet_stats(probe=False)["replicas"]) >= n)
+        lambda: {s.id for s, _ in out}
+        <= set(gw.fleet_stats(probe=False)["replicas"]))
     return out
 
 
@@ -376,6 +394,78 @@ def test_drain_unknown_replica_404(gateway):
     status, body = _client(gw).drain("10.0.0.9:1234")
     assert status == 404
     assert "unknown replica" in body["error"]
+
+
+def test_generate_routes_to_prefill_with_migrate_header(gateway):
+    gw, stubs, regs = gateway
+    (p, _), = _spawn(gw, stubs, regs, n=1, role="prefill")
+    (d, _), = _spawn(gw, stubs, regs, n=1, role="decode")
+    stats = gw.fleet_stats(probe=False)["replicas"]
+    assert stats[p.id]["role"] == "prefill"
+    assert stats[d.id]["role"] == "decode"
+    c = _client(gw)
+    status, body = c.generate([[1, 2, 3]])
+    assert status == 200
+    # :generate prefers prefill-capable replicas and tags the request
+    # with the decode peer the replica should hand the session to
+    assert body["replica"] == p.id
+    assert p.migrate_headers == [d.id]
+    assert d.generate_hits == 0
+    # :predict is role-blind — the decode replica serves it when the
+    # prefill one is busier
+    with gw._lock:
+        gw._replicas[p.id].outstanding = 3
+    status, body = c.predict([{"x": [0.0]}])
+    assert status == 200
+    assert body["replica"] == d.id
+
+
+def test_generate_role_preference_is_soft(gateway):
+    # a decode-only fleet must not go dark: the preference falls back
+    # to every routable replica, and no handoff header is attached
+    gw, stubs, regs = gateway
+    (d, _), = _spawn(gw, stubs, regs, n=1, role="decode")
+    status, body = _client(gw).generate([[4, 5, 6]])
+    assert status == 200
+    assert body["replica"] == d.id
+    assert d.migrate_headers == [None]
+
+
+def test_fleet_migrate_posts_kv_export_and_drains(gateway):
+    gw, stubs, regs = gateway
+    (p, _), = _spawn(gw, stubs, regs, n=1, role="prefill")
+    (d, _), = _spawn(gw, stubs, regs, n=1, role="decode")
+    c = _client(gw)
+    status, out = c.migrate(p.id, timeout_s=10)
+    assert status == 200
+    assert out["drained"] is True
+    # the gateway asked the replica to export to its decode peer and
+    # attached the replica's own report verbatim
+    assert p.kv_export_requests == [[{"host": d.host, "port": d.port}]]
+    assert out["migration"] == {"sessions": 2, "migrated": 2,
+                                "failed": 0, "completed_locally": 0}
+    assert p.id not in gw.fleet_stats(probe=False)["replicas"]
+    assert gw.counters.get("drains_completed") == 1
+    # no decode-capable peer left: the drain still runs, but the
+    # migration report carries the error instead of silently dropping
+    status, out = c.migrate(d.id, timeout_s=10)
+    assert status == 200
+    assert out["drained"] is True
+    assert "no decode-capable peer" in out["migration"]["error"]
+    assert d.kv_export_requests == []
+
+
+def test_fleet_stats_migration_totals(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    status, body = _client(gw).fleet_stats()
+    assert status == 200
+    t = body["totals"]
+    # summed across both stubs' generate_stats, like the TTFT keys
+    assert t["migrations_started"] == 6
+    assert t["migrations_completed"] == 4
+    assert t["migrations_failed"] == 2
+    assert t["kv_pages_exported"] == 10
 
 
 def test_gateway_metadata_passthrough(gateway):

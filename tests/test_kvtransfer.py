@@ -1,0 +1,633 @@
+"""Page-granular KV migration (disaggregated prefill/decode serving).
+
+Fast tier: wire-format round trips over real sockets (int8 payloads +
+f32 scale blocks, ragged non-pow2 page counts, empty rows, corrupt-frame
+rejection) and PageServer ticket lifecycle.  Slow tier
+(``@pytest.mark.slow``): byte-parity of mid-decode migration over real
+engines — a mixed burst where every session freezes on the source
+batcher, ships its pages through the framed TCP wire, and resumes on a
+destination batcher must emit token streams identical to the solo run —
+plus rollback parity and the MigrationEngine's retry/rollback wiring.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import kvtransfer, serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, temperature=0.0, seed=0, **kw):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None), **kw)
+    return np.asarray(out)[0].tolist()
+
+
+def _round_trip(meta, blocks):
+    """Ship (meta, blocks) through write_snapshot/read_snapshot over a
+    real socketpair and return what the far side decoded."""
+    a, b = socket.socketpair()
+    box = {}
+
+    def recv():
+        box["out"] = kvtransfer.read_snapshot(kvtransfer.KvSocket(), b)
+
+    t = threading.Thread(target=recv)
+    t.start()
+    try:
+        kvtransfer.write_snapshot(kvtransfer.KvSocket(), a, meta, blocks)
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        a.close()
+        b.close()
+    return box["out"]
+
+
+# ---------------------------------------------------------------- fast --
+
+
+def test_wire_round_trip_int8_scales_and_ragged_lengths():
+    rng = np.random.default_rng(0)
+    blocks = {
+        # 3 pages is deliberately non-pow2 (ragged): the wire ships
+        # exactly the occupied pages, padding is the destination's job
+        "layers_0/k": rng.integers(-128, 127, (3, 8, 2, 4), np.int8),
+        "layers_0/k_scale": rng.random((3, 8, 2), np.float32),
+        "layers_0/v": rng.integers(-128, 127, (3, 8, 2, 4), np.int8),
+        "layers_0/v_scale": rng.random((3, 8, 2), np.float32),
+    }
+    meta = {"version": 1, "kind": "paged", "seq": [1, 2, 3], "plen": 2}
+    meta2, blocks2 = _round_trip(meta, blocks)
+    assert meta2 == meta
+    assert set(blocks2) == set(blocks)
+    for name, arr in blocks.items():
+        assert blocks2[name].dtype == arr.dtype
+        assert blocks2[name].shape == arr.shape
+        np.testing.assert_array_equal(blocks2[name], arr)
+
+
+def test_wire_round_trip_bf16_empty_rows_and_no_blocks():
+    import ml_dtypes
+    # zero-row arrays (an empty pool slice) and exotic dtypes survive
+    blocks = {"k": np.zeros((0, 4, 2), ml_dtypes.bfloat16),
+              "v": np.arange(8, dtype=np.float16).reshape(2, 4)}
+    meta2, blocks2 = _round_trip({"kind": "dense"}, blocks)
+    assert meta2 == {"kind": "dense"}
+    assert blocks2["k"].shape == (0, 4, 2)
+    assert blocks2["k"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(blocks2["v"],
+                                  np.asarray(blocks["v"]))
+    # a snapshot with no blocks at all is legal (header + end frame)
+    meta3, blocks3 = _round_trip({"empty": True}, {})
+    assert meta3 == {"empty": True} and blocks3 == {}
+
+
+def test_wire_rejects_out_of_order_and_short_blocks():
+    a, b = socket.socketpair()
+    box = {}
+
+    def recv():
+        try:
+            kvtransfer.read_snapshot(kvtransfer.KvSocket(), b)
+        except ValueError as e:
+            box["err"] = str(e)
+
+    t = threading.Thread(target=recv)
+    t.start()
+    try:
+        ms = kvtransfer.KvSocket()
+        arr = np.arange(16, dtype=np.float32)
+        ms.send(a, {"kind": "header", "version": kvtransfer.WIRE_VERSION,
+                    "meta": {}, "blocks": [
+                        {"name": "k", "dtype": "float32",
+                         "shape": [16], "nbytes": 64}]})
+        # chunk lands at offset 32 with nothing at 0..32: out of order
+        ms.send(a, {"kind": "block", "i": 0, "off": 32,
+                    "data": arr.tobytes()[32:]})
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        a.close()
+        b.close()
+    assert "out of order" in box["err"] or "order" in box["err"]
+
+
+def test_page_server_ticket_lifecycle():
+    server = kvtransfer.PageServer()
+    try:
+        blocks = {"k": np.arange(6, dtype=np.int8).reshape(2, 3)}
+        ticket = server.register({"kind": "paged", "n_pages": 2}, blocks)
+        meta, got = kvtransfer.pull_snapshot(server.addr, ticket)
+        assert meta["n_pages"] == 2
+        np.testing.assert_array_equal(got["k"], blocks["k"])
+        # a ticket is multi-pull (retries re-pull the same snapshot)
+        meta2, _ = kvtransfer.pull_snapshot(server.addr, ticket)
+        assert meta2 == meta
+        server.release(ticket)
+        with pytest.raises(ValueError, match="ticket"):
+            kvtransfer.pull_snapshot(server.addr, ticket)
+        # releasing twice (or an unknown ticket) is a no-op
+        server.release(ticket)
+    finally:
+        server.close()
+
+
+def test_wire_snapshot_slices_occupied_pages(model_and_params):
+    # wire_snapshot must ship ONLY the occupied page prefix of the
+    # (pow2-padded) device gather, and carry full resume metadata
+    frozen = {
+        "row": 1, "gen": 3, "seq": [5, 6, 7, 8], "plen": 3,
+        "remaining": 2, "kind": "paged", "n_pages": 3,
+        "item": {"max_new": 3, "temp": 0.5, "eos": None, "seed": 9,
+                 "topk": 0, "topp": 1.0, "minp": 0.0, "stops": [],
+                 "rep": 1.0, "adapter": None},
+        "kv": {"k": np.zeros((4, 8, 2, 4), np.float32)},  # pow2-padded
+    }
+    meta, blocks = kvtransfer.wire_snapshot(frozen, "m", page_size=8)
+    assert blocks["k"].shape[0] == 3            # sliced to n_pages
+    assert meta["kind"] == "paged" and meta["page_size"] == 8
+    assert meta["seq"] == [5, 6, 7, 8] and meta["plen"] == 3
+    assert meta["remaining"] == 2 and meta["max_new"] == 3
+    assert meta["temp"] == 0.5 and meta["seed"] == 9
+
+
+def test_submit_resume_validates_eagerly(model_and_params):
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=12)
+    try:
+        good_meta = {"kind": "paged", "page_size": 8,
+                     "seq": [1, 2, 3, 4], "plen": 3, "max_new": 3,
+                     "remaining": 2, "n_pages": 1, "temp": 0.0}
+        with pytest.raises(ValueError, match="layout mismatch"):
+            b.submit_resume(dict(good_meta, kind="dense"), {})
+        with pytest.raises(ValueError, match="page size"):
+            b.submit_resume(dict(good_meta, page_size=16), {})
+        with pytest.raises(ValueError, match="at least one"):
+            b.submit_resume(dict(good_meta, plen=4), {})
+        with pytest.raises(ValueError, match="vocab"):
+            b.submit_resume(dict(good_meta, seq=[1, 2, 3, 99]), {})
+        with pytest.raises(ValueError, match="budget"):
+            b.submit_resume(dict(good_meta, remaining=1), {})
+        with pytest.raises(ValueError, match="pages"):
+            b.submit_resume(dict(good_meta, n_pages=3), {})
+        with pytest.raises(ValueError, match="missing kv blocks"):
+            b.submit_resume(good_meta, {})
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------- slow --
+
+# the acceptance burst: dense+paged x greedy+seeded-sampled, varied
+# lengths, and (paged) a prefix-cache hit via the warm prompt
+_WARM = list(range(1, 19))
+_BURST = [
+    (_WARM, 3, 0.0, 0),                          # prefix hit when paged
+    ([1, 2, 3, 4, 5], 4, 0.0, 0),
+    ([9, 8, 7], 4, 0.9, 13),                     # sampled, seeded
+    ([5, 4, 3, 2, 1, 6, 7], 3, 0.0, 0),
+    ([2, 3, 2, 3], 4, 0.7, 5),                   # sampled, seeded
+    (list(range(10, 19)), 3, 0.0, 0),
+    ([4, 5], 5, 0.0, 0),
+]
+
+
+def _migrate_handle(src, dst, h):
+    """Freeze `h` on `src`, ship it through a real PageServer socket,
+    resume it on `dst`.  Returns the continuation handle (or `h` itself
+    when the session finished before the cut landed)."""
+    frozen = src.freeze_session(h, timeout_s=60)
+    if frozen is None:
+        return h, False
+    server = kvtransfer.PageServer()
+    try:
+        meta, blocks = kvtransfer.wire_snapshot(
+            frozen, "m", page_size=src.kv_page_size)
+        ticket = server.register(meta, blocks)
+        meta2, blocks2 = kvtransfer.pull_snapshot(server.addr, ticket)
+    finally:
+        server.close()
+    h2, installed = dst.submit_resume(meta2, blocks2)
+    assert installed.wait(60), "resume install timed out"
+    src.complete_migration(frozen)
+    return h2, True
+
+
+def _burst_with_migration(model, params, **kw):
+    src = serve.ContinuousBatcher(model, params, n_slots=4, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=8, read_chunk=1,
+                                  **kw)
+    outs, n_migrated = [], 0
+    try:
+        assert src.submit(_WARM, 3).result(timeout=300)  # warm prefix
+        handles = [src.submit(p, n, temperature=t, seed=s)
+                   for p, n, t, s in _BURST]
+        # request every cut up front, in threads: the cut then lands
+        # deterministically at each session's next token commit (these
+        # tiny sessions would otherwise finish while an earlier
+        # migration pays the freeze/scatter compiles).  Threads because
+        # freeze_session blocks until the cut lands, and a queued
+        # session's cut cannot land until a frozen row ahead of it
+        # completes its migration and frees the slot.
+        frozens = [None] * len(handles)
+
+        def _freeze(i, h):
+            frozens[i] = src.freeze_session(h, timeout_s=300)
+
+        freezers = [threading.Thread(target=_freeze, args=(i, h),
+                                     daemon=True)
+                    for i, h in enumerate(handles)]
+        for t in freezers:
+            t.start()
+        server = kvtransfer.PageServer()
+        conts = []
+        try:
+            for i, h in enumerate(handles):
+                freezers[i].join(300)
+                assert not freezers[i].is_alive(), "freeze wedged"
+                frozen = frozens[i]
+                assert frozen is not None, "session finished before cut"
+                first = h.tokens.get(timeout=300)   # pre-cut tokens
+                meta, blocks = kvtransfer.wire_snapshot(
+                    frozen, "m", page_size=src.kv_page_size)
+                ticket = server.register(meta, blocks)
+                try:
+                    meta2, blocks2 = kvtransfer.pull_snapshot(
+                        server.addr, ticket)
+                finally:
+                    server.release(ticket)
+                h2, installed = dst.submit_resume(meta2, blocks2)
+                assert installed.wait(300), "resume install timed out"
+                src.complete_migration(frozen)      # frees the row ->
+                n_migrated += 1                     # next queued cut lands
+                conts.append((h, list(first), h2))
+        finally:
+            server.close()
+        for h, first, h2 in conts:
+            out = h2.result(timeout=300)
+            # the source streamed `first` before the cut; the
+            # destination's sequence must carry it verbatim
+            plen = len(h.prompt)
+            assert out[plen:plen + len(first)] == first
+            outs.append(out)
+        # slot retirement is asynchronous (device-thread queue): let the
+        # pools settle before reading the accounting snapshot
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                src.stats()["slots_busy"] or dst.stats()["slots_busy"]):
+            time.sleep(0.05)
+        src_stats, dst_stats = src.stats(), dst.stats()
+    finally:
+        src.stop()
+        dst.stop()
+    return outs, n_migrated, src_stats, dst_stats
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["paged", "dense"])
+def test_burst_parity_with_mid_decode_migration(model_and_params, kind):
+    model, params = model_and_params
+    kw = (dict(prefill_chunk=16, kv_page_size=8, kv_pages=40)
+          if kind == "paged" else dict(prefill_chunk=8))
+    outs, n_migrated, src_s, dst_s = _burst_with_migration(
+        model, params, **kw)
+    for (p, n, t, s), got in zip(_BURST, outs):
+        assert got == _solo(model, params, p, n, temperature=t, seed=s)
+    # every session moved: the cuts were requested before any decode
+    # output was read, so none can finish locally
+    assert n_migrated == len(_BURST)
+    assert dst_s["migrations_resumed"] == n_migrated
+    assert src_s["migrations_completed"] == n_migrated
+    if kind == "paged":
+        assert src_s["kv_pages_exported"] >= n_migrated
+        assert dst_s["kv_pages_imported"] == src_s["kv_pages_exported"]
+        # every migrated page was returned to both pools at the end:
+        # whatever is still resident on the source is a cached prefix
+        # page (rc 0), never a page a session still owns
+        assert src_s["kv_pages_used"] == src_s["prefix_pages_cached"]
+        assert dst_s["kv_pages_used"] == 0
+
+
+@pytest.mark.slow
+def test_migration_parity_int8_kv(model_and_params):
+    model, params = model_and_params
+    kw = dict(prefill_chunk=8, kv_page_size=8, kv_pages=20,
+              kv_dtype="int8")
+    src = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    try:
+        h = src.submit([1, 2, 3, 4, 5], 5)
+        h.tokens.get(timeout=300)
+        h2, migrated = _migrate_handle(src, dst, h)
+        assert migrated
+        assert h2.result(timeout=300) == _solo(model, params,
+                                               [1, 2, 3, 4, 5], 5,
+                                               kv_dtype="int8")
+    finally:
+        src.stop()
+        dst.stop()
+
+
+@pytest.mark.slow
+def test_rollback_resumes_decode_on_source(model_and_params):
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=20)
+    try:
+        prompt = list(range(1, 10))
+        h = b.submit(prompt, 6)
+        got = list(h.tokens.get(timeout=300))
+        while len(got) < 2:
+            got.extend(h.tokens.get(timeout=300))
+        frozen = b.freeze_session(h, timeout_s=60)
+        assert frozen is not None
+        assert b.rollback_migration(frozen)
+        # the stream continues on the source, byte-identical to solo
+        assert h.result(timeout=300) == _solo(model, params, prompt, 6)
+        assert b.stats()["migrations_completed"] == 0
+    finally:
+        b.stop()
+
+
+@pytest.mark.slow
+def test_migration_engine_retry_and_rollback(model_and_params):
+    # MigrationEngine against a dead destination: bounded retries, then
+    # rollback — the session finishes on the source with exact parity
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=20)
+    eng = kvtransfer.MigrationEngine(b, timeout_s=5.0, retries=1)
+    try:
+        # a listener that never speaks HTTP: every attempt fails fast
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead.listen(1)
+        try:
+            h = b.submit([3, 1, 4, 1, 5], 6)
+            h.tokens.get(timeout=300)
+            out = eng.migrate(h, dead.getsockname(), timeout_s=5.0)
+        finally:
+            dead.close()
+        assert out["migrated"] is False and "error" in out
+        assert h.result(timeout=300) == _solo(model, params,
+                                              [3, 1, 4, 1, 5], 6)
+        s = b.stats()
+        assert s["migrations_started"] == 1
+        assert s["migrations_failed"] == 1
+        assert s["migrations_completed"] == 0
+    finally:
+        eng.close()
+        b.stop()
+
+
+@pytest.mark.slow
+def test_migrate_all_moves_live_sessions(model_and_params):
+    # the /v1/kv:export workhorse: every live session moves to the
+    # destination replica and still finishes byte-identically
+    model, params = model_and_params
+    kw = dict(prefill_chunk=8, kv_page_size=8, kv_pages=24)
+    src = serve.ContinuousBatcher(model, params, n_slots=4, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=4, read_chunk=1,
+                                  **kw)
+    srv = None
+    try:
+        # a minimal :resume HTTP endpoint wrapping `dst` (the full
+        # server is exercised in test_serve.py; here the engines are
+        # the subject)
+        import json
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                pull = req["pull"]
+                meta, blocks = kvtransfer.pull_snapshot(
+                    (pull["host"], pull["port"]), pull["ticket"])
+                h, installed = dst.submit_resume(req["meta"], blocks)
+                assert installed.wait(60)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def emit(ev):
+                    line = (json.dumps(ev) + "\n").encode()
+                    self.wfile.write(f"{len(line):X}\r\n".encode()
+                                     + line + b"\r\n")
+                    self.wfile.flush()
+
+                emit({"resumed": True})
+                while True:
+                    toks = h.tokens.get()
+                    if toks is None:
+                        break
+                    for t in toks:
+                        emit({"token": int(t)})
+                emit({"done": True, "output": h.result()})
+                self.wfile.write(b"0\r\n\r\n")
+
+            def log_message(self, fmt, *args):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+        eng = kvtransfer.MigrationEngine(src, timeout_s=30.0)
+        # long-ish sessions: the snapshot must catch them mid-decode
+        # (a finished one would just fall off live_handles)
+        prompts = [([1, 2, 3], 12), ([4, 5, 6, 7], 10), ([8, 9], 12)]
+        handles = [src.submit(p, n) for p, n in prompts]
+        for h in handles:
+            h.tokens.get(timeout=300)            # all live mid-decode
+        report = eng.migrate_all([srv.server_address], timeout_s=30.0)
+        # a session may still finish between the snapshot and its cut
+        # (completed_locally) — but nothing may FAIL, and the moved
+        # path must actually be exercised
+        assert report["failed"] == 0, report["details"]
+        assert (report["migrated"] + report["completed_locally"]
+                == report["sessions"])
+        assert report["migrated"] >= 1, report["details"]
+        for (p, n), h in zip(prompts, handles):
+            assert h.result(timeout=300) == _solo(model, params, p, n)
+        eng.close()
+    finally:
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        src.stop()
+        dst.stop()
+
+
+@pytest.mark.slow
+def test_gateway_disaggregated_serving_end_to_end(tmp_path):
+    # the acceptance path over real HTTP: a prefill-role and a
+    # decode-role replica behind a real gateway.  Phase 1: a streamed
+    # :generate through the gateway is prefilled on the prefill
+    # replica, auto-migrates to the decode replica once its first
+    # tokens flush (X-Fleet-Migrate-To), and the client's stream is
+    # byte-identical to solo decode.  Phase 2: POST /v1/fleet:migrate
+    # moves a live direct stream off the prefill replica without
+    # terminating it.
+    import json
+    import urllib.request
+
+    from tensorflowonspark_tpu import export as export_mod
+    from tensorflowonspark_tpu import fleet, fleet_client
+
+    cfg_kw = dict(vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2,
+                  n_layers=2, d_ff=64, max_seq_len=256, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export_mod.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:"
+                "build_transformer",
+        builder_kwargs=cfg_kw)
+
+    gw = fleet.Gateway(heartbeat_timeout_s=10.0, monitor_interval_s=0.1,
+                       connect_timeout_s=5.0, replica_timeout_s=300.0,
+                       probe_timeout_s=5.0)
+    gw.start()
+    servers, regs = [], []
+
+    def _replica(role, slots):
+        args = serve.build_argparser().parse_args(
+            ["--export_dir", str(tmp_path / "lm"), "--host", "127.0.0.1",
+             "--port", "0", "--generate_slots", str(slots),
+             "--generate_prefill_chunk", "16",
+             "--generate_kv_page_size", "8", "--generate_kv_pages", "64",
+             "--role", role, "--fleet", "%s:%d" % gw.registry_addr,
+             "--fleet_heartbeat_s", "0.2"])
+        srv, _svc = serve.make_server(args)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        regs.append(serve._register_with_fleet(args, srv))
+        return srv.server_address[1]
+
+    def _stream(url, prompt, n_new):
+        req = urllib.request.Request(
+            url, data=json.dumps({"inputs": [prompt],
+                                  "max_new_tokens": n_new,
+                                  "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=300)
+
+    try:
+        p_port = _replica("prefill", 2)
+        d_port = _replica("decode", 4)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(
+                gw.fleet_stats(probe=False)["replicas"]) < 2:
+            time.sleep(0.05)
+        replicas = gw.fleet_stats(probe=False)["replicas"]
+        assert {r["role"] for r in replicas.values()} == \
+            {"prefill", "decode"}
+
+        # ---- phase 1: gateway stream, handed off prefill -> decode --
+        prompt, n_new = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3], 24
+        toks, done = [], None
+        with _stream("http://%s:%d/v1/models/default:generate"
+                     % gw.http_addr, prompt, n_new) as r:
+            for line in r:
+                ev = json.loads(line)
+                if "token" in ev:
+                    toks.append(ev["token"])
+                if ev.get("done"):
+                    done = ev["output"]
+        want = _solo(model, params, prompt, n_new)
+        assert done == want          # byte parity across the handoff
+        assert toks == want[len(prompt):]
+        totals = gw.fleet_stats()["totals"]
+        assert totals["migrations_started"] == 1
+        assert totals["migrations_completed"] == 1
+        assert totals["migrations_failed"] == 0
+        assert totals["kv_pages_exported"] >= 1
+
+        # ---- phase 2: fleet:migrate drains a live direct stream -----
+        p_id = f"127.0.0.1:{p_port}"
+        # long stream: the migrate must land mid-decode, and the path
+        # from first client-visible token to the replica freeze is an
+        # HTTP round trip plus the drain bookkeeping — give it seconds
+        # of runway, not tens of milliseconds
+        prompt2, n_new2 = [7, 7, 3, 2, 9, 1, 4, 4, 8, 6], 200
+        box = {}
+        first_token = threading.Event()
+
+        def _consume():
+            toks2, done2 = [], None
+            with _stream(f"http://127.0.0.1:{p_port}"
+                         "/v1/models/default:generate",
+                         prompt2, n_new2) as r:
+                for line in r:
+                    ev = json.loads(line)
+                    if "token" in ev:
+                        toks2.append(ev["token"])
+                        first_token.set()
+                    if ev.get("done"):
+                        done2 = ev["output"]
+            box["toks"], box["done"] = toks2, done2
+
+        t = threading.Thread(target=_consume, daemon=True)
+        t.start()
+        assert first_token.wait(120), "stream never produced a token"
+        status, out = fleet_client.FleetClient(*gw.http_addr).migrate(
+            p_id, timeout_s=120)
+        t.join(300)
+        assert not t.is_alive(), "stream did not finish"
+        assert status == 200 and out["drained"] is True
+        mig = out["migration"]
+        assert mig["failed"] == 0, mig
+        assert mig["migrated"] == 1, mig
+        want2 = _solo(model, params, prompt2, n_new2)
+        assert box["done"] == want2  # the stream survived the drain
+        assert box["toks"] == want2[len(prompt2):]
+        assert p_id not in gw.fleet_stats(probe=False)["replicas"]
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        gw.stop()

@@ -504,3 +504,131 @@ def test_evicting_shared_page_is_impossible(model_and_params):
     assert batcher._evict_cached_pages(kv_pages) == 2
     assert sorted(batcher._free_pages) == list(range(kv_pages))
     _pool_conserved(batcher, kv_pages)
+
+
+def test_page_conservation_under_mid_migration_faults(model_and_params):
+    # ISSUE-9 satellite: the migration ops (freeze cut, rollback,
+    # resume-install) join the fault-injection cycle.  A device failure
+    # inside `_install_resume` between the page pops and the commit must
+    # hand every page back; freeze+rollback must leave ownership
+    # untouched; freeze+complete retires the row's pages exactly once.
+    import random
+
+    model, params = model_and_params
+    kv_pages = 8
+    batcher = serve.ContinuousBatcher(model, params, n_slots=3,
+                                      kv_page_size=8, kv_pages=kv_pages)
+    batcher.stop()                      # direct drive, no driver races
+    rng = random.Random(99)
+    orig_set_table = batcher._set_table
+    armed = {"fail": False, "fired": 0}
+
+    def flaky_set_table(cache, row, entries):
+        if armed["fail"]:
+            armed["fail"] = False
+            armed["fired"] += 1
+            raise RuntimeError("injected device OOM")
+        return orig_set_table(cache, row, entries)
+
+    batcher._set_table = flaky_set_table
+
+    def _item(prompt, max_new):
+        return {"h": serve.SlotHandle(prompt), "prompt": list(prompt),
+                "max_new": max_new, "temp": 0.0, "eos": None, "seed": 0,
+                "aidx": 0, "topk": 0, "topp": 1.0, "minp": 0.0,
+                "stops": [], "rep": 1.0, "adapter": None}
+
+    def _occupy(row, item):
+        """What _finish_admission does for the freeze path's needs."""
+        seq = list(item["prompt"]) + [1]
+        batcher._slots[row] = {
+            "handle": item["h"], "seq": seq, "remaining": item["max_new"],
+            "temp": 0.0, "eos": None, "stops": [],
+            "plen": len(item["prompt"]), "filtered": False, "pen": False,
+            "item": item}
+        return seq
+
+    def _resume_item(prompt, max_new, decoded=1):
+        import threading as threading_mod
+        seq = list(prompt) + [(i % 60) + 1 for i in range(decoded)]
+        n_pages = max(1, -(-(len(seq) - 1) // batcher.kv_page_size))
+        width = serve._pow2_width(n_pages)
+        paths = jax.tree_util.tree_flatten_with_path(batcher._cache)[0]
+        kv = {decode._path_str(p): np.zeros(
+                  (width,) + tuple(leaf.shape[1:]), leaf.dtype)
+              for p, leaf in paths
+              if decode._leaf_name(p) in decode._POOL_LEAVES}
+        item = _item(prompt, max_new + decoded)
+        item["resume"] = {"seq": seq, "remaining": max_new,
+                          "n_pages": n_pages, "kv": kv,
+                          "installed": threading_mod.Event()}
+        return item
+
+    prompt_pool = [list(range(1, 11)), list(range(1, 19)), [7] * 9,
+                   [3, 1, 4, 1, 5, 9, 2, 6]]
+    active = {}                          # row -> slot seq
+    froze = {"rollback": 0, "complete": 0, "install_fault": 0}
+    for cycle in range(80):
+        free_rows = [r for r in range(3) if r not in active]
+        op = rng.choice(["alloc", "resume", "freeze_rollback",
+                         "freeze_complete", "cancel", "evict"])
+        if op == "alloc" and free_rows:
+            row = rng.choice(free_rows)
+            item = _item(rng.choice(prompt_pool), rng.randint(2, 4))
+            inject = rng.random() < 0.3
+            armed["fail"] = inject
+            try:
+                if batcher._try_allocate(row, item):
+                    active[row] = _occupy(row, item)
+            except RuntimeError:
+                assert inject
+                assert batcher._row_pages[row] is None
+            armed["fail"] = False
+        elif op == "resume" and free_rows:
+            row = rng.choice(free_rows)
+            item = _resume_item(rng.choice(prompt_pool),
+                                rng.randint(2, 4))
+            inject = rng.random() < 0.4
+            armed["fail"] = inject
+            try:
+                if batcher._install_resume(row, item):
+                    active[row] = batcher._slots[row]["seq"]
+            except RuntimeError:
+                assert inject
+                froze["install_fault"] += 1
+                assert batcher._row_pages[row] is None
+            armed["fail"] = False
+        elif op in ("freeze_rollback", "freeze_complete") and active:
+            row = rng.choice(sorted(active))
+            box = {}
+            batcher._apply_freeze(row, box)
+            assert box.get("ok")
+            s = batcher._slots[row]
+            frozen = {"row": row, "gen": batcher._gen[row],
+                      "seq": list(s["seq"]), "plen": s["plen"],
+                      "remaining": s["remaining"], "item": s["item"],
+                      "kind": "paged", "kv": box["kv"],
+                      "n_pages": box["n_pages"]}
+            if op == "freeze_rollback":
+                rb = {}
+                batcher._apply_rollback(row, frozen, rb)
+                assert rb.get("ok")      # session decodes on, same pages
+                froze["rollback"] += 1
+            else:
+                batcher._free_row(row)   # what _retire does post-ack
+                del active[row]
+                froze["complete"] += 1
+        elif op == "cancel" and active:
+            row = rng.choice(sorted(active))
+            batcher._free_row(row)
+            batcher._slots[row] = None
+            del active[row]
+        elif op == "evict":
+            batcher._evict_cached_pages(rng.randint(1, 3))
+        _pool_conserved(batcher, kv_pages)
+    assert armed["fired"] > 0
+    assert froze["rollback"] > 0 and froze["complete"] > 0
+    for row in sorted(active):
+        batcher._free_row(row)
+    _pool_conserved(batcher, kv_pages)
+    assert len(batcher._free_pages) + len(batcher._prefix) == kv_pages
