@@ -309,6 +309,68 @@ def bench_migrate_segment(reps=5, result_timeout=600):
             n_pages, nbytes)
 
 
+def bench_recover_segment(reps=5, result_timeout=600):
+    """The recover segment: a mid-decode session LOST with its replica
+    (no kv survives, unlike migrate_ms) and rebuilt on a second batcher
+    from its token record alone via ``submit_replay`` — re-prefill over
+    prompt+emitted, resume splice, decode live again.  This is the
+    replica-crash recovery path the fleet gateway drives from its
+    stream journal; the segment prices it end to end.  Rep 0 pays the
+    prefill/splice compiles and is discarded; the rest report medians.
+    Returns ``(recover_ms, gap_ms, n_replayed)`` where ``recover_ms``
+    is submit_replay→splice-installed, ``gap_ms`` is the client-visible
+    token gap across the crash (last token from the lost replica to
+    first token from the recovered session), and ``n_replayed`` is the
+    re-prefilled sequence length."""
+    import statistics
+
+    from tensorflowonspark_tpu.benchmarks import make_migrate_pair
+
+    src, dst, prompt, max_new = make_migrate_pair()
+    prompt = list(prompt)
+    recover_ms, gap_ms = [], []
+    n_replayed = 0
+    try:
+        for _ in range(max(2, reps)):
+            h = src.submit(prompt, max_new)
+            emitted = list(h.tokens.get(timeout=result_timeout))
+            t_last = time.perf_counter()
+            while True:                      # drain what the "crashed"
+                try:                         # replica already committed
+                    batch = h.tokens.get(timeout=0.05)
+                except queue.Empty:
+                    break
+                if batch is None:
+                    break
+                emitted.extend(batch)
+                t_last = time.perf_counter()
+            assert 0 < len(emitted) < max_new, \
+                "session finished before the kill"
+            h.cancel()                       # the crash: source row gone,
+            t0 = time.perf_counter()         # only the token record left
+            h2, installed = dst.submit_replay(
+                {"seq": prompt + emitted, "plen": len(prompt),
+                 "max_new": max_new, "remaining": max_new - len(emitted),
+                 "temp": 0.0, "seed": 0})
+            assert installed.wait(result_timeout), "replay splice timed out"
+            t1 = time.perf_counter()
+            h2.tokens.get(timeout=result_timeout)  # live again
+            t2 = time.perf_counter()
+            out = h2.result(timeout=result_timeout)
+            # byte parity over the recovered region: greedy, so the
+            # continuation must re-commit exactly what was journaled
+            assert out[:len(prompt) + len(emitted)] == prompt + emitted, \
+                "recovered session diverged from its journal"
+            recover_ms.append((t1 - t0) * 1e3)
+            gap_ms.append((t2 - t_last) * 1e3)
+            n_replayed = len(prompt) + len(emitted)
+    finally:
+        src.stop()
+        dst.stop()
+    return (statistics.median(recover_ms[1:]),   # rep 0 = compile warmup
+            statistics.median(gap_ms[1:]), n_replayed)
+
+
 def _opt_segment_setup():
     """Cheap, CPU-safe registry smoke: the segment's builders and frozen
     config resolve without building the 0.87B model or touching a
@@ -418,6 +480,28 @@ def _migrate_segment_result():
                     "kv_bytes": nbytes}}
 
 
+def _recover_segment_setup():
+    from tensorflowonspark_tpu import serve
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_MIGRATE,
+                                                  make_migrate_pair)
+
+    assert callable(make_migrate_pair)
+    assert callable(serve.ContinuousBatcher.submit_replay)
+    d = FLAGSHIP_MIGRATE
+    assert d["prompt_len"] + d["max_new"] <= d["max_seq"]
+    # the replay re-prefills prompt+emitted on the destination alone
+    assert d["kv_pages"] * d["kv_page_size"] >= d["max_seq"]
+    return {"config": dict(d)}
+
+
+def _recover_segment_result():
+    recover_ms, gap_ms, n_replayed = bench_recover_segment()
+    return {"metric": "recover_ms", "value": round(recover_ms, 1),
+            "unit": "ms/recovery",
+            "aux": {"stream_gap_ms": round(gap_ms, 1),
+                    "replayed_tokens": n_replayed}}
+
+
 # segment registry: every entry shares the off-TPU skip + one-JSON-line-
 # per-segment protocol, so growing a segment is one row (the old
 # hardcoded opt_ms plumbing could not be reused).  Each entry carries:
@@ -453,6 +537,12 @@ SEGMENTS = {
         "help": "mid-decode kv migration between two batchers over a "
                 "page-server socket (freeze to resume splice, plus the "
                 "client-visible stream stall)"},
+    "recover_ms": {
+        "run": _recover_segment_result,
+        "setup": _recover_segment_setup,
+        "help": "crash recovery of a lost session from its token record "
+                "alone (submit_replay re-prefill to resume splice, plus "
+                "the client-visible stream gap)"},
 }
 
 

@@ -137,6 +137,20 @@ SPECS = (
         acquire=("freeze_session",),
         release=("complete_migration", "rollback_migration"),
     ),
+    # Gateway stream-journal entries (fleet.py).  `journal_open` admits
+    # a streaming session into the re-drive journal; `journal_close`
+    # retires it once the client has the final event (or the session is
+    # abandoned).  An entry left open past its stream is a stranded
+    # journal — the gateway would re-drive a session nobody is reading —
+    # so every open must reach exactly one close on all paths, including
+    # replica-crash and client-disconnect exits.
+    ResourceSpec(
+        name="journal-entry",
+        description="gateway per-stream recovery journal entry "
+                    "(journal_open → journal_close)",
+        acquire=("journal_open",),
+        release=("journal_close",),
+    ),
     # jax.jit donated buffers.  Not acquire/release shaped: donation is
     # inferred from donate_argnums/donate_argnames on jitted callables
     # (including the `_jitted_*` factory idiom in models/decode.py) and

@@ -14,7 +14,7 @@ from __future__ import annotations
 import ast
 import re
 
-from .core import Finding, Rule, register
+from .core import Finding, Rule, register, _posix
 
 MAX_LINE = 160
 
@@ -81,6 +81,51 @@ class DebuggerCallRule(Rule):
                   and fn.value.id in ("pdb", "ipdb")):
                 yield Finding(ctx.path, node.lineno, self.name,
                               f"{fn.value.id}.set_trace() call")
+
+
+# Network / recovery-path modules where a swallowed exception can turn a
+# transient fault into a silent hang or a stranded session.  Crash
+# recovery (fleet re-drive, migration rollback) DEPENDS on failures
+# propagating to the layer that journals and retries them.
+_RECOVERY_MODULES = frozenset({
+    "reservation.py", "fleet.py", "fleet_client.py", "kvtransfer.py",
+    "serve.py", "faults.py",
+})
+
+
+@register
+class SwallowedNetworkErrorRule(Rule):
+    name = "swallowed-network-error"
+    description = ("bare `except:`/`except Exception:` with a pass-only "
+                   "body in a network/recovery module")
+    scope = "package"
+    kind = "semantic"
+
+    def _broad(self, handler):
+        t = handler.type
+        if t is None:
+            return True
+        return isinstance(t, ast.Name) and t.id in ("Exception",
+                                                    "BaseException")
+
+    def check(self, ctx):
+        fname = _posix(ctx.path).rsplit("/", 1)[-1]
+        if fname not in _RECOVERY_MODULES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._broad(node):
+                continue
+            body = [s for s in node.body
+                    if not (isinstance(s, ast.Expr)
+                            and isinstance(s.value, ast.Constant))]
+            if all(isinstance(s, ast.Pass) for s in body):
+                yield Finding(
+                    ctx.path, node.lineno, self.name,
+                    "broad except with pass-only body swallows "
+                    "network/recovery failures — narrow the exception "
+                    "or log and re-raise")
 
 
 class _UsageVisitor(ast.NodeVisitor):

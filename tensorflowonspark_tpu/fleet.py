@@ -49,14 +49,16 @@ Routing policy (stdlib-only, no extra deps):
 import argparse
 import hashlib
 import http.client
+import itertools
 import json
 import logging
 import threading
 import time
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import reservation
+from . import faults, reservation, util
 from .metrics import Counters
 
 logger = logging.getLogger(__name__)
@@ -90,6 +92,10 @@ class Replica:
         self.errors = 0          # connect/5xx failures observed (monotone)
         self.failures = 0        # CONSECUTIVE failures (breaker input)
         self.open_until = 0.0    # breaker open until this monotonic time
+        self.misses = 0          # CONSECUTIVE over-age monitor sweeps
+        self.fresh_since = None  # ejected: when beats turned fresh again
+        self.ejections = 0       # times this registration was ejected
+        self.readmissions = 0    # times it was readmitted after cooldown
         self.registered_at = time.time()
 
     def describe(self):
@@ -99,7 +105,46 @@ class Replica:
                 "state": self.state,
                 "outstanding": self.outstanding, "requests": self.requests,
                 "errors": self.errors,
+                "ejections": self.ejections,
+                "readmissions": self.readmissions,
                 "breaker_open": self.open_until > time.monotonic()}
+
+
+class StreamJournal:
+    """Per-stream recovery journal: everything needed to re-drive a lost
+    session lives here — the (seeded) request body and every token the
+    client has already been sent.  Journaling is a tee in the gateway's
+    relay loop, so it costs one list append per token; entries close in
+    a ``finally`` when their stream ends (delivered, failed, or the
+    client went away), so a drained gateway always reports zero entries
+    — the invariant the chaos suite's stranded-journal check pins, and
+    the lifecycle rule (analysis/resources.py) audits statically."""
+
+    def __init__(self):
+        self._entries = {}
+        self._lock = threading.Lock()
+
+    def journal_open(self, body):
+        """Open a journal entry for one streaming :generate.  The
+        returned entry's ``key`` doubles as the stream's
+        Idempotency-Key: stable across re-drives, unique per stream."""
+        entry = {"key": uuid.uuid4().hex, "body": body, "tokens": []}
+        with self._lock:
+            self._entries[entry["key"]] = entry
+        return entry
+
+    def record(self, entry, token):
+        # single-writer per entry (the stream's own relay loop), so the
+        # append needs no lock
+        entry["tokens"].append(int(token))
+
+    def journal_close(self, entry):
+        with self._lock:
+            self._entries.pop(entry["key"], None)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
 
 
 class _Registry(reservation.Server):
@@ -141,7 +186,9 @@ class Gateway:
                  queue_depth_factor=2.0, breaker_threshold=3,
                  breaker_cooldown_s=5.0, connect_timeout_s=5.0,
                  replica_timeout_s=600.0, probe_timeout_s=5.0,
-                 retry_after_s=1.0):
+                 retry_after_s=1.0, ejection_misses=3,
+                 readmit_cooldown_s=None, redrive_attempts=3,
+                 redrive_deadline_s=30.0):
         self.host, self.port = host, int(port)
         self.registry_host = registry_host or host
         self.registry_port = int(registry_port)
@@ -149,6 +196,26 @@ class Gateway:
         self.monitor_interval_s = (monitor_interval_s
                                    or max(self.heartbeat_timeout_s / 4.0,
                                           0.05))
+        # K-consecutive-miss ejection + readmission cool-down: one slow
+        # GC pause (a single over-age sweep) must not bounce a healthy
+        # replica, and a flapping one must hold beats fresh for the
+        # cool-down before taking traffic again
+        self.ejection_misses = max(1, int(ejection_misses))
+        self.readmit_cooldown_s = (float(readmit_cooldown_s)
+                                   if readmit_cooldown_s is not None
+                                   else self.heartbeat_timeout_s / 2.0)
+        # session recovery: total tries per stream and the wall-time
+        # bound a mid-stream session may wait for a replica to come back
+        self.redrive_attempts = max(1, int(redrive_attempts))
+        self.redrive_deadline_s = float(redrive_deadline_s)
+        self._redrive_backoff = util.RetryPolicy(
+            attempts=self.redrive_attempts, base_delay=0.1,
+            cap_delay=1.0, jitter=0.25)
+        self.journal = StreamJournal()
+        # gateway-assigned seeds for unseeded sampled streams (disjoint
+        # from the replicas' own 1<<20 auto-seed range): a re-drive must
+        # replay the SAME chain the first replica sampled
+        self._auto_seed = itertools.count(1 << 21)
         # None = adopt the first registrant's announced kv_page_size
         # (the replica-side prefix-cache unit), else 64
         self._prefix_tokens = prefix_tokens
@@ -229,25 +296,52 @@ class Gateway:
     def _monitor(self):
         """Eject replicas whose heartbeat went silent; re-admit when
         beats resume.  The beat table is the reservation server's own —
-        replicas run the stock `Client.start_heartbeat`."""
+        replicas run the stock `Client.start_heartbeat`.
+
+        Anti-flap discipline: ejection needs `ejection_misses`
+        CONSECUTIVE over-age sweeps (one GC pause is one miss, not an
+        ejection), and readmission needs beats to stay fresh for
+        `readmit_cooldown_s` (a replica limping back for one beat does
+        not take traffic).  A fresh REG still readmits immediately —
+        a restarted replica announced itself; there is nothing to
+        distrust."""
         while not self._stop.is_set():
             beats = self._registry.last_beats()
             now = time.monotonic()
             with self._lock:
                 for r in self._replicas.values():
                     age = now - beats.get(r.id, now)
-                    if r.state == UP and age > self.heartbeat_timeout_s:
+                    fresh = age <= self.heartbeat_timeout_s
+                    if r.state == UP:
+                        if fresh:
+                            r.misses = 0
+                            continue
+                        r.misses += 1
+                        if r.misses < self.ejection_misses:
+                            continue
                         r.state = EJECTED
+                        r.fresh_since = None
+                        r.ejections += 1
                         self.counters.inc("ejections")
-                        logger.warning("ejected replica %s (silent %.1fs)",
-                                       r.id, age)
-                    elif r.state == EJECTED and \
-                            age <= self.heartbeat_timeout_s:
+                        logger.warning("ejected replica %s (silent %.1fs,"
+                                       " %d consecutive misses)",
+                                       r.id, age, r.misses)
+                    elif r.state == EJECTED:
+                        if not fresh:
+                            r.fresh_since = None
+                            continue
+                        if r.fresh_since is None:
+                            r.fresh_since = now
+                        if now - r.fresh_since < self.readmit_cooldown_s:
+                            continue
                         r.state = UP
+                        r.misses, r.fresh_since = 0, None
                         r.failures, r.open_until = 0, 0.0
+                        r.readmissions += 1
                         self.counters.inc("readmissions")
-                        logger.info("re-admitted replica %s (beats "
-                                    "resumed)", r.id)
+                        logger.info("re-admitted replica %s (beats fresh "
+                                    "for the %.1fs cool-down)", r.id,
+                                    self.readmit_cooldown_s)
             self._stop.wait(self.monitor_interval_s)
 
     # ---- routing ---------------------------------------------------------
@@ -280,10 +374,15 @@ class Gateway:
                 if preferred:
                     routable = preferred
             if not routable:
-                if self._replicas:
-                    raise Saturated("no routable replica (ejected/"
-                                    "draining/circuit-open)")
-                raise NoReplica("no replicas registered")
+                if not self._replicas:
+                    raise NoReplica("no replicas registered")
+                if not any(r.state == UP for r in
+                           self._replicas.values()):
+                    # every replica is dead/draining, not merely busy:
+                    # a typed 503 (+ Retry-After) — clients should back
+                    # off and retry, not treat it as overload
+                    raise NoReplica("all replicas ejected or draining")
+                raise Saturated("no routable replica (circuit-open)")
             open_ = [r for r in routable
                      if r.outstanding < self._max_outstanding(r)]
             if not open_:
@@ -362,6 +461,68 @@ class Gateway:
             return key if key else None
         except (KeyError, IndexError, TypeError):
             return None
+
+    # ---- session recovery (streaming :generate) --------------------------
+
+    def _seed_body(self, body):
+        """A re-drive must replay the SAME sampling chain the first
+        replica used, so unseeded sampled requests get a gateway-chosen
+        seed BEFORE journaling (each replica's own auto-seed counter
+        would pick a different one on the re-drive).  Greedy and
+        explicitly-seeded requests pass through untouched."""
+        try:
+            if (body.get("seed") is None
+                    and float(body.get("temperature") or 0.0) > 0):
+                body["seed"] = next(self._auto_seed)
+        except (TypeError, ValueError):
+            pass   # malformed sampling params: the replica 400s them
+
+    def _replay_meta(self, body, tokens):
+        """The ``:resume`` ``replay`` object for a journaled session:
+        :func:`kvtransfer.wire_snapshot` key names, minus the kv-layout
+        fields a token-record replay does not need."""
+        prompt = [int(t) for t in body["inputs"][0]]
+        max_new = int(body.get("max_new_tokens", 16))
+        return {"seq": prompt + list(tokens), "plen": len(prompt),
+                "max_new": max_new, "remaining": max_new - len(tokens),
+                "temp": float(body.get("temperature") or 0.0),
+                "seed": int(body.get("seed") or 0),
+                "eos": body.get("eos_id"),
+                "topk": int(body.get("top_k") or 0),
+                "topp": float(body.get("top_p", 1.0)),
+                "minp": float(body.get("min_p") or 0.0),
+                "stops": body.get("stop") or [],
+                "rep": float(body.get("repetition_penalty", 1.0)),
+                "adapter": body.get("adapter")}
+
+    def _synth_done(self, body, tokens):
+        """The ``done`` event for a journaled session that already saw
+        its LAST token (the break ate only the final event), or None
+        when the session genuinely needs a replay.  Replaying such a
+        session would be wrong, not just wasteful: a spliced row checks
+        stop conditions only after its next decoded token, so a
+        sequence already ending on a stop would overrun it."""
+        if not tokens:
+            return None
+        try:
+            prompt = [int(t) for t in body["inputs"][0]]
+            max_new = int(body.get("max_new_tokens", 16))
+        except (KeyError, IndexError, TypeError, ValueError):
+            return None
+        eos = body.get("eos_id")
+        stops = body.get("stop") or []
+        finished = (len(tokens) >= max_new
+                    or (eos is not None and tokens[-1] == eos))
+        try:
+            finished = finished or any(
+                st and len(tokens) >= len(st)
+                and tokens[-len(st):] == [int(x) for x in st]
+                for st in stops)
+        except (TypeError, ValueError):
+            pass
+        if not finished:
+            return None
+        return {"done": True, "output": prompt + list(tokens)}
 
     # ---- replica I/O -----------------------------------------------------
 
@@ -555,11 +716,15 @@ class Gateway:
                             "heartbeat_timeout_s": self.heartbeat_timeout_s,
                             "queue_depth_factor": self.queue_depth_factor,
                             "breaker_threshold": self.breaker_threshold,
+                            "ejection_misses": self.ejection_misses,
+                            "readmit_cooldown_s": self.readmit_cooldown_s,
+                            "journal_depth": len(self.journal),
                             "registry": list(self.registry_addr or ())}}
 
 
 class NoReplica(RuntimeError):
-    """No replicas registered at all (503)."""
+    """Nothing the gateway could route to: no replicas registered, or
+    every registered one is dead/draining (typed 503 + Retry-After)."""
 
 
 class Saturated(RuntimeError):
@@ -599,7 +764,11 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                                  str(gw.retry_after_s))])
         else:
             gw.counters.inc("rejected_no_replica")
-            self._send(503, {"error": str(e), "type": "no_replica"})
+            # Retry-After here too: an all-dead fleet usually heals (a
+            # readmission or re-REG), so tell clients when to come back
+            self._send(503, {"error": str(e), "type": "no_replica"},
+                       headers=[("Retry-After",
+                                 str(gw.retry_after_s))])
 
     def _relay(self, conn, resp):
         """Copy a replica response through verbatim — streamed chunk by
@@ -636,6 +805,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         breaker and closed the connection."""
         gw = self.gateway
         try:
+            faults.check("fleet.forward")
             conn, resp = gw._request(r, "POST", path, body=body,
                                      headers=headers)
         except OSError as e:
@@ -649,6 +819,224 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             gw._release(r, ok=False)
             return False, None, err
         return True, conn, resp
+
+    # -- streaming :generate with session recovery --
+
+    def _begin_stream(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _chunk(self, data):
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_stream(self):
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
+
+    def _stream_generate(self, body, name):
+        """Streaming :generate is RECOVERABLE: the journal holds the
+        seeded request and every token the client saw, so replica death
+        re-drives the session onto a live peer instead of 502ing the
+        stream (non-streaming :generate keeps the typed fail-fast —
+        its client never saw partial output and can simply retry)."""
+        gw = self.gateway
+        gw._seed_body(body)
+        entry = gw.journal.journal_open(body)
+        try:
+            self._drive_stream(entry, name)
+        finally:
+            gw.journal.journal_close(entry)
+
+    def _drive_stream(self, entry, name):
+        """Drive `entry`'s stream to completion: attempt on a chosen
+        replica, and on failure re-drive — fresh :generate when no
+        token was emitted yet, ``:resume``-replay otherwise — until the
+        done event lands, attempts run out, or the recovery deadline
+        passes.  A mid-stream session with NOTHING routable waits (the
+        journal is its queue) for a readmission to rescue it."""
+        gw, body = self.gateway, entry["body"]
+        state = {"started": False}
+        deadline = time.monotonic() + gw.redrive_deadline_s
+        failed = set()
+        attempt = 0
+        last_err = None
+        while True:
+            ev = gw._synth_done(body, entry["tokens"])
+            if ev is not None:
+                # the break ate only the final done event; rebuild it
+                if not state["started"]:
+                    self._begin_stream()
+                    state["started"] = True
+                self._chunk(json.dumps(ev).encode() + b"\n")
+                self._end_stream()
+                return
+            try:
+                try:
+                    r = gw._choose(prefix_key=gw.prefix_key(body),
+                                   roles=("prefill", "mixed"),
+                                   exclude=failed)
+                except (NoReplica, Saturated):
+                    if not failed:
+                        raise
+                    failed = set()   # only known-bad picks left: any
+                    r = gw._choose(prefix_key=gw.prefix_key(body),
+                                   roles=("prefill", "mixed"))
+            except (NoReplica, Saturated) as e:
+                if not state["started"]:
+                    # nothing sent yet: fail FAST (typed 503/429 with
+                    # Retry-After), never park a fresh request
+                    if attempt == 0:
+                        self._reject(e)
+                    else:
+                        self._finish_failed(state, last_err or e)
+                    return
+                if time.monotonic() >= deadline:
+                    self._finish_failed(state, e)
+                    return
+                # mid-stream limbo: the journaled session queues here
+                # until a replica readmits (or the deadline passes)
+                gw.counters.inc("redrive_waits")
+                time.sleep(min(0.25,
+                               max(0.0, deadline - time.monotonic())))
+                continue
+            if attempt:
+                gw.counters.inc("session_redrives")
+            ok, err = self._attempt_stream(r, entry, state, name)
+            if ok:
+                if attempt:
+                    gw.counters.inc("sessions_recovered")
+                if state["started"]:
+                    self._end_stream()
+                return
+            failed.add(r.id)
+            last_err = err
+            attempt += 1
+            if (attempt >= gw.redrive_attempts
+                    or time.monotonic() >= deadline):
+                self._finish_failed(state, last_err)
+                return
+            time.sleep(gw._redrive_backoff.delay(attempt - 1))
+
+    def _attempt_stream(self, r, entry, state, name):
+        """One try at `entry`'s stream on `r`.  Returns ``(done, err)``;
+        ``done`` means the stream finished (delivered or verdict
+        relayed) and must not be re-driven.  Already-emitted tokens
+        turn the try into a ``:resume`` replay whose splice ack is
+        swallowed — the client's ndjson stream continues seamlessly."""
+        gw = self.gateway
+        is_replay = bool(entry["tokens"])
+        hdrs = {"Idempotency-Key": entry["key"]}
+        if is_replay:
+            path = f"/v1/models/{name}:resume"
+            payload = json.dumps({"replay": gw._replay_meta(
+                entry["body"], entry["tokens"])}).encode()
+        else:
+            path = f"/v1/models/{name}:generate"
+            payload = json.dumps(entry["body"]).encode()
+            dest = gw.migrate_target(r)
+            if dest is not None:
+                # disaggregation handoff rides the first drive only; a
+                # replay already lands on a decode-capable pick
+                hdrs["X-Fleet-Migrate-To"] = f"{dest.host}:{dest.port}"
+        try:
+            faults.check("fleet.forward")
+            conn, resp = gw._request(r, "POST", path, body=payload,
+                                     headers=hdrs)
+        except OSError as e:
+            gw._release(r, ok=False)
+            return False, e
+        ok, err = False, None
+        expect_ack = is_replay
+        try:
+            if resp.status >= 500:
+                err = RuntimeError(f"replica {r.id} returned "
+                                   f"{resp.status}: {resp.read(2048)!r}")
+                return False, err
+            if resp.status != 200:
+                if is_replay:
+                    # the peer refused the replay (pool too small, bad
+                    # layout): another peer may take it
+                    err = RuntimeError(
+                        f"replica {r.id} refused replay: "
+                        f"{resp.status} {resp.read(2048)!r}")
+                    return False, err
+                # the replica rejected the request itself (4xx): relay
+                # the verdict — a re-drive would be rejected identically
+                data = resp.read()
+                self.send_response(resp.status)
+                self.send_header("Content-Type",
+                                 resp.getheader("Content-Type",
+                                                "application/json"))
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                ok = True
+                return True, None
+            while True:
+                try:
+                    faults.check("fleet.relay")
+                    line = resp.readline()
+                except (OSError, ValueError) as e:
+                    err = e
+                    return False, e
+                if not line:
+                    err = RuntimeError(f"replica {r.id} ended the "
+                                       "stream without done")
+                    return False, err
+                try:
+                    ev = json.loads(line)
+                except ValueError as e:
+                    err = e
+                    return False, e
+                if "error" in ev:
+                    # replica-side engine trouble mid-stream — exactly
+                    # the crash shape recovery exists for
+                    err = RuntimeError(str(ev["error"]))
+                    return False, err
+                if expect_ack:
+                    expect_ack = False
+                    if ev.get("resumed"):
+                        continue      # swallow the splice ack
+                    err = RuntimeError(f"replica {r.id} did not ack "
+                                       "the replay")
+                    return False, err
+                if "token" in ev:
+                    # the journaling tee: recorded BEFORE the client
+                    # write, so a token the client may have seen is
+                    # never replayed as fresh
+                    gw.journal.record(entry, ev["token"])
+                if not state["started"]:
+                    self._begin_stream()
+                    state["started"] = True
+                # client-side write failures propagate out: the CLIENT
+                # is gone, there is nothing left to recover for
+                self._chunk(line if line.endswith(b"\n")
+                            else line + b"\n")
+                if ev.get("done"):
+                    ok = True
+                    return True, None
+        finally:
+            conn.close()
+            gw._release(r, ok=ok or err is None)
+
+    def _finish_failed(self, state, err):
+        gw = self.gateway
+        gw.counters.inc("generate_failures")
+        payload = {"error": str(err), "type": "replica_failure",
+                   "retryable": True}
+        if state["started"]:
+            try:
+                self._chunk(json.dumps(payload).encode() + b"\n")
+            except OSError:
+                pass
+            self._end_stream()
+        else:
+            self._send(502, payload)
 
     # -- HTTP surface --
 
@@ -720,10 +1108,19 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(length) if length else b"{}"
         prefix_key = None
         if is_generate:
+            body_obj = None
             try:
-                prefix_key = gw.prefix_key(json.loads(body))
+                body_obj = json.loads(body)
             except ValueError:
-                prefix_key = None   # replica will 400 the bad JSON
+                pass                # replica will 400 the bad JSON
+            if isinstance(body_obj, dict) and body_obj.get("stream"):
+                # streaming sessions ride the journaled recovery path:
+                # replica death costs latency, not the stream
+                name = path[len("/v1/models/"):-len(":generate")]
+                self._stream_generate(body_obj, name)
+                return
+            if isinstance(body_obj, dict):
+                prefix_key = gw.prefix_key(body_obj)
         try:
             # :generate prefers prefill-capable replicas; when the pick
             # is a dedicated prefill node, plant the handoff header so
@@ -757,25 +1154,37 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                              "type": "replica_failure", "replica": r.id,
                              "retryable": True})
             return
-        # predict: one hedged retry on a DIFFERENT replica
-        gw.counters.inc("hedged_retries")
-        try:
-            r2 = gw._choose(exclude=(r.id,))
-        except (NoReplica, Saturated):
-            self._send(502, {"error": f"replica {r.id} failed and no "
-                             f"alternative is admitting: {resp_or_err}",
-                             "type": "replica_failure", "replica": r.id})
-            return
-        ok2, conn2, resp_or_err2 = self._forward_once(r2, self.path, body)
-        if not ok2:
-            self._send(502, {"error": f"retry on {r2.id} failed too: "
-                             f"{resp_or_err2}",
-                             "type": "replica_failure", "replica": r2.id})
-            return
-        try:
-            self._relay(conn2, resp_or_err2)
-        finally:
-            gw._release(r2, ok=True)
+        # predict is idempotent, so retrying is safe; the shared
+        # RetryPolicy (attempts=2, no backoff) IS the hedged retry —
+        # one immediate second try on a DIFFERENT replica
+        policy = util.RetryPolicy(attempts=2, base_delay=0.0,
+                                  cap_delay=0.0)
+        last_err, last_r = resp_or_err, r
+        for attempt in policy.sleeps():
+            if attempt == 0:
+                continue            # the first try already failed above
+            gw.counters.inc("hedged_retries")
+            try:
+                r2 = gw._choose(exclude=(r.id,))
+            except (NoReplica, Saturated):
+                self._send(502, {"error": f"replica {r.id} failed and "
+                                 f"no alternative is admitting: "
+                                 f"{resp_or_err}",
+                                 "type": "replica_failure",
+                                 "replica": r.id})
+                return
+            ok2, conn2, resp_or_err2 = self._forward_once(r2, self.path,
+                                                          body)
+            if ok2:
+                try:
+                    self._relay(conn2, resp_or_err2)
+                finally:
+                    gw._release(r2, ok=True)
+                return
+            last_err, last_r = resp_or_err2, r2
+        self._send(502, {"error": f"retry on {last_r.id} failed too: "
+                         f"{last_err}",
+                         "type": "replica_failure", "replica": last_r.id})
 
     def log_message(self, fmt, *args):
         logger.debug("fleet http: " + fmt, *args)
@@ -798,6 +1207,19 @@ def build_argparser():
     p.add_argument("--heartbeat_timeout_s", type=float, default=10.0,
                    help="eject a replica silent for this long; beats "
                         "resuming re-admit it")
+    p.add_argument("--ejection_misses", type=int, default=3,
+                   help="consecutive over-age monitor sweeps before a "
+                        "silent replica is ejected (anti-flap)")
+    p.add_argument("--readmit_cooldown_s", type=float, default=None,
+                   help="how long beats must stay fresh before an "
+                        "ejected replica takes traffic again (default: "
+                        "heartbeat_timeout_s / 2)")
+    p.add_argument("--redrive_attempts", type=int, default=3,
+                   help="total tries per streaming :generate session "
+                        "(1 = no crash recovery)")
+    p.add_argument("--redrive_deadline_s", type=float, default=30.0,
+                   help="wall-time bound on recovering one stream, "
+                        "including waits for a replica readmission")
     p.add_argument("--prefix_tokens", type=int, default=None,
                    help=":generate affinity-hash prefix length (default: "
                         "the first registrant's announced kv_page_size, "
@@ -828,7 +1250,13 @@ def make_gateway(args):
                  breaker_threshold=args.breaker_threshold,
                  breaker_cooldown_s=args.breaker_cooldown_s,
                  connect_timeout_s=args.connect_timeout_s,
-                 replica_timeout_s=args.replica_timeout_s)
+                 replica_timeout_s=args.replica_timeout_s,
+                 ejection_misses=getattr(args, "ejection_misses", 3),
+                 readmit_cooldown_s=getattr(args, "readmit_cooldown_s",
+                                            None),
+                 redrive_attempts=getattr(args, "redrive_attempts", 3),
+                 redrive_deadline_s=getattr(args, "redrive_deadline_s",
+                                            30.0))
     gw.start()
     return gw
 

@@ -159,6 +159,48 @@ class FleetClient:
         return self._call(
             "POST", f"/v1/models/{self.model_name}:generate", payload)
 
+    def generate_stream(self, prompt, idempotency_key=None, timeout=None,
+                        **extra):
+        """Streaming ``:generate`` for ONE prompt: yield decoded ndjson
+        events as they arrive.  Against a gateway this is the
+        session-recovery surface — the gateway journals the stream and
+        re-drives it onto a live replica if the serving one dies, so the
+        iterator keeps yielding byte-identical tokens across a replica
+        crash.  Against a bare replica, pass ``idempotency_key`` to make
+        retries safe: a re-sent key cancels the prior in-flight run
+        instead of double-generating."""
+        payload = {"inputs": [list(prompt)], "stream": True}
+        payload.update(extra)
+        headers = {"Content-Type": "application/json"}
+        if idempotency_key is not None:
+            headers["Idempotency-Key"] = str(idempotency_key)
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout or self.timeout)
+        try:
+            conn.request("POST",
+                         f"/v1/models/{self.model_name}:generate",
+                         body=json.dumps(payload).encode(),
+                         headers=headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                data = resp.read()
+                try:
+                    decoded = json.loads(data) if data else {}
+                except ValueError:
+                    decoded = {"raw": data.decode("utf-8", "replace")}
+                raise RuntimeError(
+                    f"streaming generate failed: HTTP {resp.status} "
+                    f"{decoded}")
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+        finally:
+            conn.close()
+
     def metadata(self):
         return self._call("GET", f"/v1/models/{self.model_name}")
 

@@ -46,7 +46,7 @@ import http.client
 
 import numpy as np
 
-from . import util
+from . import faults, util
 from .reservation import MessageSocket
 
 logger = logging.getLogger(__name__)
@@ -168,6 +168,7 @@ def wire_snapshot(frozen, model_name, page_size=0):
 
 def pull_snapshot(addr, ticket, timeout=30.0):
     """Dial a :class:`PageServer` and pull the snapshot for ``ticket``."""
+    faults.check("kvtransfer.pull")
     msock = KvSocket()
     sock = socket.create_connection((addr[0], int(addr[1])),
                                     timeout=timeout)
@@ -302,7 +303,13 @@ class MigrationEngine:
             ticket = self.server.register(meta, blocks)
             nbytes = sum(int(a.nbytes) for a in blocks.values())
             n_pages = int(frozen.get("n_pages", 0))
-            for attempt in range(retries + 1):
+            # jittered backoff between attempts so a fleet of sources
+            # retrying the same flapping destination doesn't synchronize;
+            # the explicit deadline still bounds each attempt's budget
+            policy = util.RetryPolicy(attempts=retries + 1, base_delay=0.25,
+                                      cap_delay=2.0, jitter=0.25,
+                                      deadline_s=timeout_s)
+            for attempt in policy.sleeps():
                 budget = deadline - time.monotonic()
                 if budget <= 0:
                     last_err = "migration deadline exhausted"
@@ -382,6 +389,7 @@ class MigrationEngine:
     def _post_resume(self, dest, meta, ticket, timeout):
         """POST ``:resume`` and read the first (ack) event of the
         ndjson response.  Returns ``(conn, resp, first_event)``."""
+        faults.check("kvtransfer.post_resume")
         body = json.dumps({
             "meta": meta,
             "pull": {"host": self._advertise_host,
@@ -439,6 +447,7 @@ class MigrationEngine:
                 conn.sock.settimeout(None)
             while True:
                 try:
+                    faults.check("kvtransfer.relay")
                     line = resp.readline()
                 except (OSError, ValueError) as e:
                     if handle.cancelled.is_set():

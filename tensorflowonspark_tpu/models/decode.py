@@ -956,6 +956,18 @@ def step_keys(rng, n):
     return jax.vmap(lambda t: jax.random.fold_in(rng, t))(jnp.arange(n))
 
 
+def replay_key(seed, ordinal):
+    """Reconstruct the sampling key for new-token ordinal ``ordinal`` of
+    a request seeded with integer ``seed`` — the crash-recovery side of
+    the `step_keys` schedule.  Because the key is a pure function of
+    (seed, position) with NO chained state, a re-driven session needs
+    only (seed, tokens-emitted-so-far) to continue byte-identically: the
+    gateway journals both, and a replica rebuilding the session calls
+    the chain at ``ordinal = len(emitted)`` as if the crash never
+    happened (tests/test_chaos.py pins the parity)."""
+    return jax.random.fold_in(jax.random.key(int(seed)), int(ordinal))
+
+
 def _check_penalty(repetition_penalty):
     """Validate a repetition penalty; True when active.  The finite cap
     matters: rep=inf times a zero-valued seen logit is NaN, which would

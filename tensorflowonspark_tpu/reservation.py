@@ -41,7 +41,7 @@ import time
 
 import msgpack
 
-from . import util
+from . import faults, util
 
 logger = logging.getLogger(__name__)
 
@@ -58,8 +58,11 @@ RPC_TIMEOUT_SECS = 60.0
 
 def _backoff_delay(attempt, base, cap):
     """Capped exponential delay before connect retry `attempt` (0-based):
-    base, 2*base, 4*base, ... never exceeding `cap`."""
-    return min(float(cap), float(base) * (2.0 ** attempt))
+    base, 2*base, 4*base, ... never exceeding `cap`.  Delegates to the
+    package-wide :class:`util.RetryPolicy` schedule (jitterless here:
+    tests pin exact delays through the module knobs)."""
+    return util.RetryPolicy(attempts=2, base_delay=base,
+                            cap_delay=cap).delay(attempt)
 
 
 class Reservations:
@@ -394,6 +397,7 @@ class Client(MessageSocket):
         """One fresh connection to the server.  The per-RPC timeout bounds
         receive(): if the server host dies without RST, a blocked read must
         not hang the executor forever."""
+        faults.check("reservation.dial")
         s = socket.create_connection(self.server_addr,
                                      timeout=connect_timeout)
         try:
@@ -421,16 +425,16 @@ class Client(MessageSocket):
         cap = (self._retry_delay_cap if self._retry_delay_cap is not None
                else CONNECT_RETRY_DELAY_CAP_SECS)
         ct, rt = self._effective_timeouts()
+        policy = util.RetryPolicy(attempts=max(1, retries),
+                                  base_delay=base, cap_delay=cap)
         last = None
-        for attempt in range(retries):
+        for attempt in policy.sleeps():
             try:
                 return self._dial(connect_timeout=ct, rpc_timeout=rt)
             except OSError as e:
                 last = e
                 logger.warning("connect to %s failed (%s); retry %d/%d",
                                self.server_addr, e, attempt + 1, retries)
-                if attempt < retries - 1:   # no pointless post-final sleep
-                    time.sleep(_backoff_delay(attempt, base, cap))
         raise ConnectionError(f"could not reach reservation server at {self.server_addr}: {last}")
 
     def _request(self, msg):
@@ -438,6 +442,7 @@ class Client(MessageSocket):
             if self._sock is None:
                 self._sock = self._connect()
             try:
+                faults.check("reservation.rpc")
                 self.send(self._sock, msg)
                 return self.receive(self._sock)
             except Exception:
@@ -518,6 +523,7 @@ class Client(MessageSocket):
             ct, rt = self._effective_timeouts()
             while not self._hb_stop.is_set():
                 try:
+                    faults.check("reservation.heartbeat")
                     if hb is None:
                         hb = self._dial(connect_timeout=min(5.0, ct),
                                         rpc_timeout=min(10.0, rt))

@@ -37,6 +37,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import faults
+
 logger = logging.getLogger(__name__)
 
 
@@ -1448,6 +1450,9 @@ class ContinuousBatcher:
         rest; the caller parks the item until pages free."""
         import jax.numpy as jnp
 
+        if faults.deny("serve.alloc"):
+            return False
+
         prompt, max_new, temp = item["prompt"], item["max_new"], item["temp"]
         need = self._pages_needed(len(prompt), max_new, temperature=temp)
         shared, keys = self._prefix_lookup(
@@ -1549,17 +1554,24 @@ class ContinuousBatcher:
                 self._sink_entries)
 
     def _start_admission(self, row, item):
+        faults.check("serve.admission")
         h, prompt = item["h"], item["prompt"]
         if h.cancelled.is_set():        # client gone before admission
             h._finish(list(prompt))
             return
-        if "resume" in item:
+        if "resume" in item and "kv" in item["resume"]:
             # a migrated-in session: no prefill — upload its kv and
             # occupy the row mid-sequence (parks like any admission
             # when the pool is full)
             if not self._install_resume(row, item):
                 self._parked = (row, item)
             return
+        # a kv-less "resume" is a REPLAY (crash recovery): the dead
+        # replica's pages are gone, so the committed sequence minus its
+        # last token re-prefills here — the splice registers are then
+        # installed exactly as a migration would, and decode continues
+        # byte-identically (seed + ordinal reconstruct the RNG chain)
+        src = item["resume"]["seq"][:-1] if "resume" in item else prompt
         if self.kv_page_size and not self._try_allocate(row, item):
             self._parked = (row, item)   # wait for pages (FIFO: nothing
             return                       # else admits while parked)
@@ -1574,7 +1586,8 @@ class ContinuousBatcher:
                          if self.kv_page_size else 0)
         self._admissions.append({
             "row": row, "item": item, "offset": shared_tokens, "i": 0,
-            "sizes": self._prefill_chunk_sizes(len(prompt) - shared_tokens),
+            "src": src,
+            "sizes": self._prefill_chunk_sizes(len(src) - shared_tokens),
             "d_off": 0, "di": 0,
             "d_sizes": (self._prefill_chunk_sizes(shared_tokens)
                         if shared_tokens and self.draft_model is not None
@@ -1685,13 +1698,15 @@ class ContinuousBatcher:
             return
         entries, finishing = [], []
         for adm in selected:
-            item, off = adm["item"], adm["offset"]
+            off = adm["offset"]
             size = adm["sizes"][adm["i"]]
-            chunk = item["prompt"][off:off + size]
+            # "src" is the prefill target: the prompt for a fresh
+            # admission, prompt+emitted-minus-last for a crash replay
+            chunk = adm["src"][off:off + size]
             entries.append((adm["row"], chunk, off))
             adm["offset"] = off + len(chunk)
             adm["i"] += 1
-            if adm["offset"] >= len(item["prompt"]):
+            if adm["offset"] >= len(adm["src"]):
                 finishing.append(adm)
         chunks, rows, starts, n_valids = self._prefill_args(
             entries, count_sink=True)
@@ -1724,6 +1739,12 @@ class ContinuousBatcher:
         record TTFT, and occupy the row for decode."""
         import jax.numpy as jnp
 
+        if "resume" in adm["item"]:
+            # crash replay: the final chunk's logits correspond to a
+            # token the dead replica already emitted — no pick, no
+            # emission; splice the registers mid-sequence instead
+            self._finish_replay(adm)
+            return
         item, row = adm["item"], adm["row"]
         h, prompt, max_new = item["h"], item["prompt"], item["max_new"]
         temp, eos_id, seed = item["temp"], item["eos"], item["seed"]
@@ -1785,6 +1806,55 @@ class ContinuousBatcher:
                             # arrays alone can't be read back mid-flight)
                             "item": item}
 
+    def _finish_replay(self, adm):
+        """Final replay chunk done: the row's cache now holds kv for
+        every committed position except the last token's (written by
+        the first decode step, exactly like a migration splice);
+        install the mid-sequence registers and occupy the row.  The
+        last committed token was already delivered to the client by the
+        dead replica, so nothing is emitted here — the handle's first
+        tokens are the continuation."""
+        item, row = adm["item"], adm["row"]
+        res = item["resume"]
+        h, seq, remaining = item["h"], res["seq"], res["remaining"]
+        if self.kv_page_size:
+            # the replayed prompt's full-prefix pages are real computed
+            # kv: publish them like any admission's
+            self._register_prefix_pages(row)
+        self._gen[row] += 1
+        self._install_row_state(row, seq, len(item["prompt"]),
+                                remaining, item)
+        if self.lora_rank:
+            self._lora_ids = self._lora_ids.at[row].set(item["aidx"])
+        filtered = bool(item["topk"] or item["topp"] < 1.0
+                        or item["minp"] > 0.0)
+        if filtered:
+            self._n_filtered += 1
+        penalized = item["rep"] != 1.0   # seen-bits/rep arrays were set
+        if penalized:                    # by _install_row_state
+            self._n_penalized += 1
+        self._slots[row] = {"handle": h, "seq": list(seq),
+                            "remaining": remaining, "temp": item["temp"],
+                            "eos": item["eos"], "stops": item["stops"],
+                            "plen": len(item["prompt"]),
+                            "filtered": filtered, "pen": penalized,
+                            "item": item}
+        self.counters.inc("replays_resumed")
+        res["installed"].set()
+
+    def _admit_one(self, row, item):
+        """One admission, with the item's handle tied to its fate: a
+        raise mid-admission happens AFTER the item left `_pending` but
+        (possibly) before it joined `_admissions`, so `_die`'s sweeps
+        cannot see it — without this tie the client would hang until
+        its own timeout instead of hearing the engine died (the chaos
+        suite's mid-prefill kill found exactly that orphan)."""
+        try:
+            self._start_admission(row, item)
+        except BaseException as e:
+            item["h"]._fail(e)      # idempotent if _die also sweeps it
+            raise
+
     def _admit(self, block=False):
         """Pull waiting requests into the admission pipeline until it is
         `prefill_rows` wide (or rows/requests run out).  Mid-prefill
@@ -1809,7 +1879,7 @@ class ContinuousBatcher:
                 if row is None:
                     self._parked = (0, item)
                     return
-            self._start_admission(row, item)
+            self._admit_one(row, item)
             if self._parked is not None:
                 return      # still starved: FIFO — nothing else admits
             claimed.add(row)
@@ -1821,7 +1891,7 @@ class ContinuousBatcher:
                 item = self._pending.get(timeout=0.05 if block else 0)
             except queue_mod.Empty:
                 return
-            self._start_admission(row, item)
+            self._admit_one(row, item)
             if self._parked is not None:
                 return      # pool starved: later arrivals wait (FIFO)
             claimed.add(row)
@@ -2204,6 +2274,85 @@ class ContinuousBatcher:
             self._drain_pending(RuntimeError(f"batcher died: {self._dead}"))
         return h, installed
 
+    def submit_replay(self, meta):
+        """Admission that REBUILDS a lost session from its token record
+        alone: no kv arrives (the dead replica's pages are gone) — the
+        committed sequence re-prefills here and the splice registers
+        install as a migration's would, so decode continues
+        byte-identically (the sampling chain is a pure function of
+        (seed, ordinal)).  ``meta`` uses :func:`kvtransfer.wire_snapshot`
+        key names minus the kv-layout fields, so a journal entry works
+        against any layout — dense, paged, int8-kv — unlike a page
+        snapshot.  Returns ``(handle, installed)`` like
+        :meth:`submit_resume`."""
+        if self._dead is not None:
+            raise RuntimeError(f"batcher died: {self._dead}")
+        if self.draft_model is not None:
+            raise ValueError("this replica runs speculative decoding; "
+                             "it cannot replay recovered sessions")
+        seq = [int(t) for t in (meta.get("seq") or ())]
+        plen = int(meta.get("plen") or 0)
+        max_new = int(meta.get("max_new") or 0)
+        remaining = int(meta.get("remaining") or 0)
+        vocab = self.slot_model.cfg.vocab_size
+        if not (0 < plen < len(seq)):
+            raise ValueError("replay needs a prompt and at least one "
+                             "decoded token")
+        if any(not 0 <= t < vocab for t in seq):
+            raise ValueError(f"sequence token out of vocab range {vocab}")
+        if remaining <= 0 or remaining != max_new - (len(seq) - plen):
+            raise ValueError(
+                f"inconsistent budget: remaining={remaining} with "
+                f"{len(seq) - plen} of max_new={max_new} decoded")
+        if len(seq) + remaining > self.max_seq:
+            raise ValueError(
+                f"replayed sequence needs {len(seq) + remaining} "
+                f"positions; this replica's max_seq_len is "
+                f"{self.max_seq}")
+        temp = float(meta.get("temp") or 0.0)
+        if (self.kv_page_size
+                and self._pages_needed(plen, max_new,
+                                       temperature=temp)
+                > self._total_pages):
+            raise ValueError(
+                "replayed request does not fit this replica's kv "
+                "pool; raise --generate_kv_pages")
+        eos = meta.get("eos")
+        stops = [list(map(int, st)) for st in (meta.get("stops") or ())]
+        adapter = meta.get("adapter")
+        aidx = 0
+        if adapter is not None:
+            if not self.lora_rank:
+                raise ValueError(
+                    f"session uses adapter {adapter!r} but this replica "
+                    "has no LoRA bank")
+            with self._lora_lock:
+                if adapter not in self._adapters:
+                    raise ValueError(
+                        f"unknown adapter {adapter!r} on this replica")
+                aidx = self._adapters[adapter]
+                self._adapter_refs[aidx] = self._adapter_refs.get(aidx,
+                                                                  0) + 1
+        h = SlotHandle(seq[:plen])
+        if aidx:
+            h._on_done = lambda idx=aidx: self._release_adapter(idx)
+        installed = threading.Event()
+        self._pending.put({
+            "h": h, "prompt": seq[:plen], "max_new": max_new,
+            "temp": temp, "eos": int(eos) if eos is not None else None,
+            "seed": int(meta.get("seed") or 0), "aidx": aidx,
+            "topk": int(meta.get("topk") or 0),
+            "topp": float(meta.get("topp", 1.0)),
+            "minp": float(meta.get("minp") or 0.0),
+            "stops": stops, "rep": float(meta.get("rep", 1.0)),
+            "adapter": adapter, "t_submit": time.monotonic(),
+            # no "kv" key: _start_admission reads that as "re-prefill"
+            "resume": {"seq": seq, "remaining": remaining,
+                       "installed": installed}})
+        if self._dead is not None:
+            self._drain_pending(RuntimeError(f"batcher died: {self._dead}"))
+        return h, installed
+
     def _install_resume(self, row, item):
         """Device thread: allocate fresh pages, upload migrated kv,
         splice the page table, and occupy `row` mid-sequence.  Returns
@@ -2213,6 +2362,7 @@ class ContinuousBatcher:
         publishes pages whose content this replica computed itself."""
         import jax.numpy as jnp
 
+        faults.check("serve.resume_install")
         res = item["resume"]
         h, seq, remaining = item["h"], res["seq"], res["remaining"]
         if self.kv_page_size:
@@ -2740,6 +2890,18 @@ class GenerateService:
         # noise); pass "seed" for reproducibility
         self._auto_seed = itertools.count(1 << 20)
         self.requests = 0
+        # Idempotency-Key dedupe: the gateway attaches one key per
+        # stream, so a recovery re-drive that lands back on a replica
+        # still decoding the "lost" session (false-positive death: a
+        # network blip, not a crash) cancels the orphan instead of
+        # double-generating.  Recently-finished keys are kept for a TTL
+        # so a late re-drive of a completed stream is observable
+        # (counter) — the rerun itself is harmless: same seed, same
+        # bytes.
+        self._idem_lock = threading.Lock()
+        self._idem_live = {}       # key -> live SlotHandle
+        self._idem_done = {}       # key -> monotonic finish time
+        self._idem_ttl_s = 120.0
 
     # values that reach the batcher's driver thread become int32 device
     # scalars there; an out-of-range int raising INSIDE the single driver
@@ -2809,6 +2971,36 @@ class GenerateService:
         return (inputs, max_new, temperature, eos_id, seed, adapter,
                 top_k, top_p, min_p, stop, float(rep))
 
+    def _idem_claim(self, key, h):
+        """Register `h` as the live session for Idempotency-Key `key`,
+        cancelling any prior live session under the same key: its
+        consumer is gone (the gateway re-drives only streams whose
+        relay broke), so letting it decode on would double-generate."""
+        if key is None:
+            return
+        with self._idem_lock:
+            now = time.monotonic()
+            for k in [k for k, t in self._idem_done.items()
+                      if now - t > self._idem_ttl_s]:
+                del self._idem_done[k]
+            prior = self._idem_live.get(key)
+            if prior is not None and prior is not h:
+                self.batcher.counters.inc("idempotency_cancels")
+                prior.cancel()
+            if key in self._idem_done:
+                self.batcher.counters.inc("idempotency_reruns")
+            self._idem_live[key] = h
+
+    def _idem_finish(self, key, h):
+        """Stream over: retire the live entry (only if still ours) and
+        remember the key as recently finished."""
+        if key is None:
+            return
+        with self._idem_lock:
+            if self._idem_live.get(key) is h:
+                del self._idem_live[key]
+            self._idem_done[key] = time.monotonic()
+
     def _prompt_seeds(self, n, seed, temperature):
         """Per-prompt seeds: explicit seed s -> s, s+1, ... (documented
         reproducible); unseeded sampling -> a FRESH auto-seed per prompt
@@ -2821,7 +3013,7 @@ class GenerateService:
             return [next(self._auto_seed) for _ in range(n)]
         return [0] * n
 
-    def stream(self, req, on_handle=None):
+    def stream(self, req, on_handle=None, idem_key=None):
         """Yield JSON-able events for a single-prompt generation:
         ``{"token": t}`` per decoded token (eos-trimmed), then
         ``{"done": true, "output": [...full sequence...]}``.
@@ -2842,6 +3034,7 @@ class GenerateService:
                                 eos_id=eos_id, seed=seed, adapter=adapter,
                                 top_k=top_k, top_p=top_p, min_p=min_p,
                                 stop=stop, repetition_penalty=rep)
+        self._idem_claim(idem_key, h)
         self.requests += 1
         if on_handle is not None:
             try:
@@ -2865,6 +3058,7 @@ class GenerateService:
                 # consumer died/finished: free the slot instead of
                 # decoding to max_new for a client nobody serves
                 h.cancel()
+                self._idem_finish(idem_key, h)
 
         return slot_events()
 
@@ -2893,20 +3087,35 @@ class GenerateService:
         self.requests += 1
         return outs
 
-    def resume(self, req):
-        """``POST :resume`` — continue a session migrated from another
-        replica.  Pulls the kv snapshot from the source's page server,
-        submits the prefill-skipping admission, and returns the event
-        generator whose FIRST event (``{"resumed": true}``) is the
-        splice ack: the source frees its pages only after reading it.
-        Validation and the pull both happen eagerly (before any
-        response bytes), so a bad snapshot 400s instead of dying
-        mid-stream."""
+    def resume(self, req, idem_key=None):
+        """``POST :resume`` — continue a session that left its replica.
+
+        Two modes share the splice-ack event protocol.  With ``meta`` +
+        ``pull`` (migration), the kv snapshot is pulled from the
+        source's page server and installed without prefill.  With
+        ``replay`` (crash recovery), there is no source left to pull
+        from: the gateway's journaled token record re-prefills here and
+        decode continues byte-identically.  Either way the FIRST event
+        (``{"resumed": true}``) is the ack the caller keys off —
+        migration sources free their pages on it, the gateway marks the
+        re-drive live.  Validation (and the pull) happen eagerly
+        (before any response bytes), so a bad snapshot 400s instead of
+        dying mid-stream."""
         from . import kvtransfer
 
+        replay = req.get("replay")
+        if replay is not None:
+            if not isinstance(replay, dict):
+                raise ValueError('":resume" "replay" must be a meta '
+                                 "object")
+            h, installed = self.batcher.submit_replay(replay)
+            self._idem_claim(idem_key, h)
+            self.requests += 1
+            return self._resume_events(h, installed, idem_key)
         meta, pull = req.get("meta"), req.get("pull")
         if not isinstance(meta, dict) or not isinstance(pull, dict):
-            raise ValueError(':resume needs "meta" and "pull" objects')
+            raise ValueError(':resume needs "meta" and "pull" objects '
+                             '(or "replay")')
         if not pull.get("host") or not _is_int(pull.get("port")) \
                 or not pull.get("ticket"):
             raise ValueError('"pull" must carry host, port and ticket')
@@ -2918,7 +3127,9 @@ class GenerateService:
         # self-describing for tooling
         h, installed = self.batcher.submit_resume(meta, blocks)
         self.requests += 1
+        return self._resume_events(h, installed, None)
 
+    def _resume_events(self, h, installed, idem_key):
         def resume_events():
             try:
                 deadline = time.monotonic() + min(60.0,
@@ -2953,6 +3164,7 @@ class GenerateService:
                 yield {"done": True, "output": out}
             finally:
                 h.cancel()
+                self._idem_finish(idem_key, h)
 
         return resume_events()
 
@@ -3044,11 +3256,13 @@ class _Handler(BaseHTTPRequestHandler):
                                      + (reason or "this export is not a "
                                         "decoder LM")})
                     return
+                idem_key = self.headers.get("Idempotency-Key")
                 if is_resume:
                     # always streams: the first ndjson event is the
-                    # migration's splice ack, the rest is the token
-                    # relay back to the source
-                    self._stream_events(gen.resume(req))
+                    # splice ack (migration or crash replay), the rest
+                    # is the token relay back to the caller
+                    self._stream_events(gen.resume(req,
+                                                   idem_key=idem_key))
                 elif req.get("stream"):
                     on_handle = None
                     migrate_to = self.headers.get("X-Fleet-Migrate-To")
@@ -3058,7 +3272,8 @@ class _Handler(BaseHTTPRequestHandler):
                         on_handle = self.service.auto_migrate_hook(
                             migrate_to)
                     self._stream_events(gen.stream(req,
-                                                   on_handle=on_handle))
+                                                   on_handle=on_handle,
+                                                   idem_key=idem_key))
                 else:
                     self._send(200, {"outputs": gen.generate(req)})
             else:

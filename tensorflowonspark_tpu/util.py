@@ -6,11 +6,88 @@ imported and unit-tested without paying accelerator-runtime startup.
 import errno
 import logging
 import os
+import random
 import socket
+import time
 
 logger = logging.getLogger(__name__)
 
 EXECUTOR_ID_FILE = "executor_id"
+
+
+class RetryPolicy:
+    """ONE retry/backoff discipline for every network loop in the package.
+
+    Three loops grew three divergent retry shapes (reservation.Client's
+    capped-exponential connect retries, the fleet gateway's hedged
+    predict retry, kvtransfer.MigrationEngine's deadline-bounded attempt
+    loop); this class is the shared schedule they all thread their
+    existing knobs through.  ``attempts`` is the TOTAL number of tries
+    (not extra retries), ``delay(i)`` the capped exponential backoff
+    before try ``i+1`` — base, 2*base, 4*base, ... never exceeding
+    ``cap_delay`` — plus up to ``jitter``-fraction uniform noise so a
+    fleet of clients retrying the same dead endpoint doesn't
+    synchronize.  ``deadline_s`` bounds the loop's total wall time
+    (sleeps are clipped to it, and no try starts past it).
+
+    ``sleeps()`` is the iteration helper::
+
+        for attempt in policy.sleeps():
+            try:
+                return dial()
+            except OSError as e:
+                last = e
+        raise ConnectionError(last)
+
+    It yields attempt indices and sleeps the backoff BETWEEN tries
+    (never after the last — the no-pointless-post-final-sleep rule every
+    hand-rolled loop had to re-derive).
+    """
+
+    def __init__(self, attempts=3, base_delay=2.0, cap_delay=15.0,
+                 jitter=0.0, deadline_s=None):
+        if attempts < 1:
+            raise ValueError(f"attempts={attempts} must be >= 1")
+        if base_delay < 0 or cap_delay < 0:
+            raise ValueError("delays must be >= 0")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter={jitter} must be in [0, 1]")
+        self.attempts = int(attempts)
+        self.base_delay = float(base_delay)
+        self.cap_delay = float(cap_delay)
+        self.jitter = float(jitter)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+
+    def delay(self, attempt):
+        """Backoff before retry `attempt` (0-based: the sleep after the
+        first failed try is ``delay(0)``)."""
+        d = min(self.cap_delay, self.base_delay * (2.0 ** attempt))
+        if self.jitter:
+            d += random.uniform(0.0, self.jitter * d)
+        return d
+
+    def sleeps(self, stop=None):
+        """Yield attempt indices ``0..attempts-1``, sleeping the backoff
+        between them and ending early at the deadline.  ``stop`` is an
+        optional ``threading.Event``-like object: the inter-try sleep
+        waits on it instead of ``time.sleep`` so a shutdown can end the
+        loop mid-backoff."""
+        start = time.monotonic()
+        for attempt in range(self.attempts):
+            if (attempt and self.deadline_s is not None
+                    and time.monotonic() - start >= self.deadline_s):
+                return
+            yield attempt
+            if attempt < self.attempts - 1:
+                d = self.delay(attempt)
+                if self.deadline_s is not None:
+                    d = min(d, max(0.0, self.deadline_s
+                                   - (time.monotonic() - start)))
+                if stop is not None:
+                    if stop.wait(d):
+                        return
+                elif d > 0:
+                    time.sleep(d)
 
 
 def get_ip_address():

@@ -48,6 +48,7 @@ def mp_ctx():
 SLOW_FILES = {
     "test_aot.py",              # 70 s — native lib + mock PJRT round trips
     "test_bert.py",             # 45 s
+    "test_chaos.py",            # ~60 s — kill/recover soak over real engines
     "test_cluster.py",          # 86 s — multi-process integration
     "test_convert.py",          # 31 s — HF checkpoint parity
     "test_decode.py",           # 62 s — KV-cache generation compiles

@@ -1,0 +1,342 @@
+"""Deterministic chaos suite: kill real engines at seeded fault points
+and pin the recovery invariants the crash-tolerance work promises.
+
+Every scenario runs in-process over real ``ContinuousBatcher`` engines
+(the same small transformer the migration suite uses) with faults
+injected through :mod:`tensorflowonspark_tpu.faults` or by cancelling
+the source handle — the in-process stand-in for a replica dying with
+its kv pages.  The invariants:
+
+* **byte parity** — a session recovered from its journal (prompt +
+  emitted tokens + sampling params) continues byte-identically to the
+  uninterrupted solo run, across dense, paged, int8-kv, and
+  seeded-sampled engines (the sampling chain is a pure function of
+  (seed, ordinal), see ``decode.replay_key``);
+* **rollback parity** — a migration that dies mid-pull or mid-install
+  rolls back and finishes on the source, still byte-identical;
+* **conservation** — a 100-cycle randomized kill/recover loop strands
+  zero journal entries and returns every kv page to the pools.
+
+The whole file is marker-gated (``-m chaos``, ``tox -e chaos``) and
+seeded via ``CHAOS_SEED`` so CI can run the same schedules on fixed
+seeds and a soak box can sweep new ones.
+"""
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import faults, fleet, kvtransfer, serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+pytestmark = pytest.mark.chaos
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, temperature=0.0, seed=0, **kw):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None), **kw)
+    return np.asarray(out)[0].tolist()
+
+
+def _replay_meta(prompt, emitted, max_new, temp=0.0, seed=0):
+    """What a gateway journal entry yields for re-driving: the committed
+    sequence and the sampling params — no kv, the dead replica took it."""
+    return {"seq": list(prompt) + list(emitted), "plen": len(prompt),
+            "max_new": max_new, "remaining": max_new - len(emitted),
+            "temp": temp, "seed": seed}
+
+
+def _snapshot_via_wire(src, frozen):
+    """Ship a frozen session through a real PageServer socket (register,
+    pull, release) and return what the far side decoded."""
+    meta, blocks = kvtransfer.wire_snapshot(frozen, "m",
+                                            page_size=src.kv_page_size)
+    server = kvtransfer.PageServer()
+    try:
+        ticket = server.register(meta, blocks)
+        return kvtransfer.pull_snapshot(server.addr, ticket)
+    finally:
+        server.close()
+
+
+# ------------------------------------------------- mid-decode kills ----
+
+# the acceptance matrix: every kv layout the engines support, plus a
+# seeded-sampled session (the case that NEEDS the replay_key chain)
+_KILL_KINDS = {
+    "dense": (dict(prefill_chunk=8), {}, 0.0, 0),
+    "paged": (dict(prefill_chunk=8, kv_page_size=8, kv_pages=24),
+              {}, 0.0, 0),
+    "int8-kv": (dict(prefill_chunk=8, kv_page_size=8, kv_pages=24,
+                     kv_dtype="int8"), {"kv_dtype": "int8"}, 0.0, 0),
+    "sampled": (dict(prefill_chunk=8, kv_page_size=8, kv_pages=24),
+                {}, 0.8, 11),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(_KILL_KINDS))
+def test_mid_decode_kill_replays_byte_identically(model_and_params, kind):
+    model, params = model_and_params
+    kw, solo_kw, temp, seed = _KILL_KINDS[kind]
+    src = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    journal = fleet.StreamJournal()
+    prompt, n_new = [3, 1, 4, 1, 5], 6
+    try:
+        entry = journal.journal_open({"prompt": prompt, "seed": seed})
+        h = src.submit(prompt, n_new, temperature=temp, seed=seed)
+        emitted = list(h.tokens.get(timeout=300))   # the tee
+        for t in emitted:
+            journal.record(entry, t)
+        assert 0 < len(emitted) < n_new
+        h.cancel()          # the crash: src's kv for this session is gone
+        h2, installed = dst.submit_replay(
+            _replay_meta(prompt, emitted, n_new, temp=temp, seed=seed))
+        assert installed.wait(300), "replay install timed out"
+        out = h2.result(timeout=300)
+        want = _solo(model, params, prompt, n_new, temperature=temp,
+                     seed=seed, **solo_kw)
+        assert out == want                          # full byte parity
+        # and the splice carried the client-visible prefix verbatim
+        assert out[:len(prompt) + len(emitted)] == prompt + emitted
+        journal.journal_close(entry)
+        assert len(journal) == 0
+    finally:
+        src.stop()
+        dst.stop()
+
+
+# ------------------------------------------------ mid-prefill kills ----
+
+def test_mid_prefill_kill_fails_loud_and_rerun_matches(model_and_params):
+    # a replica dying DURING admission has committed nothing: the
+    # correct recovery is a fresh :generate elsewhere, and the dead
+    # engine must fail its handles loudly rather than wedge them
+    model, params = model_and_params
+    kw = dict(prefill_chunk=8, kv_page_size=8, kv_pages=20)
+    src = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    prompt, n_new = [2, 7, 1, 8, 2, 8], 5
+    try:
+        plan = faults.FaultPlan(CHAOS_SEED).on("serve.admission",
+                                               kind="oserror", nth=1)
+        with faults.active(plan):
+            h = src.submit(prompt, n_new)
+            with pytest.raises(OSError, match="injected fault"):
+                h.result(timeout=300)
+        assert plan.fired == [("serve.admission", "oserror")]
+        # the engine died with the admission; later submits fail fast
+        with pytest.raises(RuntimeError, match="batcher died"):
+            src.submit(prompt, n_new)
+        assert dst.submit(prompt, n_new).result(timeout=300) == \
+            _solo(model, params, prompt, n_new)
+    finally:
+        src.stop()
+        dst.stop()
+
+
+# ---------------------------------------------- mid-migration faults ----
+
+def test_mid_migration_pull_fault_retries_then_lands(model_and_params):
+    # a transient wire fault mid-pull: the ticket is multi-pull, so the
+    # retry re-pulls the SAME snapshot and the migration still lands
+    model, params = model_and_params
+    kw = dict(prefill_chunk=8, kv_page_size=8, kv_pages=20)
+    src = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    prompt, n_new = [1, 2, 3, 4, 5], 5
+    try:
+        h = src.submit(prompt, n_new)
+        h.tokens.get(timeout=300)                   # live mid-decode
+        frozen = src.freeze_session(h, timeout_s=60)
+        assert frozen is not None
+        meta, blocks = kvtransfer.wire_snapshot(
+            frozen, "m", page_size=src.kv_page_size)
+        server = kvtransfer.PageServer()
+        try:
+            ticket = server.register(meta, blocks)
+            plan = faults.FaultPlan(CHAOS_SEED).on(
+                "kvtransfer.pull", kind="oserror", nth=1, times=1)
+            with faults.active(plan):
+                with pytest.raises(OSError):
+                    kvtransfer.pull_snapshot(server.addr, ticket)
+                meta2, blocks2 = kvtransfer.pull_snapshot(server.addr,
+                                                          ticket)
+            assert plan.fired
+        finally:
+            server.close()
+        h2, installed = dst.submit_resume(meta2, blocks2)
+        assert installed.wait(300), "resume install timed out"
+        src.complete_migration(frozen)
+        assert h2.result(timeout=300) == _solo(model, params, prompt,
+                                               n_new)
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_mid_migration_pull_dead_rolls_back_to_source(model_and_params):
+    # every pull attempt fails (destination unreachable): the source
+    # rolls the frozen session back and finishes it byte-identically
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=20)
+    prompt, n_new = [5, 4, 3, 2, 1, 6, 7], 6
+    try:
+        h = b.submit(prompt, n_new)
+        h.tokens.get(timeout=300)
+        frozen = b.freeze_session(h, timeout_s=60)
+        assert frozen is not None
+        meta, blocks = kvtransfer.wire_snapshot(
+            frozen, "m", page_size=b.kv_page_size)
+        server = kvtransfer.PageServer()
+        try:
+            ticket = server.register(meta, blocks)
+            plan = faults.FaultPlan(CHAOS_SEED).on(
+                "kvtransfer.pull", kind="oserror", nth=1, times=None)
+            with faults.active(plan):
+                for _ in range(2):                  # retries fail too
+                    with pytest.raises(OSError):
+                        kvtransfer.pull_snapshot(server.addr, ticket)
+        finally:
+            server.close()
+        assert b.rollback_migration(frozen)
+        assert h.result(timeout=300) == _solo(model, params, prompt,
+                                              n_new)
+        assert b.stats()["migrations_completed"] == 0
+    finally:
+        b.stop()
+
+
+def test_mid_resume_install_kill_rolls_back_to_source(model_and_params):
+    # the destination dies INSTALLING the pulled pages (post-transfer,
+    # pre-ack): the splice ack never arrives, so the source still owns
+    # the session and rollback must finish it byte-identically
+    model, params = model_and_params
+    kw = dict(prefill_chunk=8, kv_page_size=8, kv_pages=20)
+    src = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    prompt, n_new = [9, 8, 7, 6, 5], 6
+    try:
+        h = src.submit(prompt, n_new)
+        h.tokens.get(timeout=300)
+        frozen = src.freeze_session(h, timeout_s=60)
+        assert frozen is not None
+        meta2, blocks2 = _snapshot_via_wire(src, frozen)
+        plan = faults.FaultPlan(CHAOS_SEED).on("serve.resume_install",
+                                               kind="oserror", nth=1)
+        with faults.active(plan):
+            h2, installed = dst.submit_resume(meta2, blocks2)
+            with pytest.raises(OSError, match="injected fault"):
+                h2.result(timeout=300)
+        assert plan.fired
+        assert not installed.is_set()               # no ack: src owns it
+        with pytest.raises(RuntimeError, match="batcher died"):
+            dst.submit_replay(_replay_meta([1, 2], [3], 1))
+        assert src.rollback_migration(frozen)
+        assert h.result(timeout=300) == _solo(model, params, prompt,
+                                              n_new)
+        assert src.stats()["migrations_completed"] == 0
+    finally:
+        src.stop()
+        dst.stop()
+
+
+# ------------------------------------- randomized kill/recover soak ----
+
+def test_kill_recover_cycles_conserve_pool_and_journal(model_and_params):
+    # 100 seeded cycles of submit -> (maybe) kill mid-decode -> replay
+    # on the peer, with the gateway's StreamJournal as the tee.  After
+    # the storm: zero stranded journal entries, every kv page back in
+    # both pools (only rc-0 cached prefix pages may stay out of free),
+    # and every single stream — killed or not — byte-identical to solo.
+    model, params = model_and_params
+    kw = dict(prefill_chunk=8, kv_page_size=8, kv_pages=24)
+    a = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                **kw)
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                **kw)
+    journal = fleet.StreamJournal()
+    rng = random.Random(CHAOS_SEED)
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7, 6], [2, 4, 6, 8, 10, 12]]
+    n_new = 4
+    solos = {}
+
+    def want(prompt, temp, seed):
+        key = (tuple(prompt), temp, seed)
+        if key not in solos:
+            solos[key] = _solo(model, params, prompt, n_new,
+                               temperature=temp, seed=seed)
+        return solos[key]
+
+    recovered = 0
+    try:
+        for cycle in range(100):
+            src, dst = (a, b) if rng.random() < 0.5 else (b, a)
+            prompt = rng.choice(prompts)
+            temp, seed = rng.choice([(0.0, 0), (0.7, 5)])
+            entry = journal.journal_open({"prompt": prompt, "seed": seed})
+            h = src.submit(prompt, n_new, temperature=temp, seed=seed)
+            emitted = list(h.tokens.get(timeout=300))
+            for t in emitted:
+                journal.record(entry, t)
+            if rng.random() < 0.6 and len(emitted) < n_new:
+                h.cancel()          # replica crash mid-decode
+                h2, installed = dst.submit_replay(
+                    _replay_meta(prompt, emitted, n_new, temp=temp,
+                                 seed=seed))
+                assert installed.wait(300), \
+                    f"cycle {cycle}: replay install timed out"
+                out = h2.result(timeout=300)
+                recovered += 1
+            else:
+                out = h.result(timeout=300)
+            assert out == want(prompt, temp, seed), f"cycle {cycle}"
+            assert out[:len(prompt) + len(emitted)] == prompt + emitted
+            journal.journal_close(entry)
+        assert recovered >= 20      # the kill path actually soaked
+        assert len(journal) == 0    # zero stranded journal entries
+        for eng in (a, b):
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    eng.stats()["slots_busy"]:
+                time.sleep(0.05)
+            s = eng.stats()
+            assert s["slots_busy"] == 0
+            assert s["kv_pages_used"] == s["prefix_pages_cached"]
+    finally:
+        a.stop()
+        b.stop()

@@ -1,0 +1,261 @@
+"""Fast-tier units for the crash-tolerance plumbing: the deterministic
+fault-injection registry (:mod:`tensorflowonspark_tpu.faults`), the
+shared :class:`util.RetryPolicy` backoff schedule, the replay-key RNG
+reconstruction contract, and the graftcheck rule/lifecycle-spec
+satellites.  No sockets, no engines — the end-to-end crash/recover
+scenarios live in tests/test_chaos.py (marker-gated) and
+tests/test_fleet.py (stub replicas).
+"""
+import textwrap
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import faults, util
+from tensorflowonspark_tpu.analysis import core, resources
+from tensorflowonspark_tpu.analysis import style  # noqa: F401  (registers)
+
+
+# ------------------------------------------------------------- faults ----
+
+def test_plan_rejects_unknown_sites_kinds_and_bad_params():
+    plan = faults.FaultPlan()
+    with pytest.raises(ValueError):
+        plan.on("no.such.site")
+    with pytest.raises(ValueError):
+        plan.on("fleet.relay", kind="nuke")
+    with pytest.raises(ValueError):
+        plan.on("fleet.relay", nth=0)
+    with pytest.raises(ValueError):
+        plan.on("fleet.relay", times=0)
+    with pytest.raises(ValueError):
+        plan.on("fleet.relay", p=1.5)
+
+
+def test_disarmed_probes_are_silent_everywhere():
+    faults.disarm()
+    for site in sorted(faults.SITES):
+        faults.check(site)                 # no raise, no delay
+        assert faults.deny(site) is False
+
+
+def test_armed_probe_on_unregistered_site_is_an_error():
+    # a probe that was renamed/deleted must not silently no-op a chaos
+    # test; arming surfaces the drift immediately
+    with faults.active(faults.FaultPlan()):
+        with pytest.raises(ValueError):
+            faults.check("serve.not_a_site")
+        with pytest.raises(ValueError):
+            faults.deny("serve.not_a_site")
+
+
+def test_nth_match_fires_inside_its_window_only():
+    plan = faults.FaultPlan().on("reservation.rpc", kind="oserror",
+                                 nth=3, times=2)
+    with faults.active(plan):
+        faults.check("reservation.rpc")    # 1: before the window
+        faults.check("reservation.rpc")    # 2
+        for _ in range(2):                 # 3 and 4: the window
+            with pytest.raises(OSError):
+                faults.check("reservation.rpc")
+        faults.check("reservation.rpc")    # 5: window closed
+    assert plan.fired == [("reservation.rpc", "oserror")] * 2
+
+
+def test_times_none_keeps_firing():
+    plan = faults.FaultPlan().on("kvtransfer.pull", nth=2, times=None)
+    with faults.active(plan):
+        faults.check("kvtransfer.pull")
+        for _ in range(3):
+            with pytest.raises(OSError):
+                faults.check("kvtransfer.pull")
+
+
+def test_eof_delay_and_deny_kinds():
+    plan = (faults.FaultPlan()
+            .on("kvtransfer.relay", kind="eof", nth=1)
+            .on("serve.alloc", kind="deny", nth=1)
+            .on("fleet.forward", kind="delay", nth=1, delay_s=0.05))
+    with faults.active(plan):
+        with pytest.raises(ConnectionError):
+            faults.check("kvtransfer.relay")
+        assert faults.deny("serve.alloc") is True
+        assert faults.deny("serve.alloc") is False   # window exhausted
+        t0 = time.monotonic()
+        faults.check("fleet.forward")                # delays, no raise
+        assert time.monotonic() - t0 >= 0.04
+    assert ("serve.alloc", "deny") in plan.fired
+
+
+def test_deny_rules_and_check_probes_do_not_cross_fire():
+    # an alloc-failure rule must not turn a raise-probe into a raise,
+    # and vice versa — the two probe shapes are separate populations
+    plan = (faults.FaultPlan()
+            .on("serve.alloc", kind="deny", nth=1)
+            .on("serve.admission", kind="oserror", nth=1))
+    with faults.active(plan):
+        faults.check("serve.alloc")                  # deny rule ignored
+        assert faults.deny("serve.admission") is False
+        assert faults.deny("serve.alloc") is True
+        with pytest.raises(OSError):
+            faults.check("serve.admission")
+
+
+def test_seeded_probability_schedule_replays_exactly():
+    def schedule(seed):
+        plan = faults.FaultPlan(seed).on("fleet.relay", p=0.3,
+                                         times=None)
+        fired = []
+        with faults.active(plan):
+            for _ in range(200):
+                try:
+                    faults.check("fleet.relay")
+                    fired.append(False)
+                except OSError:
+                    fired.append(True)
+        return fired
+
+    a = schedule(7)
+    assert a == schedule(7)                # same seed, same failures
+    assert any(a) and not all(a)           # p=0.3 actually sampled
+    assert schedule(8) != a                # a different seed differs
+
+
+def test_active_contextmanager_always_disarms():
+    plan = faults.FaultPlan().on("fleet.relay", nth=1)
+    with pytest.raises(OSError):
+        with faults.active(plan):
+            faults.check("fleet.relay")
+    faults.check("fleet.relay")            # disarmed again
+
+
+# -------------------------------------------------------- RetryPolicy ----
+
+def test_retry_policy_capped_exponential_schedule():
+    pol = util.RetryPolicy(attempts=5, base_delay=0.1, cap_delay=0.4,
+                           jitter=0.0)
+    assert [pol.delay(a) for a in range(4)] == pytest.approx(
+        [0.1, 0.2, 0.4, 0.4])
+
+
+def test_retry_policy_jitter_bounds():
+    pol = util.RetryPolicy(attempts=3, base_delay=1.0, cap_delay=8.0,
+                           jitter=0.5)
+    for a in range(3):
+        base = min(8.0, 1.0 * 2 ** a)
+        for _ in range(25):
+            assert base <= pol.delay(a) <= base * 1.5
+
+
+def test_retry_policy_validates_knobs():
+    with pytest.raises(ValueError):
+        util.RetryPolicy(attempts=0)
+    with pytest.raises(ValueError):
+        util.RetryPolicy(base_delay=-1)
+    with pytest.raises(ValueError):
+        util.RetryPolicy(jitter=2.0)
+
+
+def test_sleeps_yields_attempts_without_post_final_sleep():
+    pol = util.RetryPolicy(attempts=3, base_delay=0.01, cap_delay=0.01)
+    t0 = time.monotonic()
+    assert list(pol.sleeps()) == [0, 1, 2]
+    took = time.monotonic() - t0
+    assert 0.015 <= took < 0.5             # 2 inter-try sleeps, not 3
+
+
+def test_sleeps_deadline_bars_late_tries_and_clips_sleeps():
+    pol = util.RetryPolicy(attempts=50, base_delay=0.05, cap_delay=0.05,
+                           deadline_s=0.2)
+    t0 = time.monotonic()
+    tries = list(pol.sleeps())
+    took = time.monotonic() - t0
+    assert 1 < len(tries) < 50             # deadline ended the loop early
+    assert took < 1.0
+
+
+def test_sleeps_stop_event_interrupts_backoff():
+    pol = util.RetryPolicy(attempts=5, base_delay=10.0, cap_delay=10.0)
+    stop = threading.Event()
+    seen = []
+    t0 = time.monotonic()
+    for attempt in pol.sleeps(stop=stop):
+        seen.append(attempt)
+        stop.set()                         # shutdown mid-backoff
+    assert seen == [0]
+    assert time.monotonic() - t0 < 1.0
+
+
+# ------------------------------------------- replay-key reconstruction ----
+
+def test_replay_key_matches_step_key_schedule():
+    import jax
+
+    from tensorflowonspark_tpu.models import decode
+
+    keys = decode.step_keys(jax.random.key(17), 6)
+    for t in range(6):
+        # the crash-recovery reconstruction is the SAME pure function of
+        # (seed, ordinal) the live serving chain uses — byte-identical
+        # continuation depends on exactly this identity
+        rk = decode.replay_key(17, t)
+        assert jax.random.key_data(rk).tolist() == \
+            jax.random.key_data(keys[t]).tolist()
+
+
+# ----------------------------------------- analysis-layer satellites ----
+
+def test_journal_entry_lifecycle_spec_registered():
+    spec = resources.spec_by_name("journal-entry")
+    assert spec.acquire == ("journal_open",)
+    assert spec.release == ("journal_close",)
+    assert spec.leak_check
+
+
+def _run_rule(src, path):
+    findings = core.analyze_source(textwrap.dedent(src), path=path,
+                                   rules=["swallowed-network-error"])
+    return [(f.rule, f.line) for f in findings]
+
+
+def test_swallowed_network_error_flags_recovery_modules():
+    src = """
+        def pull():
+            try:
+                fetch()
+            except Exception:
+                pass
+            try:
+                fetch()
+            except:
+                pass
+    """
+    hits = _run_rule(src, "tensorflowonspark_tpu/kvtransfer.py")
+    assert hits == [("swallowed-network-error", 5),
+                    ("swallowed-network-error", 9)]
+
+
+def test_swallowed_network_error_ignores_out_of_scope_and_narrow():
+    src = """
+        def pull():
+            try:
+                fetch()
+            except Exception:
+                pass
+    """
+    # same pattern outside the network/recovery module set: no finding
+    assert _run_rule(src, "tensorflowonspark_tpu/cluster.py") == []
+    narrow = """
+        def pull():
+            try:
+                fetch()
+            except OSError:
+                pass
+            try:
+                fetch()
+            except Exception:
+                log()
+                raise
+    """
+    assert _run_rule(narrow, "tensorflowonspark_tpu/fleet.py") == []

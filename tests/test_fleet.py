@@ -43,8 +43,14 @@ class StubReplica:
         self.generate_hits = 0
         self.generate_prompts = []
         self.migrate_headers = []   # X-Fleet-Migrate-To seen per :generate
+        self.idem_keys = []         # Idempotency-Key per :generate/:resume
+        self.resume_hits = 0
+        self.resume_requests = []   # the replay meta each :resume carried
         self.kv_export_requests = []
         self.fail_next = 0          # respond 500 to this many POSTs
+        self.die_after = None       # streaming: drop the socket after
+                                    # this many token events (crash sim)
+        self.token_delay_s = 0.0    # streaming: pause between tokens
         self.in_flight = 0
         self.draining = False
         self._lock = threading.Lock()
@@ -87,6 +93,38 @@ class StubReplica:
                 else:
                     self._send(404, {"error": self.path})
 
+            def _stream_ndjson(self, prefix, start, total, ack=False):
+                """A canned token stream, serve.py-shaped: token events
+                then a done event with the full output.  Token ``i`` of
+                a request is ALWAYS ``100 + i`` — a pure function of
+                position, like the real engine's seeded chain — so a
+                recovered continuation is byte-checkable."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def put(obj):
+                    data = json.dumps(obj).encode() + b"\n"
+                    self.wfile.write(f"{len(data):X}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+
+                if ack:
+                    put({"resumed": True})
+                toks = [100 + i for i in range(start, total)]
+                for sent, t in enumerate(toks):
+                    if (stub.die_after is not None
+                            and sent >= stub.die_after):
+                        self.connection.close()   # mid-stream crash:
+                        return                    # no done event ever
+                    if stub.token_delay_s:
+                        time.sleep(stub.token_delay_s)
+                    put({"token": t})
+                put({"done": True, "output": list(prefix) + toks})
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length) or b"{}")
@@ -112,6 +150,21 @@ class StubReplica:
                         stub.predict_hits += 1
                     self._send(200, {"predictions": [{"y": [0.0]}],
                                      "replica": stub.id})
+                elif self.path.endswith(":resume"):
+                    replay = req.get("replay") or {}
+                    with stub._lock:
+                        stub.resume_hits += 1
+                        stub.resume_requests.append(replay)
+                        stub.idem_keys.append(
+                            self.headers.get("Idempotency-Key"))
+                    seq = list(replay.get("seq", []))
+                    plen = int(replay.get("plen", 0))
+                    # continue the canned chain at the next new-token
+                    # ordinal, exactly like a real seeded replay splice
+                    self._stream_ndjson(seq, start=len(seq) - plen,
+                                        total=int(replay.get("max_new",
+                                                             0)),
+                                        ack=True)
                 elif self.path.endswith(":generate"):
                     with stub._lock:
                         stub.generate_hits += 1
@@ -119,8 +172,16 @@ class StubReplica:
                             list(req.get("inputs", [[]])[0]))
                         stub.migrate_headers.append(
                             self.headers.get("X-Fleet-Migrate-To"))
+                        stub.idem_keys.append(
+                            self.headers.get("Idempotency-Key"))
                         stub.in_flight += 1
                     try:
+                        if req.get("stream"):
+                            self._stream_ndjson(
+                                list(req.get("inputs", [[]])[0]),
+                                start=0,
+                                total=int(req.get("max_new_tokens", 4)))
+                            return
                         if stub.generate_delay_s:
                             time.sleep(stub.generate_delay_s)
                         self._send(200, {"outputs": [[1, 2, 3]],
@@ -476,6 +537,63 @@ def test_gateway_metadata_passthrough(gateway):
     assert body["model"]["engine"] == "stub"
 
 
+def test_stream_relays_and_journal_drains(gateway):
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=1)
+    prompt = [7, 8, 9, 10]
+    events = list(_client(gw).generate_stream(prompt, max_new_tokens=3))
+    assert [e["token"] for e in events if "token" in e] == [100, 101, 102]
+    assert events[-1] == {"done": True, "output": prompt + [100, 101, 102]}
+    # the relay tee journaled the stream, and the finally closed it:
+    # zero entries outlive their stream (the stranded-journal invariant)
+    assert _wait_until(lambda: len(gw.journal) == 0)
+    assert gw.fleet_stats(probe=False)["gateway"]["journal_depth"] == 0
+    # the gateway attached its journal key as the Idempotency-Key
+    assert stubs[0].idem_keys != [None]
+
+
+def test_stream_redrive_resumes_without_double_generate(gateway):
+    """Satellite regression: a re-driven session must NEVER re-run
+    :generate once tokens were emitted — recovery goes through the
+    :resume replay (same Idempotency-Key), so nothing double-generates
+    and the client's byte stream is seamless across the crash."""
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2, n_slots=4)
+    prompt = [7, 8, 9, 10]
+    affine = _affine_stub(gw, stubs, prompt)
+    other = next(s for s in stubs if s.id != affine.id)
+    affine.die_after = 2            # crash after streaming 2 tokens
+    events = list(_client(gw).generate_stream(prompt, max_new_tokens=4))
+    toks = [e["token"] for e in events if "token" in e]
+    assert toks == [100, 101, 102, 103]   # byte parity across the crash
+    assert events[-1]["done"] is True
+    assert events[-1]["output"] == prompt + toks
+    # exactly one :generate ever ran; the re-drive was a :resume replay
+    assert affine.generate_hits == 1 and other.generate_hits == 0
+    assert other.resume_hits == 1
+    [replay] = other.resume_requests
+    assert replay["seq"] == prompt + [100, 101]
+    assert replay["plen"] == len(prompt)
+    assert replay["remaining"] == 2
+    # one journal key identifies the session across both replicas
+    assert affine.idem_keys == other.idem_keys != [None]
+    assert gw.counters.get("session_redrives") == 1
+    assert gw.counters.get("sessions_recovered") == 1
+    # entry closes in the handler's finally, a beat after the last chunk
+    assert _wait_until(lambda: len(gw.journal) == 0)
+
+
+def test_stream_rejects_fast_when_fleet_dark(gateway):
+    # a fresh streaming request with nothing routable fails FAST with
+    # the typed 503 — it is never parked in the journal
+    gw, stubs, regs = gateway
+    with pytest.raises(RuntimeError) as e:
+        list(_client(gw).generate_stream([1, 2, 3]))
+    assert "503" in str(e.value)
+    assert gw.counters.get("rejected_no_replica") == 1
+    assert _wait_until(lambda: len(gw.journal) == 0)
+
+
 # ---------------------------------------------------------------- slow --
 # (sleep on heartbeat windows / spin extra replica threads)
 
@@ -492,15 +610,65 @@ def test_heartbeat_ejection_and_readmission(gateway):
     reg.stop_heartbeat()                 # crash simulation: beats stop
     assert _wait_until(lambda: state() == "ejected", timeout=5)
     assert gw.counters.get("ejections") == 1
-    # ejected (not deregistered): requests get 429 backpressure, not 503
-    status, _ = _client(gw).predict([{"x": [0.0]}])
-    assert status == 429
+    # the WHOLE fleet is dead (its one replica is ejected): typed 503
+    # + Retry-After — "come back later", not "you are overloading us"
+    status, body = _client(gw).predict([{"x": [0.0]}])
+    assert status == 503
+    assert body["type"] == "no_replica"
     # beats resume -> automatic re-admission, traffic flows again
+    # (after the cool-down: beats must stay fresh, not just blip)
     reg._client.start_heartbeat(reg.replica_id, interval=0.1)
     assert _wait_until(lambda: state() == "up", timeout=5)
     assert gw.counters.get("readmissions") == 1
     status, _ = _client(gw).predict([{"x": [0.0]}])
     assert status == 200
+    # per-replica churn counters + the anti-flap knobs are observable
+    body = gw.fleet_stats(probe=False)
+    desc = body["replicas"][s.id]
+    assert desc["ejections"] == 1 and desc["readmissions"] == 1
+    assert body["gateway"]["ejection_misses"] == 3
+    assert body["gateway"]["readmit_cooldown_s"] == pytest.approx(0.3)
+
+
+@pytest.mark.slow
+def test_stream_limbo_rescued_by_fresh_replica(gateway):
+    """All-dead mid-stream: a session whose replica crashed AND got
+    ejected with no peer alive QUEUES in the journal (instead of
+    502ing) and is re-driven the moment a replica registers."""
+    gw, stubs, regs = gateway
+    (a, areg), = _spawn(gw, stubs, regs, n=1, heartbeat_s=0.1)
+    a.die_after = 1                 # EVERY drive on A loses its socket
+    a.token_delay_s = 0.5           # ...slowly enough to outlive beats
+    c = _client(gw)
+    out = {}
+
+    def _consume():
+        try:
+            out["events"] = list(c.generate_stream([5, 5, 5, 5],
+                                                   max_new_tokens=4))
+        except Exception as e:      # surfaced in the main thread
+            out["error"] = e
+
+    t = threading.Thread(target=_consume)
+    t.start()
+    assert _wait_until(lambda: a.generate_hits == 1)
+    areg.stop_heartbeat()           # the crash: beats stop mid-stream
+    assert _wait_until(
+        lambda: gw.fleet_stats(probe=False)["replicas"][a.id]["state"]
+        == "ejected", timeout=5)
+    # the stream is now in limbo, waiting on the journal; a fresh
+    # replica registering rescues it
+    assert _wait_until(lambda: gw.counters.get("redrive_waits") > 0,
+                       timeout=10)
+    (b, _breg), = _spawn(gw, stubs, regs, n=1)
+    t.join(timeout=15)
+    assert not t.is_alive() and "error" not in out
+    toks = [e["token"] for e in out["events"] if "token" in e]
+    assert toks == [100, 101, 102, 103]
+    assert out["events"][-1]["output"] == [5, 5, 5, 5] + toks
+    assert b.resume_hits == 1 and b.generate_hits == 0
+    assert gw.counters.get("sessions_recovered") == 1
+    assert _wait_until(lambda: len(gw.journal) == 0)
 
 
 @pytest.mark.slow
@@ -550,15 +718,17 @@ def test_two_replica_fleet_acceptance(gateway):
     assert _wait_until(
         lambda: gw.fleet_stats(probe=False)["replicas"]
         .get(survivor.id, {}).get("state") == "draining")
-    # new work during the drain is refused with backpressure (the only
-    # other replica is ejected), never routed to the draining replica
+    # new work during the drain is refused with a typed 503 (one
+    # replica ejected, the other draining: NOTHING is up — this is
+    # dead-fleet, not overload), never routed to the draining replica
     req = urllib.request.Request(
         "http://%s:%d/v1/models/default:predict" % gw.http_addr,
         data=json.dumps({"instances": [{"x": [0.0]}]}).encode(),
         headers={"Content-Type": "application/json"})
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=5)
-    assert e.value.code == 429
+    assert e.value.code == 503
+    assert e.value.headers["Retry-After"] is not None
     t.join()
     dt.join()
     assert results["gen"][0] == 200      # in-flight generation completed
@@ -569,5 +739,5 @@ def test_two_replica_fleet_acceptance(gateway):
     assert counters["affinity_hits"] >= 3            # (a)
     assert counters["ejections"] >= 1                # (b)
     assert counters["drains_completed"] >= 1         # (c)
-    assert counters["rejected_429"] >= 1             # (c) backpressure
+    assert counters["rejected_no_replica"] >= 1      # (c) dead-fleet 503
     assert survivor.id not in c.fleet_stats(probe=False)[1]["replicas"]
