@@ -371,6 +371,76 @@ def bench_recover_segment(reps=5, result_timeout=600):
             statistics.median(gap_ms[1:]), n_replayed)
 
 
+def bench_sched_segment(result_timeout=600):
+    """The sched segment: a paged batcher saturated by long batch-class
+    sessions while short interactive requests land on top
+    (benchmarks.make_sched_burst / FLAGSHIP_SCHED), run twice — with the
+    freeze-based preemption controller armed and disarmed.  Reports the
+    interactive p95 queueing delay for both runs plus the park traffic
+    the armed run generated; the armed p95 being lower IS the segment's
+    story (batch work absorbs the slack).  Returns ``(on_p95_ms,
+    off_p95_ms, sessions_parked, sessions_unparked)``."""
+    from tensorflowonspark_tpu.benchmarks import make_sched_burst
+
+    out = {}
+    for armed in (True, False):
+        (batcher, batch_prompts, batch_max_new,
+         inter_prompts, inter_max_new) = make_sched_burst(preempt=armed)
+        try:
+            hs = [batcher.submit(p, batch_max_new, priority="batch")
+                  for p in batch_prompts]
+            # batch sessions own every slot before interactive arrives
+            for h in hs:
+                h.tokens.get(timeout=result_timeout)
+            ihs = []
+            for p in inter_prompts:
+                ihs.append(batcher.submit(p, inter_max_new,
+                                          priority="interactive"))
+                time.sleep(0.01)
+            for h in ihs:
+                h.result(timeout=result_timeout)
+            for h in hs:
+                h.result(timeout=result_timeout)
+            st = batcher.stats()
+            out[armed] = (st.get("qdelay_interactive_p95_ms", 0.0),
+                          st.get("sessions_parked", 0),
+                          st.get("sessions_unparked", 0))
+            assert st.get("parked_sessions", 0) == 0, \
+                "park pool did not drain back to zero"
+        finally:
+            batcher.stop()
+    return (out[True][0], out[False][0], out[True][1], out[True][2])
+
+
+def _sched_segment_setup():
+    from tensorflowonspark_tpu import serve
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_SCHED,
+                                                  make_sched_burst)
+
+    assert callable(make_sched_burst)
+    assert serve.PRIORITY_CLASSES == ("interactive", "batch")
+    d = FLAGSHIP_SCHED
+    assert d["batch_prompt_len"] + d["batch_max_new"] <= d["max_seq"]
+    assert d["inter_prompt_len"] + d["inter_max_new"] <= d["max_seq"]
+    assert d["max_seq"] % d["kv_page_size"] == 0
+    # every batch session can be parked at once, and the pool still
+    # holds pages for the interactive burst riding on top
+    assert d["kv_pages"] * d["kv_page_size"] >= 2 * d["max_seq"]
+    assert d["preempt_ms"] > 0
+    return {"config": dict(d)}
+
+
+def _sched_segment_result():
+    on_p95, off_p95, parked, unparked = bench_sched_segment()
+    return {"metric": "sched_ms", "value": round(on_p95, 1),
+            "unit": "ms p95 interactive queue delay",
+            "aux": {"sched_ms_no_preempt": round(off_p95, 1),
+                    "speedup_vs_no_preempt": round(
+                        off_p95 / on_p95, 2) if on_p95 else None,
+                    "sessions_parked": parked,
+                    "sessions_unparked": unparked}}
+
+
 def _opt_segment_setup():
     """Cheap, CPU-safe registry smoke: the segment's builders and frozen
     config resolve without building the 0.87B model or touching a
@@ -543,6 +613,12 @@ SEGMENTS = {
         "help": "crash recovery of a lost session from its token record "
                 "alone (submit_replay re-prefill to resume splice, plus "
                 "the client-visible stream gap)"},
+    "sched_ms": {
+        "run": _sched_segment_result,
+        "setup": _sched_segment_setup,
+        "help": "interactive p95 queueing delay under mixed-priority "
+                "load (freeze-based preemption parking batch sessions "
+                "vs FIFO sharing)"},
 }
 
 

@@ -137,6 +137,23 @@ SPECS = (
         acquire=("freeze_session",),
         release=("complete_migration", "rollback_migration"),
     ),
+    # Parked-session snapshots (serve.py preemption controller).
+    # `_park_gather` freezes a low-priority session and wires it into a
+    # host-side snapshot entry; the entry must reach exactly one of
+    # `_park_restore` (resumed when interactive pressure drops),
+    # `_park_discard` (engine death / shutdown — the handle fails and
+    # the gateway journal re-drives the work).  An entry that reaches
+    # neither is a stranded session: its client blocks forever on a
+    # stream nobody will ever finish.  Entries legitimately live in
+    # `_park_pool` between gather and restore — the container append is
+    # the ownership transfer.
+    ResourceSpec(
+        name="parked-session",
+        description="host-side frozen snapshot of a preempted session "
+                    "(_park_gather → _park_restore/_park_discard)",
+        acquire=("self._park_gather",),
+        release=("self._park_restore", "self._park_discard"),
+    ),
     # Gateway stream-journal entries (fleet.py).  `journal_open` admits
     # a streaming session into the re-drive journal; `journal_close`
     # retires it once the client has the final event (or the session is

@@ -283,6 +283,72 @@ def make_migrate_pair(n_slots=None, prompt_len=None, max_new=None,
     return src, dst, prompt, max_new
 
 
+# The sched_ms segment workload (bench.py --segments): a paged batcher
+# saturated by long batch-class sessions while short interactive
+# requests arrive on top — the mixed-priority contention story the
+# preemption controller exists for.  With preemption on, interactive
+# pressure parks the longest-remaining batch session (freeze → host-side
+# snapshot → resume when pressure drops); the segment reports interactive
+# p95 queueing delay with the controller on vs off.  Paged KV so parking
+# exercises the real page-pool accounting.  Frozen like FLAGSHIP_ENGINE:
+# changing any value invalidates sched_ms comparability.
+FLAGSHIP_SCHED = dict(n_slots=4, batch_sessions=4, batch_prompt_len=64,
+                      batch_max_new=96, inter_sessions=8,
+                      inter_prompt_len=32, inter_max_new=4,
+                      prefill_chunk=256, kv_page_size=32, kv_pages=64,
+                      max_seq=256, preempt_ms=5.0)
+
+
+def make_sched_burst(preempt=True, n_slots=None, prefill_chunk=None,
+                     kv_page_size=None, kv_pages=None, max_seq=None,
+                     preempt_ms=None):
+    """Build the sched_ms segment workload: one paged ContinuousBatcher
+    (preemption controller armed when ``preempt``) plus the two prompt
+    populations.  Returns ``(batcher, batch_prompts, batch_max_new,
+    inter_prompts, inter_max_new)``; the caller saturates the slots with
+    the batch population, trickles the interactive one on top, drains
+    everything, and reads per-class queueing delay from
+    ``batcher.stats()``.  Caller must ``batcher.stop()``.  Prompts are
+    distinct random garbage for the same reasons as
+    :func:`make_prefill_burst`."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_SCHED
+    n_slots = n_slots or d["n_slots"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    page = kv_page_size or d["kv_page_size"]
+    pages = kv_pages or d["kv_pages"]
+    max_seq = max_seq or d["max_seq"]
+    preempt_ms = d["preempt_ms"] if preempt_ms is None else preempt_ms
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    batcher = serve_mod.ContinuousBatcher(
+        model, params, n_slots=n_slots, read_chunk=1,
+        prefill_chunk=chunk, kv_page_size=page, kv_pages=pages,
+        preempt_ms=preempt_ms if preempt else 0.0,
+        park_capacity=d["batch_sessions"])
+    rs = np.random.RandomState(0)
+
+    def burst(n, length):
+        return [rs.randint(1, cfg.vocab_size,
+                           length).astype("int32").tolist()
+                for _ in range(n)]
+
+    batch_prompts = burst(d["batch_sessions"], d["batch_prompt_len"])
+    inter_prompts = burst(d["inter_sessions"], d["inter_prompt_len"])
+    return (batcher, batch_prompts, d["batch_max_new"],
+            inter_prompts, d["inter_max_new"])
+
+
 def make_flagship_step(batch_size=None, seq_len=None, config="v2",
                        optimizer=None):
     """Build the flagship-LM training step exactly as the driver metric

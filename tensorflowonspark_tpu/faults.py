@@ -54,6 +54,15 @@ SITES = frozenset({
     "fleet.forward",           # gateway _forward_once: proxied POST
     "fleet.relay",             # gateway streaming relay: per-event read
                                # (the Nth-token stream-break site)
+    "fleet.quota_check",       # gateway _quota_admit (deny = tenant
+                               # reads as over quota; request 429s)
+    "serve.park_gather",       # ContinuousBatcher._park_gather: snapshot
+                               # wire-out of a preempted session (a raise
+                               # rolls the freeze back — session keeps
+                               # running)
+    "serve.park_restore",      # ContinuousBatcher._park_restore: resume
+                               # of a parked session (a raise re-parks it
+                               # for a later retry)
 })
 
 KINDS = ("oserror", "eof", "delay", "deny")

@@ -47,7 +47,9 @@ Routing policy (stdlib-only, no extra deps):
   replica) — the same liveness/readiness split the replicas expose.
 """
 import argparse
+import collections
 import hashlib
+import heapq
 import http.client
 import itertools
 import json
@@ -147,6 +149,98 @@ class StreamJournal:
             return len(self._entries)
 
 
+PRIORITY_CLASSES = ("interactive", "batch")
+
+
+class WeightedFairQueue:
+    """Weighted-fair ordering for requests waiting out a saturated
+    fleet — the overload degradation path.  Classic virtual-time WFQ:
+    each waiter gets a virtual finish time ``vft = max(vtime,
+    tenant's last vft) + cost / weight(class)`` and the waiter with the
+    smallest vft goes first, so under sustained overload tenants share
+    admission slots in weight proportion (interactive 8 : batch 1 by
+    default) instead of one batch-heavy tenant absorbing every freed
+    slot — the single-FIFO failure mode this replaces.  Within one
+    tenant+class, FIFO (vft is monotone per tenant and ties break on
+    arrival sequence).
+
+    Capacity signals arrive via :meth:`wake` (the gateway calls it from
+    ``_release``); :meth:`wait_turn` blocks a waiter until it is the
+    head or its deadline passes.  Ordering is fully deterministic given
+    the enter() sequence — the unit tests drive it without timing."""
+
+    DEFAULT_WEIGHTS = {"interactive": 8.0, "batch": 1.0}
+
+    def __init__(self, weights=None):
+        self._cond = threading.Condition()
+        self._weights = dict(self.DEFAULT_WEIGHTS)
+        if weights:
+            self._weights.update(weights)
+        self._vtime = 0.0          # virtual clock: advances on departure
+        self._last_vft = {}        # tenant -> last assigned finish time
+        self._seq = itertools.count()
+        self._heap = []            # (vft, seq) — lazy-deleted on leave
+        self._live = {}            # (vft, seq) -> ticket
+
+    def enter(self, tenant, cls, cost=1.0):
+        """Assign a virtual finish time and join the wait set.  Returns
+        the ticket to pass to :meth:`wait_turn` / :meth:`leave`."""
+        with self._cond:
+            w = self._weights.get(cls) or 1.0
+            start = max(self._vtime, self._last_vft.get(tenant, 0.0))
+            vft = start + float(cost) / w
+            self._last_vft[tenant] = vft
+            key = (vft, next(self._seq))
+            ticket = {"key": key, "tenant": tenant, "cls": cls}
+            heapq.heappush(self._heap, key)
+            self._live[key] = ticket
+            return ticket
+
+    def _head_key(self):
+        while self._heap and self._heap[0] not in self._live:
+            heapq.heappop(self._heap)      # lazily drop departed keys
+        return self._heap[0] if self._heap else None
+
+    def head(self):
+        with self._cond:
+            key = self._head_key()
+            return self._live.get(key) if key is not None else None
+
+    def leave(self, ticket, served=False):
+        """Depart (served or timed out).  A served departure advances
+        the virtual clock to the ticket's finish time, so later
+        arrivals cannot be assigned finish times in the past."""
+        with self._cond:
+            self._live.pop(ticket["key"], None)
+            if served:
+                self._vtime = max(self._vtime, ticket["key"][0])
+            self._cond.notify_all()
+
+    def wake(self):
+        """Capacity may be free (a request finished): let the head
+        waiter retry its admission."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait_turn(self, ticket, timeout):
+        """Block until `ticket` is the head waiter (True) or `timeout`
+        elapses (False).  Being head only grants the RIGHT to retry
+        admission — the caller loops while the fleet stays saturated."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._head_key() == ticket["key"]:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.05))
+
+    def __len__(self):
+        with self._cond:
+            return len(self._live)
+
+
 class _Registry(reservation.Server):
     """The TFoS reservation server, re-aimed at serving-replica
     membership: REG admits a replica into the routing table, BYE
@@ -188,7 +282,9 @@ class Gateway:
                  replica_timeout_s=600.0, probe_timeout_s=5.0,
                  retry_after_s=1.0, ejection_misses=3,
                  readmit_cooldown_s=None, redrive_attempts=3,
-                 redrive_deadline_s=30.0):
+                 redrive_deadline_s=30.0, retry_after_cap_s=30.0,
+                 tenant_quota=0, tenant_quotas=None, tenant_classes=None,
+                 spill_wait_s=0.0):
         self.host, self.port = host, int(port)
         self.registry_host = registry_host or host
         self.registry_port = int(registry_port)
@@ -226,6 +322,28 @@ class Gateway:
         self.replica_timeout_s = float(replica_timeout_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self.retry_after_s = retry_after_s
+        # Retry-After is derived from the fleet's observed drain rate
+        # (completions per second over a recent window) instead of the
+        # flat constant: `retry_after_s` becomes the FLOOR and
+        # `retry_after_cap_s` bounds the estimate when the fleet is
+        # nearly wedged (a client told "come back in 20 minutes" never
+        # comes back)
+        self.retry_after_cap_s = float(retry_after_cap_s)
+        self._done_times = collections.deque(maxlen=64)
+        # ---- multi-tenant identity / admission ------------------------
+        # tenant = X-Tenant (or X-API-Key) header, "anonymous" when
+        # absent.  Class = X-Priority header when valid, else the
+        # server-side tenant->class map, else "interactive".  Quotas
+        # bound a tenant's concurrent in-flight requests: tenant_quota
+        # is the default cap (0 = off), tenant_quotas per-tenant
+        # overrides.  The WFQ orders requests waiting out a saturated
+        # fleet (spill_wait_s > 0) by weighted virtual finish time.
+        self.tenant_quota = int(tenant_quota or 0)
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self.tenant_classes = dict(tenant_classes or {})
+        self.spill_wait_s = float(spill_wait_s or 0.0)
+        self._tenant_inflight = {}
+        self._wfq = WeightedFairQueue()
         self.counters = Counters()
         self._replicas = {}
         self._lock = threading.RLock()
@@ -409,6 +527,11 @@ class Gateway:
         with self._lock:
             r.outstanding = max(0, r.outstanding - 1)
             r.requests += 1
+            # drain-rate sample for Retry-After, and a capacity signal
+            # for anyone waiting out saturation in the WFQ
+            self._done_times.append(time.monotonic())
+        self._wfq.wake()
+        with self._lock:
             if ok:
                 r.failures, r.open_until = 0, 0.0
             else:
@@ -423,6 +546,108 @@ class Gateway:
                         logger.warning("circuit OPEN for replica %s "
                                        "(%d consecutive failures)",
                                        r.id, r.failures)
+
+    def _retry_after(self):
+        """Retry-After for 429/503, from the fleet's observed drain
+        rate: with `waiting` requests already in flight, a client
+        should come back roughly when `waiting + 1` completions have
+        drained at the recent completions-per-second rate.  Clamped to
+        [retry_after_s, retry_after_cap_s]; with fewer than two recent
+        completions there is no rate to speak of — return the floor."""
+        with self._lock:
+            samples = list(self._done_times)
+            waiting = sum(r.outstanding for r in self._replicas.values())
+        waiting += len(self._wfq)
+        if len(samples) < 2:
+            return float(self.retry_after_s)
+        span = samples[-1] - samples[0]
+        if span <= 0:
+            return float(self.retry_after_s)
+        rate = (len(samples) - 1) / span       # completions per second
+        est = (waiting + 1) / rate
+        return max(float(self.retry_after_s),
+                   min(est, self.retry_after_cap_s))
+
+    # ---- multi-tenant identity + quotas ----------------------------------
+
+    @staticmethod
+    def tenant_of(headers):
+        """Tenant identity for a request: X-Tenant, else X-API-Key,
+        else "anonymous" (unauthenticated traffic shares one bucket)."""
+        return (headers.get("X-Tenant")
+                or headers.get("X-API-Key") or "anonymous")
+
+    def class_of(self, headers, tenant):
+        """Priority class: explicit X-Priority header when valid, else
+        the server-side tenant->class map, else interactive (a class
+        nobody asked for must not silently deprioritize them)."""
+        hdr = headers.get("X-Priority")
+        if hdr in PRIORITY_CLASSES:
+            return hdr
+        mapped = self.tenant_classes.get(tenant)
+        if mapped in PRIORITY_CLASSES:
+            return mapped
+        return "interactive"
+
+    def _quota_for(self, tenant):
+        q = self.tenant_quotas.get(tenant)
+        return int(q) if q is not None else self.tenant_quota
+
+    def _quota_admit(self, tenant):
+        """Count `tenant` in-flight, or raise Saturated when it is at
+        its concurrency cap (0 = unlimited).  The caller MUST pair this
+        with :meth:`_quota_release` on every exit path."""
+        if faults.deny("fleet.quota_check"):
+            self.counters.inc("rejected_quota")
+            raise Saturated("tenant %r at quota (injected)" % (tenant,))
+        quota = self._quota_for(tenant)
+        with self._lock:
+            cur = self._tenant_inflight.get(tenant, 0)
+            if quota > 0 and cur >= quota:
+                self.counters.inc("rejected_quota")
+                raise Saturated("tenant %r at quota (%d in flight)"
+                                % (tenant, cur))
+            self._tenant_inflight[tenant] = cur + 1
+
+    def _quota_release(self, tenant):
+        with self._lock:
+            cur = self._tenant_inflight.get(tenant, 0)
+            if cur <= 1:
+                self._tenant_inflight.pop(tenant, None)
+            else:
+                self._tenant_inflight[tenant] = cur - 1
+
+    def _choose_degraded(self, tenant, cls, prefix_key=None,
+                         exclude=(), roles=None):
+        """`_choose`, but a Saturated fleet degrades into a bounded
+        weighted-fair wait instead of an instant 429 (overload
+        degradation).  With spill_wait_s == 0 this IS `_choose`."""
+        try:
+            return self._choose(prefix_key, exclude, roles)
+        except Saturated:
+            if self.spill_wait_s <= 0:
+                raise
+        ticket = self._wfq.enter(tenant, cls)
+        self.counters.inc("wfq_waits")
+        deadline = time.monotonic() + self.spill_wait_s
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._wfq.wait_turn(
+                        ticket, remaining):
+                    self.counters.inc("wfq_timeouts")
+                    raise Saturated("saturated after %.1fs weighted-fair"
+                                    " wait" % self.spill_wait_s)
+                try:
+                    r = self._choose(prefix_key, exclude, roles)
+                except Saturated:
+                    continue           # head, but still no room: re-wait
+                self._wfq.leave(ticket, served=True)
+                ticket = None
+                return r
+        finally:
+            if ticket is not None:
+                self._wfq.leave(ticket, served=False)
 
     def _decode_target(self, exclude_id=None):
         """Least-loaded routable decode/mixed replica other than
@@ -493,7 +718,8 @@ class Gateway:
                 "minp": float(body.get("min_p") or 0.0),
                 "stops": body.get("stop") or [],
                 "rep": float(body.get("repetition_penalty", 1.0)),
-                "adapter": body.get("adapter")}
+                "adapter": body.get("adapter"),
+                "priority": body.get("priority")}
 
     def _synth_done(self, body, tokens):
         """The ``done`` event for a journaled session that already saw
@@ -653,7 +879,19 @@ class Gateway:
                   "ttft_count": 0, "ttft_ms_sum": 0.0,
                   "decode_steps": 0, "pipeline_depth_peak": 0,
                   "migrations_started": 0, "migrations_completed": 0,
-                  "migrations_failed": 0, "kv_pages_exported": 0}
+                  "migrations_failed": 0, "kv_pages_exported": 0,
+                  # multi-tenant scheduling: park traffic sums across
+                  # replicas; per-class latency follows the TTFT rule —
+                  # only count/sum are summable (a replica that served
+                  # no traffic in a class contributes 0, so an idle
+                  # class on one replica can't poison fleet averages)
+                  "parked_sessions": 0, "sessions_parked": 0,
+                  "sessions_unparked": 0, "park_spills": 0}
+        for cls in PRIORITY_CLASSES:
+            totals[f"ttft_{cls}_count"] = 0
+            totals[f"ttft_{cls}_ms_sum"] = 0.0
+            totals[f"qdelay_{cls}_count"] = 0
+            totals[f"qdelay_{cls}_ms_sum"] = 0.0
         for rid, (r, desc) in snap.items():
             if rid in beats:
                 desc["last_beat_age_s"] = round(now - beats[rid], 3)
@@ -699,16 +937,32 @@ class Gateway:
                     for key in ("migrations_started",
                                 "migrations_completed",
                                 "migrations_failed",
-                                "kv_pages_exported"):
+                                "kv_pages_exported",
+                                "parked_sessions", "sessions_parked",
+                                "sessions_unparked", "park_spills"):
                         totals[key] += int(gstats.get(key) or 0)
+                    for cls in PRIORITY_CLASSES:
+                        for stem in (f"ttft_{cls}", f"qdelay_{cls}"):
+                            totals[f"{stem}_count"] += int(
+                                gstats.get(f"{stem}_count") or 0)
+                            totals[f"{stem}_ms_sum"] += float(
+                                gstats.get(f"{stem}_ms_sum") or 0.0)
                 except (OSError, ValueError) as e:
                     desc["probe_error"] = str(e)
         totals["ttft_ms_sum"] = round(totals["ttft_ms_sum"], 3)
         totals["ttft_avg_ms"] = (
             round(totals["ttft_ms_sum"] / totals["ttft_count"], 3)
             if totals["ttft_count"] else 0.0)
+        for cls in PRIORITY_CLASSES:
+            for stem in (f"ttft_{cls}", f"qdelay_{cls}"):
+                n = totals[f"{stem}_count"]
+                totals[f"{stem}_ms_sum"] = round(
+                    totals[f"{stem}_ms_sum"], 3)
+                totals[f"{stem}_avg_ms"] = (
+                    round(totals[f"{stem}_ms_sum"] / n, 3) if n else 0.0)
         with self._lock:
             prefix_tokens = self._prefix_tokens
+            tenants_inflight = dict(self._tenant_inflight)
         return {"replicas": {rid: desc for rid, (_, desc) in snap.items()},
                 "totals": totals,
                 "counters": self.counters.snapshot(),
@@ -719,6 +973,11 @@ class Gateway:
                             "ejection_misses": self.ejection_misses,
                             "readmit_cooldown_s": self.readmit_cooldown_s,
                             "journal_depth": len(self.journal),
+                            "tenant_quota": self.tenant_quota,
+                            "tenants_inflight": tenants_inflight,
+                            "spill_wait_s": self.spill_wait_s,
+                            "wfq_depth": len(self._wfq),
+                            "retry_after_cap_s": self.retry_after_cap_s,
                             "registry": list(self.registry_addr or ())}}
 
 
@@ -757,18 +1016,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
     def _reject(self, e):
         gw = self.gateway
+        retry_after = str(round(gw._retry_after(), 3))
         if isinstance(e, Saturated):
             gw.counters.inc("rejected_429")
             self._send(429, {"error": str(e), "type": "saturated"},
-                       headers=[("Retry-After",
-                                 str(gw.retry_after_s))])
+                       headers=[("Retry-After", retry_after)])
         else:
             gw.counters.inc("rejected_no_replica")
             # Retry-After here too: an all-dead fleet usually heals (a
             # readmission or re-REG), so tell clients when to come back
             self._send(503, {"error": str(e), "type": "no_replica"},
-                       headers=[("Retry-After",
-                                 str(gw.retry_after_s))])
+                       headers=[("Retry-After", retry_after)])
 
     def _relay(self, conn, resp):
         """Copy a replica response through verbatim — streamed chunk by
@@ -838,7 +1096,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         except OSError:
             pass
 
-    def _stream_generate(self, body, name):
+    def _stream_generate(self, body, name, tenant="anonymous",
+                         cls="interactive"):
         """Streaming :generate is RECOVERABLE: the journal holds the
         seeded request and every token the client saw, so replica death
         re-drives the session onto a live peer instead of 502ing the
@@ -846,13 +1105,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         its client never saw partial output and can simply retry)."""
         gw = self.gateway
         gw._seed_body(body)
+        # priority rides the JOURNALED body: a re-drive after replica
+        # death must admit under the same class the first drive did
+        body.setdefault("priority", cls)
         entry = gw.journal.journal_open(body)
         try:
-            self._drive_stream(entry, name)
+            self._drive_stream(entry, name, tenant, cls)
         finally:
             gw.journal.journal_close(entry)
 
-    def _drive_stream(self, entry, name):
+    def _drive_stream(self, entry, name, tenant="anonymous",
+                      cls="interactive"):
         """Drive `entry`'s stream to completion: attempt on a chosen
         replica, and on failure re-drive — fresh :generate when no
         token was emitted yet, ``:resume``-replay otherwise — until the
@@ -877,15 +1140,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 return
             try:
                 try:
-                    r = gw._choose(prefix_key=gw.prefix_key(body),
-                                   roles=("prefill", "mixed"),
-                                   exclude=failed)
+                    r = gw._choose_degraded(
+                        tenant, cls, prefix_key=gw.prefix_key(body),
+                        roles=("prefill", "mixed"), exclude=failed)
                 except (NoReplica, Saturated):
                     if not failed:
                         raise
                     failed = set()   # only known-bad picks left: any
-                    r = gw._choose(prefix_key=gw.prefix_key(body),
-                                   roles=("prefill", "mixed"))
+                    r = gw._choose_degraded(
+                        tenant, cls, prefix_key=gw.prefix_key(body),
+                        roles=("prefill", "mixed"))
             except (NoReplica, Saturated) as e:
                 if not state["started"]:
                     # nothing sent yet: fail FAST (typed 503/429 with
@@ -1106,6 +1370,22 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b"{}"
+        # tenant identity + admission quota wrap the WHOLE request
+        # lifetime (routing through relay), so a tenant's concurrency
+        # cap counts streams for as long as they hold a replica slot
+        tenant = gw.tenant_of(self.headers)
+        cls = gw.class_of(self.headers, tenant)
+        try:
+            gw._quota_admit(tenant)
+        except Saturated as e:
+            self._reject(e)
+            return
+        try:
+            self._route_models(gw, path, body, is_generate, tenant, cls)
+        finally:
+            gw._quota_release(tenant)
+
+    def _route_models(self, gw, path, body, is_generate, tenant, cls):
         prefix_key = None
         if is_generate:
             body_obj = None
@@ -1117,10 +1397,15 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 # streaming sessions ride the journaled recovery path:
                 # replica death costs latency, not the stream
                 name = path[len("/v1/models/"):-len(":generate")]
-                self._stream_generate(body_obj, name)
+                self._stream_generate(body_obj, name, tenant, cls)
                 return
             if isinstance(body_obj, dict):
                 prefix_key = gw.prefix_key(body_obj)
+                if "priority" not in body_obj:
+                    # plant the resolved class so the replica's batcher
+                    # admits under it (explicit body values win)
+                    body_obj["priority"] = cls
+                    body = json.dumps(body_obj).encode()
         try:
             # :generate prefers prefill-capable replicas; when the pick
             # is a dedicated prefill node, plant the handoff header so
@@ -1128,7 +1413,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             # first tokens flush (the stream keeps riding this proxied
             # connection via the source's relay thread)
             roles = ("prefill", "mixed") if is_generate else None
-            r = gw._choose(prefix_key=prefix_key, roles=roles)
+            r = gw._choose_degraded(tenant, cls, prefix_key=prefix_key,
+                                    roles=roles)
         except (NoReplica, Saturated) as e:
             self._reject(e)
             return
@@ -1235,8 +1521,38 @@ def build_argparser():
     p.add_argument("--replica_timeout_s", type=float, default=600.0,
                    help="read timeout on proxied replica requests "
                         "(:generate can be long)")
+    p.add_argument("--retry_after_cap_s", type=float, default=30.0,
+                   help="cap on drain-rate-derived Retry-After values "
+                        "(429/503); the floor is retry_after_s")
+    p.add_argument("--tenant_quota", type=int, default=0,
+                   help="default per-tenant concurrent-request cap "
+                        "(0 = unlimited); X-Tenant / X-API-Key names "
+                        "the tenant")
+    p.add_argument("--tenant_class", action="append", default=None,
+                   metavar="TENANT=CLASS",
+                   help="server-side tenant->priority-class mapping "
+                        "(CLASS one of interactive|batch; repeatable); "
+                        "X-Priority on a request overrides it")
+    p.add_argument("--spill_wait_s", type=float, default=0.0,
+                   help="how long a request may wait out a saturated "
+                        "fleet in the weighted-fair queue before its "
+                        "429 (0 = reject immediately)")
     p.add_argument("--verbose", action="store_true")
     return p
+
+
+def _parse_tenant_classes(pairs):
+    """``--tenant_class A=batch --tenant_class B=interactive`` ->
+    ``{"A": "batch", "B": "interactive"}``; bad entries are errors."""
+    out = {}
+    for pair in pairs or ():
+        tenant, sep, cls = str(pair).partition("=")
+        if not sep or not tenant or cls not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"--tenant_class wants TENANT=CLASS with CLASS one of "
+                f"{PRIORITY_CLASSES}, got {pair!r}")
+        out[tenant] = cls
+    return out
 
 
 def make_gateway(args):
@@ -1256,7 +1572,13 @@ def make_gateway(args):
                                             None),
                  redrive_attempts=getattr(args, "redrive_attempts", 3),
                  redrive_deadline_s=getattr(args, "redrive_deadline_s",
-                                            30.0))
+                                            30.0),
+                 retry_after_cap_s=getattr(args, "retry_after_cap_s",
+                                           30.0),
+                 tenant_quota=getattr(args, "tenant_quota", 0),
+                 tenant_classes=_parse_tenant_classes(
+                     getattr(args, "tenant_class", None)),
+                 spill_wait_s=getattr(args, "spill_wait_s", 0.0))
     gw.start()
     return gw
 

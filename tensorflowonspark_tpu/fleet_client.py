@@ -124,19 +124,36 @@ class FleetClient:
     """Tiny stdlib HTTP client for a fleet gateway (or a bare replica —
     identical surface, which is the point of the gateway)."""
 
-    def __init__(self, host, port, model_name="default", timeout=60.0):
+    def __init__(self, host, port, model_name="default", timeout=60.0,
+                 tenant=None, priority=None):
         self.host, self.port = host, int(port)
         self.model_name = model_name
         self.timeout = timeout
+        # multi-tenant identity: X-Tenant names this client's admission
+        # bucket at the gateway; X-Priority picks its default class
+        # (interactive | batch) — per-call kwargs override both
+        self.tenant = tenant
+        self.priority = priority
 
-    def _call(self, method, path, payload=None, timeout=None):
+    def _headers(self, tenant=None, priority=None):
+        headers = {"Content-Type": "application/json"}
+        tenant = tenant if tenant is not None else self.tenant
+        priority = priority if priority is not None else self.priority
+        if tenant is not None:
+            headers["X-Tenant"] = str(tenant)
+        if priority is not None:
+            headers["X-Priority"] = str(priority)
+        return headers
+
+    def _call(self, method, path, payload=None, timeout=None,
+              tenant=None, priority=None):
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=timeout or self.timeout)
         try:
             body = json.dumps(payload).encode() if payload is not None \
                 else None
             conn.request(method, path, body=body,
-                         headers={"Content-Type": "application/json"})
+                         headers=self._headers(tenant, priority))
             resp = conn.getresponse()
             data = resp.read()
             try:
@@ -153,14 +170,15 @@ class FleetClient:
         return self._call(
             "POST", f"/v1/models/{self.model_name}:predict", payload)
 
-    def generate(self, inputs, **extra):
+    def generate(self, inputs, tenant=None, priority=None, **extra):
         payload = {"inputs": inputs}
         payload.update(extra)
         return self._call(
-            "POST", f"/v1/models/{self.model_name}:generate", payload)
+            "POST", f"/v1/models/{self.model_name}:generate", payload,
+            tenant=tenant, priority=priority)
 
     def generate_stream(self, prompt, idempotency_key=None, timeout=None,
-                        **extra):
+                        tenant=None, priority=None, **extra):
         """Streaming ``:generate`` for ONE prompt: yield decoded ndjson
         events as they arrive.  Against a gateway this is the
         session-recovery surface — the gateway journals the stream and
@@ -171,7 +189,7 @@ class FleetClient:
         instead of double-generating."""
         payload = {"inputs": [list(prompt)], "stream": True}
         payload.update(extra)
-        headers = {"Content-Type": "application/json"}
+        headers = self._headers(tenant, priority)
         if idempotency_key is not None:
             headers["Idempotency-Key"] = str(idempotency_key)
         conn = http.client.HTTPConnection(self.host, self.port,

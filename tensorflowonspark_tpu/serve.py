@@ -30,6 +30,7 @@ per-request sampling controls (``top_k``/``top_p``/``min_p``/
 token-for-token via one shared implementation.
 """
 import argparse
+import collections
 from typing import Any
 import json
 import logging
@@ -40,6 +41,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from . import faults
 
 logger = logging.getLogger(__name__)
+
+# Scheduling priority classes, lowest index = highest priority.  The
+# gateway resolves a request's class (X-Priority header or its
+# tenant->class map) and forwards it in the body; direct clients may
+# set either.  Everything else in the scheduler keys off these names.
+PRIORITY_CLASSES = ("interactive", "batch")
 
 
 def build_argparser():
@@ -176,6 +183,26 @@ def build_argparser():
     p.add_argument("--advertise_host", default=None,
                    help="host the GATEWAY should dial this replica on "
                         "(default: --host; set when binding 0.0.0.0)")
+    p.add_argument("--generate_priority_weight", type=int, default=4,
+                   help="weighted-fair admission ratio for :generate "
+                        "priority classes: admit up to N interactive "
+                        "sessions per batch-class session while both "
+                        "queues are non-empty (requests carry a class "
+                        "via X-Priority or {\"priority\": ...}; default "
+                        "class is \"interactive\")")
+    p.add_argument("--generate_preempt_ms", type=float, default=0.0,
+                   help=">0 enables the preemption controller: when the "
+                        "oldest waiting interactive admission has queued "
+                        "longer than this many ms, the lowest-priority "
+                        "running session is PARKED (freeze_session "
+                        "snapshot held host-side, its kv pages freed) "
+                        "and resumed byte-identically via the :resume "
+                        "path when interactive pressure drops")
+    p.add_argument("--generate_park_capacity", type=int, default=8,
+                   help="bounded park pool: max frozen sessions held "
+                        "host-side by the preemption controller; at "
+                        "capacity further preemptions are skipped and "
+                        "counted as park_spills")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -381,6 +408,12 @@ class ModelService:
         self._gen_lora_rank = getattr(args, "generate_lora_rank", 0) or 0
         self._gen_lora_capacity = getattr(args, "generate_lora_capacity",
                                           8) or 8
+        self._gen_prio_weight = getattr(args, "generate_priority_weight",
+                                        4) or 4
+        self._gen_preempt_ms = getattr(args, "generate_preempt_ms",
+                                       0.0) or 0.0
+        self._gen_park_capacity = getattr(args, "generate_park_capacity",
+                                          8) or 8
         self._gen_lora = {}
         for spec in (getattr(args, "generate_lora", None) or []):
             name, sep, path = spec.partition("=")
@@ -442,7 +475,10 @@ class ModelService:
                         kv_dtype=self._gen_kv_dtype,
                         paged_attn_impl=self._gen_paged_attn,
                         engine=self._gen_engine,
-                        pipeline_depth=self._gen_pipeline_depth)
+                        pipeline_depth=self._gen_pipeline_depth,
+                        prio_weight=self._gen_prio_weight,
+                        preempt_ms=self._gen_preempt_ms,
+                        park_capacity=self._gen_park_capacity)
                 except TypeError as e:
                     # genuinely not a decoder LM: the documented 404
                     logger.info(":generate unavailable: %s", e)
@@ -558,7 +594,8 @@ class ModelService:
         while gen is not None:
             st = gen.batcher.stats()
             pending = (st["slots_busy"] + st["pending"]
-                       + int(st["admitting"]))
+                       + int(st["admitting"])
+                       + int(st.get("parked_sessions", 0)))
             if pending == 0 or time.monotonic() >= deadline:
                 break
             time.sleep(poll_s)
@@ -711,7 +748,8 @@ class ContinuousBatcher:
                  prefill_budget=0, draft_model=None,
                  draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
                  lora_rank=0, lora_capacity=8, kv_dtype=None,
-                 paged_attn_impl=None, engine="async", pipeline_depth=2):
+                 paged_attn_impl=None, engine="async", pipeline_depth=2,
+                 prio_weight=4, preempt_ms=0.0, park_capacity=8):
         import itertools
         import queue as queue_mod
 
@@ -893,6 +931,33 @@ class ContinuousBatcher:
         self.prefill_budget = (int(prefill_budget or 0)
                                or self.prefill_rows * self.prefill_chunk)
         self._pending = queue_mod.Queue(max_pending)
+        # ---- SLO-aware multi-tenant scheduling ------------------------
+        # `_pending` stays the thread-safe ingress; the device thread
+        # drains it into per-class deques (`_drain_ingress`) and admits
+        # from them in weighted-fair order (`_next_item`): up to
+        # `prio_weight` interactive admissions per batch admission while
+        # both classes wait, so a batch-heavy tenant cannot starve
+        # interactive sessions but batch work never starves outright.
+        if int(prio_weight) < 1:
+            raise ValueError("prio_weight must be >= 1")
+        self.prio_weight = int(prio_weight)
+        self.preempt_ms = float(preempt_ms or 0.0)
+        if self.preempt_ms < 0:
+            raise ValueError("preempt_ms must be >= 0")
+        self.park_capacity = int(park_capacity)
+        if self.park_capacity < 1:
+            raise ValueError("park_capacity must be >= 1")
+        # device-thread-owned admission queues; stats() only len()s them
+        # graftcheck: disable-next-line=thread-race
+        self._classq = {c: collections.deque() for c in PRIORITY_CLASSES}
+        self._batch_credit = 0   # interactive picks since last batch pick
+        # preemption controller state: parked sessions are frozen
+        # host-side snapshots (no device pages held) awaiting resume;
+        # the deque is shared between the controller thread and the
+        # teardown sweeps, hence the lock
+        self._park_pool = collections.deque()
+        self._park_lock = threading.Lock()
+        self._park_depth = Gauge()
         # fixed-length lists: cells are rebound (never resized), and the
         # generation protocol below makes stale host-side reads self-
         # invalidating — cross-thread cell access is the design
@@ -908,6 +973,12 @@ class ContinuousBatcher:
         # admission->first-token latency (TTFT): percentile window +
         # monotone count/sum that GET /v1/fleet aggregates
         self._ttft = LatencyWindow()
+        # per-class windows: TTFT split by priority class, plus queueing
+        # delay (submit -> admission pick), the preemption controller's
+        # pressure signal.  count/sum are monotone and fleet-summable;
+        # percentiles stay window-local
+        self._ttft_cls = {c: LatencyWindow() for c in PRIORITY_CLASSES}
+        self._qdelay = {c: LatencyWindow() for c in PRIORITY_CLASSES}
         # device-resident chains: ONE dispatch per decoded token
         self._toks = jnp.zeros((n_slots,), jnp.int32)
         self._temps = jnp.zeros((n_slots,), jnp.float32)
@@ -980,6 +1051,21 @@ class ContinuousBatcher:
             self._host_thread = threading.Thread(
                 target=self._host_loop, name="slot-host", daemon=True)
             self._host_thread.start()
+        # the preemption controller runs on its own thread because
+        # freeze_session/submit_resume both BLOCK on device-thread acks —
+        # parking from the device or host loop would deadlock the engine
+        self._preempt_thread = None
+        if self.preempt_ms > 0:
+            if draft_model is not None:
+                raise ValueError(
+                    "preempt_ms > 0 does not compose with draft "
+                    "speculation (freeze_session cannot cut a "
+                    "speculating row) — drop --draft_export_dir or "
+                    "--generate_preempt_ms")
+            self._preempt_thread = threading.Thread(
+                target=self._preempt_loop, name="preempt-controller",
+                daemon=True)
+            self._preempt_thread.start()
         self._thread.start()
 
     def stats(self):
@@ -991,7 +1077,8 @@ class ContinuousBatcher:
         read snapshotted under its lock."""
         out = {
             "slots_busy": sum(s is not None for s in self._slots),
-            "pending": self._pending.qsize(),
+            "pending": (self._pending.qsize()
+                        + sum(len(q) for q in self._classq.values())),
             "admitting": bool(self._admissions),
             "admissions_inflight": len(self._admissions),
             "prefill_rows": self.prefill_rows,
@@ -1054,6 +1141,21 @@ class ContinuousBatcher:
         for key in ("migrations_started", "migrations_completed",
                     "migrations_failed", "kv_pages_exported"):
             out[key] = self.counters.get(key)
+        # scheduling: per-class latency windows plus preemption state.
+        # All present-at-zero so fleet aggregation never sees a replica
+        # with a missing class key
+        out["priority_weight"] = self.prio_weight
+        out["preempt_ms"] = self.preempt_ms
+        out["park_capacity"] = self.park_capacity
+        with self._park_lock:
+            out["parked_sessions"] = len(self._park_pool)
+        out["parked_sessions_peak"] = self._park_depth.peak
+        for key in ("sessions_parked", "sessions_unparked", "park_spills",
+                    "park_restore_failures"):
+            out[key] = self.counters.get(key)
+        for cls in PRIORITY_CLASSES:
+            out.update(self._ttft_cls[cls].stats(f"ttft_{cls}"))
+            out.update(self._qdelay[cls].stats(f"qdelay_{cls}"))
         # event counters (kv_sink_writes, ...) ride along by name
         out.update(self.counters.snapshot())
         return out
@@ -1164,6 +1266,8 @@ class ContinuousBatcher:
         self._thread.join(timeout)
         if self._host_thread is not None:
             self._host_thread.join(timeout)
+        if self._preempt_thread is not None:
+            self._preempt_thread.join(timeout)
         err = RuntimeError("batcher stopped")
         self._dead = self._dead or err
         adms, self._admissions = self._admissions, []
@@ -1177,6 +1281,7 @@ class ContinuousBatcher:
                 s["handle"]._fail(err)
         self._slots = [None] * self.n_slots
         self._drain_pending(err)
+        self._sweep_park_pool(err)
         self._ack_retire_waiters()
 
     def _ack_retire_waiters(self):
@@ -1200,9 +1305,13 @@ class ContinuousBatcher:
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
                adapter=None, top_k=0, top_p=1.0, min_p=0.0, stop=None,
-               repetition_penalty=1.0):
+               repetition_penalty=1.0, priority=None):
         if self._dead is not None:
             raise RuntimeError(f"batcher died: {self._dead}")
+        cls = priority or "interactive"
+        if cls not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority={priority!r} not in {PRIORITY_CLASSES}")
         if adapter is not None and not self.lora_rank:
             raise ValueError(
                 "this server has no LoRA bank (start it with "
@@ -1279,6 +1388,7 @@ class ContinuousBatcher:
             "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
             "minp": float(min_p), "stops": stops,
             "rep": float(repetition_penalty), "adapter": adapter,
+            "cls": cls,
             "t_submit": time.monotonic()})  # TTFT clock starts at submit
         if self._dead is not None:
             # the loop may have died between the check above and the put
@@ -1290,6 +1400,11 @@ class ContinuousBatcher:
     def _drain_pending(self, err):
         import queue as queue_mod
 
+        # class queues first (older items — they were pulled off
+        # `_pending` already), then the raw ingress queue
+        for q in self._classq.values():
+            while q:
+                q.popleft()["h"]._fail(err)
         while True:
             try:
                 item = self._pending.get_nowait()
@@ -1615,12 +1730,25 @@ class ContinuousBatcher:
         return adm["sizes"][adm["i"]]
 
     def _select_prefill(self):
-        """FIFO slice of the admission queue for this round: at most
-        `prefill_rows` entries whose summed next-chunk lengths fit the
-        token budget.  The HEAD is always selected (stall-free rule —
-        budget caps batching, it never blocks progress)."""
+        """Priority-aware slice of the admission queue for this round:
+        at most `prefill_rows` entries whose summed next-chunk lengths
+        fit the token budget.  The HEAD is always selected (stall-free
+        rule — budget caps batching, it never blocks progress); the
+        remaining lanes consider interactive admissions before
+        batch-class ones, stable within a class, so a single-class
+        workload keeps the sequential path's exact FIFO chunk schedule
+        (the parity baseline) while a mixed round spends the Sarathi
+        budget on interactive prompts first."""
+        if not self._admissions:
+            return []
+        rest = self._admissions[1:]
+        order = [self._admissions[0]]
+        order += [a for a in rest
+                  if (a["item"] or {}).get("cls") != "batch"]
+        order += [a for a in rest
+                  if (a["item"] or {}).get("cls") == "batch"]
         selected, spent = [], 0
-        for adm in self._admissions:
+        for adm in order:
             size = self._next_chunk_len(adm)
             if selected and (len(selected) >= self.prefill_rows
                              or spent + size > self.prefill_budget):
@@ -1762,7 +1890,9 @@ class ContinuousBatcher:
         # needs no lock beyond LatencyWindow's own)
         t0 = item.get("t_submit")
         if t0 is not None:
-            self._ttft.record(time.monotonic() - t0)
+            elapsed = time.monotonic() - t0
+            self._ttft.record(elapsed)
+            self._ttft_cls[item.get("cls") or "interactive"].record(elapsed)
         h.tokens.put([tok])
         seq = prompt + [tok]
         if (max_new <= 1 or (eos_id is not None and tok == eos_id)
@@ -1855,13 +1985,61 @@ class ContinuousBatcher:
             item["h"]._fail(e)      # idempotent if _die also sweeps it
             raise
 
+    def _drain_ingress(self, block=False):
+        """Move everything waiting on the thread-safe ingress queue into
+        the per-class admission deques.  Runs on the device thread every
+        `_admit` call — even when no row is free — so the class queues
+        (the preemption controller's pressure signal and the weighted
+        pick's input) always reflect what is actually waiting.  `block`
+        waits briefly for the FIRST item (the idle-engine wake path),
+        unless a class queue already holds work."""
+        import queue as queue_mod
+
+        if block and any(self._classq.values()):
+            block = False
+        while True:
+            try:
+                item = self._pending.get(timeout=0.05 if block else 0)
+            except queue_mod.Empty:
+                return
+            block = False
+            self._classq[item.get("cls") or "interactive"].append(item)
+
+    def _next_item(self):
+        """Weighted-fair pick across the class queues: while both
+        classes wait, up to `prio_weight` interactive admissions run per
+        batch admission (interactive wins ties; batch alone drains
+        freely).  Records the picked item's queueing delay — the
+        per-class window the preemption controller and the fleet
+        dashboards watch."""
+        inter = self._classq["interactive"]
+        batch = self._classq["batch"]
+        if inter and batch:
+            if self._batch_credit >= self.prio_weight:
+                self._batch_credit = 0
+                item = batch.popleft()
+            else:
+                self._batch_credit += 1
+                item = inter.popleft()
+        elif inter:
+            item = inter.popleft()
+        elif batch:
+            self._batch_credit = 0
+            item = batch.popleft()
+        else:
+            return None
+        t0 = item.get("t_submit")
+        if t0 is not None:
+            self._qdelay[item.get("cls") or "interactive"].record(
+                time.monotonic() - t0)
+        return item
+
     def _admit(self, block=False):
         """Pull waiting requests into the admission pipeline until it is
         `prefill_rows` wide (or rows/requests run out).  Mid-prefill
         admissions hold their row via `claimed` — a row is free only
         when no slot occupies it AND no admission is prefilling it."""
-        import queue as queue_mod
-
+        self._drain_ingress(block=block)
         claimed = {adm["row"] for adm in self._admissions}
 
         def _free_row_index():
@@ -1887,15 +2065,13 @@ class ContinuousBatcher:
             row = _free_row_index()
             if row is None:
                 return
-            try:
-                item = self._pending.get(timeout=0.05 if block else 0)
-            except queue_mod.Empty:
+            item = self._next_item()
+            if item is None:
                 return
             self._admit_one(row, item)
             if self._parked is not None:
                 return      # pool starved: later arrivals wait (FIFO)
             claimed.add(row)
-            block = False    # only the first admit may block (idle wake)
 
     def _retire(self, row, gen):
         """Retire `row` (occupant generation `gen`).  `_free_row` mutates
@@ -2151,6 +2327,192 @@ class ContinuousBatcher:
         return [s["handle"] for s in self._slots
                 if s is not None and not s.get("frozen")]
 
+    # ---- preemption controller (park / resume) --------------------------
+    # Parking reuses the migration machinery end to end: freeze_session
+    # cuts the victim at a token commit, wire_snapshot flattens the cut
+    # host-side, complete_migration frees the row AND its kv pages (a
+    # parked session holds no device state at all), and submit_resume
+    # re-admits it byte-identically when pressure drops.  Because the
+    # host tick delivers a row's tokens BEFORE freezing it, everything
+    # committed pre-park already reached the client (and the gateway's
+    # stream journal) — so if this process dies holding parked
+    # snapshots, failing their handles is enough: the journal re-drives
+    # each stream on a live replica from its token record.  Note parks
+    # ride the migration counters (migrations_completed /
+    # kv_pages_exported include them); sessions_parked/unparked count
+    # the preemption traffic itself.
+
+    def _park_gather(self, h):
+        """Cut a running session and pull its snapshot host-side.  On
+        success the row and its pages are freed and the returned entry
+        OWNS the session: every entry must reach exactly one of
+        `_park_restore` (pressure dropped) or `_park_discard`
+        (teardown / client gone) — the parked-session graftcheck lease.
+        Returns None when the session finished before the cut landed;
+        on a snapshot failure the session resumes decoding in place."""
+        from . import kvtransfer
+
+        frozen = self.freeze_session(h)
+        if frozen is None:
+            return None
+        try:
+            faults.check("serve.park_gather")
+            meta, blocks = kvtransfer.wire_snapshot(
+                frozen, "parked", self.kv_page_size)
+        except BaseException:
+            self.rollback_migration(frozen)
+            raise
+        meta["priority"] = frozen["item"].get("cls") or "interactive"
+        self.complete_migration(frozen)
+        self.counters.inc("sessions_parked")
+        return {"h": h, "meta": meta, "blocks": blocks,
+                "t_parked": time.monotonic()}
+
+    def _park_restore(self, entry):
+        """Resume a parked session through the :resume admission path
+        (byte-identical continuation) and splice the resumed stream
+        into the original client handle, which never learns its tokens
+        crossed a park/resume hop."""
+        faults.check("serve.park_restore")
+        h2, _installed = self.submit_resume(entry["meta"],
+                                            entry["blocks"])
+        self.counters.inc("sessions_unparked")
+        threading.Thread(target=self._pump_resumed,
+                         args=(entry["h"], h2),
+                         name="park-splice", daemon=True).start()
+        return h2
+
+    def _park_discard(self, entry, err=None):
+        """Drop a parked session without resuming it: fail the original
+        handle (teardown — breaking the stream is what lets the
+        gateway's journal re-drive the work elsewhere) or finish it at
+        its parked sequence (the client cancelled while parked)."""
+        h = entry["h"]
+        if err is not None:
+            h._fail(err)
+        else:
+            h._finish([int(t) for t in entry["meta"]["seq"]])
+
+    def _sweep_park_pool(self, err):
+        """stop()/_die(): every parked snapshot dies with this process;
+        failing the handles hands the sessions to the journal."""
+        with self._park_lock:
+            entries = list(self._park_pool)
+            self._park_pool.clear()
+        for entry in entries:
+            self._park_discard(entry, err)
+
+    def _pump_resumed(self, h, h2):
+        """Forward the resumed handle's stream into the original (own
+        thread per restore; exits with the resumed stream)."""
+        import queue as queue_mod
+
+        try:
+            while True:
+                if h.cancelled.is_set():
+                    h2.cancel()
+                try:
+                    batch = h2.tokens.get(timeout=0.1)
+                except queue_mod.Empty:
+                    continue
+                if batch is None:
+                    break
+                h.tokens.put(batch)
+            h._finish(h2.result(timeout=10.0))
+        except BaseException as e:
+            h._fail(e)
+
+    def _pick_victim(self):
+        """Lowest-priority running session, most remaining work first.
+        Racy scan by design — the device thread owns the slot table; a
+        stale pick just means freeze_session returns None."""
+        victim, most = None, -1
+        # graftcheck: disable-next-line=thread-race
+        for s in self._slots:
+            if s is None or s.get("frozen"):
+                continue
+            if (s["item"].get("cls") or "interactive") != "batch":
+                continue
+            h = s["handle"]
+            if h.migrate_requested.is_set() or h._done.is_set():
+                continue
+            if s["remaining"] > most:
+                victim, most = h, s["remaining"]
+        return victim
+
+    def _preempt_loop(self):
+        """Controller thread body: freeze_session and submit_resume
+        both block on device-thread acks, so preemption cannot run on
+        the engine loops — it watches from here instead."""
+        while not self._stop.is_set():
+            try:
+                self._preempt_tick()
+            except BaseException:
+                if self._stop.is_set() or self._dead is not None:
+                    return
+                logger.warning("preemption tick failed", exc_info=True)
+            self._stop.wait(0.02)
+
+    def _preempt_tick(self):
+        """One controller decision: park when the oldest waiting
+        interactive admission has queued past `preempt_ms`; resume the
+        oldest parked session when no interactive work waits and a row
+        is free.  Reads of the class deques and slot table are racy by
+        design (the device thread owns them) — a stale view shifts a
+        decision by one 20ms tick, nothing more."""
+        now = time.monotonic()
+        try:
+            head = self._classq["interactive"][0]
+        except IndexError:
+            head = None
+        if head is not None:
+            t0 = head.get("t_submit")
+            if t0 is None or (now - t0) * 1000.0 <= self.preempt_ms:
+                return
+            with self._park_lock:
+                if len(self._park_pool) >= self.park_capacity:
+                    self.counters.inc("park_spills")
+                    return
+            victim = self._pick_victim()
+            if victim is None:
+                return
+            try:
+                entry = self._park_gather(victim)
+            except TimeoutError:
+                return      # no commit landed in time; session runs on
+            except BaseException:
+                self.counters.inc("park_failures")
+                logger.warning("park failed; session continues",
+                               exc_info=True)
+                return
+            if entry is None:
+                return      # finished before the cut landed
+            with self._park_lock:
+                self._park_pool.append(entry)
+                self._park_depth.set(len(self._park_pool))
+            return
+        # no interactive pressure: resume oldest-first into a free row
+        # graftcheck: disable-next-line=thread-race
+        if not any(s is None for s in self._slots):
+            return
+        with self._park_lock:
+            if not self._park_pool:
+                return
+            entry = self._park_pool.popleft()
+            self._park_depth.set(len(self._park_pool))
+        if entry["h"].cancelled.is_set():
+            self._park_discard(entry)   # client gone while parked
+            return
+        try:
+            self._park_restore(entry)
+        except BaseException:
+            self.counters.inc("park_restore_failures")
+            logger.warning("park restore failed; session stays parked",
+                           exc_info=True)
+            with self._park_lock:
+                self._park_pool.appendleft(entry)
+                self._park_depth.set(len(self._park_pool))
+
     def submit_resume(self, meta, blocks):
         """Admission that SKIPS prefill: occupy a row with a migrated
         session's committed sequence and uploaded kv blocks.  Validates
@@ -2267,6 +2629,9 @@ class ContinuousBatcher:
             "minp": float(meta.get("minp") or 0.0),
             "stops": stops, "rep": float(meta.get("rep", 1.0)),
             "adapter": adapter, "t_submit": time.monotonic(),
+            "cls": (meta.get("priority")
+                    if meta.get("priority") in PRIORITY_CLASSES
+                    else "interactive"),
             "resume": {"seq": seq, "remaining": remaining,
                        "n_pages": n_pages, "kv": kv,
                        "installed": installed}})
@@ -2346,6 +2711,9 @@ class ContinuousBatcher:
             "minp": float(meta.get("minp") or 0.0),
             "stops": stops, "rep": float(meta.get("rep", 1.0)),
             "adapter": adapter, "t_submit": time.monotonic(),
+            "cls": (meta.get("priority")
+                    if meta.get("priority") in PRIORITY_CLASSES
+                    else "interactive"),
             # no "kv" key: _start_admission reads that as "re-prefill"
             "resume": {"seq": seq, "remaining": remaining,
                        "installed": installed}})
@@ -2761,6 +3129,7 @@ class ContinuousBatcher:
                 s["handle"]._fail(e)
         self._slots = [None] * self.n_slots
         self._drain_pending(e)
+        self._sweep_park_pool(e)
         self._ack_retire_waiters()
 
 
@@ -2838,7 +3207,8 @@ class GenerateService:
                  kv_page_size=0, kv_pages=0, quantize_mode="none",
                  lora_rank=0, lora_capacity=8, lora_adapters=None,
                  kv_dtype="auto", paged_attn_impl=None, engine="async",
-                 pipeline_depth=2):
+                 pipeline_depth=2, prio_weight=4, preempt_ms=0.0,
+                 park_capacity=8):
         import itertools
 
         self.quantize_mode = quantize_mode or "none"
@@ -2863,7 +3233,8 @@ class GenerateService:
             lora_rank=lora_rank, lora_capacity=lora_capacity,
             kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype),
             paged_attn_impl=paged_attn_impl, engine=engine or "async",
-            pipeline_depth=pipeline_depth)
+            pipeline_depth=pipeline_depth, prio_weight=prio_weight,
+            preempt_ms=preempt_ms, park_capacity=park_capacity)
         try:
             for name, path in (lora_adapters or {}).items():
                 # adapter files written by lora.save_adapters; a bad file
@@ -2968,8 +3339,12 @@ class GenerateService:
                 and 0 < rep <= 1e6):
             raise ValueError('"repetition_penalty" must be a number in '
                              "(0, 1e6] (1.0 disables)")
+        priority = req.get("priority")
+        if priority is not None and priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f'"priority" must be one of {list(PRIORITY_CLASSES)}')
         return (inputs, max_new, temperature, eos_id, seed, adapter,
-                top_k, top_p, min_p, stop, float(rep))
+                top_k, top_p, min_p, stop, float(rep), priority)
 
     def _idem_claim(self, key, h):
         """Register `h` as the live session for Idempotency-Key `key`,
@@ -3025,7 +3400,7 @@ class GenerateService:
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, min_p, stop, rep) = self._validate(req)
+         top_k, top_p, min_p, stop, rep, priority) = self._validate(req)
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
@@ -3033,7 +3408,8 @@ class GenerateService:
         h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
                                 eos_id=eos_id, seed=seed, adapter=adapter,
                                 top_k=top_k, top_p=top_p, min_p=min_p,
-                                stop=stop, repetition_penalty=rep)
+                                stop=stop, repetition_penalty=rep,
+                                priority=priority)
         self._idem_claim(idem_key, h)
         self.requests += 1
         if on_handle is not None:
@@ -3064,7 +3440,7 @@ class GenerateService:
 
     def generate(self, req):
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, min_p, stop, rep) = self._validate(req)
+         top_k, top_p, min_p, stop, rep, priority) = self._validate(req)
         seeds = self._prompt_seeds(len(inputs), seed, temperature)
         # every prompt becomes a slot request; they decode concurrently
         # with each other AND with other HTTP requests' prompts (no
@@ -3075,7 +3451,8 @@ class GenerateService:
                 handles.append(self.batcher.submit(
                     p, max_new, temperature=temperature, eos_id=eos_id,
                     seed=s, adapter=adapter, top_k=top_k, top_p=top_p,
-                    min_p=min_p, stop=stop, repetition_penalty=rep))
+                    min_p=min_p, stop=stop, repetition_penalty=rep,
+                    priority=priority))
             outs = [h.result(timeout=self.timeout_s) for h in handles]
         except Exception:
             # a failed request (one prompt too long, a timeout) must not
@@ -3257,6 +3634,12 @@ class _Handler(BaseHTTPRequestHandler):
                                         "decoder LM")})
                     return
                 idem_key = self.headers.get("Idempotency-Key")
+                prio = self.headers.get("X-Priority")
+                if is_generate and prio and "priority" not in req:
+                    # header form of the body field (the gateway resolves
+                    # a tenant's class and forwards it this way); an
+                    # invalid value 400s in _validate like the body form
+                    req["priority"] = prio
                 if is_resume:
                     # always streams: the first ndjson event is the
                     # splice ack (migration or crash replay), the rest
@@ -3358,6 +3741,20 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
         raise ValueError("--role prefill/decode does not compose with "
                          "--draft_export_dir (kv migration cannot ship "
                          "the draft model's cache)")
+    if getattr(args, "generate_priority_weight", 4) < 1:
+        raise ValueError("--generate_priority_weight must be >= 1 "
+                         "(interactive admissions per batch admission)")
+    if getattr(args, "generate_preempt_ms", 0.0) < 0:
+        raise ValueError("--generate_preempt_ms must be >= 0 "
+                         "(0 disables the preemption controller)")
+    if getattr(args, "generate_preempt_ms", 0.0) and \
+            getattr(args, "draft_export_dir", None):
+        raise ValueError("--generate_preempt_ms does not compose with "
+                         "--draft_export_dir (freeze_session cannot cut "
+                         "a speculating row)")
+    if getattr(args, "generate_park_capacity", 8) < 1:
+        raise ValueError("--generate_park_capacity must be >= 1 "
+                         "(the preemption controller's park pool bound)")
     service = ModelService(args)
     handler = type("BoundHandler", (_Handler,), {"service": service})
 
@@ -3405,6 +3802,8 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
     # disaggregation: the gateway routes :generate admissions by role and
     # plants the migrate-to header for prefill replicas
     features["role"] = getattr(args, "role", "mixed") or "mixed"
+    if getattr(args, "generate_preempt_ms", 0.0):
+        features["preempt_ms"] = args.generate_preempt_ms
     return fleet_client.register_replica(
         (ghost, int(gport)),
         args.advertise_host or args.host,

@@ -67,6 +67,9 @@ SLOW_FILES = {
     "test_ops.py",              # 47 s — pallas kernels (interpret mode)
     "test_pipeline.py",         # 45 s
     "test_pipelined_lm.py",     # 25 s
+    "test_preemption.py",       # ~90 s — mixed-priority load over a real
+    # Gateway + preemption-controller engines (decode compiles, sleeps
+    # on queueing-delay windows)
     "test_quantize.py",         # 9 s — non-core (serving-width weights);
     # moved round 5 to keep the fast tier under its 90 s budget as the
     # round's layout/sampling tests accreted onto fast files

@@ -35,11 +35,11 @@ def run(src, path="tensorflowonspark_tpu/mod.py"):
 
 # ----------------------------------------------------------- spec table ----
 
-def test_spec_registry_covers_the_seven_resources():
+def test_spec_registry_covers_the_eight_resources():
     names = {s.name for s in resources.SPECS}
     assert names == {"kv-page", "decode-slot", "lora-adapter", "socket",
                      "donated-buffer", "migration-lease",
-                     "journal-entry"}
+                     "journal-entry", "parked-session"}
     kv = resources.spec_by_name("kv-page")
     assert kv.share_map == "_page_rc" and kv.device_only
     assert resources.spec_by_name("socket").release_idempotent
@@ -49,6 +49,54 @@ def test_spec_registry_covers_the_seven_resources():
     assert lease.acquire == ("freeze_session",)
     assert set(lease.release) == {"complete_migration",
                                   "rollback_migration"}
+    park = resources.spec_by_name("parked-session")
+    assert park.acquire == ("self._park_gather",)
+    assert set(park.release) == {"self._park_restore",
+                                 "self._park_discard"}
+
+
+def test_parked_session_leak_and_pool_transfer():
+    # a parked entry dropped on the floor is a stranded session ...
+    hits, _ = run("""
+        class S:
+            def f(self, h):
+                entry = self._park_gather(h)
+                do_something()
+    """)
+    assert any(r == "lifecycle-leak" for r, _ in hits)
+    # ... but parking it in the pool transfers ownership (the controller
+    # holds it there between gather and restore), and restore/discard
+    # both retire it
+    hits, _ = run("""
+        class S:
+            def f(self, h):
+                entry = self._park_gather(h)
+                if entry is None:
+                    return
+                self._park_pool.append(entry)
+    """)
+    assert hits == []
+    hits, _ = run("""
+        class S:
+            def f(self, h):
+                entry = self._park_gather(h)
+                if entry is None:
+                    return
+                self._park_restore(entry)
+    """)
+    assert hits == []
+    # restoring AND discarding the same entry is the double-free the
+    # spec exists to catch
+    hits, _ = run("""
+        class S:
+            def f(self, h):
+                entry = self._park_gather(h)
+                if entry is None:
+                    return
+                self._park_restore(entry)
+                self._park_discard(entry)
+    """)
+    assert any(r == "lifecycle-double-free" for r, _ in hits)
 
 
 def test_migration_lease_leak_and_none_guard():

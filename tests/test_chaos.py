@@ -22,6 +22,7 @@ seeded via ``CHAOS_SEED`` so CI can run the same schedules on fixed
 seeds and a soak box can sweep new ones.
 """
 import os
+import queue
 import random
 import time
 
@@ -273,6 +274,123 @@ def test_mid_resume_install_kill_rolls_back_to_source(model_and_params):
     finally:
         src.stop()
         dst.stop()
+
+
+# ------------------------------------------- parked-session faults ----
+
+def test_replica_death_with_parked_sessions_redrives_via_journal(
+        model_and_params):
+    # the scheduler scenario: a replica dies while holding PARKED
+    # sessions (frozen snapshots host-side, no device state).  The park
+    # sweep fails their handles loudly, so the gateway journal re-drives
+    # them on a peer — byte parity, and both pools conserve kv pages.
+    model, params = model_and_params
+    kw = dict(prefill_chunk=8, kv_page_size=8, kv_pages=24)
+    src = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    journal = fleet.StreamJournal()
+    prompt, n_new = [3, 1, 4, 1, 5], 6
+    try:
+        entry = journal.journal_open({"prompt": prompt})
+        h = src.submit(prompt, n_new, priority="batch")
+        emitted = list(h.tokens.get(timeout=300))
+        parked = src._park_gather(h)         # the controller's move
+        assert parked is not None
+        src._park_pool.append(parked)
+        while True:                          # tokens committed pre-park
+            try:                             # all drained to the client
+                batch = h.tokens.get(timeout=0.2)
+            except queue.Empty:
+                break
+            if batch is None:
+                break
+            emitted.extend(batch)
+        for t in emitted:
+            journal.record(entry, t)
+        assert src.stats()["parked_sessions"] == 1
+        src.stop()                           # the crash: sweep fails h
+        with pytest.raises(RuntimeError):
+            h.result(timeout=300)
+        # journal re-drive on the peer, byte-identical past the park cut
+        h2, installed = dst.submit_replay(
+            _replay_meta(prompt, emitted, n_new))
+        assert installed.wait(300), "replay install timed out"
+        out = h2.result(timeout=300)
+        assert out == _solo(model, params, prompt, n_new)
+        assert out[:len(prompt) + len(emitted)] == prompt + emitted
+        journal.journal_close(entry)
+        assert len(journal) == 0
+        s = dst.stats()
+        assert s["kv_pages_used"] == s["prefix_pages_cached"]
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_park_gather_fault_rolls_back_and_session_completes(
+        model_and_params):
+    # the snapshot wire-out dies mid-gather: the freeze must ROLL BACK
+    # (the migration-lease discipline) and the session finish on its
+    # own row byte-identically — a failed park costs nothing
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=24)
+    prompt, n_new = [5, 4, 3, 2, 1, 6, 7], 6
+    try:
+        h = b.submit(prompt, n_new, priority="batch")
+        h.tokens.get(timeout=300)            # live mid-decode
+        plan = faults.FaultPlan(CHAOS_SEED).on("serve.park_gather",
+                                               kind="oserror", nth=1)
+        with faults.active(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                b._park_gather(h)
+        assert plan.fired == [("serve.park_gather", "oserror")]
+        assert h.result(timeout=300) == _solo(model, params, prompt,
+                                              n_new)
+        s = b.stats()
+        assert s["sessions_parked"] == 0
+        assert s["parked_sessions"] == 0
+        assert s["kv_pages_used"] == s["prefix_pages_cached"]
+    finally:
+        b.stop()
+
+
+def test_park_restore_fault_stays_parked_then_retry_succeeds(
+        model_and_params):
+    # the resume dies mid-restore: the entry must survive (re-parked for
+    # a later retry, exactly what the controller does), and the retry
+    # must continue the ORIGINAL client handle byte-identically
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=24)
+    prompt, n_new = [9, 8, 7, 6, 5], 6
+    try:
+        h = b.submit(prompt, n_new, priority="batch")
+        emitted = list(h.tokens.get(timeout=300))
+        entry = b._park_gather(h)
+        assert entry is not None
+        plan = faults.FaultPlan(CHAOS_SEED).on("serve.park_restore",
+                                               kind="oserror", nth=1)
+        with faults.active(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                b._park_restore(entry)
+        assert plan.fired == [("serve.park_restore", "oserror")]
+        b._park_restore(entry)               # the retry lands
+        out = h.result(timeout=300)          # the ORIGINAL handle
+        assert out == _solo(model, params, prompt, n_new)
+        assert out[:len(prompt) + len(emitted)] == prompt + emitted
+        s = b.stats()
+        assert s["sessions_parked"] == 1
+        assert s["sessions_unparked"] == 1
+        assert s["park_restore_failures"] == 0   # counter is the
+        # controller's; the direct probe above raised before submit
+        assert s["kv_pages_used"] == s["prefix_pages_cached"]
+    finally:
+        b.stop()
 
 
 # ------------------------------------- randomized kill/recover soak ----

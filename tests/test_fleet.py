@@ -42,6 +42,8 @@ class StubReplica:
         self.predict_hits = 0
         self.generate_hits = 0
         self.generate_prompts = []
+        self.generate_requests = []  # full :generate body per hit
+        self.extra_stats = {}        # merged over canned generate_stats
         self.migrate_headers = []   # X-Fleet-Migrate-To seen per :generate
         self.idem_keys = []         # Idempotency-Key per :generate/:resume
         self.resume_hits = 0
@@ -76,20 +78,33 @@ class StubReplica:
                                {"status": "draining" if stub.draining
                                 else "ok"})
                 elif path == "/v1/models/default":
+                    gs = {"slots_busy": stub.in_flight,
+                          "pending": 0,
+                          "prefill_tokens_shared": 7,
+                          "prefix_pages_cached": 3,
+                          "ttft_count": 4,
+                          "ttft_ms_sum": 100.0,
+                          "migrations_started": 3,
+                          "migrations_completed": 2,
+                          "migrations_failed": 1,
+                          "kv_pages_exported": 5,
+                          # per-class windows: interactive traffic only —
+                          # the batch class is EMPTY on a canned stub (no
+                          # batch keys at all), like a replica that never
+                          # served that class
+                          "ttft_interactive_count": 2,
+                          "ttft_interactive_ms_sum": 40.0,
+                          "ttft_interactive_p95_ms": 25.0,
+                          "qdelay_interactive_count": 2,
+                          "qdelay_interactive_ms_sum": 10.0,
+                          "sessions_parked": 1,
+                          "sessions_unparked": 1,
+                          "parked_sessions": 0}
+                    gs.update(stub.extra_stats)
                     self._send(200, {
                         "status": "ok",
                         "model": {"engine": "stub",
-                                  "generate_stats": {
-                                      "slots_busy": stub.in_flight,
-                                      "pending": 0,
-                                      "prefill_tokens_shared": 7,
-                                      "prefix_pages_cached": 3,
-                                      "ttft_count": 4,
-                                      "ttft_ms_sum": 100.0,
-                                      "migrations_started": 3,
-                                      "migrations_completed": 2,
-                                      "migrations_failed": 1,
-                                      "kv_pages_exported": 5}}})
+                                  "generate_stats": gs}})
                 else:
                     self._send(404, {"error": self.path})
 
@@ -170,6 +185,7 @@ class StubReplica:
                         stub.generate_hits += 1
                         stub.generate_prompts.append(
                             list(req.get("inputs", [[]])[0]))
+                        stub.generate_requests.append(dict(req))
                         stub.migrate_headers.append(
                             self.headers.get("X-Fleet-Migrate-To"))
                         stub.idem_keys.append(
@@ -581,6 +597,196 @@ def test_stream_redrive_resumes_without_double_generate(gateway):
     assert gw.counters.get("sessions_recovered") == 1
     # entry closes in the handler's finally, a beat after the last chunk
     assert _wait_until(lambda: len(gw.journal) == 0)
+
+
+def test_retry_after_floor_when_no_drain_samples(gateway):
+    # satellite: cold gateway (fewer than two completions observed) has
+    # no drain rate to derive from -> the flat constant is the FLOOR
+    gw, stubs, regs = gateway
+    assert gw._retry_after() == gw.retry_after_s
+    _spawn(gw, stubs, regs, n=1)
+    assert gw._retry_after() == gw.retry_after_s
+
+
+def test_retry_after_tracks_drain_rate_between_bounds(gateway):
+    gw, stubs, regs = gateway
+    (s, _reg), = _spawn(gw, stubs, regs, n=1)
+    now = time.monotonic()
+    # 11 completions over the last second -> 10/s drain rate; 10 ahead
+    # in line -> ~1.1s estimate, between floor (1.0) and cap (30.0)
+    gw._done_times.extend(now - 1.0 + i * 0.1 for i in range(11))
+    with gw._lock:
+        gw._replicas[s.id].outstanding = 10
+    est = gw._retry_after()
+    assert gw.retry_after_s < est < gw.retry_after_cap_s
+    assert est == pytest.approx(1.1, rel=0.05)
+
+
+def test_retry_after_cap_on_429_header(gateway):
+    # satellite: a nearly-wedged fleet (slow drain, deep line) must not
+    # tell clients "come back in 20 minutes" — the cap bounds the header
+    gw, stubs, regs = gateway
+    (s, _reg), = _spawn(gw, stubs, regs, n=1, n_slots=2)
+    now = time.monotonic()
+    gw._done_times.extend([now - 1.0, now])          # 1 completion/s
+    with gw._lock:
+        gw._replicas[s.id].outstanding = 1000        # saturated AND deep
+    req = urllib.request.Request(
+        "http://%s:%d/v1/models/default:predict" % gw.http_addr,
+        data=json.dumps({"instances": [{"x": [0.0]}]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 429
+    assert float(e.value.headers["Retry-After"]) == gw.retry_after_cap_s
+
+
+def test_wfq_weighted_order_is_deterministic():
+    # pure virtual-time ordering, no timing: a batch-heavy tenant and an
+    # interactive tenant enter interleaved; heads depart in weight
+    # proportion (interactive 8:1), FIFO within one tenant
+    q = fleet.WeightedFairQueue()
+    b = [q.enter("bulk", "batch") for _ in range(3)]      # vft 1, 2, 3
+    i = [q.enter("chat", "interactive") for _ in range(3)]  # 1/8, 2/8, 3/8
+    order = []
+    while len(q):
+        t = q.head()
+        order.append(t)
+        q.leave(t, served=True)
+    assert order == i + b                  # all interactive first, FIFO
+    # wait_turn: the head returns immediately, a non-head times out
+    q2 = fleet.WeightedFairQueue()
+    first = q2.enter("a", "interactive")
+    second = q2.enter("a", "interactive")
+    assert q2.wait_turn(first, timeout=0.5)
+    assert not q2.wait_turn(second, timeout=0.05)
+    q2.leave(first, served=True)
+    assert q2.wait_turn(second, timeout=0.5)
+    q2.leave(second, served=True)
+    # a served departure advances the virtual clock: a tenant arriving
+    # AFTER a long-queued one cannot be assigned a finish time in the past
+    q3 = fleet.WeightedFairQueue()
+    old = q3.enter("a", "batch")           # vft 1.0
+    q3.leave(old, served=True)             # vtime -> 1.0
+    late = q3.enter("b", "batch")          # vft 2.0, not 1.0
+    assert late["key"][0] == pytest.approx(2.0)
+
+
+def test_tenant_quota_caps_concurrency_and_releases(gateway):
+    gw, stubs, regs = gateway
+    gw.tenant_quota = 1
+    _spawn(gw, stubs, regs, n=1, n_slots=4, generate_delay_s=0.4)
+    c = _client(gw)
+    results = {}
+
+    def _gen():
+        results["first"] = c.generate([[1, 2, 3]], tenant="acme")
+
+    t = threading.Thread(target=_gen)
+    t.start()
+    assert _wait_until(lambda: gw._tenant_inflight.get("acme") == 1)
+    # same tenant at quota -> 429; a DIFFERENT tenant still admits
+    status, body = c.generate([[4, 5, 6]], tenant="acme")
+    assert status == 429 and body["type"] == "saturated"
+    assert gw.counters.get("rejected_quota") == 1
+    status, _ = c.generate([[4, 5, 6]], tenant="other")
+    assert status == 200
+    t.join()
+    assert results["first"][0] == 200
+    # the wrap released on every exit path: nothing left in flight
+    assert _wait_until(lambda: not gw._tenant_inflight)
+    status, _ = c.generate([[7, 8]], tenant="acme")
+    assert status == 200
+
+
+def test_priority_class_resolution_and_body_injection(gateway):
+    gw, stubs, regs = gateway
+    gw.tenant_classes["bulkco"] = "batch"
+    (s, _reg), = _spawn(gw, stubs, regs, n=1)
+    c = _client(gw)
+    # header wins; the resolved class is planted into the replica body
+    status, _ = c.generate([[1, 2]], priority="batch")
+    assert status == 200
+    assert s.generate_requests[-1]["priority"] == "batch"
+    # server-side tenant->class map when no header
+    status, _ = c.generate([[1, 2]], tenant="bulkco")
+    assert status == 200
+    assert s.generate_requests[-1]["priority"] == "batch"
+    # default: interactive
+    status, _ = c.generate([[1, 2]])
+    assert status == 200
+    assert s.generate_requests[-1]["priority"] == "interactive"
+    # an explicit body value is never overwritten by the header
+    status, _ = c._call("POST", "/v1/models/default:generate",
+                        {"inputs": [[1, 2]], "priority": "interactive"},
+                        priority="batch")
+    assert status == 200
+    assert s.generate_requests[-1]["priority"] == "interactive"
+
+
+def test_wfq_spill_wait_degrades_saturation_into_delay(gateway):
+    # overload degradation: with spill_wait_s armed, a saturated fleet
+    # parks the request in the weighted-fair queue instead of 429ing;
+    # capacity freeing within the window lets it through
+    gw, stubs, regs = gateway
+    gw.spill_wait_s = 5.0
+    (s, _reg), = _spawn(gw, stubs, regs, n=1, n_slots=2)
+    with gw._lock:
+        gw._replicas[s.id].outstanding = 4       # at the queue bound
+    c = _client(gw)
+    results = {}
+
+    def _gen():
+        results["r"] = c.generate([[1, 2, 3]], tenant="acme")
+
+    t = threading.Thread(target=_gen)
+    t.start()
+    assert _wait_until(lambda: len(gw._wfq) == 1)
+    assert gw.counters.get("wfq_waits") == 1
+    with gw._lock:                               # capacity frees up
+        gw._replicas[s.id].outstanding = 0
+    gw._wfq.wake()
+    t.join(timeout=5)
+    assert results["r"][0] == 200
+    assert len(gw._wfq) == 0
+    assert gw.counters.get("rejected_429") in (None, 0)
+
+
+def test_fleet_stats_per_class_totals_sum_and_empty_class(gateway):
+    # satellite: per-class LatencyWindow aggregation — count/ms_sum are
+    # summed across replicas, a replica with an EMPTY class contributes
+    # zero (its absence must not poison the fleet average), and
+    # percentiles are never summed into totals
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    # one replica served batch traffic too; the other never did
+    stubs[0].extra_stats = {"ttft_batch_count": 3,
+                            "ttft_batch_ms_sum": 300.0,
+                            "ttft_batch_p95_ms": 500.0,
+                            "qdelay_batch_count": 3,
+                            "qdelay_batch_ms_sum": 30.0}
+    status, body = _client(gw).fleet_stats()
+    assert status == 200
+    t = body["totals"]
+    # interactive: both replicas' canned windows summed
+    assert t["ttft_interactive_count"] == 4
+    assert t["ttft_interactive_ms_sum"] == 80.0
+    assert t["ttft_interactive_avg_ms"] == 20.0
+    assert t["qdelay_interactive_count"] == 4
+    assert t["qdelay_interactive_ms_sum"] == 20.0
+    # batch: only the one replica that served it; the empty-class
+    # replica contributed 0 rather than skewing the average
+    assert t["ttft_batch_count"] == 3
+    assert t["ttft_batch_ms_sum"] == 300.0
+    assert t["ttft_batch_avg_ms"] == 100.0
+    assert t["qdelay_batch_avg_ms"] == 10.0
+    # a per-replica p95 is window-local: it never lands in totals
+    assert "ttft_batch_p95_ms" not in t
+    assert "ttft_interactive_p95_ms" not in t
+    # park traffic sums like the migration counters
+    assert t["sessions_parked"] == 2
+    assert t["sessions_unparked"] == 2
+    assert t["parked_sessions"] == 0
 
 
 def test_stream_rejects_fast_when_fleet_dark(gateway):
