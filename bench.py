@@ -412,6 +412,78 @@ def bench_sched_segment(result_timeout=600):
     return (out[True][0], out[False][0], out[True][1], out[True][2])
 
 
+def bench_warm_segment(result_timeout=600):
+    """The warm-turn segment: 8 returning conversations through a paged
+    batcher with the host-DRAM page tier armed (benchmarks.
+    make_warm_burst / FLAGSHIP_WARM).  A throwaway burst pays the
+    compiles, then the cold pass prefills every prompt from scratch;
+    the tier is flushed and the DEVICE prefix cache dropped (each
+    full-prefix page demotes to host DRAM), so the warm pass re-running
+    the SAME prompts can only be served by host->device promotion —
+    the cross-turn prefill skip.  TTFT comes from the batcher's own
+    counters (stats() deltas, same numbers operators see).  Returns
+    ``(warm_ms, cold_ms, host_hits, tokens_skipped)``."""
+    from tensorflowonspark_tpu.benchmarks import make_warm_burst
+
+    batcher, prompts, max_new = make_warm_burst()
+    try:
+        def burst():
+            s0 = batcher.stats()
+            handles = [batcher.submit(p, max_new) for p in prompts]
+            outs = [h.result(timeout=result_timeout) for h in handles]
+            s1 = batcher.stats()
+            n = max(1, s1["ttft_count"] - s0["ttft_count"])
+            return ((s1["ttft_ms_sum"] - s0["ttft_ms_sum"]) / n, outs,
+                    s1["host_hits"] - s0["host_hits"],
+                    s1["prefill_tokens_shared"]
+                    - s0["prefill_tokens_shared"])
+
+        burst()                          # compile warmup
+        batcher._host_tier.flush()
+        batcher.drop_prefix_cache()      # forget warmup conversations
+        batcher._host_tier.clear()
+        cold_ms, cold_outs, _, _ = burst()
+        batcher._host_tier.flush()       # retirement demotes land
+        batcher.drop_prefix_cache()      # device cache -> host tier only
+        batcher._host_tier.flush()
+        warm_ms, warm_outs, host_hits, skipped = burst()
+        assert warm_outs == cold_outs, \
+            "warm pass diverged from cold pass"
+        assert host_hits > 0, "warm pass never hit the host tier"
+        return warm_ms, cold_ms, host_hits, skipped
+    finally:
+        batcher.stop()
+
+
+def _warm_segment_setup():
+    from tensorflowonspark_tpu import kvtier, serve
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_WARM,
+                                                  make_warm_burst)
+
+    assert callable(make_warm_burst)
+    assert callable(kvtier.HostPageTier)
+    assert callable(serve.ContinuousBatcher.drop_prefix_cache)
+    d = FLAGSHIP_WARM
+    assert d["prompt_len"] + d["max_new"] <= d["max_seq"]
+    assert d["max_seq"] % d["kv_page_size"] == 0
+    # every conversation's full-prefix pages must fit the host tier at
+    # once, or the warm pass silently re-prefills the evicted tail
+    assert d["prompt_len"] // d["kv_page_size"] >= 2
+    assert d["host_cache_mb"] > 0 and d["conversations"] > 0
+    return {"config": dict(d)}
+
+
+def _warm_segment_result():
+    warm_ms, cold_ms, host_hits, skipped = bench_warm_segment()
+    return {"metric": "warm_ttft_ms", "value": round(warm_ms, 1),
+            "unit": "ms/request",
+            "aux": {"cold_ttft_ms": round(cold_ms, 1),
+                    "speedup_vs_cold": round(
+                        cold_ms / warm_ms, 2) if warm_ms else None,
+                    "host_hits": host_hits,
+                    "prefill_tokens_skipped": skipped}}
+
+
 def _sched_segment_setup():
     from tensorflowonspark_tpu import serve
     from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_SCHED,
@@ -619,6 +691,12 @@ SEGMENTS = {
         "help": "interactive p95 queueing delay under mixed-priority "
                 "load (freeze-based preemption parking batch sessions "
                 "vs FIFO sharing)"},
+    "warm_ttft_ms": {
+        "run": _warm_segment_result,
+        "setup": _warm_segment_setup,
+        "help": "returning-conversation time-to-first-token with prefix "
+                "pages promoted from the host-DRAM kv tier vs a cold "
+                "full prefill"},
 }
 
 

@@ -154,6 +154,25 @@ SPECS = (
         acquire=("self._park_gather",),
         release=("self._park_restore", "self._park_discard"),
     ),
+    # Host-DRAM demoted kv pages (kvtier.py).  A demote ACQUIRES one
+    # host-page entry (`_make_entry` charges its bytes against the
+    # tier's budget); promote-commit (`discard`), LRU eviction, and
+    # `clear` all RELEASE through `_drop_entry`.  Every acquire and
+    # release must run under the tier's lock — the demote worker, the
+    # device thread's promote, and the page-server's kv:prefix reads
+    # all touch the entry map concurrently.  The normal path stores the
+    # entry into `self._entries` (container ownership transfer, like
+    # parked-session), so the interesting findings are release-without-
+    # lock and an entry dropped on an error path with its bytes still
+    # charged.
+    ResourceSpec(
+        name="host-kv-page",
+        description="host-DRAM demoted KV page entry in the "
+                    "kvtier.HostPageTier LRU pool",
+        acquire=("self._make_entry",),
+        release=("self._drop_entry",),
+        lock="_lock",
+    ),
     # Gateway stream-journal entries (fleet.py).  `journal_open` admits
     # a streaming session into the re-drive journal; `journal_close`
     # retires it once the client has the final event (or the session is

@@ -349,6 +349,67 @@ def make_sched_burst(preempt=True, n_slots=None, prefill_chunk=None,
             inter_prompts, d["inter_max_new"])
 
 
+# The warm_ttft_ms segment workload (bench.py --segments): 8 returning
+# conversations against a paged batcher with the host-DRAM page tier
+# armed.  Cold pass prefills every prompt from scratch and retires, so
+# each conversation's full-prefix pages demote to the host tier; the
+# device prefix cache is then evicted so the warm pass can ONLY be
+# served by host->device promotion.  The segment reports mean TTFT for
+# the warm pass vs the cold pass — the cross-turn prefill-skip win the
+# hierarchical kv cache exists for.  Long prompts (6 full 32-token
+# pages) so the skipped prefill dominates TTFT.  Frozen like
+# FLAGSHIP_ENGINE: changing any value invalidates warm_ttft_ms
+# comparability.
+FLAGSHIP_WARM = dict(n_slots=4, conversations=8, prompt_len=192,
+                     max_new=8, prefill_chunk=256, kv_page_size=32,
+                     kv_pages=96, host_cache_mb=256, max_seq=256)
+
+
+def make_warm_burst(n_slots=None, conversations=None, prompt_len=None,
+                    max_new=None, prefill_chunk=None, kv_page_size=None,
+                    kv_pages=None, host_cache_mb=None, max_seq=None):
+    """Build the warm_ttft_ms segment workload: one paged
+    ContinuousBatcher with the host tier armed, plus the conversation
+    prompts.  Returns ``(batcher, prompts_list, max_new)``; the caller
+    runs the burst cold (timing per-request TTFT), flushes the tier,
+    evicts the device prefix cache, re-runs the SAME burst warm, and
+    compares.  Caller must ``batcher.stop()``.  Prompts are distinct
+    random garbage for the same reasons as :func:`make_prefill_burst` —
+    prefix reuse here is exact-key, so garbage reuses as well as text."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_WARM
+    n_slots = n_slots or d["n_slots"]
+    n_conv = conversations or d["conversations"]
+    prompt_len = prompt_len or d["prompt_len"]
+    max_new = max_new or d["max_new"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    page = kv_page_size or d["kv_page_size"]
+    pages = kv_pages or d["kv_pages"]
+    cache_mb = host_cache_mb or d["host_cache_mb"]
+    max_seq = max_seq or d["max_seq"]
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    batcher = serve_mod.ContinuousBatcher(
+        model, params, n_slots=n_slots, read_chunk=1,
+        prefill_chunk=chunk, kv_page_size=page, kv_pages=pages,
+        host_cache_mb=cache_mb)
+    rs = np.random.RandomState(0)
+    prompts_list = [rs.randint(1, cfg.vocab_size,
+                               prompt_len).astype("int32").tolist()
+                    for _ in range(n_conv)]
+    return batcher, prompts_list, max_new
+
+
 def make_flagship_step(batch_size=None, seq_len=None, config="v2",
                        optimizer=None):
     """Build the flagship-LM training step exactly as the driver metric

@@ -63,6 +63,16 @@ SITES = frozenset({
     "serve.park_restore",      # ContinuousBatcher._park_restore: resume
                                # of a parked session (a raise re-parks it
                                # for a later retry)
+    "serve.host_demote",       # ContinuousBatcher._demote_pages (deny =
+                               # evicted/retired pages are dropped instead
+                               # of demoted to the host tier; later turns
+                               # just run cold)
+    "serve.host_promote",      # ContinuousBatcher._host_tier_lookup (deny
+                               # = a host-tier hit reads as a miss; the
+                               # pages prefill normally, byte-identically)
+    "kvtransfer.prefix_pull",  # pull_prefix: cross-replica kv:prefix pull
+                               # (a raise = peer unreachable; the replica
+                               # falls back to its own tier + prefill)
 })
 
 KINDS = ("oserror", "eof", "delay", "deny")

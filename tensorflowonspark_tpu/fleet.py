@@ -672,6 +672,34 @@ class Gateway:
             return None
         return self._decode_target(exclude_id=r.id)
 
+    def kv_peer_for(self, prefix_key, chosen):
+        """``host:port`` of the replica most likely to hold this
+        prefix's demoted kv pages (hierarchical kv cache), or None.
+
+        The rendezvous hash that drives prefix affinity also names the
+        replica whose HOST TIER has seen the prefix before — so when
+        routing lands elsewhere (affinity spill, role preference, the
+        affine replica saturated), the chosen replica can pull the
+        returning conversation's pages from that peer's ``kv:prefix``
+        PageServer instead of re-prefilling.  Only replicas advertising
+        ``kv_prefix_addr`` qualify; when the choice IS the affine
+        replica its own tier is already the warmest, so nothing is
+        planted."""
+        if prefix_key is None:
+            return None
+        with self._lock:
+            now = time.monotonic()
+            cands = [r for r in self._replicas.values()
+                     if r.features.get("kv_prefix_addr")
+                     and self._routable(r, now)]
+        if not cands:
+            return None
+        affine = max(cands, key=lambda r: _hrw(r.id, prefix_key))
+        if affine.id == chosen.id:
+            return None
+        self.counters.inc("kv_peer_planted")
+        return str(affine.features["kv_prefix_addr"])
+
     def prefix_key(self, body):
         """Affinity key for a :generate body: the first ``prefix_tokens``
         token ids of the first prompt (None when absent/malformed — the
@@ -876,6 +904,12 @@ class Gateway:
                   "prefill_tokens_shared": 0, "prefix_pages_cached": 0,
                   "kv_pages_used": 0, "kv_pages_free": 0,
                   "kv_sink_writes": 0,
+                  # hierarchical kv cache: page-granular hit/miss and
+                  # host-tier traffic sum across replicas (a dense or
+                  # tier-less replica contributes 0 to each)
+                  "prefix_hits": 0, "prefix_misses": 0, "host_hits": 0,
+                  "host_demotions": 0, "host_evictions": 0,
+                  "host_cache_bytes": 0, "host_pages_cached": 0,
                   "ttft_count": 0, "ttft_ms_sum": 0.0,
                   "decode_steps": 0, "pipeline_depth_peak": 0,
                   "migrations_started": 0, "migrations_completed": 0,
@@ -913,7 +947,11 @@ class Gateway:
                     # kv-pool occupancy across the fleet (paged replicas
                     # report these; dense ones contribute 0)
                     for key in ("kv_pages_used", "kv_pages_free",
-                                "kv_sink_writes"):
+                                "kv_sink_writes",
+                                "prefix_hits", "prefix_misses",
+                                "host_hits", "host_demotions",
+                                "host_evictions", "host_cache_bytes",
+                                "host_pages_cached"):
                         totals[key] += int(gstats.get(key) or 0)
                     # TTFT: only count/sum are summable across replicas
                     # (percentiles aren't — each replica keeps its own
@@ -1207,6 +1245,12 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 # disaggregation handoff rides the first drive only; a
                 # replay already lands on a decode-capable pick
                 hdrs["X-Fleet-Migrate-To"] = f"{dest.host}:{dest.port}"
+            peer = gw.kv_peer_for(gw.prefix_key(entry["body"]), r)
+            if peer is not None:
+                # hierarchical kv cache: the replica pulls the
+                # conversation's demoted pages from the affine peer's
+                # host tier before prefilling
+                hdrs["X-Fleet-KV-Peer"] = peer
         try:
             faults.check("fleet.forward")
             conn, resp = gw._request(r, "POST", path, body=payload,
@@ -1424,6 +1468,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             if dest is not None:
                 headers = {"X-Fleet-Migrate-To":
                            f"{dest.host}:{dest.port}"}
+            peer = gw.kv_peer_for(prefix_key, r)
+            if peer is not None:
+                headers = headers or {}
+                headers["X-Fleet-KV-Peer"] = peer
         ok, conn, resp_or_err = self._forward_once(r, self.path, body,
                                                    headers=headers)
         if ok:

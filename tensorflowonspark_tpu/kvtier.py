@@ -1,0 +1,234 @@
+"""Host-DRAM page tier behind the paged KV pool (hierarchical KV cache).
+
+The device-side prefix cache (serve.ContinuousBatcher._prefix) is
+HBM-only: under pool pressure rc==0 pages are evicted outright, and a
+retired session's pages go straight back to the free list — so every
+multi-turn conversation re-prefills its whole history each turn.  This
+module is the second tier: a bounded host-memory LRU pool of DEMOTED
+pages, keyed by the exact cumulative-prefix keys the device cache uses
+(nested token tuples rooted per-adapter — structural equality, so a
+host hit is as collision-proof as a device hit).
+
+Data path, in the batcher's terms:
+
+demote (device thread)
+    On prefix-page eviction and on session retirement the batcher
+    gathers the victim pages into fresh buffers (``_jitted_gather_
+    pages`` — ``jnp.take`` copies, so the pool pages can be reused
+    immediately), kicks off ``copy_to_host_async``, and hands the
+    still-device blocks to :meth:`HostPageTier.demote`.  A worker
+    thread finishes the device->host conversion OFF the device thread
+    (the async copy mostly landed by then) and inserts one entry per
+    page, evicting LRU entries to stay under the byte budget.
+
+promote (device thread)
+    On a prefix-cache miss that hits the tier, ``_try_allocate`` peeks
+    the run of matching entries, scatters them into freshly allocated
+    pool pages, splices them into ``_prefix``, and discards the host
+    copies — the tokens skip prefill entirely, byte-identical to a
+    cold run (prefix kv is a pure function of the prefix tokens, and
+    the gather->numpy->scatter round trip is exact at any kv dtype).
+
+serve (page-server thread)
+    ``kv:prefix`` pulls from peer replicas read entries with
+    :meth:`peek` (non-destructive — the conversation may return here
+    too) and ship them with kvtransfer's versioned wire format.
+
+Thread safety: one lock around the entry map; every method is safe
+from any thread.  The tier never touches device state — gathers and
+scatters stay in serve.py on the device thread.
+"""
+import logging
+import queue
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def block_name(i, path):
+    """Wire block name for page ``i``'s pool leaf ``path`` in a
+    ``kv:prefix`` snapshot (sortable: page-major, then leaf name)."""
+    return "p%05d/%s" % (i, path)
+
+
+def split_prefix_blocks(meta, blocks):
+    """Inverse of the :func:`block_name` flattening: the per-page block
+    dicts of a ``kv:prefix`` snapshot, in page order."""
+    pages = []
+    for i in range(int(meta.get("n_pages") or 0)):
+        prefix = "p%05d/" % i
+        page = {name[len(prefix):]: arr for name, arr in blocks.items()
+                if name.startswith(prefix)}
+        if not page:
+            break
+        pages.append(page)
+    return pages
+
+
+class HostPageTier:
+    """Bounded LRU pool of demoted KV pages in host memory.
+
+    Entries map a cumulative-prefix key to one page's pool-leaf blocks
+    (``{leaf path: np.ndarray[page_size, ...]}``, contiguous copies so
+    evicting an entry frees real bytes).  ``capacity_bytes`` bounds the
+    payload total; inserting past it evicts least-recently-used entries
+    first, and an entry larger than the whole budget is refused.
+    """
+
+    def __init__(self, capacity_bytes):
+        self.capacity_bytes = int(capacity_bytes)
+        if self.capacity_bytes < 1:
+            raise ValueError("host tier capacity must be >= 1 byte "
+                             "(--generate_host_cache_mb)")
+        self._lock = threading.Lock()
+        self._entries = {}       # key -> {"blocks": ..., "nbytes": n};
+        # dict preserves insertion order — move-to-end on touch makes
+        # it the LRU list with no extra structure
+        self._bytes = 0
+        self.demotions = 0       # pages inserted via the demote path
+        self.evictions = 0       # entries dropped for capacity
+        self._closed = False
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._drain,
+                                        name="kv-host-tier", daemon=True)
+        self._worker.start()
+
+    # ---- entry lifecycle (graftcheck host-kv-page resource) -----------
+    # _make_entry acquires one host-page entry (bytes charged against
+    # the budget); _drop_entry releases it.  Both only run under _lock.
+
+    def _make_entry(self, blocks):
+        entry, nbytes = {}, 0
+        for path, arr in blocks.items():
+            # unconditional copy: the caller's array may be a row slice
+            # of the batched demote gather — a view would alias mutable
+            # memory AND pin the whole [width, ...] buffer per entry
+            a = np.array(arr, order="C", copy=True)
+            entry[path] = a
+            nbytes += a.nbytes
+        self._bytes += nbytes
+        return {"blocks": entry, "nbytes": nbytes}
+
+    def _drop_entry(self, entry):
+        self._bytes -= entry["nbytes"]
+
+    # ---- public surface ------------------------------------------------
+
+    def put(self, key, blocks, demotion=False):
+        """Insert one page under ``key``; returns True when stored.
+        Duplicate keys are kept (first write wins — the content is
+        identical by keying); oversized entries are refused.  Inserting
+        past the byte budget evicts least-recently-used entries."""
+        with self._lock:
+            if self._closed or key in self._entries:
+                return False
+            entry = self._make_entry(blocks)
+            nbytes = entry["nbytes"]
+            if nbytes > self.capacity_bytes:
+                self._drop_entry(entry)
+                logger.warning("host tier refused a %d-byte page "
+                               "(capacity %d)", nbytes,
+                               self.capacity_bytes)
+                return False
+            self._entries[key] = entry
+            while self._bytes > self.capacity_bytes:
+                victim = next(iter(self._entries))
+                dropped = self._entries.pop(victim)
+                self._drop_entry(dropped)
+                self.evictions += 1
+            if key not in self._entries:
+                return False         # budget so tight we evicted ourselves
+            if demotion:
+                self.demotions += 1
+            return True
+
+    def contains(self, key):
+        with self._lock:
+            return key in self._entries
+
+    def peek(self, key):
+        """The page blocks for ``key`` (LRU-bumped), or None.  The
+        entry STAYS cached — cross-replica pulls and promote lookups
+        read through here; only the promote commit discards."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return None
+            self._entries[key] = entry        # move to MRU end
+            return entry["blocks"]
+
+    def discard(self, key):
+        """Drop ``key``'s entry if present (the promote commit: the
+        page lives in the device prefix cache again)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._drop_entry(entry)
+
+    def clear(self):
+        with self._lock:
+            for entry in self._entries.values():
+                self._drop_entry(entry)
+            self._entries.clear()
+
+    # ---- demote path ----------------------------------------------------
+
+    def demote(self, keys, kv, n):
+        """Queue ``n`` gathered pages for insertion.  ``kv`` maps pool
+        leaf path -> array of shape ``[width, ...]`` (width >= n; pad
+        rows are sink garbage and ignored) — device arrays whose
+        ``copy_to_host_async`` the caller already kicked off, so the
+        worker's ``np.asarray`` mostly finds the bytes waiting."""
+        if self._closed or n < 1:
+            return 0
+        self._q.put(("demote", list(keys[:n]), kv, int(n)))
+        return n
+
+    def flush(self, timeout=30.0):
+        """Block until every demote queued so far is applied (tests and
+        shutdown; the data path never waits on the tier)."""
+        ev = threading.Event()
+        self._q.put(("flush", ev))
+        return ev.wait(timeout)
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                if item[0] == "flush":
+                    item[1].set()
+                    continue
+                _, keys, kv, n = item
+                host = {path: np.asarray(arr) for path, arr in kv.items()}
+                for i, key in enumerate(keys):
+                    if i >= n:
+                        break
+                    self.put(key, {path: a[i] for path, a in host.items()},
+                             demotion=True)
+            except Exception:
+                # a poisoned demote must not kill the worker: the tier
+                # degrades to a smaller cache, never to a dead thread
+                logger.warning("host tier demote failed", exc_info=True)
+
+    # ---- observability / teardown ---------------------------------------
+
+    def stats(self):
+        with self._lock:
+            return {"host_cache_bytes": int(self._bytes),
+                    "host_cache_capacity_bytes": self.capacity_bytes,
+                    "host_pages_cached": len(self._entries),
+                    "host_demotions": int(self.demotions),
+                    "host_evictions": int(self.evictions)}
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._q.put(None)
+        self._worker.join(timeout=5.0)
+        self.clear()

@@ -180,15 +180,47 @@ def pull_snapshot(addr, ticket, timeout=30.0):
         sock.close()
 
 
+def pull_prefix(addr, tokens, page_size, timeout=10.0):
+    """Dial a peer's :class:`PageServer` and pull its host-tier prefix
+    pages for ``tokens``.  Returns ``(meta, pages)`` where ``pages`` is
+    a list of per-page block dicts in page order (possibly empty — a
+    cold peer is a valid answer, not an error)."""
+    faults.check("kvtransfer.prefix_pull")
+    from . import kvtier
+
+    msock = KvSocket()
+    sock = socket.create_connection((addr[0], int(addr[1])),
+                                    timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        msock.send(sock, {"kind": "prefix",
+                          "tokens": [int(t) for t in tokens],
+                          "page_size": int(page_size)})
+        meta, blocks = read_snapshot(msock, sock)
+        return meta, kvtier.split_prefix_blocks(meta, blocks)
+    finally:
+        sock.close()
+
+
 class PageServer:
     """Serves registered KV snapshots to destinations that pull them.
 
     One per replica, bound lazily on the serving interface.  Tickets
     stay registered until the engine releases them, so a retried
     ``:resume`` can re-pull the same frozen bytes.
+
+    Beyond the ticketed migration pull, the server answers ``kv:prefix``
+    requests (``{"kind": "prefix", "tokens": [...], "page_size": P}``)
+    through ``prefix_provider`` — a callback returning ``(meta,
+    blocks)`` for the longest run of host-tier prefix pages matching
+    the token list (serve.py wires the batcher's host tier in).  The
+    request ships the ACTUAL tokens, not hashes: the provider recomputes
+    the exact cumulative keys, so a cross-replica hit is as
+    collision-proof as a local one.
     """
 
-    def __init__(self, host="127.0.0.1"):
+    def __init__(self, host="127.0.0.1", prefix_provider=None):
+        self.prefix_provider = prefix_provider
         self._sock = util.bind_socket(host)
         self.addr = self._sock.getsockname()[:2]
         self._msock = KvSocket()
@@ -225,6 +257,22 @@ class PageServer:
         try:
             sock.settimeout(60.0)
             req = self._msock.receive(sock)
+            if req.get("kind") == "prefix":
+                provider = self.prefix_provider
+                if provider is None:
+                    self._msock.send(sock, {"kind": "err", "error":
+                                            "no kv:prefix provider"})
+                    return
+                try:
+                    meta, blocks = provider(
+                        [int(t) for t in (req.get("tokens") or [])],
+                        int(req.get("page_size") or 0))
+                except Exception as e:
+                    self._msock.send(sock, {"kind": "err", "error":
+                                            f"{type(e).__name__}: {e}"})
+                    return
+                write_snapshot(self._msock, sock, meta, blocks)
+                return
             with self._lock:
                 entry = self._tickets.get(req.get("ticket"))
             if req.get("kind") != "pull" or entry is None:
@@ -256,13 +304,15 @@ class MigrationEngine:
     """
 
     def __init__(self, batcher, model_name="default", host="127.0.0.1",
-                 advertise_host=None, timeout_s=30.0, retries=1):
+                 advertise_host=None, timeout_s=30.0, retries=1,
+                 prefix_provider=None):
         self.batcher = batcher
         self.model_name = model_name
         self.timeout_s = float(timeout_s)
         self.retries = int(retries)
         self._host = host or "127.0.0.1"
         self._advertise_host = advertise_host or self._host
+        self._prefix_provider = prefix_provider
         self._server = None
         self._server_lock = threading.Lock()
         self._closed = False
@@ -273,8 +323,14 @@ class MigrationEngine:
             if self._server is None:
                 if self._closed:
                     raise RuntimeError("migration engine is closed")
-                self._server = PageServer(self._host)
+                self._server = PageServer(
+                    self._host, prefix_provider=self._prefix_provider)
             return self._server
+
+    def prefix_addr(self):
+        """``host:port`` peers should dial for ``kv:prefix`` pulls
+        (forces the lazy PageServer bind)."""
+        return "%s:%d" % (self._advertise_host, self.server.addr[1])
 
     def migrate(self, handle, dest, timeout_s=None, retries=None):
         """Move one live session to ``dest`` = ``(host, port)``.

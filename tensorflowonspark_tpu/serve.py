@@ -123,6 +123,17 @@ def build_argparser():
                         "each (requires --generate_kv_pages)")
     p.add_argument("--generate_kv_pages", type=int, default=0,
                    help="pool size (pages) for --generate_kv_page_size")
+    p.add_argument("--generate_host_cache_mb", type=int, default=0,
+                   help=">0 enables the host-DRAM KV page tier behind "
+                        "the paged pool: evicted and retired full-prefix "
+                        "pages are demoted into a bounded host-side LRU "
+                        "cache of this many MiB and promoted back into "
+                        "the prefix cache (skipping their prefill "
+                        "entirely) when a later prompt shares the "
+                        "prefix — warm multi-turn TTFT becomes a "
+                        "page-in instead of an O(history) re-prefill.  "
+                        "Requires --generate_kv_page_size; also serves "
+                        "peers' kv:prefix pulls when fleet-registered")
     p.add_argument("--generate_paged_attn", choices=["kernel", "einsum"],
                    default=None,
                    help="paged kv READ path: \"kernel\" (default) = the "
@@ -400,6 +411,8 @@ class ModelService:
         self._gen_timeout_s = getattr(args, "generate_timeout_s", None)
         self._gen_kv_page_size = getattr(args, "generate_kv_page_size", 0)
         self._gen_kv_pages = getattr(args, "generate_kv_pages", 0)
+        self._gen_host_cache_mb = getattr(args, "generate_host_cache_mb",
+                                          0) or 0
         self._gen_kv_dtype = getattr(args, "generate_kv_dtype",
                                      "auto") or "auto"
         self._gen_paged_attn = getattr(args, "generate_paged_attn", None)
@@ -468,6 +481,7 @@ class ModelService:
                         request_timeout_s=self._gen_timeout_s,
                         kv_page_size=self._gen_kv_page_size,
                         kv_pages=self._gen_kv_pages,
+                        host_cache_mb=self._gen_host_cache_mb,
                         quantize_mode=self._gen_quantize,
                         lora_rank=self._gen_lora_rank,
                         lora_capacity=self._gen_lora_capacity,
@@ -512,7 +526,11 @@ class ModelService:
                     host=host,
                     advertise_host=(self._advertise_host
                                     or ("127.0.0.1"
-                                        if host == "0.0.0.0" else host)))
+                                        if host == "0.0.0.0" else host)),
+                    # kv:prefix pulls read the batcher's host tier (an
+                    # empty answer when the tier is off/cold — peers
+                    # just prefill)
+                    prefix_provider=gen.batcher.host_prefix_provider)
             return self._migrator
 
     def kv_export(self, body):
@@ -747,6 +765,7 @@ class ContinuousBatcher:
                  read_chunk=8, prefill_chunk=512, prefill_rows=4,
                  prefill_budget=0, draft_model=None,
                  draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
+                 host_cache_mb=0,
                  lora_rank=0, lora_capacity=8, kv_dtype=None,
                  paged_attn_impl=None, engine="async", pipeline_depth=2,
                  prio_weight=4, preempt_ms=0.0, park_capacity=8):
@@ -791,6 +810,11 @@ class ContinuousBatcher:
             raise ValueError(
                 "kv_page_size > 0 requires kv_pages >= 1 (the shared "
                 "pool's size; --generate_kv_pages on the CLI)")
+        if int(host_cache_mb or 0) > 0 and not self.kv_page_size:
+            raise ValueError(
+                "host_cache_mb > 0 requires a paged kv cache "
+                "(--generate_kv_page_size): the host tier holds "
+                "demoted PAGES")
         if self.kv_page_size:
             # PAGED kv: rows draw pages from a shared pool sized by
             # kv_pages instead of reserving max_seq_len each — n_slots
@@ -827,6 +851,18 @@ class ContinuousBatcher:
             self._row_shared_n = [0] * n_slots
             self._row_prefix_keys = [None] * n_slots
             self.prefill_tokens_shared = 0
+            # host-DRAM page tier (hierarchical kv cache): evicted and
+            # retired full-prefix pages demote into this bounded LRU
+            # pool and promote back on a later prefix match, skipping
+            # their prefill.  The tier is its own module (kvtier) —
+            # the batcher only gathers/scatters on the device thread
+            if int(host_cache_mb or 0) > 0:
+                from . import kvtier
+
+                self._host_tier = kvtier.HostPageTier(
+                    int(host_cache_mb) << 20)
+            else:
+                self._host_tier = None
             max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
             self._sink_entries = jnp.full((max_pages,), self._sink,
                                           jnp.int32)
@@ -837,6 +873,7 @@ class ContinuousBatcher:
         else:
             self.slot_model, self._cache = decode_mod.init_slot_cache(
                 model, n_slots, kv_dtype=kv_dtype)
+            self._host_tier = None
         # swap-to-None teardown in stop()/_die() runs after the worker
         # threads are joined/dead (happens-after, not a live race)
         # graftcheck: disable-next-line=thread-race
@@ -1120,6 +1157,22 @@ class ContinuousBatcher:
             out["admission_waiting_for_pages"] = self._parked is not None
             out["prefix_pages_cached"] = len(self._prefix)
             out["prefill_tokens_shared"] = self.prefill_tokens_shared
+            # hierarchical kv cache: page-granular hit accounting
+            # (device-cache hits / host-tier promotions / cold-prefilled
+            # full pages) plus the host tier's own gauges.  All present-
+            # at-zero — fleet totals and dashboards must see them on a
+            # replica that has not served a warm turn yet (or runs with
+            # the tier disabled)
+            for key in ("prefix_hits", "prefix_misses", "host_hits"):
+                out[key] = self.counters.get(key)
+            tier = self._host_tier
+            tstats = tier.stats() if tier is not None else {}
+            out["host_cache_bytes"] = int(
+                tstats.get("host_cache_bytes", 0))
+            out["host_pages_cached"] = int(
+                tstats.get("host_pages_cached", 0))
+            out["host_demotions"] = int(tstats.get("host_demotions", 0))
+            out["host_evictions"] = int(tstats.get("host_evictions", 0))
             # explicit (not just via the counter fold): present-at-zero
             # so dashboards see the gauge before the first sink write
             out["kv_sink_writes"] = self.counters.get("kv_sink_writes")
@@ -1283,6 +1336,8 @@ class ContinuousBatcher:
         self._drain_pending(err)
         self._sweep_park_pool(err)
         self._ack_retire_waiters()
+        if self._host_tier is not None:
+            self._host_tier.close()
 
     def _ack_retire_waiters(self):
         """Release any host-side `_retire` waiter after the device thread
@@ -1530,21 +1585,179 @@ class ContinuousBatcher:
 
     def _evict_cached_pages(self, want):
         """Free up to `want` pages by evicting rc==0 cached prefix pages,
-        least recently used first.  Returns number freed."""
+        least recently used first.  Returns number freed.  With the host
+        tier enabled, victims DEMOTE before their pool pages are reused:
+        the gather snapshots their bytes into fresh buffers, so the tier
+        keeps serving the prefix after the device copy is overwritten."""
         evictable = sorted(
             (k for k, p in self._prefix.items()
              if self._page_rc.get(p, 0) == 0),
             key=lambda k: self._prefix_lru.get(k, 0))
-        freed = 0
-        for key in evictable:
-            if freed >= want:
-                break
-            page = self._prefix.pop(key)
+        victims = [(k, self._prefix[k]) for k in evictable[:max(0, want)]]
+        if not victims:
+            return 0
+        self._demote_pages([k for k, _ in victims],
+                           [p for _, p in victims])
+        for key, page in victims:
+            self._prefix.pop(key)
             self._prefix_lru.pop(key, None)
             self._page_rc.pop(page, None)
             self._free_pages.append(page)
-            freed += 1
-        return freed
+        return len(victims)
+
+    def _demote_pages(self, keys, pages):
+        """Device thread: snapshot `pages` (still device-valid — the
+        caller frees them only AFTER this returns) and hand them to the
+        host tier.  One batched gather covers every victim; the jitted
+        take produces fresh buffers, and copy_to_host_async starts the
+        device->host move under the continuing decode steps so the
+        tier's worker mostly finds the bytes waiting.  Best-effort by
+        design: any failure just means those prefixes run cold later."""
+        tier = self._host_tier
+        if tier is None or not keys:
+            return
+        todo = [(k, p) for k, p in zip(keys, pages)
+                if not tier.contains(k)]
+        if not todo or faults.deny("serve.host_demote"):
+            return
+        import jax.numpy as jnp
+
+        n = len(todo)
+        width = _pow2_width(n)
+        ids = jnp.asarray([p for _, p in todo]
+                          + [self._sink] * (width - n), jnp.int32)
+        try:
+            kv = self._gather_kv(self._cache, ids)
+        except Exception:
+            logger.warning("host-tier demote gather failed",
+                           exc_info=True)
+            return
+        for arr in kv.values():
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                self.counters.inc("copy_to_host_fallbacks")
+                break
+        tier.demote([k for k, _ in todo], kv, n)
+
+    def drop_prefix_cache(self, timeout_s=30.0):
+        """Evict every rc==0 page from the DEVICE prefix cache (each
+        full-prefix page demotes to the host tier first when it is
+        armed); pages still shared with live rows stay.  Thread-safe:
+        from any host thread this posts a device-loop op and blocks on
+        the ack.  Ops/bench hook — the warm_ttft_ms segment calls this
+        between its cold and warm passes so the warm pass can only be
+        served by host->device promotion, and an operator can use it to
+        return a quiesced replica's pool to 100% free.  Returns the
+        number of pages evicted."""
+        if not self.kv_page_size:
+            return 0
+        if self._dead is not None:
+            raise RuntimeError(f"batcher died: {self._dead}")
+        if threading.current_thread() is self._thread:
+            # device thread: apply in place
+            return self._evict_cached_pages(self._total_pages)
+        box = {}
+        ev = threading.Event()
+        self._freeze_q.put(("drop_prefix", box, ev))
+        deadline = time.monotonic() + timeout_s
+        while not ev.wait(0.05):
+            if self._stop.is_set() or self._dead is not None:
+                return 0    # device thread gone: stop()/death drains acks
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"prefix-cache drop did not land in {timeout_s:.1f}s")
+        return box.get("n", 0)
+
+    def _host_tier_lookup(self, keys, start):
+        """The contiguous run of host-tier pages extending a device
+        prefix-cache run of `start` pages: ``[(key, blocks), ...]``.
+        The entries stay cached until the promote COMMITS (peek, not
+        pop) — a parked admission must not strand pages outside both
+        tiers."""
+        tier = self._host_tier
+        if tier is None or start >= len(keys):
+            return []
+        run = []
+        for key in keys[start:]:
+            blocks = tier.peek(key)
+            if blocks is None:
+                break
+            run.append((key, blocks))
+        if run and faults.deny("serve.host_promote"):
+            return []        # tier reads as cold; prefill runs normally
+        return run
+
+    def host_prefix_provider(self, tokens, page_size):
+        """``kv:prefix`` pull path (PageServer callback): the longest
+        host-tier run of full-page prefixes of `tokens`, flattened to
+        kvtransfer wire blocks.  Base-model keys only — LoRA roots are
+        replica-local registration tokens, so adapter pages never match
+        across replicas (exactly the tenant-isolation property the
+        per-registration root exists for)."""
+        from . import kvtier as kvtier_mod
+
+        meta = {"kind": "prefix", "page_size": int(self.kv_page_size),
+                "n_pages": 0}
+        tier = self._host_tier
+        if (tier is None or not self.kv_page_size
+                or int(page_size) != self.kv_page_size):
+            return meta, {}
+        keys = self._prefix_keys(list(tokens), len(tokens))
+        blocks, n = {}, 0
+        for i, key in enumerate(keys):
+            page = tier.peek(key)
+            if page is None:
+                break
+            for path, arr in page.items():
+                blocks[kvtier_mod.block_name(i, path)] = arr
+            n += 1
+        meta["n_pages"] = n
+        return meta, blocks
+
+    def prefetch_prefix(self, peer, prompt):
+        """HTTP-thread warm-up for a gateway-planted kv peer
+        (``X-Fleet-KV-Peer``): pull the prefix pages the local host
+        tier lacks from the peer's PageServer and insert them, so this
+        request's admission promotes them instead of prefilling.  Pure
+        pre-warming — any failure (or a cold peer) inserts nothing and
+        admission falls through to normal prefill.  Returns the number
+        of pages inserted."""
+        tier = self._host_tier
+        if tier is None or not self.kv_page_size or not peer:
+            return 0
+        host, _, port = str(peer).rpartition(":")
+        if not host or not port.isdigit():
+            logger.warning("ignoring malformed X-Fleet-KV-Peer %r", peer)
+            return 0
+        keys = self._prefix_keys(prompt, len(prompt) - 1)
+        start = 0
+        for key in keys:       # skip the locally-warm head of the run
+            if not tier.contains(key):
+                break
+            start += 1
+        if start >= len(keys):
+            return 0
+        from . import kvtransfer
+
+        try:
+            meta, pages = kvtransfer.pull_prefix(
+                (host, int(port)),
+                prompt[:len(keys) * self.kv_page_size],
+                self.kv_page_size)
+        except (OSError, ValueError) as e:
+            self.counters.inc("prefix_pull_failures")
+            logger.debug("kv peer prefix pull failed: %s", e)
+            return 0
+        n = 0
+        for i, page in enumerate(pages):
+            if i >= len(keys):
+                break
+            if tier.put(keys[i], page):
+                n += 1
+        if n:
+            self.counters.inc("prefix_pull_pages", n)
+        return n
 
     def _assert_no_sink(self, pages):
         """The sink page absorbs garbage writes from EVERY free row and
@@ -1578,6 +1791,11 @@ class ContinuousBatcher:
         # (corrupted kv + a permanently leaked page via negative rc)
         for page in shared:
             self._page_rc[page] = self._page_rc.get(page, 0) + 1
+        # host-tier promotion: a run of demoted pages extending the
+        # device-cache run fills from the host copies instead of
+        # prefilling — they occupy FRESH pool pages (popped below), get
+        # scattered, and re-enter the prefix cache at rc=1
+        host_run = self._host_tier_lookup(keys, len(shared))
         fresh_need = need - len(shared)
         if len(self._free_pages) < fresh_need:
             self._evict_cached_pages(fresh_need - len(self._free_pages))
@@ -1586,6 +1804,7 @@ class ContinuousBatcher:
                 self._page_rc[page] -= 1
             return False
         fresh = [self._free_pages.pop() for _ in range(fresh_need)]
+        promo = fresh[:len(host_run)]
         try:
             pages = self._assert_no_sink(shared + fresh)
             max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
@@ -1596,6 +1815,8 @@ class ContinuousBatcher:
             self._cache = self._set_table(self._cache,
                                           jnp.asarray(row, jnp.int32),
                                           entries)
+            if host_run:
+                self._promote_scatter(promo, host_run)
         except BaseException:
             # lifecycle-leak: a device OOM (or the sink assert) between
             # the pops and the table write must not strand the fresh
@@ -1606,12 +1827,54 @@ class ContinuousBatcher:
                 self._page_rc[page] -= 1
             raise
         # row bookkeeping only after the slot table committed, so a
-        # failed allocation leaves no row state behind
+        # failed allocation leaves no row state behind.  Promoted pages
+        # publish into the prefix cache NOW (rc=1, this row): the kv is
+        # resident and key-exact, so a concurrent twin shares it like
+        # any cached page; the host copy retires (it would go stale
+        # relative to LRU bookkeeping, and re-demotion recreates it)
+        for (key, _), page in zip(host_run, promo):
+            self._prefix[key] = page
+            self._lru_tick += 1
+            self._prefix_lru[key] = self._lru_tick
+            self._page_rc[page] = 1
+            self._host_tier.discard(key)
+        n_shared = len(shared) + len(host_run)
         self._row_pages[row] = pages
-        self._row_shared_n[row] = len(shared)
+        self._row_shared_n[row] = n_shared
         self._row_prefix_keys[row] = keys        # for post-prefill registration
-        self.prefill_tokens_shared += len(shared) * self.kv_page_size
+        self.prefill_tokens_shared += n_shared * self.kv_page_size
+        if shared:
+            self.counters.inc("prefix_hits", len(shared))
+        if host_run:
+            self.counters.inc("host_hits", len(host_run))
+        if len(keys) > n_shared:
+            self.counters.inc("prefix_misses", len(keys) - n_shared)
         return True
+
+    def _promote_scatter(self, promo, host_run):
+        """Device thread: upload `host_run`'s tier blocks into the
+        freshly allocated pool pages `promo` (sink-padded pow2 ids for
+        compile reuse, like the migration scatter).  Bit-exact: the
+        blocks are gather copies at the pool dtype, so astype in the
+        scatter is the identity."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        n = len(host_run)
+        width = _pow2_width(n)
+        ids = jnp.asarray(list(promo) + [self._sink] * (width - n),
+                          jnp.int32)
+        blocks = {}
+        for path in host_run[0][1]:
+            stacked = np.stack([blk[path] for _, blk in host_run])
+            if width > n:
+                # pad rows land in the sink; their content is ignored
+                pad = np.broadcast_to(stacked[-1:],
+                                      (width - n,) + stacked.shape[1:])
+                stacked = np.concatenate([stacked, pad], axis=0)
+            blocks[path] = stacked
+        self._cache = self._scatter_kv(self._cache, ids, blocks)
 
     def _register_prefix_pages(self, row):
         """After `row`'s prefill completed, publish its freshly computed
@@ -1656,6 +1919,30 @@ class ContinuousBatcher:
             # dropped by the generation filter)
             self._lora_ids = self._lora_ids.at[row].set(0)
         if self.kv_page_size and self._row_pages[row] is not None:
+            if self._host_tier is not None and s is not None:
+                # cross-turn demotion: the retiring session's full-page
+                # prefix (prompt AND generated tokens — kv committed
+                # for positions [0, len(seq)-1), same cut freeze uses)
+                # snapshots into the host tier while the table is still
+                # valid, so the conversation's NEXT turn promotes
+                # instead of re-prefilling its history
+                try:
+                    item = s.get("item") or {}
+                    seq = s.get("seq") or []
+                    # migrated-in kv keeps the existing rule — only
+                    # pages this replica computed itself are published
+                    # (device cache OR host tier)
+                    if "kv" not in (item.get("resume") or {}):
+                        root = self._lora_prefix_root(
+                            item.get("aidx", 0))
+                        rkeys = self._prefix_keys(seq, len(seq) - 1,
+                                                  root=root)
+                        owned = self._row_pages[row]
+                        n = min(len(rkeys), len(owned))
+                        self._demote_pages(rkeys[:n], owned[:n])
+                except Exception:
+                    logger.warning("retirement demote failed",
+                                   exc_info=True)
             for page in self._row_pages[row]:
                 if page in self._page_rc:
                     self._page_rc[page] -= 1     # cached: stays in pool
@@ -2164,6 +2451,9 @@ class ContinuousBatcher:
             if entry[0] == "freeze":
                 _, row, box, ev = entry
                 self._apply_freeze(row, box)
+            elif entry[0] == "drop_prefix":
+                _, box, ev = entry
+                box["n"] = self._evict_cached_pages(self._total_pages)
             else:
                 _, row, frozen, box, ev = entry
                 self._apply_rollback(row, frozen, box)
@@ -3204,7 +3494,8 @@ class GenerateService:
                  draft_export_dir=None, draft_k=4, slots=8, read_chunk=8,
                  prefill_chunk=512, prefill_rows=4, prefill_budget=0,
                  request_timeout_s=None,
-                 kv_page_size=0, kv_pages=0, quantize_mode="none",
+                 kv_page_size=0, kv_pages=0, host_cache_mb=0,
+                 quantize_mode="none",
                  lora_rank=0, lora_capacity=8, lora_adapters=None,
                  kv_dtype="auto", paged_attn_impl=None, engine="async",
                  pipeline_depth=2, prio_weight=4, preempt_ms=0.0,
@@ -3230,6 +3521,7 @@ class GenerateService:
             prefill_rows=prefill_rows, prefill_budget=prefill_budget,
             draft_model=draft_model, draft_params=draft_params,
             draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages,
+            host_cache_mb=host_cache_mb,
             lora_rank=lora_rank, lora_capacity=lora_capacity,
             kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype),
             paged_attn_impl=paged_attn_impl, engine=engine or "async",
@@ -3388,7 +3680,7 @@ class GenerateService:
             return [next(self._auto_seed) for _ in range(n)]
         return [0] * n
 
-    def stream(self, req, on_handle=None, idem_key=None):
+    def stream(self, req, on_handle=None, idem_key=None, kv_peer=None):
         """Yield JSON-able events for a single-prompt generation:
         ``{"token": t}`` per decoded token (eos-trimmed), then
         ``{"done": true, "output": [...full sequence...]}``.
@@ -3404,6 +3696,11 @@ class GenerateService:
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
+        if kv_peer:
+            # gateway-planted prefix peer: pull the pages the local
+            # host tier lacks BEFORE submitting, so this admission
+            # promotes them (failure = normal prefill, nothing to undo)
+            self.batcher.prefetch_prefix(kv_peer, inputs[0])
         seed = self._prompt_seeds(1, seed, temperature)[0]
         h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
                                 eos_id=eos_id, seed=seed, adapter=adapter,
@@ -3438,9 +3735,12 @@ class GenerateService:
 
         return slot_events()
 
-    def generate(self, req):
+    def generate(self, req, kv_peer=None):
         (inputs, max_new, temperature, eos_id, seed, adapter,
          top_k, top_p, min_p, stop, rep, priority) = self._validate(req)
+        if kv_peer:
+            for p in inputs:
+                self.batcher.prefetch_prefix(kv_peer, p)
         seeds = self._prompt_seeds(len(inputs), seed, temperature)
         # every prompt becomes a slot request; they decode concurrently
         # with each other AND with other HTTP requests' prompts (no
@@ -3654,11 +3954,17 @@ class _Handler(BaseHTTPRequestHandler):
                         # replica prefills, the named replica decodes
                         on_handle = self.service.auto_migrate_hook(
                             migrate_to)
+                    # gateway-planted prefix peer (hierarchical kv
+                    # cache): the affinity replica likely holds this
+                    # conversation's demoted pages — prefetch them
+                    kv_peer = self.headers.get("X-Fleet-KV-Peer")
                     self._stream_events(gen.stream(req,
                                                    on_handle=on_handle,
-                                                   idem_key=idem_key))
+                                                   idem_key=idem_key,
+                                                   kv_peer=kv_peer))
                 else:
-                    self._send(200, {"outputs": gen.generate(req)})
+                    self._send(200, {"outputs": gen.generate(
+                        req, kv_peer=self.headers.get("X-Fleet-KV-Peer"))})
             else:
                 preds = self.service.predict(req.get("instances"))
                 self._send(200, {"predictions": preds})
@@ -3714,6 +4020,14 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
             getattr(args, "generate_kv_pages", 0) < 1:
         raise ValueError("--generate_kv_page_size needs "
                          "--generate_kv_pages >= 1 (the shared pool size)")
+    if getattr(args, "generate_host_cache_mb", 0) < 0:
+        raise ValueError("--generate_host_cache_mb must be >= 0 "
+                         "(0 disables the host-DRAM kv page tier)")
+    if getattr(args, "generate_host_cache_mb", 0) and \
+            not getattr(args, "generate_kv_page_size", 0):
+        raise ValueError("--generate_host_cache_mb needs "
+                         "--generate_kv_page_size > 0 (the host tier "
+                         "holds demoted pages of the paged kv cache)")
     if getattr(args, "generate_lora", None) and \
             not getattr(args, "generate_lora_rank", 0):
         raise ValueError("--generate_lora needs --generate_lora_rank > 0 "
@@ -3770,7 +4084,8 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
     return server, service
 
 
-def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
+def _register_with_fleet(args: Any, server: ThreadingHTTPServer,
+                         service: "ModelService | None" = None):
     """Join the fleet gateway named by ``--fleet HOST:PORT``: REG this
     replica's advertised endpoint + capacity over the reservation plane
     and start the liveness heartbeat.  Returns the live registration
@@ -3789,6 +4104,22 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer):
         features["kv_pages"] = args.generate_kv_pages
         features["paged_attn_impl"] = (
             getattr(args, "generate_paged_attn", None) or "kernel")
+    if getattr(args, "generate_host_cache_mb", 0) and \
+            getattr(args, "generate_kv_page_size", 0):
+        # hierarchical kv cache: advertise the kv:prefix pull endpoint
+        # so the gateway can point spilled requests at this replica's
+        # host tier (REG features are static — force the PageServer
+        # bind now).  A non-LM export just skips the feature
+        features["host_cache_mb"] = args.generate_host_cache_mb
+        try:
+            eng = (service.migration_engine()
+                   if service is not None else None)
+        except Exception:
+            logger.warning("kv:prefix endpoint unavailable",
+                           exc_info=True)
+            eng = None
+        if eng is not None:
+            features["kv_prefix_addr"] = eng.prefix_addr()
     if getattr(args, "draft_export_dir", None):
         features["speculative"] = True
     if getattr(args, "generate_quantize", "none") != "none":
@@ -3826,7 +4157,7 @@ def main(argv: Any = None) -> None:
     print(f"serving on http://{host}:{port} ({service.desc})", flush=True)
     registration = None
     if getattr(args, "fleet", None):
-        registration = _register_with_fleet(args, server)
+        registration = _register_with_fleet(args, server, service)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

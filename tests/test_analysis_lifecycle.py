@@ -35,11 +35,11 @@ def run(src, path="tensorflowonspark_tpu/mod.py"):
 
 # ----------------------------------------------------------- spec table ----
 
-def test_spec_registry_covers_the_eight_resources():
+def test_spec_registry_covers_the_nine_resources():
     names = {s.name for s in resources.SPECS}
     assert names == {"kv-page", "decode-slot", "lora-adapter", "socket",
                      "donated-buffer", "migration-lease",
-                     "journal-entry", "parked-session"}
+                     "journal-entry", "parked-session", "host-kv-page"}
     kv = resources.spec_by_name("kv-page")
     assert kv.share_map == "_page_rc" and kv.device_only
     assert resources.spec_by_name("socket").release_idempotent

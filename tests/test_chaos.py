@@ -458,3 +458,138 @@ def test_kill_recover_cycles_conserve_pool_and_journal(model_and_params):
     finally:
         a.stop()
         b.stop()
+
+
+# ------------------------------------ host-tier (kvtier) fault sites ----
+
+def _drain_tier(b, timeout=30.0):
+    """Wait out the async demote worker (retirement demotes enqueue on
+    the device thread after result() fires)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        b._host_tier.flush(5)
+        if not b.stats()["slots_busy"]:
+            return
+        time.sleep(0.01)
+
+
+def test_host_demote_deny_drops_pages_and_conserves_pool(
+        model_and_params):
+    # allocation-failure at serve.host_demote: the retiring session's
+    # pages are DROPPED instead of demoted — the tier stays empty, the
+    # pool stays conserved, and the conversation's next turn simply
+    # prefills cold, byte-identically
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=24, host_cache_mb=16)
+    prompt, n_new = list(range(1, 19)), 4
+    try:
+        plan = faults.FaultPlan(CHAOS_SEED).on(
+            "serve.host_demote", kind="deny", nth=1, times=None)
+        with faults.active(plan):
+            cold = b.submit(prompt, n_new).result(timeout=300)
+            _drain_tier(b)
+            b.drop_prefix_cache()        # eviction demote denied too
+            b._host_tier.flush(10)
+        assert ("serve.host_demote", "deny") in plan.fired
+        assert b._host_tier.stats()["host_pages_cached"] == 0
+        assert b._host_tier.stats()["host_demotions"] == 0
+        # next turn finds both tiers cold and prefills normally
+        s0 = b.stats()
+        assert b.submit(prompt, n_new).result(timeout=300) == cold
+        s1 = b.stats()
+        assert s1["host_hits"] == s0["host_hits"]
+        assert (s1["prefill_tokens_shared"]
+                == s0["prefill_tokens_shared"])
+        assert cold == _solo(model, params, prompt, n_new)
+        _drain_tier(b)
+        s = b.stats()
+        assert s["kv_pages_used"] == s["prefix_pages_cached"]
+    finally:
+        b.stop()
+
+
+def test_host_promote_deny_falls_back_to_cold_prefill(model_and_params):
+    # allocation-failure at serve.host_promote: a warm host tier reads
+    # as cold — the request prefills normally and BYTE-IDENTICALLY,
+    # the tier keeps its entries (peek never committed), and the pool
+    # stays conserved; with the fault gone the SAME entries promote
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=24, host_cache_mb=16)
+    prompt, n_new = list(range(1, 19)), 4
+    try:
+        cold = b.submit(prompt, n_new).result(timeout=300)
+        _drain_tier(b)
+        assert b.drop_prefix_cache() > 0
+        b._host_tier.flush(10)
+        warm_pages = b._host_tier.stats()["host_pages_cached"]
+        assert warm_pages >= 2
+        plan = faults.FaultPlan(CHAOS_SEED).on(
+            "serve.host_promote", kind="deny", nth=1, times=None)
+        with faults.active(plan):
+            s0 = b.stats()
+            denied = b.submit(prompt, n_new).result(timeout=300)
+            s1 = b.stats()
+        assert ("serve.host_promote", "deny") in plan.fired
+        assert denied == cold                 # byte parity through deny
+        assert s1["host_hits"] == s0["host_hits"]
+        # entries survived the denied lookup; the retry promotes them
+        _drain_tier(b)
+        assert b.drop_prefix_cache() > 0      # forget the denied run's
+        b._host_tier.flush(10)                # re-registered pages
+        assert b._host_tier.stats()["host_pages_cached"] >= warm_pages
+        s0 = b.stats()
+        assert b.submit(prompt, n_new).result(timeout=300) == cold
+        s1 = b.stats()
+        assert s1["host_hits"] - s0["host_hits"] == 2
+        _drain_tier(b)
+        s = b.stats()
+        assert s["kv_pages_used"] == s["prefix_pages_cached"]
+    finally:
+        b.stop()
+
+
+def test_prefix_pull_fault_falls_back_to_local_prefill(model_and_params):
+    # the cross-replica kv:prefix pull dies on the wire: the prefetch
+    # inserts nothing, counts a failure, and the request falls through
+    # to a normal local prefill — byte-identical to the peerless run
+    model, params = model_and_params
+    mk = lambda: serve.ContinuousBatcher(model, params, n_slots=2,
+                                         read_chunk=1, prefill_chunk=8,
+                                         kv_page_size=8, kv_pages=24,
+                                         host_cache_mb=16)
+    a, b = mk(), mk()
+    srv = kvtransfer.PageServer(prefix_provider=a.host_prefix_provider)
+    prompt, n_new = list(range(1, 19)), 4
+    peer = "%s:%d" % (srv.addr[0], srv.addr[1])
+    try:
+        cold = a.submit(prompt, n_new).result(timeout=300)
+        _drain_tier(a)
+        assert a._host_tier.stats()["host_pages_cached"] >= 2
+        plan = faults.FaultPlan(CHAOS_SEED).on(
+            "kvtransfer.prefix_pull", kind="oserror", nth=1)
+        with faults.active(plan):
+            assert b.prefetch_prefix(peer, prompt) == 0
+        assert plan.fired == [("kvtransfer.prefix_pull", "oserror")]
+        assert b.counters.get("prefix_pull_failures") == 1
+        assert b._host_tier.stats()["host_pages_cached"] == 0
+        # the request lands anyway, served by a plain local prefill
+        out = b.submit(prompt, n_new).result(timeout=300)
+        assert out == cold
+        assert b.counters.get("host_hits") == 0
+        # with the wire healthy the SAME peer warms the next pull
+        # (clear B's tier first: its own retirement just warmed it, and
+        # a locally-warm prefix never dials)
+        _drain_tier(b)
+        b._host_tier.clear()
+        assert b.prefetch_prefix(peer, prompt) == 2
+        _drain_tier(b)
+        s = b.stats()
+        assert s["kv_pages_used"] == s["prefix_pages_cached"]
+    finally:
+        srv.close()
+        a.stop()
+        b.stop()

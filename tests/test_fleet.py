@@ -45,6 +45,7 @@ class StubReplica:
         self.generate_requests = []  # full :generate body per hit
         self.extra_stats = {}        # merged over canned generate_stats
         self.migrate_headers = []   # X-Fleet-Migrate-To seen per :generate
+        self.kv_peer_headers = []   # X-Fleet-KV-Peer seen per :generate
         self.idem_keys = []         # Idempotency-Key per :generate/:resume
         self.resume_hits = 0
         self.resume_requests = []   # the replay meta each :resume carried
@@ -88,6 +89,14 @@ class StubReplica:
                           "migrations_completed": 2,
                           "migrations_failed": 1,
                           "kv_pages_exported": 5,
+                          # hierarchical kv cache (host-DRAM tier)
+                          "prefix_hits": 2,
+                          "prefix_misses": 1,
+                          "host_hits": 2,
+                          "host_demotions": 3,
+                          "host_evictions": 1,
+                          "host_cache_bytes": 2048,
+                          "host_pages_cached": 2,
                           # per-class windows: interactive traffic only —
                           # the batch class is EMPTY on a canned stub (no
                           # batch keys at all), like a replica that never
@@ -188,6 +197,8 @@ class StubReplica:
                         stub.generate_requests.append(dict(req))
                         stub.migrate_headers.append(
                             self.headers.get("X-Fleet-Migrate-To"))
+                        stub.kv_peer_headers.append(
+                            self.headers.get("X-Fleet-KV-Peer"))
                         stub.idem_keys.append(
                             self.headers.get("Idempotency-Key"))
                         stub.in_flight += 1
@@ -244,14 +255,19 @@ def gateway():
 
 
 def _spawn(gw, stubs, regs, n=2, n_slots=2, generate_delay_s=0.0,
-           heartbeat_s=0.15, role=None):
-    """Start `n` stub replicas and register each with the gateway."""
+           heartbeat_s=0.15, role=None, extra_features=None):
+    """Start `n` stub replicas and register each with the gateway.
+    ``extra_features`` may be a dict (merged into every replica's
+    features) or a callable of the replica index returning one."""
     out = []
-    for _ in range(n):
+    for i in range(n):
         s = StubReplica(generate_delay_s=generate_delay_s)
         features = {"kv_page_size": 4}
         if role is not None:
             features["role"] = role
+        if extra_features is not None:
+            features.update(extra_features(i) if callable(extra_features)
+                            else extra_features)
         reg = fleet_client.register_replica(
             gw.registry_addr, s.host, s.port, n_slots=n_slots,
             features=features,
@@ -543,6 +559,71 @@ def test_fleet_stats_migration_totals(gateway):
     assert t["migrations_completed"] == 4
     assert t["migrations_failed"] == 2
     assert t["kv_pages_exported"] == 10
+
+
+def test_fleet_stats_host_tier_totals(gateway):
+    # ISSUE-12 satellite: the hierarchical-kv-cache counters sum into
+    # the fleet totals beside prefix_pages_cached
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    status, body = _client(gw).fleet_stats()
+    assert status == 200
+    t = body["totals"]
+    assert t["prefix_hits"] == 4
+    assert t["prefix_misses"] == 2
+    assert t["host_hits"] == 4
+    assert t["host_demotions"] == 6
+    assert t["host_evictions"] == 2
+    assert t["host_cache_bytes"] == 4096
+    assert t["host_pages_cached"] == 4
+
+
+def test_generate_spill_plants_kv_peer_header(gateway):
+    # ISSUE-12 tentpole: when routing lands AWAY from the prefix-affine
+    # replica (here: it saturated), the gateway hands the chosen one
+    # the affine peer's kv:prefix address so it can pull the returning
+    # conversation's pages instead of re-prefilling
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2, n_slots=2,
+           extra_features=lambda i: {"kv_prefix_addr":
+                                     "10.0.0.%d:7400" % (i + 1)})
+    shared = [7, 8, 9, 10]
+    affine = _affine_stub(gw, stubs, shared)
+    other = next(s for s in stubs if s.id != affine.id)
+    with gw._lock:
+        affine_addr = \
+            gw._replicas[affine.id].features["kv_prefix_addr"]
+        gw._replicas[affine.id].outstanding = 4    # saturate affine
+    status, body = _client(gw).generate([shared])
+    assert status == 200
+    assert body["replica"] == other.id
+    assert other.kv_peer_headers == [affine_addr]
+    assert gw.counters.get("kv_peer_planted") == 1
+    # routed TO the affine replica, nothing is planted: its own host
+    # tier is already the warmest copy
+    with gw._lock:
+        gw._replicas[affine.id].outstanding = 0
+    status, body = _client(gw).generate([shared])
+    assert status == 200
+    assert body["replica"] == affine.id
+    assert affine.kv_peer_headers == [None]
+    assert gw.counters.get("kv_peer_planted") == 1
+
+
+def test_kv_peer_skipped_without_advertised_addr(gateway):
+    # replicas that never advertise kv_prefix_addr (host tier off) are
+    # never named as peers, and nothing is planted fleet-wide
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2, n_slots=2)
+    shared = [7, 8, 9, 10]
+    affine = _affine_stub(gw, stubs, shared)
+    with gw._lock:
+        gw._replicas[affine.id].outstanding = 4
+    status, body = _client(gw).generate([shared])
+    assert status == 200
+    for s in stubs:
+        assert all(h is None for h in s.kv_peer_headers)
+    assert gw.counters.get("kv_peer_planted") == 0
 
 
 def test_gateway_metadata_passthrough(gateway):

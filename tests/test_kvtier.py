@@ -1,0 +1,205 @@
+"""Host-DRAM page tier (kvtier.HostPageTier) and the kv:prefix pull.
+
+Unit coverage for the second cache tier behind the paged pool: LRU
+byte accounting, the async demote worker, and the PageServer
+``kv:prefix`` path that ships host-tier pages to a peer replica.  The
+end-to-end promote/demote paths through a live batcher live in
+tests/test_paged.py; fault-injection in tests/test_chaos.py.
+"""
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import faults, kvtier, kvtransfer
+
+
+def _page(v, shape=(8, 4), dtype=np.float32):
+    return {"k": np.full(shape, v, dtype), "v": np.full(shape, -v, dtype)}
+
+
+def _nbytes(page):
+    return sum(a.nbytes for a in page.values())
+
+
+@pytest.fixture()
+def tier():
+    t = kvtier.HostPageTier(4 * _nbytes(_page(0)))   # room for 4 pages
+    yield t
+    t.close()
+
+
+def test_put_peek_discard_accounting(tier):
+    one = _nbytes(_page(0))
+    assert tier.put(("a",), _page(1.0))
+    assert tier.put(("b",), _page(2.0))
+    st = tier.stats()
+    assert st["host_pages_cached"] == 2
+    assert st["host_cache_bytes"] == 2 * one
+    # peek returns the stored blocks and LEAVES the entry cached
+    got = tier.peek(("a",))
+    np.testing.assert_array_equal(got["k"], _page(1.0)["k"])
+    assert tier.contains(("a",))
+    # duplicate keys refuse (first write wins), unknown peeks miss
+    assert not tier.put(("a",), _page(9.0))
+    assert tier.peek(("zzz",)) is None
+    # discard is the promote commit: entry gone, bytes refunded
+    tier.discard(("a",))
+    assert not tier.contains(("a",))
+    assert tier.stats()["host_cache_bytes"] == one
+    tier.discard(("a",))                 # idempotent
+    tier.clear()
+    assert tier.stats() == {"host_cache_bytes": 0,
+                            "host_cache_capacity_bytes": 4 * one,
+                            "host_pages_cached": 0, "host_demotions": 0,
+                            "host_evictions": 0}
+
+
+def test_lru_eviction_order_and_bump(tier):
+    for i in range(4):
+        assert tier.put(("k", i), _page(float(i)))
+    # touching key 0 bumps it to MRU, so inserting two more evicts 1, 2
+    assert tier.peek(("k", 0)) is not None
+    assert tier.put(("k", 4), _page(4.0))
+    assert tier.put(("k", 5), _page(5.0))
+    st = tier.stats()
+    assert st["host_evictions"] == 2
+    assert st["host_pages_cached"] == 4
+    assert tier.contains(("k", 0))
+    assert not tier.contains(("k", 1)) and not tier.contains(("k", 2))
+    # bytes stay exactly at the live-entry total through the churn
+    assert st["host_cache_bytes"] == 4 * _nbytes(_page(0))
+
+
+def test_oversize_entry_refused(tier):
+    big = _page(1.0, shape=(1024, 64))
+    assert _nbytes(big) > tier.capacity_bytes
+    assert not tier.put(("big",), big)
+    assert tier.stats()["host_cache_bytes"] == 0
+
+
+def test_tiny_budget_still_conserves_bytes():
+    t = kvtier.HostPageTier(1)           # nothing fits
+    try:
+        assert not t.put(("a",), _page(1.0))
+        assert t.stats()["host_cache_bytes"] == 0
+        assert t.stats()["host_pages_cached"] == 0
+    finally:
+        t.close()
+    with pytest.raises(ValueError):
+        kvtier.HostPageTier(0)
+
+
+def test_demote_worker_and_flush(tier):
+    # the demote path: batched [width, ...] arrays, n live rows, the
+    # rest sink garbage the worker must ignore
+    n, width = 3, 4
+    kv = {"k": np.stack([np.full((8, 4), float(i), np.float32)
+                         for i in range(width)]),
+          "v": np.zeros((width, 8, 4), np.float32)}
+    keys = [("d", i) for i in range(n)]
+    assert tier.demote(keys, kv, n) == n
+    assert tier.flush(10)
+    st = tier.stats()
+    assert st["host_pages_cached"] == n
+    assert st["host_demotions"] == n
+    for i in range(n):
+        np.testing.assert_array_equal(tier.peek(("d", i))["k"],
+                                      np.full((8, 4), float(i)))
+    # demoted copies are decoupled from the caller's buffers
+    kv["k"][:] = 99.0
+    np.testing.assert_array_equal(tier.peek(("d", 0))["k"],
+                                  np.zeros((8, 4)) + 0.0)
+    # n=0 and closed tiers are no-ops
+    assert tier.demote([], kv, 0) == 0
+
+
+def test_close_refuses_further_inserts(tier):
+    assert tier.put(("a",), _page(1.0))
+    tier.close()
+    assert not tier.put(("b",), _page(2.0))
+    assert tier.demote([("c",)], {"k": np.zeros((1, 2, 2))}, 1) == 0
+    assert tier.stats()["host_pages_cached"] == 0   # close() clears
+    tier.close()                         # idempotent
+
+
+def test_block_name_split_round_trip():
+    pages = [{"k": np.full((2, 2), float(i)),
+              "v": np.full((2, 2), -float(i))} for i in range(3)]
+    blocks = {}
+    for i, page in enumerate(pages):
+        for path, arr in page.items():
+            blocks[kvtier.block_name(i, path)] = arr
+    meta = {"kind": "prefix", "page_size": 2, "n_pages": 3}
+    back = kvtier.split_prefix_blocks(meta, blocks)
+    assert len(back) == 3
+    for orig, got in zip(pages, back):
+        assert set(got) == {"k", "v"}
+        np.testing.assert_array_equal(got["k"], orig["k"])
+    # a lying n_pages stops at the first absent page
+    assert len(kvtier.split_prefix_blocks(
+        {"n_pages": 7}, blocks)) == 3
+    assert kvtier.split_prefix_blocks({"n_pages": 0}, blocks) == []
+
+
+def _fake_provider(store, page_size):
+    """A provider over a dict of key -> page, keyed like serve.py does
+    (cumulative full-page token tuples)."""
+    def provide(tokens, psize):
+        meta = {"kind": "prefix", "page_size": int(psize), "n_pages": 0}
+        if int(psize) != page_size:
+            return meta, {}
+        blocks, n = {}, 0
+        key = ()
+        for i in range(len(tokens) // page_size):
+            key = (key, tuple(tokens[i * page_size:(i + 1) * page_size]))
+            page = store.get(key)
+            if page is None:
+                break
+            for path, arr in page.items():
+                blocks[kvtier.block_name(i, path)] = arr
+            n += 1
+        meta["n_pages"] = n
+        return meta, blocks
+    return provide
+
+
+def test_page_server_prefix_pull_end_to_end():
+    P = 4
+    tokens = list(range(1, 11))          # 2 full pages + a 2-token tail
+    store, key = {}, ()
+    for i in range(2):
+        key = (key, tuple(tokens[i * P:(i + 1) * P]))
+        store[key] = _page(float(i + 1), shape=(P, 2))
+    srv = kvtransfer.PageServer(prefix_provider=_fake_provider(store, P))
+    try:
+        meta, pages = kvtransfer.pull_prefix(srv.addr, tokens, P)
+        assert meta["n_pages"] == 2 and meta["page_size"] == P
+        assert len(pages) == 2
+        for i, page in enumerate(pages):
+            np.testing.assert_array_equal(
+                page["k"], _page(float(i + 1), shape=(P, 2))["k"])
+        # a cold prefix is an empty answer, not an error
+        meta, pages = kvtransfer.pull_prefix(srv.addr, [42, 43, 44, 45], P)
+        assert meta["n_pages"] == 0 and pages == []
+        # mismatched page size reads as cold too
+        meta, pages = kvtransfer.pull_prefix(srv.addr, tokens, P * 2)
+        assert pages == []
+    finally:
+        srv.close()
+
+
+def test_page_server_without_provider_errors():
+    srv = kvtransfer.PageServer()
+    try:
+        with pytest.raises(ValueError, match="no kv:prefix provider"):
+            kvtransfer.pull_prefix(srv.addr, [1, 2, 3, 4], 4)
+    finally:
+        srv.close()
+
+
+def test_pull_prefix_fault_site():
+    plan = faults.FaultPlan(seed=7).on("kvtransfer.prefix_pull",
+                                       "oserror")
+    with faults.active(plan):
+        with pytest.raises(OSError):
+            kvtransfer.pull_prefix(("127.0.0.1", 1), [1, 2], 2)
+    assert plan.fired == [("kvtransfer.prefix_pull", "oserror")]

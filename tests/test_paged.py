@@ -632,3 +632,157 @@ def test_page_conservation_under_mid_migration_faults(model_and_params):
         batcher._free_row(row)
     _pool_conserved(batcher, kv_pages)
     assert len(batcher._free_pages) + len(batcher._prefix) == kv_pages
+
+
+def _wait_host_pages(tier, n, timeout=30.0):
+    """Poll until the tier's async demote worker has applied at least
+    `n` entries (retirement runs on the device thread AFTER the
+    handle's result() fires, so the demote enqueue itself is racy)."""
+    import time as time_mod
+
+    deadline = time_mod.time() + timeout
+    while time_mod.time() < deadline:
+        tier.flush(5)
+        if tier.stats()["host_pages_cached"] >= n:
+            return
+        time_mod.sleep(0.01)
+    raise AssertionError(
+        f"host tier never reached {n} pages: {tier.stats()}")
+
+
+def test_host_tier_warm_turn_byte_parity(model_and_params):
+    # ISSUE-12 tentpole: a returning conversation whose prefix pages
+    # live ONLY in the host-DRAM tier emits byte-identical tokens to
+    # the cold run while skipping prefill for every cached full page
+    # (the prefill token count drops by pages * P).
+    model, params = model_and_params
+    kv_pages = 6
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      kv_page_size=8, kv_pages=kv_pages,
+                                      host_cache_mb=64)
+    try:
+        prompt = list(range(1, 19))      # 2 full prefix pages + tail
+        cold = batcher.submit(prompt, 4).result(timeout=120)
+        assert cold == _solo(model, params, prompt, 4)
+        # retirement demoted the session's full pages; now drop the
+        # DEVICE prefix cache so the warm turn can only be served by
+        # host->device promotion
+        _wait_host_pages(batcher._host_tier, 2)
+        assert batcher.drop_prefix_cache() == 2
+        assert batcher._host_tier.flush(30)
+        assert batcher._host_tier.stats()["host_pages_cached"] >= 2
+        s0 = batcher.stats()
+        assert s0["prefix_pages_cached"] == 0
+        warm = batcher.submit(prompt, 4).result(timeout=120)
+        assert warm == cold
+        s1 = batcher.stats()
+        assert s1["host_hits"] - s0["host_hits"] == 2
+        assert s1["prefix_hits"] == s0["prefix_hits"]
+        # prefill skipped for BOTH cached full pages (16 of 18 tokens)
+        assert (s1["prefill_tokens_shared"]
+                - s0["prefill_tokens_shared"]) == 16
+        # promoted pages were re-registered into the device cache
+        assert s1["prefix_pages_cached"] == 2
+        _pool_conserved(batcher, kv_pages)
+    finally:
+        batcher.stop()
+
+
+def test_cross_replica_prefix_pull_warm_turn(model_and_params):
+    # ISSUE-12 tentpole: replica B serves a conversation that ran on
+    # replica A byte-identically, prefetching A's demoted pages through
+    # the PageServer kv:prefix path instead of re-prefilling them.
+    from tensorflowonspark_tpu import kvtransfer
+
+    model, params = model_and_params
+    mk = lambda: serve.ContinuousBatcher(model, params, n_slots=2,
+                                         kv_page_size=8, kv_pages=6,
+                                         host_cache_mb=64)
+    a, b = mk(), mk()
+    srv = kvtransfer.PageServer(prefix_provider=a.host_prefix_provider)
+    try:
+        prompt = list(range(1, 19))
+        cold = a.submit(prompt, 4).result(timeout=120)
+        _wait_host_pages(a._host_tier, 2)
+        # the gateway would plant this peer via X-Fleet-KV-Peer
+        n = b.prefetch_prefix("%s:%d" % (srv.addr[0], srv.addr[1]),
+                              prompt)
+        assert n == 2
+        assert b.counters.get("prefix_pull_pages") == 2
+        warm = b.submit(prompt, 4).result(timeout=120)
+        assert warm == cold
+        assert b.counters.get("host_hits") == 2
+        # a second prefetch is a no-op: the pages are already local
+        assert b.prefetch_prefix("%s:%d" % (srv.addr[0], srv.addr[1]),
+                                 prompt) == 0
+        # an unreachable peer inserts nothing and fails soft (fresh
+        # prompt: a locally-warm one never dials at all)
+        assert b.prefetch_prefix("127.0.0.1:9", list(range(30, 48))) == 0
+        assert b.counters.get("prefix_pull_failures") == 1
+    finally:
+        srv.close()
+        a.stop()
+        b.stop()
+
+
+def test_page_conservation_with_host_tier(model_and_params):
+    # ISSUE-12 satellite: demote/promote joins the randomized cycle —
+    # the host tier must never duplicate or strand a pool page through
+    # alloc/retire/evict/promote churn, and its byte accounting must
+    # stay within budget at every step.
+    import random
+
+    from tensorflowonspark_tpu import kvtier
+
+    model, params = model_and_params
+    kv_pages = 6
+    batcher = serve.ContinuousBatcher(model, params, n_slots=3,
+                                      kv_page_size=8, kv_pages=kv_pages,
+                                      host_cache_mb=4)
+    batcher.stop()                      # direct drive, no driver races
+    batcher._host_tier = kvtier.HostPageTier(4 << 20)  # stop() closed it
+    tier = batcher._host_tier
+    rng = random.Random(2468)
+    prompt_pool = [list(range(1, 11)), list(range(1, 19)),
+                   [7] * 9, list(range(1, 19))]   # repeats promote
+    active = set()
+    try:
+        for cycle in range(150):
+            free_rows = [r for r in range(3) if r not in active]
+            op = rng.choice(["alloc", "alloc", "retire", "evict",
+                             "register", "flush"])
+            if op == "alloc" and free_rows:
+                row = rng.choice(free_rows)
+                prompt = rng.choice(prompt_pool)
+                item = {"prompt": prompt, "max_new": rng.randint(1, 4),
+                        "temp": 0.0, "aidx": 0}
+                if batcher._try_allocate(row, item):
+                    # direct drive: give the slot the record retirement
+                    # reads (seq = prompt + one decoded token)
+                    batcher._slots[row] = {"item": item,
+                                           "seq": list(prompt) + [1]}
+                    active.add(row)
+            elif op == "retire" and active:
+                row = rng.choice(sorted(active))
+                batcher._free_row(row)
+                active.discard(row)
+            elif op == "evict":
+                batcher._evict_cached_pages(rng.randint(1, 3))
+            elif op == "register" and active:
+                batcher._register_prefix_pages(rng.choice(sorted(active)))
+            elif op == "flush":
+                assert tier.flush(10)
+            _pool_conserved(batcher, kv_pages)
+            st = tier.stats()
+            assert 0 <= st["host_cache_bytes"] <= \
+                st["host_cache_capacity_bytes"]
+        assert tier.flush(10)
+        for row in sorted(active):
+            batcher._free_row(row)
+        _pool_conserved(batcher, kv_pages)
+        assert len(batcher._free_pages) + len(batcher._prefix) == kv_pages
+        # both directions of the tier actually exercised
+        assert batcher.counters.get("host_hits") > 0
+        assert tier.stats()["host_demotions"] > 0
+    finally:
+        tier.close()
