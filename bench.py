@@ -39,6 +39,10 @@ Round 9 adds the `engine_tps` segment: sustained decode tokens/s
 through the full continuous batcher (benchmarks.make_engine_burst) —
 the async double-buffered engine vs the serialized single-thread loop,
 with the device-idle fraction and pipeline-depth peak in aux.
+The `prefill_ms` segment prices the paged S>1 chunk dispatch: the
+Pallas in-place page-write prefill kernel vs the full-pool einsum
+blend (benchmarks.make_prefill_chunk_step), with the analytic kv
+write-traffic contrast in aux.
 
 On a device whose bf16 peak is unknown (not in benchmarks.PEAK_BF16) the
 metric falls back to tokens/sec — an MFU percent against a guessed peak
@@ -171,6 +175,36 @@ def bench_decode_segment(steps=32, windows=3):
         return best * 1000
 
     return timed("kernel"), timed("einsum")
+
+
+def bench_prefill_segment(steps=16, windows=3):
+    """The paged-prefill segment: steady-state batched multi-row prefill
+    chunk dispatch on the flagship dims
+    (benchmarks.make_prefill_chunk_step / FLAGSHIP_PREFILL_KERNEL — rows
+    holding 2000 tokens of paged context, 256-token chunks), Pallas
+    in-place page-write kernel vs the full-pool einsum blend reference.
+    Returns (kernel_ms, blend_ms)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.benchmarks import make_prefill_chunk_step
+
+    def timed(impl):
+        prefill, params, cache, (chunks, rows, starts, n_valids, sink) = \
+            make_prefill_chunk_step(impl)
+        logits, cache = prefill(params, cache, chunks, rows, starts,
+                                n_valids, sink)
+        np.asarray(logits)                         # compile + sync
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                logits, cache = prefill(params, cache, chunks, rows,
+                                        starts, n_valids, sink)
+            np.asarray(logits)                     # host readback barrier
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best * 1000
+
+    return timed("kernel"), timed("blend")
 
 
 def bench_ttft_segment(reps=3, result_timeout=600):
@@ -553,6 +587,37 @@ def _decode_segment_result():
                     "speedup_vs_einsum": round(einsum_ms / kernel_ms, 2)}}
 
 
+def _prefill_segment_setup():
+    from tensorflowonspark_tpu.benchmarks import (
+        FLAGSHIP_PREFILL_KERNEL, make_prefill_chunk_step,
+        prefill_chunk_write_bytes)
+
+    assert callable(make_prefill_chunk_step)
+    d = FLAGSHIP_PREFILL_KERNEL
+    assert d["fill"] + d["chunk"] <= d["max_seq"]
+    assert d["max_seq"] % d["page_size"] == 0
+    # the in-place write claim the segment exists to price: kernel
+    # traffic scales with the chunk, blend traffic with the whole pool
+    assert (prefill_chunk_write_bytes("kernel")
+            < prefill_chunk_write_bytes("blend"))
+    return {"config": dict(d)}
+
+
+def _prefill_segment_result():
+    from tensorflowonspark_tpu.benchmarks import prefill_chunk_write_bytes
+
+    kernel_ms, blend_ms = bench_prefill_segment()
+    kb = prefill_chunk_write_bytes("kernel")
+    bb = prefill_chunk_write_bytes("blend")
+    return {"metric": "prefill_ms", "value": round(kernel_ms, 2),
+            "unit": "ms/chunk",
+            "aux": {"prefill_ms_blend": round(blend_ms, 2),
+                    "speedup_vs_blend": round(blend_ms / kernel_ms, 2),
+                    "kv_write_mb_kernel": round(kb / 1e6, 2),
+                    "kv_write_mb_blend": round(bb / 1e6, 2),
+                    "kv_write_ratio": round(bb / kb, 1)}}
+
+
 def _ttft_segment_setup():
     from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_PREFILL,
                                                   make_prefill_burst)
@@ -663,6 +728,12 @@ SEGMENTS = {
         "setup": _decode_segment_setup,
         "help": "steady-state paged slot-decode step "
                 "(flash-decode kernel vs einsum full-gather)"},
+    "prefill_ms": {
+        "run": _prefill_segment_result,
+        "setup": _prefill_segment_setup,
+        "help": "steady-state paged prefill chunk dispatch (in-place "
+                "page-write kernel vs full-pool einsum blend, with the "
+                "analytic kv write-traffic contrast)"},
     "ttft_ms": {
         "run": _ttft_segment_result,
         "setup": _ttft_segment_setup,

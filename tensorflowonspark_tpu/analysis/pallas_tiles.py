@@ -16,6 +16,18 @@
     convention (``ops.default_interpret()``) runs kernels in interpret mode
     off-TPU so the CPU test harness exercises them; a pallas_call without
     the flag hard-fails on every machine without a TPU.
+
+``pallas-prefetch-arity``
+    Under a ``PrefetchScalarGridSpec(num_scalar_prefetch=k, grid=(...))``
+    every BlockSpec index_map receives the grid coordinates PLUS the k
+    scalar-prefetch refs — len(grid) + k arguments.  A lambda written for
+    the plain-GridSpec arity (grid coordinates only) fails at trace time
+    with an opaque arity TypeError deep inside pallas; the lint names the
+    lambda and the expected count instead.  Checked per enclosing
+    function when it builds exactly one PrefetchScalarGridSpec with a
+    literal ``num_scalar_prefetch`` and a literal grid tuple (the repo
+    idiom — ops/paged_attention.py, ops/paged_prefill.py); index_maps
+    given as local ``def``s are resolved too, ``*args`` signatures pass.
 """
 from __future__ import annotations
 
@@ -78,6 +90,96 @@ class PallasTileRule(Rule):
                         f"BlockSpec second-minor dim {sub} is not a multiple "
                         f"of {_SUBLANE} (f32 sublane; bf16 needs 16, int8 "
                         "needs 32)")
+
+
+def _prefetch_arity(call):
+    """PrefetchScalarGridSpec call -> len(grid) + num_scalar_prefetch,
+    or None when either is not literal enough to know."""
+    k = grid = None
+    for kw in call.keywords:
+        if kw.arg == "num_scalar_prefetch":
+            if not (isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, int)):
+                return None
+            k = kw.value.value
+        elif kw.arg == "grid":
+            if not isinstance(kw.value, (ast.Tuple, ast.List)):
+                return None
+            grid = len(kw.value.elts)
+    if k is None or grid is None:
+        return None
+    return grid + k
+
+
+def _index_map_params(arg, local_defs):
+    """index_map argument -> (n_params, lineno), or None when the arity
+    cannot be known statically (*args, non-local callables, partials)."""
+    if isinstance(arg, ast.Lambda):
+        a = arg.args
+        if a.vararg is not None:
+            return None
+        return (len(a.posonlyargs) + len(a.args), arg.lineno)
+    if isinstance(arg, ast.Name) and arg.id in local_defs:
+        fn = local_defs[arg.id]
+        a = fn.args
+        if a.vararg is not None:
+            return None
+        return (len(a.posonlyargs) + len(a.args), arg.lineno)
+    return None
+
+
+@register
+class PallasPrefetchArityRule(Rule):
+    name = "pallas-prefetch-arity"
+    description = ("BlockSpec index_map arity does not match the "
+                   "enclosing PrefetchScalarGridSpec (len(grid) + "
+                   "num_scalar_prefetch arguments)")
+    kind = "semantic"
+    scope = "package"
+
+    def check(self, ctx):
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            expected = set()
+            local_defs = {}
+            specs = []
+            for node in ast.walk(func):
+                if node is not func and isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    local_defs[node.name] = node
+                if not isinstance(node, ast.Call):
+                    continue
+                last = (_call_name(node.func) or "").split(".")[-1]
+                if last == "PrefetchScalarGridSpec":
+                    expected.add(_prefetch_arity(node))
+                elif last == "BlockSpec":
+                    specs.append(node)
+            # only a single unambiguous literal grid spec pins the arity
+            # (zero or several leave the expectation unknown — pass)
+            if len(expected) != 1 or None in expected:
+                continue
+            want = expected.pop()
+            for spec in specs:
+                arg = None
+                if len(spec.args) >= 2:
+                    arg = spec.args[1]
+                else:
+                    for kw in spec.keywords:
+                        if kw.arg == "index_map":
+                            arg = kw.value
+                if arg is None:
+                    continue
+                got = _index_map_params(arg, local_defs)
+                if got is None or got[0] == want:
+                    continue
+                yield Finding(
+                    ctx.path, got[1], self.name,
+                    f"index_map takes {got[0]} args but the enclosing "
+                    f"PrefetchScalarGridSpec passes {want} (len(grid) + "
+                    "num_scalar_prefetch); the missing scalar-prefetch "
+                    "refs fail at trace time with a bare arity TypeError")
 
 
 @register

@@ -115,6 +115,102 @@ def make_decode_step(impl="kernel", n_slots=None, page_size=None,
     return step, params, cache, (toks, temps, seeds, ords)
 
 
+# The prefill_ms segment workload (bench.py --segments): steady-state
+# batched multi-row prefill into a paged pool — every row already
+# holding `fill` tokens of context, each timed dispatch pushing one
+# more `chunk`-wide slab for ALL rows through _jitted_slot_prefill_many.
+# `fill` is deliberately NOT page-aligned (matching FLAGSHIP_DECODE's)
+# so the steady state exercises the page-straddling chunk path.  The
+# contrast is the paged S>1 WRITE discipline ("kernel" = the Pallas
+# paged-prefill flash kernel writing W = chunk//page + 1 pages per row
+# in place, "blend" = the one-hot einsum blend that materializes the
+# ENTIRE pool every chunk — TransformerConfig.paged_prefill_impl).
+# Frozen like FLAGSHIP_DECODE: changing any value invalidates
+# prefill_ms comparability.
+FLAGSHIP_PREFILL_KERNEL = dict(n_slots=4, page_size=64, max_seq=4096,
+                               fill=2000, chunk=256)
+
+
+def make_prefill_chunk_step(impl="kernel", n_slots=None, page_size=None,
+                            max_seq=None, fill=None, chunk=None):
+    """Build the steady-state paged prefill chunk step for the
+    prefill_ms segment: flagship-LM dims (FLAGSHIP_LM_V2) at
+    ``max_seq``, every row fully page-mapped, each dispatch prefilling
+    the same ``chunk``-wide slab at offset ``fill`` for all ``n_slots``
+    rows at once.  Re-dispatch is idempotent — the row indices are SET
+    to ``fill + chunk`` (not accumulated) and the same pages are
+    rewritten — so timing loops just rebind the donated cache.
+    ``impl`` picks the paged S>1 prefill path ("kernel" = the Pallas
+    in-place page-write kernel, "blend" = the full-pool einsum blend —
+    TransformerConfig.paged_prefill_impl).  Returns
+    ``(prefill, params, cache, (chunks, rows, starts, n_valids, sink))``;
+    advance with ``logits, cache = prefill(params, cache, *args)``.
+    The kv content is untrained garbage: prefill cost is shape-bound,
+    not value-bound, so timing is unaffected."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import decode as decode_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_PREFILL_KERNEL
+    n_slots = n_slots or d["n_slots"]
+    page = page_size or d["page_size"]
+    max_seq = max_seq or d["max_seq"]
+    fill = d["fill"] if fill is None else fill
+    chunk = chunk or d["chunk"]
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    # params don't depend on seq length: init with a short trace
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    max_pages = max_seq // page
+    n_pages = n_slots * max_pages + 1       # +1 = the sink page
+    slot_model, cache = decode_mod.init_paged_slot_cache(
+        model, n_slots, page, n_pages, paged_prefill_impl=impl)
+    set_table = decode_mod._jitted_set_row_page_table(slot_model)
+    for row in range(n_slots):
+        entries = jnp.arange(row * max_pages, (row + 1) * max_pages,
+                             dtype=jnp.int32)
+        cache = set_table(cache, jnp.asarray(row, jnp.int32), entries)
+    prefill = decode_mod._jitted_slot_prefill_many(slot_model)
+    rs = np.random.RandomState(0)
+    chunks = jnp.asarray(rs.randint(1, cfg.vocab_size, (n_slots, chunk)),
+                         jnp.int32)
+    rows = jnp.arange(n_slots, dtype=jnp.int32)
+    starts = jnp.full((n_slots,), fill, jnp.int32)
+    n_valids = jnp.full((n_slots,), chunk, jnp.int32)
+    sink = jnp.asarray(n_pages - 1, jnp.int32)
+    return prefill, params, cache, (chunks, rows, starts, n_valids, sink)
+
+
+def prefill_chunk_write_bytes(impl, n_slots=None, page_size=None,
+                              max_seq=None, chunk=None):
+    """Analytic KV-pool WRITE traffic per prefill_ms dispatch (all
+    layers, k + v, bf16 pool): the blend path materializes a full new
+    pool every chunk — every page, occupied or not — while the kernel
+    writes only the W = chunk//page + 1 pages each row's chunk can
+    touch, in place.  The segment reports both so the
+    traffic-scales-with-chunk claim is a number in the JSON, not
+    prose."""
+    d = FLAGSHIP_PREFILL_KERNEL
+    n_slots = n_slots or d["n_slots"]
+    page = page_size or d["page_size"]
+    max_seq = max_seq or d["max_seq"]
+    chunk = chunk or d["chunk"]
+    n_kv = FLAGSHIP_LM_V2["n_kv_heads"]
+    dh = FLAGSHIP_LM_V2["d_model"] // FLAGSHIP_LM_V2["n_heads"]
+    page_bytes = page * n_kv * dh * 2       # bf16 kv pool
+    if impl == "blend":
+        pages = n_slots * (max_seq // page) + 1   # the WHOLE pool
+    else:
+        pages = n_slots * (chunk // page + 1)     # W pages/row, in place
+    return FLAGSHIP_LM_V2["n_layers"] * 2 * pages * page_bytes
+
+
 # The ttft_ms segment workload (bench.py --segments): a burst of queued
 # prompts admitted through the continuous batcher's prefill engine —
 # time-to-first-token with batched multi-row prefill (prefill_rows=4)

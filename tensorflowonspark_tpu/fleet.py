@@ -910,6 +910,11 @@ class Gateway:
                   "prefix_hits": 0, "prefix_misses": 0, "host_hits": 0,
                   "host_demotions": 0, "host_evictions": 0,
                   "host_cache_bytes": 0, "host_pages_cached": 0,
+                  # paged prefill path split: Pallas kernel dispatches
+                  # vs blend fallbacks sum across replicas (dense
+                  # replicas contribute 0 to both)
+                  "prefill_kernel_dispatches": 0,
+                  "prefill_blend_fallbacks": 0,
                   "ttft_count": 0, "ttft_ms_sum": 0.0,
                   "decode_steps": 0, "pipeline_depth_peak": 0,
                   "migrations_started": 0, "migrations_completed": 0,
@@ -951,7 +956,9 @@ class Gateway:
                                 "prefix_hits", "prefix_misses",
                                 "host_hits", "host_demotions",
                                 "host_evictions", "host_cache_bytes",
-                                "host_pages_cached"):
+                                "host_pages_cached",
+                                "prefill_kernel_dispatches",
+                                "prefill_blend_fallbacks"):
                         totals[key] += int(gstats.get(key) or 0)
                     # TTFT: only count/sum are summable across replicas
                     # (percentiles aren't — each replica keeps its own

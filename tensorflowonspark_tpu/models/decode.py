@@ -150,14 +150,19 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
 # only, TFModel.scala:245-292).
 
 def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
-                    kv_dtype=None, paged_attn_impl=None):
+                    kv_dtype=None, paged_attn_impl=None,
+                    paged_prefill_impl=None):
     """Build the slot-decode model + empty cache with `n_slots` rows.
     ``page_size``/``n_pages`` > 0 switches to the PAGED kv layout
     (see `init_paged_slot_cache`); ``kv_dtype="int8"`` quantizes the
     cache storage (TransformerConfig.kv_dtype); ``paged_attn_impl``
     picks the paged READ path ("kernel" = the Pallas flash-decode
     kernel, "einsum" = the gather reference —
-    TransformerConfig.paged_attn_impl; None keeps the config's)."""
+    TransformerConfig.paged_attn_impl; None keeps the config's);
+    ``paged_prefill_impl`` picks the paged S>1 chunk path ("kernel" =
+    the Pallas in-place page-write + chunked flash read, "blend" = the
+    one-hot einsum blend reference —
+    TransformerConfig.paged_prefill_impl; None keeps the config's)."""
     from tensorflowonspark_tpu.models.transformer import (
         Transformer, TransformerConfig)
 
@@ -172,7 +177,9 @@ def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
             kv_page_size=page_size, kv_pages=n_pages,
             **({"kv_dtype": kv_dtype} if kv_dtype is not None else {}),
             **({"paged_attn_impl": paged_attn_impl}
-               if paged_attn_impl is not None else {})))
+               if paged_attn_impl is not None else {}),
+            **({"paged_prefill_impl": paged_prefill_impl}
+               if paged_prefill_impl is not None else {})))
     shapes = jax.eval_shape(
         lambda: slot_model.init(jax.random.key(0),
                                 jnp.zeros((n_slots, 1), jnp.int32)))
@@ -182,7 +189,8 @@ def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
 
 
 def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages,
-                          kv_dtype=None, paged_attn_impl=None):
+                          kv_dtype=None, paged_attn_impl=None,
+                          paged_prefill_impl=None):
     """Build a PAGED slot-decode model + empty cache: kv lives in a
     shared pool of ``n_pages`` pages of ``page_size`` tokens, mapped per
     row through a page table (TransformerConfig.kv_page_size).  The
@@ -196,7 +204,8 @@ def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages,
     kv_pages + 1 and uses the extra page as the sink)."""
     return init_slot_cache(model_or_cfg, n_slots, page_size=page_size,
                            n_pages=n_pages, kv_dtype=kv_dtype,
-                           paged_attn_impl=paged_attn_impl)
+                           paged_attn_impl=paged_attn_impl,
+                           paged_prefill_impl=paged_prefill_impl)
 
 
 def _leaf_name(path):

@@ -20,6 +20,16 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
+# SUBMODULE-path imports (graftcheck note): `tensorflowonspark_tpu.ops`
+# rebinds the attribute `paged_attention` to the re-exported FUNCTION
+# (ops/__init__), so the availability helpers are only reachable through
+# the submodule path.  Hoisted to module scope — these used to run on
+# every traced layer call inside _paged_attention_body.
+from tensorflowonspark_tpu.ops.paged_attention import (
+    paged_attention, paged_attention_available)
+from tensorflowonspark_tpu.ops.paged_prefill import (
+    paged_prefill, paged_prefill_available)
 
 logger = logging.getLogger(__name__)
 
@@ -90,6 +100,16 @@ class TransformerConfig:
     # into the page read); "einsum" = the reference full-gather body
     # (kept for parity tests and as the fallback under an active mesh,
     # where an unpartitionable pallas custom call cannot run)
+    paged_prefill_impl: str = "kernel"  # paged prefill (S>1) WRITE+READ
+    # path: "kernel" = the Pallas paged-prefill kernels
+    # (ops/paged_prefill.py — the chunk's k/v store page-granular and IN
+    # PLACE into the pool via input_output_aliases, int8 requantization
+    # and scale-page writes fused into the store; the read is online
+    # softmax over [occupied context pages || chunk] with no dense
+    # [B, max_seq] kv view) — per-chunk traffic scales with the CHUNK,
+    # not the pool; "blend" = the reference one-hot einsum blend +
+    # full-gather read (O(pool) write / O(max_seq) read per chunk, kept
+    # for parity tests and as the mesh fallback like paged_attn_impl)
 
 
 def apply_rope(x, positions, theta=10000.0):
@@ -226,8 +246,6 @@ class Attention(nn.Module):
                 # dense path: broadcast back to full heads for the
                 # attention cores (the narrow projection already saved
                 # the params + kv-cache HBM; XLA fuses the repeat)
-                from tensorflowonspark_tpu.parallel.ring_attention import (
-                    _kv_repeat)
                 k, v = _kv_repeat(q, k, v)
                 if mask is not None and cfg.attention_impl == "flash":
                     # arbitrary key-padding masks aren't implemented in the
@@ -264,7 +282,6 @@ class Attention(nn.Module):
             # only reachable from here)
             raise ValueError(
                 f"kv_dtype={cfg.kv_dtype!r} not in ('auto', 'int8')")
-        from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
         B, S, n_kv, Dh = k.shape
         L = cfg.max_seq_len
         dtype = k.dtype
@@ -282,6 +299,10 @@ class Attention(nn.Module):
                 raise ValueError(
                     f"paged_attn_impl={cfg.paged_attn_impl!r} not in "
                     "('kernel', 'einsum')")
+            if cfg.paged_prefill_impl not in ("kernel", "blend"):
+                raise ValueError(
+                    f"paged_prefill_impl={cfg.paged_prefill_impl!r} not "
+                    "in ('kernel', 'blend')")
             return _paged_attention_body(self, q, k, v)
         quant = cfg.kv_dtype == "int8"
         store = jnp.int8 if quant else dtype
@@ -401,8 +422,14 @@ def _paged_attention_body(attn_self, q, k, v):
     page, n_kv, Dh]``; each row owns the pool pages its per-row
     ``page_table [B, max_seq/page]`` names (the serving layer allocates
     them from a free list at admission and returns them at retirement —
-    serve.ContinuousBatcher).  Writes follow the measured slot-cache
-    rule (one-hot masked blend, never a scatter: BASELINE.md round 4).
+    serve.ContinuousBatcher).  Prefill chunks (S > 1) default to the
+    Pallas paged-prefill kernels (``cfg.paged_prefill_impl ==
+    "kernel"``, ops/paged_prefill.py): page-granular in-place pool
+    stores + one online softmax over [occupied context pages || chunk],
+    O(chunk) traffic with the blend below kept as the parity reference
+    and the mesh fallback.  Decode steps (S == 1) and the "blend"
+    impl follow the measured slot-cache rule (one-hot masked blend,
+    never a scatter: BASELINE.md round 4).
     Reads go through ``cfg.paged_attn_impl``: "kernel" (the default)
     runs the Pallas flash-decode kernel, which walks each row's page
     table in place and touches only its OCCUPIED pages — per-token read
@@ -426,7 +453,6 @@ def _paged_attention_body(attn_self, q, k, v):
     live row.
     """
     cfg = attn_self.cfg
-    from tensorflowonspark_tpu.parallel.ring_attention import _kv_repeat
     B, S, n_kv, Dh = k.shape
     P, NP = cfg.kv_page_size, cfg.kv_pages
     max_pages = cfg.max_seq_len // P
@@ -452,6 +478,25 @@ def _paged_attention_body(attn_self, q, k, v):
         kf, vf = _kv_repeat(q, k, v)
         return dot_product_attention(q, kf, vf, causal=cfg.causal)
     idx = ci.value
+    if (S > 1 and cfg.paged_prefill_impl == "kernel"
+            and paged_prefill_available() and _ambient_mesh() is None):
+        # Pallas paged-prefill kernels (ops/paged_prefill.py): the
+        # chunk's k/v store page-granular IN PLACE into the pool
+        # (int8 requantization fused, bit-identical to the blend's
+        # bytes), then one online softmax over [occupied context pages
+        # || chunk] — per-chunk traffic scales with the chunk, never
+        # the pool, and no dense [B, max_seq] kv view exists.  S == 1
+        # decode keeps the blend write + flash-decode read below
+        # (split-K pays off there; a one-token page store does not).
+        out, new_pools = paged_prefill(
+            q, k, v, pk.value, pv.value, table.value, idx,
+            key_scales=pks.value if quant else None,
+            value_scales=pvs.value if quant else None)
+        pk.value, pv.value = new_pools[0], new_pools[1]
+        if quant:
+            pks.value, pvs.value = new_pools[2], new_pools[3]
+        ci.value = idx + S
+        return out
     pos = idx[:, None] + jnp.arange(S)[None, :]              # [B, S]
     block = jnp.clip(pos // P, 0, max_pages - 1)
     phys = jnp.take_along_axis(table.value, block, axis=1)   # [B, S]
@@ -484,10 +529,6 @@ def _paged_attention_body(attn_self, q, k, v):
             "bsn,bso,bsh->noh", oh_p.astype(jnp.float32),
             oh_o.astype(jnp.float32), v_sc), pvs.value)
     ci.value = idx + S
-    # submodule-path import: the bare package attribute is the
-    # re-exported FUNCTION (ops/__init__), not this module
-    from tensorflowonspark_tpu.ops.paged_attention import (
-        paged_attention, paged_attention_available)
     if (cfg.paged_attn_impl == "kernel" and paged_attention_available()
             and _ambient_mesh() is None):
         # in-place page walk: lengths = the post-write cache_index (the

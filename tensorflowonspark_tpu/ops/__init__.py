@@ -19,15 +19,23 @@ interpret-mode path so the same kernels are testable on the CPU mesh.
   logical-view gather), online softmax + split-K LSE combine, int8
   dequant fused into the page read (see ops/paged_attention.py;
   the default paged read path, TransformerConfig.paged_attn_impl)
+- paged_prefill : chunked prefill over the same pool — the chunk's k/v
+  store page-granular and IN PLACE (input_output_aliases, int8
+  requantization fused into the page store), then one online softmax
+  over [occupied context pages || chunk]; O(chunk) traffic, no dense
+  [B, max_seq] kv view (see ops/paged_prefill.py; the default S>1
+  paged path, TransformerConfig.paged_prefill_impl)
 """
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 from tensorflowonspark_tpu.ops.fused_optim import adamw_fused, lion_fused
 from tensorflowonspark_tpu.ops.layernorm import fused_layernorm
 from tensorflowonspark_tpu.ops.paged_attention import paged_attention
+from tensorflowonspark_tpu.ops.paged_prefill import paged_prefill
 from tensorflowonspark_tpu.ops.xent import fused_unembed_xent
 
 __all__ = ["flash_attention", "fused_layernorm", "fused_unembed_xent",
-           "adamw_fused", "lion_fused", "paged_attention"]
+           "adamw_fused", "lion_fused", "paged_attention",
+           "paged_prefill"]
 
 
 def default_interpret():

@@ -142,6 +142,15 @@ def build_argparser():
                         "the full-gather reference body (parity / "
                         "debugging).  Only meaningful with "
                         "--generate_kv_page_size")
+    p.add_argument("--generate_paged_prefill", choices=["kernel", "blend"],
+                   default=None,
+                   help="paged prefill (S>1 chunk) path: \"kernel\" "
+                        "(default) = the Pallas paged-prefill kernels "
+                        "(page-granular in-place pool writes + chunked "
+                        "flash read, O(chunk) traffic); \"blend\" = the "
+                        "one-hot einsum blend + full-gather reference "
+                        "(parity / debugging).  Only meaningful with "
+                        "--generate_kv_page_size")
     p.add_argument("--generate_kv_dtype", choices=["auto", "int8"],
                    default="auto",
                    help="int8 = quantized slot kv cache (int8 payload + "
@@ -416,6 +425,8 @@ class ModelService:
         self._gen_kv_dtype = getattr(args, "generate_kv_dtype",
                                      "auto") or "auto"
         self._gen_paged_attn = getattr(args, "generate_paged_attn", None)
+        self._gen_paged_prefill = getattr(args, "generate_paged_prefill",
+                                          None)
         self._gen_quantize = getattr(args, "generate_quantize",
                                      "none") or "none"
         self._gen_lora_rank = getattr(args, "generate_lora_rank", 0) or 0
@@ -488,6 +499,7 @@ class ModelService:
                         lora_adapters=self._gen_lora,
                         kv_dtype=self._gen_kv_dtype,
                         paged_attn_impl=self._gen_paged_attn,
+                        paged_prefill_impl=self._gen_paged_prefill,
                         engine=self._gen_engine,
                         pipeline_depth=self._gen_pipeline_depth,
                         prio_weight=self._gen_prio_weight,
@@ -767,7 +779,8 @@ class ContinuousBatcher:
                  draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
                  host_cache_mb=0,
                  lora_rank=0, lora_capacity=8, kv_dtype=None,
-                 paged_attn_impl=None, engine="async", pipeline_depth=2,
+                 paged_attn_impl=None, paged_prefill_impl=None,
+                 engine="async", pipeline_depth=2,
                  prio_weight=4, preempt_ms=0.0, park_capacity=8):
         import itertools
         import queue as queue_mod
@@ -833,7 +846,17 @@ class ContinuousBatcher:
             self._total_pages = int(kv_pages)
             self.slot_model, self._cache = decode_mod.init_paged_slot_cache(
                 model, n_slots, self.kv_page_size, int(kv_pages) + 1,
-                kv_dtype=kv_dtype, paged_attn_impl=paged_attn_impl)
+                kv_dtype=kv_dtype, paged_attn_impl=paged_attn_impl,
+                paged_prefill_impl=paged_prefill_impl)
+            # host-side mirror of the model's S>1 prefill gate (the
+            # branch resolves at trace time, so the jit itself cannot
+            # count): drives the prefill_kernel_dispatches /
+            # prefill_blend_fallbacks observability split
+            from .ops.paged_prefill import paged_prefill_available
+
+            self._prefill_kernel_active = (
+                self.slot_model.cfg.paged_prefill_impl == "kernel"
+                and paged_prefill_available())
             self._set_table = decode_mod._jitted_set_row_page_table(
                 self.slot_model)
             # device-thread-owned free list; stats() only takes len() of a
@@ -1154,6 +1177,13 @@ class ContinuousBatcher:
             out["kv_pages_used"] = self._total_pages - free
             out["kv_page_size"] = self.kv_page_size
             out["paged_attn_impl"] = self.slot_model.cfg.paged_attn_impl
+            out["paged_prefill_impl"] = (
+                self.slot_model.cfg.paged_prefill_impl)
+            # S>1 prefill path split (kernel vs blend), present-at-zero
+            # so fleet totals see the keys before the first dispatch
+            for key in ("prefill_kernel_dispatches",
+                        "prefill_blend_fallbacks"):
+                out[key] = self.counters.get(key)
             out["admission_waiting_for_pages"] = self._parked is not None
             out["prefix_pages_cached"] = len(self._prefix)
             out["prefill_tokens_shared"] = self.prefill_tokens_shared
@@ -2143,6 +2173,13 @@ class ContinuousBatcher:
                 self.draft_params, self._d_cache, chunks, rows, starts,
                 n_valids, jnp.asarray(0, jnp.int32))
         self.counters.inc("prefill_dispatches")
+        if self.kv_page_size:
+            # which S>1 path served this dispatch: the Pallas paged-
+            # prefill kernels or the einsum blend (impl="blend", or
+            # pallas-tpu unavailable on this jaxlib)
+            self.counters.inc("prefill_kernel_dispatches"
+                              if self._prefill_kernel_active
+                              else "prefill_blend_fallbacks")
         for i, adm in enumerate(selected):
             if adm not in finishing:
                 continue
@@ -3497,7 +3534,8 @@ class GenerateService:
                  kv_page_size=0, kv_pages=0, host_cache_mb=0,
                  quantize_mode="none",
                  lora_rank=0, lora_capacity=8, lora_adapters=None,
-                 kv_dtype="auto", paged_attn_impl=None, engine="async",
+                 kv_dtype="auto", paged_attn_impl=None,
+                 paged_prefill_impl=None, engine="async",
                  pipeline_depth=2, prio_weight=4, preempt_ms=0.0,
                  park_capacity=8):
         import itertools
@@ -3524,7 +3562,9 @@ class GenerateService:
             host_cache_mb=host_cache_mb,
             lora_rank=lora_rank, lora_capacity=lora_capacity,
             kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype),
-            paged_attn_impl=paged_attn_impl, engine=engine or "async",
+            paged_attn_impl=paged_attn_impl,
+            paged_prefill_impl=paged_prefill_impl,
+            engine=engine or "async",
             pipeline_depth=pipeline_depth, prio_weight=prio_weight,
             preempt_ms=preempt_ms, park_capacity=park_capacity)
         try:
@@ -4104,6 +4144,8 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer,
         features["kv_pages"] = args.generate_kv_pages
         features["paged_attn_impl"] = (
             getattr(args, "generate_paged_attn", None) or "kernel")
+        features["paged_prefill_impl"] = (
+            getattr(args, "generate_paged_prefill", None) or "kernel")
     if getattr(args, "generate_host_cache_mb", 0) and \
             getattr(args, "generate_kv_page_size", 0):
         # hierarchical kv cache: advertise the kv:prefix pull endpoint

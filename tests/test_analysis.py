@@ -247,6 +247,58 @@ def test_pallas_tile_negative_aligned_smem_symbolic():
     assert hits == []
 
 
+def test_pallas_prefetch_arity_positive():
+    hits, fs = run("""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def build(B, W):
+            # grid len 2 + 2 scalar refs = 4-arg index_maps required
+            short = pl.BlockSpec((1, 128), lambda b, w: (b, 0))
+            kwarg = pl.BlockSpec((1, 128),
+                                 index_map=lambda b, w, tr: (b, 0))
+            spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2, grid=(B, W),
+                in_specs=[short, kwarg])
+            return spec
+    """, ["pallas-prefetch-arity"])
+    assert hits == [("pallas-prefetch-arity", 7),
+                    ("pallas-prefetch-arity", 9)]
+    assert "num_scalar_prefetch" in fs[0].message
+
+
+def test_pallas_prefetch_arity_negative():
+    hits, _ = run("""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def build(B, W):
+            def _page(b, w, tr, sr):
+                return tr[b, w]
+
+            ok = pl.BlockSpec((1, 128), lambda b, w, tr, sr: (b, 0))
+            named = pl.BlockSpec((1, 128), _page)
+            splat = pl.BlockSpec((1, 128), lambda *a: (0, 0))
+            plain = pl.BlockSpec((1, 128))
+            spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2, grid=(B, W),
+                in_specs=[ok, named, splat, plain])
+            return spec
+
+        def no_spec_here(B):
+            # no PrefetchScalarGridSpec in scope: arity unknowable
+            loose = pl.BlockSpec((1, 128), lambda b: (b,))
+            return loose
+
+        def symbolic(B, k, dims):
+            # non-literal num_scalar_prefetch / grid: arity unknowable
+            anyarity = pl.BlockSpec((1, 128), lambda b: (b,))
+            return pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=k, grid=dims, in_specs=[anyarity])
+    """, ["pallas-prefetch-arity"])
+    assert hits == []
+
+
 def test_pallas_interpret_positive_negative():
     hits, _ = run("""
         from jax.experimental import pallas as pl

@@ -26,6 +26,7 @@ def _load_bench():
 def test_segment_registry_shape_and_setup_dry_run():
     bench = _load_bench()
     assert bench.SEGMENTS, "segment registry must not be empty"
+    assert "prefill_ms" in bench.SEGMENTS
     assert "ttft_ms" in bench.SEGMENTS
     assert "engine_tps" in bench.SEGMENTS
     assert "sched_ms" in bench.SEGMENTS

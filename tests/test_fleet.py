@@ -561,6 +561,21 @@ def test_fleet_stats_migration_totals(gateway):
     assert t["kv_pages_exported"] == 10
 
 
+def test_fleet_stats_prefill_path_totals(gateway):
+    # the kernel/blend prefill dispatch split sums across replicas;
+    # replicas that never report the keys (dense, old builds) count 0
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    stubs[0].extra_stats = {"prefill_kernel_dispatches": 5,
+                            "prefill_blend_fallbacks": 1}
+    stubs[1].extra_stats = {"prefill_kernel_dispatches": 2}
+    status, body = _client(gw).fleet_stats()
+    assert status == 200
+    t = body["totals"]
+    assert t["prefill_kernel_dispatches"] == 7
+    assert t["prefill_blend_fallbacks"] == 1
+
+
 def test_fleet_stats_host_tier_totals(gateway):
     # ISSUE-12 satellite: the hierarchical-kv-cache counters sum into
     # the fleet totals beside prefix_pages_cached

@@ -380,6 +380,12 @@ def test_kv_pool_occupancy_and_sink_write_stats(model_and_params):
         s = batcher.stats()
         assert s["kv_pages_used"] == s["kv_pages_total"] - s["kv_pages_free"]
         assert s["paged_attn_impl"] in ("kernel", "einsum")
+        # ISSUE-13: the paged S>1 dispatch path split.  The kernel is
+        # available here (interpret mode on CPU), so the admission's
+        # prefill dispatches count as kernel dispatches, never fallbacks
+        assert s["paged_prefill_impl"] == "kernel"
+        assert s["prefill_kernel_dispatches"] > 0
+        assert s["prefill_blend_fallbacks"] == 0
         # 2 slots with 1 occupied: every dispatch wrote one junk token
         # per idle row into the sink; prefill bucket padding (3-token
         # prompt padded to 8) adds more

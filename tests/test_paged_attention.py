@@ -134,24 +134,21 @@ def test_model_body_kernel_vs_einsum(monkeypatch):
     kernel branch actually traced (a silently-disabled kernel would
     otherwise make this einsum-vs-einsum)."""
     from tensorflowonspark_tpu.models import decode
+    from tensorflowonspark_tpu.models import transformer as tf_mod
     from tensorflowonspark_tpu.models.transformer import (
         Transformer, TransformerConfig)
-    import importlib
 
-    # the package attribute is the re-exported function; patching must
-    # target the real submodule (transformer re-imports from it per
-    # trace, so the spy is seen)
-    pa_mod = importlib.import_module(
-        "tensorflowonspark_tpu.ops.paged_attention")
-
+    # the kernel entry point is a module-scope binding of transformer.py
+    # now (hoisted from _paged_attention_body), so the spy patches THAT
+    # binding — the tracing below reads it through the module global
     traced = {"kernel": False}
-    real = pa_mod.paged_attention
+    real = tf_mod.paged_attention
 
     def spy(*a, **kw):
         traced["kernel"] = True
         return real(*a, **kw)
 
-    monkeypatch.setattr(pa_mod, "paged_attention", spy)
+    monkeypatch.setattr(tf_mod, "paged_attention", spy)
 
     # distinctive dims so the lru-cached jits can't be a stale trace
     # from another test file (the spy must see THIS tracing)
