@@ -247,17 +247,27 @@ def bench_engine_segment(reps=3, result_timeout=600):
     double-buffered pipeline vs the serialized single-thread baseline.
     Per engine: one warmup burst pays the compiles, then best
     tokens/s of the remaining bursts from wall clock (generated tokens
-    only).  Returns (async_tps, serial_tps, stats) where ``stats`` holds
-    the async engine's device_idle_fraction and pipeline_depth_peak."""
+    only).  A third pass re-runs the async engine with EVERY request
+    traced (fresh trace id per submit) to price the observability
+    layer: its span recording must be lost in the noise, and the
+    ``trace_overhead`` aux keeps that claim regression-checked.
+    Returns (async_tps, traced_tps, serial_tps, stats) where ``stats``
+    holds the async engine's device_idle_fraction and
+    pipeline_depth_peak."""
+    from tensorflowonspark_tpu import trace
     from tensorflowonspark_tpu.benchmarks import make_engine_burst
 
-    def timed(engine):
+    def timed(engine, traced=False):
         batcher, prompts, max_new = make_engine_burst(engine=engine)
         try:
             best = 0.0
             for rep in range(max(2, reps)):
                 t0 = time.perf_counter()
-                handles = [batcher.submit(p, max_new) for p in prompts]
+                handles = [
+                    batcher.submit(p, max_new,
+                                   trace_id=(trace.new_id() if traced
+                                             else None))
+                    for p in prompts]
                 total = sum(len(h.result(timeout=result_timeout)) - len(p)
                             for h, p in zip(handles, prompts))
                 tps = total / (time.perf_counter() - t0)
@@ -269,8 +279,9 @@ def bench_engine_segment(reps=3, result_timeout=600):
         return best, stats
 
     async_tps, astats = timed("async")
+    traced_tps, _ = timed("async", traced=True)
     serial_tps, _ = timed("serial")
-    return async_tps, serial_tps, astats
+    return async_tps, traced_tps, serial_tps, astats
 
 
 def bench_migrate_segment(reps=5, result_timeout=600):
@@ -650,11 +661,17 @@ def _engine_segment_setup():
 
 
 def _engine_segment_result():
-    async_tps, serial_tps, astats = bench_engine_segment()
+    async_tps, traced_tps, serial_tps, astats = bench_engine_segment()
     return {"metric": "engine_tps", "value": round(async_tps, 1),
             "unit": "tokens/s",
             "aux": {"engine_tps_serial": round(serial_tps, 1),
                     "speedup_vs_serial": round(async_tps / serial_tps, 2),
+                    # fractional tokens/s lost with every request
+                    # traced (negative = noise); keeps "tracing is
+                    # free on the hot path" an actual regression check
+                    "engine_tps_traced": round(traced_tps, 1),
+                    "trace_overhead":
+                        round(1.0 - traced_tps / async_tps, 4),
                     "device_idle_fraction":
                         astats.get("device_idle_fraction", 0.0),
                     "pipeline_depth_peak":

@@ -187,6 +187,22 @@ SPECS = (
         acquire=("journal_open",),
         release=("journal_close",),
     ),
+    # Request-trace spans (trace.py).  `begin` opens a span whose dict
+    # is the resource; exactly one of `end` (record) or `abandon`
+    # (discard, e.g. on an exception path) must close it — a span left
+    # open is a hole in the request timeline that reads as "stage still
+    # running" forever.  Hot paths sidestep the discipline entirely by
+    # using `event`/`span_at` (no open resource ever exists), so this
+    # spec guards exactly the explicit begin/end sites.  Bare patterns:
+    # recorders are reached as `self.trace.begin`, `rec.begin`, ... and
+    # no other repo call is named begin/end/abandon.
+    ResourceSpec(
+        name="trace-span",
+        description="open request-trace span (trace.Recorder.begin "
+                    "→ end/abandon)",
+        acquire=("begin",),
+        release=("end", "abandon"),
+    ),
     # jax.jit donated buffers.  Not acquire/release shaped: donation is
     # inferred from donate_argnums/donate_argnames on jitted callables
     # (including the `_jitted_*` factory idiom in models/decode.py) and

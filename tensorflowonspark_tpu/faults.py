@@ -73,6 +73,11 @@ SITES = frozenset({
     "kvtransfer.prefix_pull",  # pull_prefix: cross-replica kv:prefix pull
                                # (a raise = peer unreachable; the replica
                                # falls back to its own tier + prefill)
+    "trace.export",            # trace.Recorder._push (deny = spans are
+                               # dropped silently) and the /metrics +
+                               # /v1/trace HTTP exporters (a raise = the
+                               # endpoint 500s); serving itself must
+                               # never notice either way
 })
 
 KINDS = ("oserror", "eof", "delay", "deny")

@@ -60,8 +60,8 @@ import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import faults, reservation, util
-from .metrics import Counters
+from . import faults, reservation, trace, util
+from .metrics import Counters, LatencyWindow, prometheus_text
 
 logger = logging.getLogger(__name__)
 
@@ -345,6 +345,9 @@ class Gateway:
         self._tenant_inflight = {}
         self._wfq = WeightedFairQueue()
         self.counters = Counters()
+        # gateway-side span ring: route/relay/replay spans, stitched
+        # with replica spans by GET /v1/trace/<id>
+        self.trace = trace.Recorder()
         self._replicas = {}
         self._lock = threading.RLock()
         self._registry = _Registry(self)
@@ -747,7 +750,8 @@ class Gateway:
                 "stops": body.get("stop") or [],
                 "rep": float(body.get("repetition_penalty", 1.0)),
                 "adapter": body.get("adapter"),
-                "priority": body.get("priority")}
+                "priority": body.get("priority"),
+                "trace": body.get("trace")}
 
     def _synth_done(self, body, tokens):
         """The ``done`` event for a journaled session that already saw
@@ -931,6 +935,7 @@ class Gateway:
             totals[f"ttft_{cls}_ms_sum"] = 0.0
             totals[f"qdelay_{cls}_count"] = 0
             totals[f"qdelay_{cls}_ms_sum"] = 0.0
+        hist_acc = {}        # "<stem>_hist" -> per-replica histograms
         for rid, (r, desc) in snap.items():
             if rid in beats:
                 desc["last_beat_age_s"] = round(now - beats[rid], 3)
@@ -961,8 +966,10 @@ class Gateway:
                                 "prefill_blend_fallbacks"):
                         totals[key] += int(gstats.get(key) or 0)
                     # TTFT: only count/sum are summable across replicas
-                    # (percentiles aren't — each replica keeps its own
-                    # p50/p95 in its stats snapshot)
+                    # (exact percentiles aren't — the fleet-wide view
+                    # comes from the merged *_hist bucket counts below,
+                    # which ARE summable; each replica still keeps its
+                    # exact window p50/p95 in its own stats snapshot)
                     totals["ttft_count"] += int(
                         gstats.get("ttft_count") or 0)
                     totals["ttft_ms_sum"] += float(
@@ -992,8 +999,26 @@ class Gateway:
                                 gstats.get(f"{stem}_count") or 0)
                             totals[f"{stem}_ms_sum"] += float(
                                 gstats.get(f"{stem}_ms_sum") or 0.0)
+                    for key, val in gstats.items():
+                        if (key.endswith("_hist")
+                                and isinstance(val, dict) and "le" in val):
+                            hist_acc.setdefault(key, []).append(val)
                 except (OSError, ValueError) as e:
                     desc["probe_error"] = str(e)
+        # the fleet-p95 gap: replica-window percentiles don't compose,
+        # but fixed-bucket histograms do — merge each latency family's
+        # buckets across replicas and estimate quantiles from the sum
+        # (histogram_quantile semantics: interpolated within a bucket)
+        for key in sorted(hist_acc):
+            merged = LatencyWindow.merge_histograms(hist_acc[key])
+            if merged is None:
+                continue
+            stem = key[:-len("_hist")]
+            totals[key] = merged
+            totals[f"{stem}_p50_est_ms"] = \
+                LatencyWindow.quantile_from_histogram(merged, 0.50)
+            totals[f"{stem}_p95_est_ms"] = \
+                LatencyWindow.quantile_from_histogram(merged, 0.95)
         totals["ttft_ms_sum"] = round(totals["ttft_ms_sum"], 3)
         totals["ttft_avg_ms"] = (
             round(totals["ttft_ms_sum"] / totals["ttft_count"], 3)
@@ -1024,6 +1049,58 @@ class Gateway:
                             "wfq_depth": len(self._wfq),
                             "retry_after_cap_s": self.retry_after_cap_s,
                             "registry": list(self.registry_addr or ())}}
+
+    def metrics_text(self, probe=True):
+        """Prometheus text exposition for ``GET /metrics``: the
+        gateway's own counters + trace-ring gauges, the merged fleet
+        totals (incl. the merged-histogram quantile estimates), and —
+        with `probe` — one ``{replica="<id>"}``-labeled group per live
+        replica, so a single gateway scrape covers the whole fleet."""
+        stats = self.fleet_stats(probe=probe)
+        gw_stats = dict(stats["counters"])
+        gw_stats.update(self.trace.stats())
+        groups = [("gateway", None, gw_stats),
+                  ("fleet", None, stats["totals"])]
+        for rid, desc in sorted(stats["replicas"].items()):
+            gstats = (desc.get("model") or {}).get("generate_stats")
+            if gstats:
+                groups.append(("replica", {"replica": rid}, gstats))
+        return prometheus_text(groups)
+
+    def trace_timeline(self, trace_id):
+        """One stitched timeline for `trace_id`: the gateway's own
+        route/relay/replay spans plus every replica's — including a
+        migration destination's, since the id rides the wire snapshot
+        meta — tagged by source and time-sorted.  Clocks are
+        per-process monotonic, so cross-source ordering is best-effort;
+        within one source it is exact."""
+        spans = [dict(s, source="gateway")
+                 for s in self.trace.spans(trace_id)]
+        with self._lock:
+            replicas = list(self._replicas.values())
+        errors = {}
+        for r in replicas:
+            if r.state == EJECTED:
+                continue
+            try:
+                status, out = self.probe(r, f"/v1/trace/{trace_id}")
+                if status != 200:
+                    raise ValueError(f"status {status}")
+            except (OSError, ValueError) as e:
+                # a silent replica costs coverage, never the endpoint
+                errors[r.id] = str(e)
+                continue
+            for s in out.get("spans") or ():
+                if isinstance(s, dict):
+                    spans.append(dict(s, source=r.id))
+        spans.sort(key=lambda s: s.get("t0_ms") or 0.0)
+        out = {"id": trace_id, "spans": spans,
+               "sources": sorted({s["source"] for s in spans}),
+               "stages": sorted({s.get("name") for s in spans
+                                 if s.get("name")})}
+        if errors:
+            out["probe_errors"] = errors
+        return out
 
 
 class NoReplica(RuntimeError):
@@ -1056,6 +1133,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         for k, v in headers:
             self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code, text):
+        body = text.encode("utf-8")
+        self.send_response(code)
+        # the version=0.0.4 content type Prometheus scrapers expect
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
@@ -1153,6 +1240,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         # priority rides the JOURNALED body: a re-drive after replica
         # death must admit under the same class the first drive did
         body.setdefault("priority", cls)
+        # ...and so does the trace id (client-sent via body/X-Trace-Id,
+        # minted here otherwise): every re-drive and every migration
+        # destination records under the SAME id, which is what lets
+        # GET /v1/trace/<id> stitch one timeline out of all of them
+        if not trace.valid_id(body.get("trace")):
+            hdr = self.headers.get("X-Trace-Id")
+            body["trace"] = hdr if trace.valid_id(hdr) else trace.new_id()
         entry = gw.journal.journal_open(body)
         try:
             self._drive_stream(entry, name, tenant, cls)
@@ -1168,6 +1262,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         passes.  A mid-stream session with NOTHING routable waits (the
         journal is its queue) for a readmission to rescue it."""
         gw, body = self.gateway, entry["body"]
+        tid = body.get("trace")
         state = {"started": False}
         deadline = time.monotonic() + gw.redrive_deadline_s
         failed = set()
@@ -1183,6 +1278,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 self._chunk(json.dumps(ev).encode() + b"\n")
                 self._end_stream()
                 return
+            t_route = time.monotonic()
             try:
                 try:
                     r = gw._choose_degraded(
@@ -1213,9 +1309,21 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 time.sleep(min(0.25,
                                max(0.0, deadline - time.monotonic())))
                 continue
+            # gateway.route covers the WFQ wait too: _choose_degraded
+            # blocks inside the fair queue when the class is saturated
+            gw.trace.span_at(tid, "gateway.route", t_route,
+                             time.monotonic(), replica=r.id, cls=cls,
+                             attempt=attempt)
             if attempt:
                 gw.counters.inc("session_redrives")
+                gw.trace.event(tid, "gateway.replay", replica=r.id,
+                               attempt=attempt,
+                               tokens_journaled=len(entry["tokens"]))
+            t_relay = time.monotonic()
             ok, err = self._attempt_stream(r, entry, state, name)
+            gw.trace.span_at(tid, "gateway.relay", t_relay,
+                             time.monotonic(), replica=r.id,
+                             attempt=attempt, ok=bool(ok))
             if ok:
                 if attempt:
                     gw.counters.inc("sessions_recovered")
@@ -1370,6 +1478,30 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             qs = urllib.parse.parse_qs(urllib.parse.urlsplit(self.path).query)
             probe = qs.get("probe", ["1"])[0] not in ("0", "false")
             self._send(200, gw.fleet_stats(probe=probe))
+        elif path in ("/metrics", "/v1/metrics"):
+            qs = urllib.parse.parse_qs(urllib.parse.urlsplit(self.path).query)
+            probe = qs.get("probe", ["1"])[0] not in ("0", "false")
+            try:
+                # an exporter failure 500s the SCRAPE only — serving
+                # never routes through this path
+                faults.check("trace.export")
+                text = gw.metrics_text(probe=probe)
+            except Exception as e:
+                self._send(500, {"error": f"metrics export failed: {e}"})
+                return
+            self._send_text(200, text)
+        elif path.startswith("/v1/trace/"):
+            tid = path[len("/v1/trace/"):]
+            if not trace.valid_id(tid):
+                self._send(400, {"error": "invalid trace id"})
+                return
+            try:
+                faults.check("trace.export")
+                out = gw.trace_timeline(tid)
+            except Exception as e:
+                self._send(500, {"error": f"trace export failed: {e}"})
+                return
+            self._send(200, out)
         elif path.startswith("/v1/models/"):
             # metadata passthrough: any one healthy replica's view
             try:
@@ -1412,6 +1544,47 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 return
             self._send(200 if out["drained"] else 504, out)
             return
+        if path == "/v1/debug:profile":
+            # on-demand TPU profiling, proxied to one replica
+            # (?replica=<id> pins it; default: any routable pick).
+            # Not quota-fenced — operators profile DURING incidents.
+            qs = urllib.parse.parse_qs(split.query)
+            rid = (qs.get("replica") or [None])[0]
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b"{}"
+            chosen = False
+            if rid:
+                with gw._lock:
+                    r = gw._replicas.get(rid)
+                if r is None:
+                    self._send(404, {"error": f"unknown replica {rid!r}"})
+                    return
+            else:
+                try:
+                    r = gw._choose()
+                    chosen = True
+                except (NoReplica, Saturated) as e:
+                    self._reject(e)
+                    return
+            try:
+                # direct relay, NOT _forward_once: a replica whose
+                # profiler is unavailable answers 503, and that verdict
+                # must reach the operator without tripping the breaker
+                conn, resp = gw._request(r, "POST", "/v1/debug:profile",
+                                         body=body, timeout=30.0)
+            except OSError as e:
+                if chosen:
+                    gw._release(r, ok=False)
+                self._send(502, {"error": f"replica {r.id}: {e}",
+                                 "type": "replica_failure",
+                                 "replica": r.id})
+                return
+            try:
+                self._relay(conn, resp)
+            finally:
+                if chosen:
+                    gw._release(r, ok=True)
+            return
         is_predict = path.startswith("/v1/models/") and \
             path.endswith(":predict")
         is_generate = path.startswith("/v1/models/") and \
@@ -1452,10 +1625,22 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 return
             if isinstance(body_obj, dict):
                 prefix_key = gw.prefix_key(body_obj)
+                rewrite = False
                 if "priority" not in body_obj:
                     # plant the resolved class so the replica's batcher
                     # admits under it (explicit body values win)
                     body_obj["priority"] = cls
+                    rewrite = True
+                # a client-sent X-Trace-Id is planted into the body so
+                # the replica records under it; absent both, the
+                # request runs untraced (non-stream responses have no
+                # event to carry a summary, so minting buys nothing)
+                tid_hdr = self.headers.get("X-Trace-Id")
+                if ("trace" not in body_obj and tid_hdr
+                        and trace.valid_id(tid_hdr)):
+                    body_obj["trace"] = tid_hdr
+                    rewrite = True
+                if rewrite:
                     body = json.dumps(body_obj).encode()
         try:
             # :generate prefers prefill-capable replicas; when the pick
@@ -1464,11 +1649,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             # first tokens flush (the stream keeps riding this proxied
             # connection via the source's relay thread)
             roles = ("prefill", "mixed") if is_generate else None
+            t_route = time.monotonic()
             r = gw._choose_degraded(tenant, cls, prefix_key=prefix_key,
                                     roles=roles)
         except (NoReplica, Saturated) as e:
             self._reject(e)
             return
+        if is_generate and isinstance(body_obj, dict):
+            gw.trace.span_at(body_obj.get("trace"), "gateway.route",
+                             t_route, time.monotonic(), replica=r.id,
+                             cls=cls)
         headers = None
         if is_generate:
             dest = gw.migrate_target(r)

@@ -41,8 +41,11 @@ scatters stay in serve.py on the device thread.
 import logging
 import queue
 import threading
+import time
 
 import numpy as np
+
+from . import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -89,6 +92,10 @@ class HostPageTier:
         self._bytes = 0
         self.demotions = 0       # pages inserted via the demote path
         self.evictions = 0       # entries dropped for capacity
+        # demote batch apply latency (worker thread, host clock): the
+        # histogram exports per-replica via /metrics so a backed-up
+        # tier shows up in scrapes before it shows up as cold turns
+        self._demote_lat = metrics.LatencyWindow()
         self._closed = False
         self._q = queue.Queue()
         self._worker = threading.Thread(target=self._drain,
@@ -203,12 +210,14 @@ class HostPageTier:
                     item[1].set()
                     continue
                 _, keys, kv, n = item
+                t0 = time.monotonic()
                 host = {path: np.asarray(arr) for path, arr in kv.items()}
                 for i, key in enumerate(keys):
                     if i >= n:
                         break
                     self.put(key, {path: a[i] for path, a in host.items()},
                              demotion=True)
+                self._demote_lat.record(time.monotonic() - t0)
             except Exception:
                 # a poisoned demote must not kill the worker: the tier
                 # degrades to a smaller cache, never to a dead thread
@@ -218,11 +227,13 @@ class HostPageTier:
 
     def stats(self):
         with self._lock:
-            return {"host_cache_bytes": int(self._bytes),
-                    "host_cache_capacity_bytes": self.capacity_bytes,
-                    "host_pages_cached": len(self._entries),
-                    "host_demotions": int(self.demotions),
-                    "host_evictions": int(self.evictions)}
+            out = {"host_cache_bytes": int(self._bytes),
+                   "host_cache_capacity_bytes": self.capacity_bytes,
+                   "host_pages_cached": len(self._entries),
+                   "host_demotions": int(self.demotions),
+                   "host_evictions": int(self.evictions)}
+        out.update(self._demote_lat.stats("host_demote_apply"))
+        return out
 
     def close(self):
         with self._lock:

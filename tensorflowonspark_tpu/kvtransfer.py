@@ -156,7 +156,11 @@ def wire_snapshot(frozen, model_name, page_size=0):
             "eos": item["eos"], "seed": int(item["seed"]),
             "topk": int(item["topk"]), "topp": float(item["topp"]),
             "minp": float(item["minp"]), "stops": item["stops"],
-            "rep": float(item["rep"]), "adapter": item.get("adapter")}
+            "rep": float(item["rep"]), "adapter": item.get("adapter"),
+            # the request's trace id crosses the wire with the session
+            # (like priority): the destination's spans join the same
+            # stitched timeline
+            "trace": item.get("trace")}
     blocks = {}
     for name, arr in frozen["kv"].items():
         a = np.asarray(arr)
@@ -354,11 +358,16 @@ class MigrationEngine:
         last_err = "migration timed out before the first attempt"
         try:
             b.counters.inc("migrations_started")
+            t_wire = time.monotonic()
             meta, blocks = wire_snapshot(frozen, self.model_name,
                                          page_size=b.kv_page_size)
             ticket = self.server.register(meta, blocks)
             nbytes = sum(int(a.nbytes) for a in blocks.values())
             n_pages = int(frozen.get("n_pages", 0))
+            tid = meta.get("trace")
+            b.trace.span_at(tid, "wire", t_wire, time.monotonic(),
+                            pages=n_pages, bytes=nbytes,
+                            dest=f"{dest[0]}:{dest[1]}")
             # jittered backoff between attempts so a fleet of sources
             # retrying the same flapping destination doesn't synchronize;
             # the explicit deadline still bounds each attempt's budget
@@ -388,6 +397,11 @@ class MigrationEngine:
                     threading.Thread(
                         target=self._relay, args=(handle, conn, resp),
                         name="kv-migrate-relay", daemon=True).start()
+                    # recorded only after conn is the relay thread's
+                    # problem: a raise here must not strand the socket
+                    b.trace.event(tid, "migrate_ack",
+                                  dest=f"{dest[0]}:{dest[1]}",
+                                  attempt=attempt + 1)
                     return {"migrated": True,
                             "dest": [dest[0], int(dest[1])],
                             "pages": n_pages,

@@ -38,7 +38,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import faults
+from . import faults, trace
 
 logger = logging.getLogger(__name__)
 
@@ -223,6 +223,15 @@ def build_argparser():
                         "host-side by the preemption controller; at "
                         "capacity further preemptions are skipped and "
                         "counted as park_spills")
+    p.add_argument("--generate_trace_ring", type=int, default=4096,
+                   help="per-process span ring capacity for request "
+                        "tracing (trace.Recorder); old spans fall off "
+                        "the back, recording never blocks serving")
+    p.add_argument("--generate_trace_decode_sample", type=int, default=16,
+                   help="record a decode span every Nth committed host "
+                        "tick per traced row (0 disables decode "
+                        "sampling; admission/prefill/retire and the "
+                        "migration/park hops are always recorded)")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -438,6 +447,11 @@ class ModelService:
                                        0.0) or 0.0
         self._gen_park_capacity = getattr(args, "generate_park_capacity",
                                           8) or 8
+        self._gen_trace_ring = getattr(args, "generate_trace_ring",
+                                       4096) or 4096
+        sample = getattr(args, "generate_trace_decode_sample", 16)
+        self._gen_trace_sample = 16 if sample is None else int(sample)
+        self._profile_lock = threading.Lock()   # one capture at a time
         self._gen_lora = {}
         for spec in (getattr(args, "generate_lora", None) or []):
             name, sep, path = spec.partition("=")
@@ -504,7 +518,9 @@ class ModelService:
                         pipeline_depth=self._gen_pipeline_depth,
                         prio_weight=self._gen_prio_weight,
                         preempt_ms=self._gen_preempt_ms,
-                        park_capacity=self._gen_park_capacity)
+                        park_capacity=self._gen_park_capacity,
+                        trace_ring=self._gen_trace_ring,
+                        trace_decode_sample=self._gen_trace_sample)
                 except TypeError as e:
                     # genuinely not a decoder LM: the documented 404
                     logger.info(":generate unavailable: %s", e)
@@ -675,6 +691,70 @@ class ModelService:
                     "float_equivalent_bytes": fb}
         return out
 
+    def metrics_text(self):
+        """``GET /metrics``: Prometheus text exposition generated from
+        the same ``stats()`` dict the fleet probes — every counter,
+        gauge, and LatencyWindow key, plus histogram triplets.  Never
+        force-builds the :generate engine (an un-probed replica scrapes
+        its HTTP-level stats only)."""
+        from . import metrics as metrics_mod
+
+        groups = [("replica", None,
+                   {"http_requests": self.requests,
+                    "draining": self.draining})]
+        with self._gen_lock:
+            gen = self._gen or None
+        if gen is not None:
+            groups.append(("replica", None, gen.batcher.stats()))
+        return metrics_mod.prometheus_text(groups)
+
+    def trace_spans(self, trace_id):
+        """``GET /v1/trace/<id>``: this replica's retained spans for a
+        trace (empty when :generate never ran here — the gateway's
+        stitcher treats that as "this replica saw nothing")."""
+        with self._gen_lock:
+            gen = self._gen or None
+        if gen is None:
+            return []
+        return gen.batcher.trace.spans(trace_id)
+
+    def debug_profile(self, body):
+        """``POST /v1/debug:profile``: run a time-bounded
+        ``jax.profiler.trace`` capture and return the artifact dir.
+        Returns ``(status_code, payload)``: 409 while another capture
+        holds the (single) profiler, 503 when the runtime cannot
+        profile here (CPU-only jaxlib, missing plugin) — serving is
+        untouched either way."""
+        dur = body.get("duration_ms", 500)
+        if (not isinstance(dur, (int, float)) or isinstance(dur, bool)
+                or not 0 < dur <= 10000):
+            raise ValueError('"duration_ms" must be a number in '
+                             "(0, 10000]")
+        out_dir = body.get("dir")
+        if out_dir is not None and not isinstance(out_dir, str):
+            raise ValueError('"dir" must be a string path')
+        if not self._profile_lock.acquire(blocking=False):
+            return 409, {"error": "a profile capture is already running"}
+        try:
+            if out_dir is None:
+                import tempfile
+
+                out_dir = tempfile.mkdtemp(prefix="tpu-profile-")
+            try:
+                import jax
+
+                with jax.profiler.trace(out_dir):
+                    time.sleep(float(dur) / 1000.0)
+            except Exception as e:
+                # degrade, don't die: profiling is best-effort
+                logger.warning("profiler capture failed: %s", e)
+                return 503, {"error": "profiler unavailable: "
+                             f"{type(e).__name__}: {e}"}
+            return 200, {"artifact": out_dir,
+                         "duration_ms": float(dur)}
+        finally:
+            self._profile_lock.release()
+
 
 class SlotHandle:
     """One in-flight generation in the continuous batcher: tokens stream
@@ -781,7 +861,9 @@ class ContinuousBatcher:
                  lora_rank=0, lora_capacity=8, kv_dtype=None,
                  paged_attn_impl=None, paged_prefill_impl=None,
                  engine="async", pipeline_depth=2,
-                 prio_weight=4, preempt_ms=0.0, park_capacity=8):
+                 prio_weight=4, preempt_ms=0.0, park_capacity=8,
+                 trace_recorder=None, trace_ring=4096,
+                 trace_decode_sample=16):
         import itertools
         import queue as queue_mod
 
@@ -809,6 +891,12 @@ class ContinuousBatcher:
         # stats() folds snapshot() in, so the fleet gateway and
         # GET /v1/metadata see every counter without extra plumbing
         self.counters = Counters()
+        # request tracing: per-process bounded span ring; an injected
+        # recorder lets in-process tests share one ring across paired
+        # batchers.  All span clocks are host time.monotonic() —
+        # recording NEVER reads a device value
+        self.trace = trace_recorder or trace.Recorder(
+            capacity=trace_ring, decode_sample=trace_decode_sample)
         # "int8" stores the slot kv cache quantized (int8 payload +
         # per-(token, head) f32 scales — TransformerConfig.kv_dtype):
         # ~2x less resident kv vs bf16, composing with paging (pool
@@ -1203,6 +1291,11 @@ class ContinuousBatcher:
                 tstats.get("host_pages_cached", 0))
             out["host_demotions"] = int(tstats.get("host_demotions", 0))
             out["host_evictions"] = int(tstats.get("host_evictions", 0))
+            # demote-apply latency (worker-thread batches): exported
+            # whole so /metrics renders the histogram per-replica
+            for k, v in tstats.items():
+                if k.startswith("host_demote_apply"):
+                    out[k] = v
             # explicit (not just via the counter fold): present-at-zero
             # so dashboards see the gauge before the first sink write
             out["kv_sink_writes"] = self.counters.get("kv_sink_writes")
@@ -1239,6 +1332,7 @@ class ContinuousBatcher:
         for cls in PRIORITY_CLASSES:
             out.update(self._ttft_cls[cls].stats(f"ttft_{cls}"))
             out.update(self._qdelay[cls].stats(f"qdelay_{cls}"))
+        out.update(self.trace.stats())
         # event counters (kv_sink_writes, ...) ride along by name
         out.update(self.counters.snapshot())
         return out
@@ -1390,9 +1484,13 @@ class ContinuousBatcher:
 
     def submit(self, prompt, max_new, temperature=0.0, eos_id=None, seed=0,
                adapter=None, top_k=0, top_p=1.0, min_p=0.0, stop=None,
-               repetition_penalty=1.0, priority=None):
+               repetition_penalty=1.0, priority=None, trace_id=None):
         if self._dead is not None:
             raise RuntimeError(f"batcher died: {self._dead}")
+        # tracing is best-effort by construction: a malformed id is
+        # dropped here rather than 400ing a generation that would
+        # otherwise succeed (byte-parity with the untraced request)
+        tid = trace_id if trace.valid_id(trace_id) else None
         cls = priority or "interactive"
         if cls not in PRIORITY_CLASSES:
             raise ValueError(
@@ -1473,8 +1571,10 @@ class ContinuousBatcher:
             "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
             "minp": float(min_p), "stops": stops,
             "rep": float(repetition_penalty), "adapter": adapter,
-            "cls": cls,
+            "cls": cls, "trace": tid,
             "t_submit": time.monotonic()})  # TTFT clock starts at submit
+        self.trace.event(tid, "submit", cls=cls, prompt_len=len(prompt),
+                         max_new=max_new)
         if self._dead is not None:
             # the loop may have died between the check above and the put
             # (its death-drain already ran): fail whatever is queued,
@@ -1745,7 +1845,7 @@ class ContinuousBatcher:
         meta["n_pages"] = n
         return meta, blocks
 
-    def prefetch_prefix(self, peer, prompt):
+    def prefetch_prefix(self, peer, prompt, trace_id=None):
         """HTTP-thread warm-up for a gateway-planted kv peer
         (``X-Fleet-KV-Peer``): pull the prefix pages the local host
         tier lacks from the peer's PageServer and insert them, so this
@@ -1770,6 +1870,7 @@ class ContinuousBatcher:
             return 0
         from . import kvtransfer
 
+        t0 = time.monotonic()
         try:
             meta, pages = kvtransfer.pull_prefix(
                 (host, int(port)),
@@ -1777,6 +1878,9 @@ class ContinuousBatcher:
                 self.kv_page_size)
         except (OSError, ValueError) as e:
             self.counters.inc("prefix_pull_failures")
+            self.trace.span_at(trace_id, "prefix_pull", t0,
+                               time.monotonic(), peer=str(peer),
+                               failed=True)
             logger.debug("kv peer prefix pull failed: %s", e)
             return 0
         n = 0
@@ -1787,6 +1891,8 @@ class ContinuousBatcher:
                 n += 1
         if n:
             self.counters.inc("prefix_pull_pages", n)
+        self.trace.span_at(trace_id, "prefix_pull", t0, time.monotonic(),
+                           peer=str(peer), pages=n)
         return n
 
     def _assert_no_sink(self, pages):
@@ -1877,6 +1983,8 @@ class ContinuousBatcher:
             self.counters.inc("prefix_hits", len(shared))
         if host_run:
             self.counters.inc("host_hits", len(host_run))
+            self.trace.event(item.get("trace"), "promote", row=row,
+                             pages=len(host_run))
         if len(keys) > n_shared:
             self.counters.inc("prefix_misses", len(keys) - n_shared)
         return True
@@ -2018,7 +2126,7 @@ class ContinuousBatcher:
                          if self.kv_page_size else 0)
         self._admissions.append({
             "row": row, "item": item, "offset": shared_tokens, "i": 0,
-            "src": src,
+            "src": src, "t_admit": time.monotonic(),
             "sizes": self._prefill_chunk_sizes(len(src) - shared_tokens),
             "d_off": 0, "di": 0,
             "d_sizes": (self._prefill_chunk_sizes(shared_tokens)
@@ -2140,6 +2248,10 @@ class ContinuousBatcher:
                 self.draft_params, self._d_cache, chunks, rows, starts,
                 n_valids, jnp.asarray(0, jnp.int32))
             self.counters.inc("prefill_dispatches")
+            for (erow, chunk, off), adm in zip(entries, catchup):
+                self.trace.event(adm["item"].get("trace"), "prefill",
+                                 row=erow, chunk=len(chunk), offset=off,
+                                 draft_catchup=True)
             return
         entries, finishing = [], []
         for adm in selected:
@@ -2173,6 +2285,11 @@ class ContinuousBatcher:
                 self.draft_params, self._d_cache, chunks, rows, starts,
                 n_valids, jnp.asarray(0, jnp.int32))
         self.counters.inc("prefill_dispatches")
+        # per-chunk prefill spans: host-clocked at dispatch (the jit
+        # call returns asynchronously; no device value is read here)
+        for (erow, chunk, off), adm in zip(entries, selected):
+            self.trace.event(adm["item"].get("trace"), "prefill",
+                             row=erow, chunk=len(chunk), offset=off)
         if self.kv_page_size:
             # which S>1 path served this dispatch: the Pallas paged-
             # prefill kernels or the einsum blend (impl="blend", or
@@ -2217,6 +2334,14 @@ class ContinuousBatcher:
             elapsed = time.monotonic() - t0
             self._ttft.record(elapsed)
             self._ttft_cls[item.get("cls") or "interactive"].record(elapsed)
+        tid = item.get("trace")
+        if tid:
+            now = time.monotonic()
+            t_adm = adm.get("t_admit", now)
+            if t0 is not None:
+                self.trace.span_at(tid, "queue", t0, t_adm)
+            self.trace.span_at(tid, "admit", t_adm, now, row=row,
+                               prompt_len=len(prompt))
         h.tokens.put([tok])
         seq = prompt + [tok]
         if (max_new <= 1 or (eos_id is not None and tok == eos_id)
@@ -2224,6 +2349,7 @@ class ContinuousBatcher:
             self._free_row(row)
             h._finish(seq)
             self.counters.inc("requests_served")
+            self.trace.event(tid, "retire", row=row, reason="first_token")
             return
         self._gen[row] += 1
         (self._toks, self._temps, self._seeds, self._ords,
@@ -2271,6 +2397,8 @@ class ContinuousBatcher:
         item, row = adm["item"], adm["row"]
         res = item["resume"]
         h, seq, remaining = item["h"], res["seq"], res["remaining"]
+        self.trace.event(item.get("trace"), "replay", row=row,
+                         committed=len(seq), remaining=remaining)
         if self.kv_page_size:
             # the replayed prompt's full-prefix pages are real computed
             # kv: publish them like any admission's
@@ -2473,6 +2601,9 @@ class ContinuousBatcher:
                     "remaining": s["remaining"], "item": s["item"],
                     "kind": "paged" if self.kv_page_size else "dense",
                     "kv": box["kv"], "n_pages": box.get("n_pages", 0)}
+        self.trace.event(s["item"].get("trace"), "freeze", row=row,
+                         committed=len(s["seq"]),
+                         n_pages=box.get("n_pages", 0))
         h.freeze_done.set()
 
     def _apply_migrations(self):
@@ -2692,6 +2823,9 @@ class ContinuousBatcher:
         meta["priority"] = frozen["item"].get("cls") or "interactive"
         self.complete_migration(frozen)
         self.counters.inc("sessions_parked")
+        self.trace.event(meta.get("trace"), "park",
+                         committed=len(meta["seq"]),
+                         n_pages=meta.get("n_pages", 0))
         return {"h": h, "meta": meta, "blocks": blocks,
                 "t_parked": time.monotonic()}
 
@@ -2704,6 +2838,10 @@ class ContinuousBatcher:
         h2, _installed = self.submit_resume(entry["meta"],
                                             entry["blocks"])
         self.counters.inc("sessions_unparked")
+        self.trace.event(
+            entry["meta"].get("trace"), "unpark",
+            parked_ms=round(
+                (time.monotonic() - entry["t_parked"]) * 1000.0, 3))
         threading.Thread(target=self._pump_resumed,
                          args=(entry["h"], h2),
                          name="park-splice", daemon=True).start()
@@ -2959,6 +3097,8 @@ class ContinuousBatcher:
             "cls": (meta.get("priority")
                     if meta.get("priority") in PRIORITY_CLASSES
                     else "interactive"),
+            "trace": (meta.get("trace")
+                      if trace.valid_id(meta.get("trace")) else None),
             "resume": {"seq": seq, "remaining": remaining,
                        "n_pages": n_pages, "kv": kv,
                        "installed": installed}})
@@ -3041,6 +3181,8 @@ class ContinuousBatcher:
             "cls": (meta.get("priority")
                     if meta.get("priority") in PRIORITY_CLASSES
                     else "interactive"),
+            "trace": (meta.get("trace")
+                      if trace.valid_id(meta.get("trace")) else None),
             # no "kv" key: _start_admission reads that as "re-prefill"
             "resume": {"seq": seq, "remaining": remaining,
                        "installed": installed}})
@@ -3120,6 +3262,8 @@ class ContinuousBatcher:
                             "item": item}
         self.counters.inc("migrations_resumed")
         self.counters.inc("kv_pages_imported", res["n_pages"])
+        self.trace.event(item.get("trace"), "resume", row=row,
+                         committed=len(seq), n_pages=res["n_pages"])
         res["installed"].set()
         return True
 
@@ -3149,6 +3293,19 @@ class ContinuousBatcher:
             toks = pend.pop(r, None)
             if toks:
                 s["handle"].tokens.put(toks)
+                tid = s["item"].get("trace") if s.get("item") else None
+                if tid:
+                    # SAMPLED decode spans, recorded here on the host
+                    # drain thread at token-commit time — the device
+                    # thread never sees tracing and stays
+                    # hostsync-clean
+                    n = self.trace.decode_sample
+                    s["_trace_ticks"] = s.get("_trace_ticks", 0) + 1
+                    if n and (s["_trace_ticks"] - 1) % n == 0:
+                        self.trace.event(tid, "decode", row=r,
+                                         tokens=len(toks),
+                                         seq_len=len(s["seq"]),
+                                         tick=s["_trace_ticks"])
 
         for i, (gens, row_toks) in enumerate(zip(gens_list, block)):
             for r, s in enumerate(self._slots):
@@ -3177,6 +3334,8 @@ class ContinuousBatcher:
                     self._retire(r, gens[r])
                     s["handle"]._finish(s["seq"])
                     self.counters.inc("requests_served")
+                    self.trace.event(s["item"].get("trace"), "retire",
+                                     row=r, reason="cancelled")
                     continue
                 if counts is None:
                     toks = [int(row_toks[r])]
@@ -3196,6 +3355,9 @@ class ContinuousBatcher:
                     self._retire(r, gens[r])
                     s["handle"]._finish(s["seq"])
                     self.counters.inc("requests_served")
+                    self.trace.event(s["item"].get("trace"), "retire",
+                                     row=r, reason="stop",
+                                     seq_len=len(s["seq"]))
         # per-tick delivery for every stream that did NOT finish this
         # chunk: all its tokens in one put
         for r, s in enumerate(self._slots):
@@ -3537,7 +3699,8 @@ class GenerateService:
                  kv_dtype="auto", paged_attn_impl=None,
                  paged_prefill_impl=None, engine="async",
                  pipeline_depth=2, prio_weight=4, preempt_ms=0.0,
-                 park_capacity=8):
+                 park_capacity=8, trace_ring=4096,
+                 trace_decode_sample=16):
         import itertools
 
         self.quantize_mode = quantize_mode or "none"
@@ -3566,7 +3729,9 @@ class GenerateService:
             paged_prefill_impl=paged_prefill_impl,
             engine=engine or "async",
             pipeline_depth=pipeline_depth, prio_weight=prio_weight,
-            preempt_ms=preempt_ms, park_capacity=park_capacity)
+            preempt_ms=preempt_ms, park_capacity=park_capacity,
+            trace_ring=trace_ring,
+            trace_decode_sample=trace_decode_sample)
         try:
             for name, path in (lora_adapters or {}).items():
                 # adapter files written by lora.save_adapters; a bad file
@@ -3675,8 +3840,14 @@ class GenerateService:
         if priority is not None and priority not in PRIORITY_CLASSES:
             raise ValueError(
                 f'"priority" must be one of {list(PRIORITY_CLASSES)}')
+        trace_id = req.get("trace")
+        if trace_id is not None and not trace.valid_id(trace_id):
+            raise ValueError(
+                '"trace" must be a hex (dashes allowed) trace id of at '
+                f"most {trace.MAX_ID_LEN} chars")
         return (inputs, max_new, temperature, eos_id, seed, adapter,
-                top_k, top_p, min_p, stop, float(rep), priority)
+                top_k, top_p, min_p, stop, float(rep), priority,
+                trace_id)
 
     def _idem_claim(self, key, h):
         """Register `h` as the live session for Idempotency-Key `key`,
@@ -3732,7 +3903,8 @@ class GenerateService:
         # validate EAGERLY (before any response bytes): a malformed
         # request must 400, not die mid-stream after a 200 header
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, min_p, stop, rep, priority) = self._validate(req)
+         top_k, top_p, min_p, stop, rep, priority,
+         trace_id) = self._validate(req)
         if len(inputs) != 1:
             raise ValueError('"stream": true serves exactly one prompt '
                              "per request")
@@ -3740,13 +3912,14 @@ class GenerateService:
             # gateway-planted prefix peer: pull the pages the local
             # host tier lacks BEFORE submitting, so this admission
             # promotes them (failure = normal prefill, nothing to undo)
-            self.batcher.prefetch_prefix(kv_peer, inputs[0])
+            self.batcher.prefetch_prefix(kv_peer, inputs[0],
+                                         trace_id=trace_id)
         seed = self._prompt_seeds(1, seed, temperature)[0]
         h = self.batcher.submit(inputs[0], max_new, temperature=temperature,
                                 eos_id=eos_id, seed=seed, adapter=adapter,
                                 top_k=top_k, top_p=top_p, min_p=min_p,
                                 stop=stop, repetition_penalty=rep,
-                                priority=priority)
+                                priority=priority, trace_id=trace_id)
         self._idem_claim(idem_key, h)
         self.requests += 1
         if on_handle is not None:
@@ -3766,7 +3939,14 @@ class GenerateService:
                     # tick); the event protocol stays per-token
                     for tok in batch:
                         yield {"token": tok}
-                yield {"done": True, "output": h.result()}
+                done = {"done": True, "output": h.result()}
+                if trace_id:
+                    # summary rides the FINAL event only — token events
+                    # are byte-identical to an untraced stream
+                    summ = self.batcher.trace.summary(trace_id)
+                    if summ is not None:
+                        done["trace"] = summ
+                yield done
             finally:
                 # consumer died/finished: free the slot instead of
                 # decoding to max_new for a client nobody serves
@@ -3777,10 +3957,12 @@ class GenerateService:
 
     def generate(self, req, kv_peer=None):
         (inputs, max_new, temperature, eos_id, seed, adapter,
-         top_k, top_p, min_p, stop, rep, priority) = self._validate(req)
+         top_k, top_p, min_p, stop, rep, priority,
+         trace_id) = self._validate(req)
         if kv_peer:
             for p in inputs:
-                self.batcher.prefetch_prefix(kv_peer, p)
+                self.batcher.prefetch_prefix(kv_peer, p,
+                                             trace_id=trace_id)
         seeds = self._prompt_seeds(len(inputs), seed, temperature)
         # every prompt becomes a slot request; they decode concurrently
         # with each other AND with other HTTP requests' prompts (no
@@ -3792,7 +3974,7 @@ class GenerateService:
                     p, max_new, temperature=temperature, eos_id=eos_id,
                     seed=s, adapter=adapter, top_k=top_k, top_p=top_p,
                     min_p=min_p, stop=stop, repetition_penalty=rep,
-                    priority=priority))
+                    priority=priority, trace_id=trace_id))
             outs = [h.result(timeout=self.timeout_s) for h in handles]
         except Exception:
             # a failed request (one prompt too long, a timeout) must not
@@ -3902,6 +4084,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code, text):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         name = self.service.model_name
         # EXACT path matching (modulo one trailing slash): endswith()
@@ -3919,6 +4110,29 @@ class _Handler(BaseHTTPRequestHandler):
                            headers=[("Retry-After", "1")])
             else:
                 self._send(200, {"status": "ok"})
+        elif path in ("/metrics", "/v1/metrics"):
+            # Prometheus scrape, generated from the same stats() the
+            # fleet probes; an injected trace.export fault 500s the
+            # SCRAPE only — serving never notices
+            try:
+                faults.check("trace.export")
+                text = self.service.metrics_text()
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send_text(200, text)
+        elif path.startswith("/v1/trace/"):
+            tid = path[len("/v1/trace/"):]
+            if not trace.valid_id(tid):
+                self._send(400, {"error": "malformed trace id"})
+                return
+            try:
+                faults.check("trace.export")
+                spans = self.service.trace_spans(tid)
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {"id": tid, "spans": spans})
         elif path == "/" or path == f"/v1/models/{name}":
             self._send(200, self.service.metadata())
         else:
@@ -3946,6 +4160,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
             except Exception as e:
                 logger.exception("kv:export failed")
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if self.path.rstrip("/") == "/v1/debug:profile":
+            # time-bounded on-device profile capture (jax.profiler) —
+            # the "why is the device idle" layer under
+            # device_idle_fraction.  Not fenced on draining: a
+            # misbehaving replica is exactly the one worth profiling
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("request body must be a JSON object")
+                code, payload = self.service.debug_profile(body)
+                self._send(code, payload)
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            except Exception as e:
+                logger.exception("debug:profile failed")
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
             return
         is_predict = self.path == f"/v1/models/{name}:predict"
@@ -3980,6 +4212,10 @@ class _Handler(BaseHTTPRequestHandler):
                     # a tenant's class and forwards it this way); an
                     # invalid value 400s in _validate like the body form
                     req["priority"] = prio
+                tid_hdr = self.headers.get("X-Trace-Id")
+                if is_generate and tid_hdr and "trace" not in req:
+                    # header form of the trace id, mirroring X-Priority
+                    req["trace"] = tid_hdr
                 if is_resume:
                     # always streams: the first ndjson event is the
                     # splice ack (migration or crash replay), the rest

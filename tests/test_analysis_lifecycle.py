@@ -35,11 +35,12 @@ def run(src, path="tensorflowonspark_tpu/mod.py"):
 
 # ----------------------------------------------------------- spec table ----
 
-def test_spec_registry_covers_the_nine_resources():
+def test_spec_registry_covers_the_ten_resources():
     names = {s.name for s in resources.SPECS}
     assert names == {"kv-page", "decode-slot", "lora-adapter", "socket",
                      "donated-buffer", "migration-lease",
-                     "journal-entry", "parked-session", "host-kv-page"}
+                     "journal-entry", "parked-session", "host-kv-page",
+                     "trace-span"}
     kv = resources.spec_by_name("kv-page")
     assert kv.share_map == "_page_rc" and kv.device_only
     assert resources.spec_by_name("socket").release_idempotent
@@ -53,6 +54,9 @@ def test_spec_registry_covers_the_nine_resources():
     assert park.acquire == ("self._park_gather",)
     assert set(park.release) == {"self._park_restore",
                                  "self._park_discard"}
+    span = resources.spec_by_name("trace-span")
+    assert span.acquire == ("begin",)
+    assert set(span.release) == {"end", "abandon"}
 
 
 def test_parked_session_leak_and_pool_transfer():
@@ -95,6 +99,46 @@ def test_parked_session_leak_and_pool_transfer():
                     return
                 self._park_restore(entry)
                 self._park_discard(entry)
+    """)
+    assert any(r == "lifecycle-double-free" for r, _ in hits)
+
+
+def test_trace_span_leak_and_balanced_close():
+    # an open span dropped on the floor reads as "stage still running"
+    # forever — that's the leak this spec exists to catch
+    hits, _ = run("""
+        class S:
+            def f(self, tid):
+                sp = self.trace.begin(tid, "stage")
+                do_work()
+    """)
+    assert any(r == "lifecycle-leak" for r, _ in hits)
+    # begin → end on the happy path and begin → abandon on the error
+    # path are both legal closes (the None early-out is the untraced
+    # request: nothing acquired, nothing owed)
+    hits, _ = run("""
+        class S:
+            def f(self, tid):
+                sp = self.trace.begin(tid, "stage")
+                if sp is None:
+                    return
+                try:
+                    do_work()
+                except Exception:
+                    self.trace.abandon(sp)
+                    raise
+                self.trace.end(sp)
+    """)
+    assert hits == []
+    # closing twice is the double-free
+    hits, _ = run("""
+        class S:
+            def f(self, tid):
+                sp = self.trace.begin(tid, "stage")
+                if sp is None:
+                    return
+                self.trace.end(sp)
+                self.trace.abandon(sp)
     """)
     assert any(r == "lifecycle-double-free" for r, _ in hits)
 

@@ -593,3 +593,44 @@ def test_prefix_pull_fault_falls_back_to_local_prefill(model_and_params):
         srv.close()
         a.stop()
         b.stop()
+
+
+def test_trace_export_deny_never_costs_tokens(model_and_params):
+    # the observability plane fails: every span export is denied for
+    # the whole run.  The contract is asymmetric on purpose — tracing
+    # may lose ALL its spans, serving may lose NOTHING: the traced
+    # stream under deny stays byte-identical to solo decode, the drops
+    # are counted, and the moment the fault clears the SAME engine
+    # records a full lifecycle again
+    from tensorflowonspark_tpu import trace
+
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, kv_page_size=8,
+                                kv_pages=24)
+    prompt, n_new = [3, 1, 4, 1, 5, 9], 6
+    try:
+        want = _solo(model, params, prompt, n_new)
+        tid = trace.new_id()
+        plan = faults.FaultPlan(CHAOS_SEED).on("trace.export",
+                                               kind="deny", nth=1,
+                                               times=None)
+        with faults.active(plan):
+            out = b.submit(prompt, n_new,
+                           trace_id=tid).result(timeout=300)
+        assert ("trace.export", "deny") in plan.fired
+        assert out == want                    # byte parity through deny
+        assert b.trace.spans(tid) == []       # every span dropped...
+        st = b.trace.stats()
+        assert st["trace_spans_dropped"] > 0  # ...and counted
+        assert st["trace_spans_recorded"] == 0
+        # fault cleared: same engine, fresh id, full lifecycle recorded
+        tid2 = trace.new_id()
+        assert b.submit(prompt, n_new,
+                        trace_id=tid2).result(timeout=300) == want
+        names = {s["name"] for s in b.trace.spans(tid2)}
+        assert {"submit", "queue", "admit", "prefill", "decode",
+                "retire"} <= names
+        assert b.trace.summary(tid2)["spans"] >= 6
+    finally:
+        b.stop()

@@ -135,6 +135,8 @@ def test_build_prefill_batch_pads_and_rejects_duplicates():
 def test_latency_window_percentiles_and_monotone_sums():
     w = metrics.LatencyWindow(window=4)
     zero = w.stats("ttft")
+    hist = zero.pop("ttft_hist")        # scrape-side histogram rides along
+    assert hist["count"] == 0
     assert zero == {"ttft_count": 0, "ttft_ms_sum": 0.0,
                     "ttft_avg_ms": 0.0, "ttft_p50_ms": 0.0,
                     "ttft_p95_ms": 0.0}

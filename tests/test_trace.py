@@ -1,0 +1,585 @@
+"""Observability tests: request tracing (trace.py), Prometheus text
+exposition (metrics.prometheus_text + /metrics), merged-histogram fleet
+quantiles, and the end-to-end stitched timeline.
+
+Fast tier: Recorder semantics (ring bound, id validation, the
+begin/end/abandon discipline), histogram merge/quantile math, and the
+exposition format — all model-free.  ``@pytest.mark.slow``: the
+byte-parity burst (a mixed 7-request burst with a mid-decode migration
+and a park/unpark cycle, tracing on vs off) over real engines, and the
+acceptance path — a real Gateway over two serve.py replicas where a
+streamed :generate migrates prefill->decode mid-stream and
+``GET /v1/trace/<id>`` returns ONE timeline with spans from the
+gateway, the source, and the destination.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tensorflowonspark_tpu import faults, metrics, trace
+
+# ------------------------------------------------------ id handling ----
+
+
+def test_new_id_is_valid_and_unique():
+    a, b = trace.new_id(), trace.new_id()
+    assert a != b
+    assert trace.valid_id(a) and trace.valid_id(b)
+    assert len(a) == 32
+
+
+def test_valid_id_rejects_garbage():
+    assert trace.valid_id("deadbeef")
+    assert trace.valid_id("4f2a-BEEF-0011")        # dashed, mixed case
+    assert not trace.valid_id("")
+    assert not trace.valid_id(None)
+    assert not trace.valid_id(123)
+    assert not trace.valid_id("hello world")       # non-hex
+    assert not trace.valid_id("a" * (trace.MAX_ID_LEN + 1))
+
+
+# ------------------------------------------------- recorder basics ----
+
+
+def test_recorder_noops_without_trace_id():
+    rec = trace.Recorder()
+    assert rec.begin(None, "x") is None
+    rec.end(None)
+    rec.abandon(None)
+    rec.event(None, "x")
+    rec.span_at(None, "x", 0.0, 1.0)
+    with rec.span(None, "x"):
+        pass
+    assert rec.stats()["trace_spans_recorded"] == 0
+
+
+def test_begin_end_records_duration_and_attrs():
+    rec = trace.Recorder()
+    s = rec.begin("aa11", "prefill", row=3)
+    time.sleep(0.002)
+    rec.end(s, chunk=8)
+    (got,) = rec.spans("aa11")
+    assert got["name"] == "prefill"
+    assert got["attrs"] == {"row": 3, "chunk": 8}
+    assert got["dur_ms"] >= 1.0
+    assert got["t1_ms"] >= got["t0_ms"]
+    assert rec.spans("bb22") == []
+
+
+def test_abandon_marks_the_cut():
+    rec = trace.Recorder()
+    rec.abandon(rec.begin("aa11", "wire"))
+    (got,) = rec.spans("aa11")
+    assert got["attrs"]["abandoned"] is True
+
+
+def test_span_contextmanager_abandons_on_error():
+    rec = trace.Recorder()
+    with pytest.raises(RuntimeError):
+        with rec.span("aa11", "freeze"):
+            raise RuntimeError("boom")
+    with rec.span("aa11", "resume"):
+        pass
+    by_name = {s["name"]: s for s in rec.spans("aa11")}
+    assert by_name["freeze"]["attrs"].get("abandoned") is True
+    assert "abandoned" not in by_name["resume"]["attrs"]
+
+
+def test_event_and_span_at():
+    rec = trace.Recorder()
+    rec.event("aa11", "retire", reason="stop")
+    t0 = time.monotonic()
+    rec.span_at("aa11", "queue", t0, t0 + 0.25, depth=2)
+    ev, sp = rec.spans("aa11")
+    assert ev["dur_ms"] == 0.0
+    assert abs(sp["dur_ms"] - 250.0) < 1.0
+    assert sp["attrs"] == {"depth": 2}
+
+
+def test_ring_bound_drops_oldest():
+    rec = trace.Recorder(capacity=8)
+    for i in range(20):
+        rec.event("aa11", f"e{i}")
+    st = rec.stats()
+    assert st["trace_ring_len"] == 8
+    assert st["trace_ring_capacity"] == 8
+    assert st["trace_spans_recorded"] == 20
+    names = [s["name"] for s in rec.spans("aa11")]
+    assert names == [f"e{i}" for i in range(12, 20)]   # oldest gone
+
+
+def test_summary_digest():
+    rec = trace.Recorder()
+    assert rec.summary("aa11") is None
+    rec.event("aa11", "decode")
+    rec.event("aa11", "decode")
+    t0 = time.monotonic()
+    rec.span_at("aa11", "admit", t0, t0 + 0.01)
+    summ = rec.summary("aa11")
+    assert summ["id"] == "aa11" and summ["spans"] == 3
+    assert summ["stages"]["decode"]["count"] == 2
+    assert summ["stages"]["admit"]["ms"] > 0
+
+
+def test_export_deny_drops_spans_silently():
+    # the chaos contract at the recorder layer: deny = spans vanish,
+    # nothing raises, the drop is counted, and disarm restores recording
+    rec = trace.Recorder()
+    plan = faults.FaultPlan(0).on("trace.export", kind="deny", nth=1,
+                                  times=None)
+    with faults.active(plan):
+        rec.event("aa11", "submit")
+        rec.end(rec.begin("aa11", "admit"))
+    assert plan.fired
+    assert rec.spans("aa11") == []
+    st = rec.stats()
+    assert st["trace_spans_dropped"] == 2
+    assert st["trace_spans_recorded"] == 0
+    rec.event("aa11", "retire")
+    assert [s["name"] for s in rec.spans("aa11")] == ["retire"]
+
+
+# --------------------------------------- histogram merge / quantile ----
+
+
+def _window_with(values_ms):
+    w = metrics.LatencyWindow()
+    for ms in values_ms:
+        w.record(ms / 1000.0)
+    return w
+
+
+def test_latency_window_histogram_is_cumulative():
+    w = _window_with([2.0, 2.0, 40.0, 20000.0])
+    h = w.histogram()
+    assert h["le"][-1] == "+Inf"
+    assert len(h["le"]) == len(h["counts"])
+    assert h["counts"][-1] == h["count"] == 4
+    assert all(b >= a for a, b in zip(h["counts"], h["counts"][1:]))
+    # 2 ms values land at the 2.5 bucket, nothing below 1 ms
+    i25 = h["le"].index(2.5)
+    assert h["counts"][i25] == 2 and h["counts"][0] == 0
+    # the 20 s outlier only shows up in +Inf
+    assert h["counts"][-1] - h["counts"][-2] == 1
+
+
+def test_merge_histograms_sums_replicas():
+    a = _window_with([2.0, 40.0]).histogram()
+    b = _window_with([2.0, 600.0]).histogram()
+    m = metrics.LatencyWindow.merge_histograms([a, b])
+    assert m["count"] == 4
+    assert m["counts"][-1] == 4
+    assert m["sum_ms"] == pytest.approx(a["sum_ms"] + b["sum_ms"])
+    # foreign layouts and junk are skipped, not fatal
+    assert metrics.LatencyWindow.merge_histograms(
+        [a, {"le": [1], "counts": [0, 1]}, None, 7])["count"] == 2
+    assert metrics.LatencyWindow.merge_histograms([]) is None
+
+
+def test_quantile_from_histogram_interpolates():
+    h = _window_with([2.0] * 50 + [40.0] * 50).histogram()
+    p50 = metrics.LatencyWindow.quantile_from_histogram(h, 0.50)
+    p95 = metrics.LatencyWindow.quantile_from_histogram(h, 0.95)
+    assert 1.0 <= p50 <= 2.5
+    assert 25.0 <= p95 <= 50.0
+    # overflow bucket reports its lower bound (Prometheus convention)
+    h2 = _window_with([20000.0] * 4).histogram()
+    assert metrics.LatencyWindow.quantile_from_histogram(h2, 0.95) == \
+        pytest.approx(10000.0)
+    assert metrics.LatencyWindow.quantile_from_histogram(None, 0.5) == 0.0
+
+
+def test_stats_carries_the_histogram():
+    st = _window_with([2.0, 40.0]).stats("ttft")
+    assert st["ttft_hist"]["count"] == 2
+    assert st["ttft_count"] == 2
+
+
+# ------------------------------------------------ text exposition ----
+
+
+def test_prometheus_text_gauges_histograms_and_labels():
+    hist = _window_with([2.0, 40.0]).histogram()
+    text = metrics.prometheus_text([
+        ("gateway", None, {"requests": 7, "draining": False,
+                           "name": "skipme", "things": [1, 2],
+                           "ratio": 0.25}),
+        ("replica", {"replica": "127.0.0.1:1"}, {"slots_busy": 1,
+                                                 "ttft_hist": hist}),
+        ("replica", {"replica": "127.0.0.1:2"}, {"slots_busy": 2}),
+    ])
+    assert text.endswith("\n")
+    assert "tfospark_gateway_requests 7" in text
+    assert "tfospark_gateway_draining 0" in text          # bool -> 0/1
+    assert "tfospark_gateway_ratio 0.25" in text
+    assert "skipme" not in text and "things" not in text  # non-numeric
+    # histogram triplet under the _hist-stripped stem
+    assert 'tfospark_replica_ttft_bucket{le="+Inf",replica="127.0.0.1:1"}' \
+        in text
+    assert 'tfospark_replica_ttft_sum{replica="127.0.0.1:1"}' in text
+    assert 'tfospark_replica_ttft_count{replica="127.0.0.1:1"}' in text
+    assert "# TYPE tfospark_replica_ttft histogram" in text
+    # one TYPE line even though slots_busy repeats across replicas
+    assert text.count("# TYPE tfospark_replica_slots_busy gauge") == 1
+    assert 'tfospark_replica_slots_busy{replica="127.0.0.1:2"} 2' in text
+
+
+def test_prometheus_name_sanitization():
+    assert metrics._prom_name("a-b.c") == "a_b_c"
+    assert metrics._prom_name("0bad") == "_0bad"
+
+
+# =================================================================
+# engine-level tests (jit compiles: slow tier)
+# =================================================================
+
+BURST = [
+    # (prompt, n_new, temperature, seed, priority)
+    ([3, 1, 4, 1, 5], 6, 0.0, 0, "interactive"),
+    ([9, 8, 7, 6], 6, 0.8, 11, "interactive"),
+    ([2, 4, 6, 8, 10], 8, 0.0, 0, "batch"),        # parked + unparked
+    ([1, 2, 3, 4, 5, 6], 8, 0.0, 0, "interactive"),  # migrated
+    ([5, 4, 3], 5, 0.7, 5, "batch"),
+    ([11, 12, 13, 14], 6, 0.0, 0, "interactive"),
+    ([6, 6, 6, 6, 6, 6], 7, 0.9, 3, "interactive"),
+]
+PARK_I, MIG_I = 2, 3
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, temperature=0.0, seed=0):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import decode
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None))
+    return np.asarray(out)[0].tolist()
+
+
+def _run_burst(model, params, traced):
+    """The mixed burst with a mid-decode migration (request MIG_I) and
+    a park/unpark cycle (request PARK_I).  Identical operation sequence
+    either way; ``traced`` only decides whether trace ids ride along.
+    Returns (outputs, src recorder, dst recorder, tids)."""
+    from tensorflowonspark_tpu import kvtransfer, serve
+
+    kw = dict(n_slots=4, read_chunk=1, prefill_chunk=8, kv_page_size=8,
+              kv_pages=48)
+    src = serve.ContinuousBatcher(model, params, **kw)
+    dst = serve.ContinuousBatcher(model, params, **kw)
+    tids = [("%032x" % (i + 1)) if traced else None
+            for i in range(len(BURST))]
+    outs = [None] * len(BURST)
+    try:
+        def sub(eng, i):
+            p, n, t, s, c = BURST[i]
+            return eng.submit(p, n, temperature=t, seed=s, priority=c,
+                              trace_id=tids[i])
+
+        # the exotic pair first so their mid-decode cuts land reliably
+        h_mig, h_park = sub(src, MIG_I), sub(src, PARK_I)
+        h_mig.tokens.get(timeout=300)
+        h_park.tokens.get(timeout=300)
+        parked = src._park_gather(h_park)
+        assert parked is not None
+        frozen = src.freeze_session(h_mig, timeout_s=60)
+        assert frozen is not None
+        meta, blocks = kvtransfer.wire_snapshot(
+            frozen, "m", page_size=src.kv_page_size)
+        server = kvtransfer.PageServer()
+        try:
+            ticket = server.register(meta, blocks)
+            meta2, blocks2 = kvtransfer.pull_snapshot(server.addr, ticket)
+        finally:
+            server.close()
+        h2, installed = dst.submit_resume(meta2, blocks2)
+        assert installed.wait(300), "resume install timed out"
+        src.complete_migration(frozen)
+        # the rest of the burst rides alongside
+        rest = {i: sub(src, i) for i in range(len(BURST))
+                if i not in (MIG_I, PARK_I)}
+        src._park_restore(parked)
+        outs[MIG_I] = h2.result(timeout=300)
+        outs[PARK_I] = h_park.result(timeout=300)
+        for i, h in rest.items():
+            outs[i] = h.result(timeout=300)
+        return outs, src.trace, dst.trace, tids
+    finally:
+        src.stop()
+        dst.stop()
+
+
+@pytest.mark.slow
+def test_traced_burst_byte_identical_to_untraced(model_and_params):
+    # satellite regression: the FULL mixed burst — greedy + seeded
+    # sampling, both priority classes, a mid-decode migration, a
+    # park/unpark cycle — produces byte-identical tokens with tracing
+    # on and off, and both match solo decode
+    model, params = model_and_params
+    on, src_rec, dst_rec, tids = _run_burst(model, params, traced=True)
+    off, _, _, _ = _run_burst(model, params, traced=False)
+    assert on == off
+    for (p, n, t, s, _), out in zip(BURST, on):
+        assert out == _solo(model, params, p, n, temperature=t, seed=s)
+
+    # the traced run actually recorded the lifecycle it claims to
+    mig = tids[MIG_I]
+    src_names = {sp["name"] for sp in src_rec.spans(mig)}
+    dst_names = {sp["name"] for sp in dst_rec.spans(mig)}
+    # "wire" is recorded by the :migrate HTTP handler, not by a direct
+    # wire_snapshot() call — the gateway e2e test covers that stage
+    assert {"submit", "queue", "admit", "prefill", "freeze"} <= src_names
+    assert {"resume", "decode", "retire"} <= dst_names
+    park_names = {sp["name"] for sp in src_rec.spans(tids[PARK_I])}
+    assert {"submit", "park", "unpark", "retire"} <= park_names
+    for i, tid in enumerate(tids):
+        if i in (MIG_I, PARK_I):
+            continue
+        names = {sp["name"] for sp in src_rec.spans(tid)}
+        assert {"submit", "admit", "retire"} <= names, (i, names)
+    summ = src_rec.summary(tids[0])
+    assert summ["spans"] >= 3 and "admit" in summ["stages"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_traced_burst_byte_identical_under_export_deny(model_and_params):
+    # the chaos contract at engine scale: with trace.export denied for
+    # the WHOLE burst, tokens stay byte-identical and every span is
+    # dropped rather than recorded
+    model, params = model_and_params
+    plan = faults.FaultPlan(0).on("trace.export", kind="deny", nth=1,
+                                  times=None)
+    with faults.active(plan):
+        denied, src_rec, dst_rec, tids = _run_burst(model, params,
+                                                    traced=True)
+    assert plan.fired
+    clean, _, _, _ = _run_burst(model, params, traced=False)
+    assert denied == clean
+    assert all(src_rec.spans(t) == [] for t in tids)
+    assert all(dst_rec.spans(t) == [] for t in tids)
+    assert src_rec.stats()["trace_spans_dropped"] > 0
+    assert src_rec.stats()["trace_spans_recorded"] == 0
+
+
+# ---------------------------------------------- gateway acceptance ----
+
+
+def _get_json(url, timeout=60):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.slow
+def test_gateway_stitched_timeline_and_metrics_end_to_end(tmp_path):
+    # the acceptance path: real Gateway over a prefill-role and a
+    # decode-role serve.py replica.  A streamed :generate sent with
+    # X-Trace-Id prefills on one replica, auto-migrates mid-decode to
+    # the other, stays byte-identical — and GET /v1/trace/<id> on the
+    # gateway returns ONE stitched timeline whose spans come from the
+    # gateway AND both replicas, covering the whole lifecycle.  Both
+    # processes also expose every stats() key on GET /metrics.
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import export as export_mod
+    from tensorflowonspark_tpu import fleet, serve
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2,
+                  n_layers=2, d_ff=64, max_seq_len=256, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export_mod.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:"
+                "build_transformer",
+        builder_kwargs=cfg_kw)
+
+    gw = fleet.Gateway(heartbeat_timeout_s=10.0, monitor_interval_s=0.1,
+                       connect_timeout_s=5.0, replica_timeout_s=300.0,
+                       probe_timeout_s=30.0)
+    gw.start()
+    servers, regs = [], []
+
+    def _replica(role, slots):
+        args = serve.build_argparser().parse_args(
+            ["--export_dir", str(tmp_path / "lm"), "--host", "127.0.0.1",
+             "--port", "0", "--generate_slots", str(slots),
+             "--generate_prefill_chunk", "16",
+             "--generate_kv_page_size", "8", "--generate_kv_pages", "64",
+             "--role", role, "--fleet", "%s:%d" % gw.registry_addr,
+             "--fleet_heartbeat_s", "0.2"])
+        srv, _svc = serve.make_server(args)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        regs.append(serve._register_with_fleet(args, srv))
+        return srv.server_address[1]
+
+    try:
+        p_port = _replica("prefill", 2)
+        d_port = _replica("decode", 4)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(
+                gw.fleet_stats(probe=False)["replicas"]) < 2:
+            time.sleep(0.05)
+
+        tid = "feedface" * 4                        # client-chosen id
+        prompt, n_new = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3], 24
+        req = urllib.request.Request(
+            "http://%s:%d/v1/models/default:generate" % gw.http_addr,
+            data=json.dumps({"inputs": [prompt], "max_new_tokens": n_new,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Trace-Id": tid})
+        toks, done = [], None
+        with urllib.request.urlopen(req, timeout=300) as r:
+            for line in r:
+                ev = json.loads(line)
+                if "token" in ev:
+                    toks.append(ev["token"])
+                if ev.get("done"):
+                    done = ev
+        want = _solo(model, params, prompt, n_new)
+        assert done["output"] == want               # parity across cut
+        assert toks == want[len(prompt):]
+        totals = gw.fleet_stats()["totals"]
+        assert totals["migrations_completed"] == 1
+
+        # ---- the stitched timeline -------------------------------
+        out = _get_json("http://%s:%d/v1/trace/%s"
+                        % (gw.http_addr + (tid,)))
+        assert out["id"] == tid
+        sources = set(out["sources"])
+        assert "gateway" in sources
+        assert len(sources - {"gateway"}) == 2      # BOTH replicas
+        stages = set(out["stages"])
+        assert {"gateway.route", "gateway.relay", "submit", "admit",
+                "prefill", "decode", "freeze", "wire", "resume",
+                "retire"} <= stages
+        # spans are one merged time-sorted list
+        t0s = [s["t0_ms"] for s in out["spans"]]
+        assert t0s == sorted(t0s)
+        by_src = {}
+        for s in out["spans"]:
+            by_src.setdefault(s["source"], set()).add(s["name"])
+        gw_stages = by_src["gateway"]
+        assert {"gateway.route", "gateway.relay"} <= gw_stages
+        src_stages = set().union(*(v for k, v in by_src.items()
+                                   if k != "gateway"))
+        assert "freeze" in src_stages and "resume" in src_stages
+        # a bogus id is rejected, an unknown one returns empty
+        with pytest.raises(urllib.error.HTTPError):
+            _get_json("http://%s:%d/v1/trace/nothex!" % gw.http_addr)
+        empty = _get_json("http://%s:%d/v1/trace/%s"
+                          % (gw.http_addr + ("0" * 32,)))
+        assert empty["spans"] == []
+
+        # ---- /metrics on the replica -----------------------------
+        meta = _get_json(f"http://127.0.0.1:{d_port}/v1/models/default")
+        gstats = meta["model"]["generate_stats"]
+        rtext = urllib.request.urlopen(
+            f"http://127.0.0.1:{d_port}/metrics", timeout=60)
+        assert rtext.headers["Content-Type"].startswith("text/plain")
+        rbody = rtext.read().decode()
+        for key, val in gstats.items():
+            if isinstance(val, dict):
+                stem = key[:-5] if key.endswith("_hist") else key
+                assert f"tfospark_replica_{metrics._prom_name(stem)}" \
+                    "_bucket" in rbody, key
+            elif isinstance(val, (int, float)):
+                assert f"tfospark_replica_{metrics._prom_name(key)}" \
+                    in rbody, key
+        assert "tfospark_replica_trace_spans_recorded" in rbody
+        # /v1/metrics is an alias
+        alias = urllib.request.urlopen(
+            f"http://127.0.0.1:{d_port}/v1/metrics", timeout=60)
+        assert alias.headers["Content-Type"].startswith("text/plain")
+
+        # ---- /metrics on the gateway -----------------------------
+        gtext = urllib.request.urlopen(
+            "http://%s:%d/metrics" % gw.http_addr, timeout=120)
+        assert gtext.headers["Content-Type"].startswith("text/plain")
+        gbody = gtext.read().decode()
+        gw_stats = gw.fleet_stats()
+        for key, val in gw_stats["counters"].items():
+            if isinstance(val, (int, float)):
+                assert f"tfospark_gateway_{metrics._prom_name(key)}" \
+                    in gbody, key
+        for key, val in gw_stats["totals"].items():
+            if isinstance(val, dict):
+                stem = key[:-5] if key.endswith("_hist") else key
+                assert f"tfospark_fleet_{metrics._prom_name(stem)}" \
+                    "_bucket" in gbody, key
+            elif isinstance(val, (int, float)):
+                assert f"tfospark_fleet_{metrics._prom_name(key)}" \
+                    in gbody, key
+        # per-replica labeled groups rode along
+        assert 'replica="127.0.0.1:%d"' % d_port in gbody
+
+        # ---- merged-histogram fleet quantiles (the p95 gap) ------
+        totals = gw.fleet_stats()["totals"]
+        assert totals["ttft_hist"]["count"] >= 1
+        assert totals["ttft_p95_est_ms"] > 0
+        assert totals["ttft_p50_est_ms"] <= totals["ttft_p95_est_ms"]
+
+        # ---- on-demand profiling through the gateway -------------
+        preq = urllib.request.Request(
+            "http://%s:%d/v1/debug:profile?replica=127.0.0.1:%d"
+            % (gw.http_addr + (d_port,)),
+            data=json.dumps({"duration_ms": 60}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(preq, timeout=120) as r:
+                prof = json.loads(r.read())
+                assert prof["duration_ms"] == 60.0
+                assert prof["artifact"]
+        except urllib.error.HTTPError as e:
+            # CPU-only jaxlib without profiler support: typed 503
+            assert e.code == 503
+        # malformed duration is a 400, not a capture
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{d_port}/v1/debug:profile",
+            data=json.dumps({"duration_ms": -5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=60)
+        assert ei.value.code == 400
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        gw.stop()
