@@ -43,6 +43,13 @@ The `prefill_ms` segment prices the paged S>1 chunk dispatch: the
 Pallas in-place page-write prefill kernel vs the full-pool einsum
 blend (benchmarks.make_prefill_chunk_step), with the analytic kv
 write-traffic contrast in aux.
+The `qmm_ms` segment prices the fused-dequant weight matmuls
+(ops/quant_matmul.py): one decode-shaped flagship projection
+(benchmarks.make_qmm_op) per weight store — int8 and nibble-packed
+int4 Pallas kernels vs the dense bf16 baseline, with the analytic
+weight-bytes contrast in aux; `decode_ms` and `engine_tps` each gain
+an int8-quantized pass (aux) so the end-to-end decode win is priced
+where operators feel it.
 
 On a device whose bf16 peak is unknown (not in benchmarks.PEAK_BF16) the
 metric falls back to tokens/sec — an MFU percent against a guessed peak
@@ -154,14 +161,17 @@ def bench_decode_segment(steps=32, windows=3):
     time on the flagship dims (benchmarks.make_decode_step /
     FLAGSHIP_DECODE — max_seq 4096, rows filled to 2000 tokens, the
     gather path's worst case), flash-decode kernel vs the einsum
-    full-gather reference.  Returns (kernel_ms, einsum_ms)."""
+    full-gather reference, plus a third pass with the weights int8-
+    quantized through the fused-dequant quant_matmul path (weight-only
+    W8A16 — the serving --generate_quantize int8 store).  Returns
+    (kernel_ms, einsum_ms, int8_ms)."""
     import numpy as np
 
     from tensorflowonspark_tpu.benchmarks import make_decode_step
 
-    def timed(impl):
+    def timed(impl, quantize=None):
         step, params, cache, (toks, temps, seeds, ords) = \
-            make_decode_step(impl)
+            make_decode_step(impl, quantize=quantize)
         toks, cache, ords = step(params, cache, toks, temps, seeds, ords)
         np.asarray(toks)                           # compile + sync
         best = float("inf")
@@ -174,7 +184,35 @@ def bench_decode_segment(steps=32, windows=3):
             best = min(best, (time.perf_counter() - t0) / steps)
         return best * 1000
 
-    return timed("kernel"), timed("einsum")
+    return timed("kernel"), timed("einsum"), timed("kernel", "int8")
+
+
+def bench_qmm_segment(steps=64, windows=3):
+    """The quantized-matmul segment: one flagship projection matmul
+    (benchmarks.make_qmm_op / FLAGSHIP_QMM — 16 decode rows through the
+    2048x8192 kernel) per weight store, the fused-dequant int8 and
+    nibble-packed int4 Pallas kernels (ops.quant_matmul) vs the dense
+    bf16 compute-width baseline.  Decode matmuls are weight-read-bound,
+    so ms should track benchmarks.qmm_weight_bytes.  Returns
+    {mode: ms} for modes bf16/int8/int4."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.benchmarks import make_qmm_op
+
+    out = {}
+    for mode in ("bf16", "int8", "int4"):
+        fn, x, w = make_qmm_op(mode)
+        y = fn(x, w)
+        np.asarray(y)                              # compile + sync
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                y = fn(x, w)
+            np.asarray(y)                          # host readback barrier
+            best = min(best, (time.perf_counter() - t0) / steps)
+        out[mode] = best * 1000
+    return out
 
 
 def bench_prefill_segment(steps=16, windows=3):
@@ -250,15 +288,19 @@ def bench_engine_segment(reps=3, result_timeout=600):
     only).  A third pass re-runs the async engine with EVERY request
     traced (fresh trace id per submit) to price the observability
     layer: its span recording must be lost in the noise, and the
-    ``trace_overhead`` aux keeps that claim regression-checked.
-    Returns (async_tps, traced_tps, serial_tps, stats) where ``stats``
-    holds the async engine's device_idle_fraction and
-    pipeline_depth_peak."""
+    ``trace_overhead`` aux keeps that claim regression-checked.  A
+    fourth pass re-runs the async engine with the weights int8-
+    quantized (the fused-dequant quant_matmul store serving uses for
+    --generate_quantize int8) to price weight-only quantization at the
+    full-batcher level.  Returns (async_tps, traced_tps, serial_tps,
+    int8_tps, stats) where ``stats`` holds the async engine's
+    device_idle_fraction and pipeline_depth_peak."""
     from tensorflowonspark_tpu import trace
     from tensorflowonspark_tpu.benchmarks import make_engine_burst
 
-    def timed(engine, traced=False):
-        batcher, prompts, max_new = make_engine_burst(engine=engine)
+    def timed(engine, traced=False, quantize=None):
+        batcher, prompts, max_new = make_engine_burst(engine=engine,
+                                                      quantize=quantize)
         try:
             best = 0.0
             for rep in range(max(2, reps)):
@@ -281,7 +323,8 @@ def bench_engine_segment(reps=3, result_timeout=600):
     async_tps, astats = timed("async")
     traced_tps, _ = timed("async", traced=True)
     serial_tps, _ = timed("serial")
-    return async_tps, traced_tps, serial_tps, astats
+    int8_tps, _ = timed("async", quantize="int8")
+    return async_tps, traced_tps, serial_tps, int8_tps, astats
 
 
 def bench_migrate_segment(reps=5, result_timeout=600):
@@ -591,11 +634,54 @@ def _decode_segment_setup():
 
 
 def _decode_segment_result():
-    kernel_ms, einsum_ms = bench_decode_segment()
+    kernel_ms, einsum_ms, int8_ms = bench_decode_segment()
     return {"metric": "decode_ms", "value": round(kernel_ms, 2),
             "unit": "ms/step",
             "aux": {"decode_ms_einsum": round(einsum_ms, 2),
-                    "speedup_vs_einsum": round(einsum_ms / kernel_ms, 2)}}
+                    "speedup_vs_einsum": round(einsum_ms / kernel_ms, 2),
+                    # same step with the weights int8-quantized through
+                    # the fused-dequant matmul path (W8A16 serving store)
+                    "decode_ms_int8": round(int8_ms, 2),
+                    "speedup_int8_vs_bf16": round(kernel_ms / int8_ms, 2)}}
+
+
+def _qmm_segment_setup():
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_QMM,
+                                                  make_qmm_op,
+                                                  qmm_weight_bytes)
+    from tensorflowonspark_tpu.ops import quant_matmul_available
+
+    assert callable(make_qmm_op) and callable(quant_matmul_available)
+    d = FLAGSHIP_QMM
+    assert d["rows"] > 0 and d["group_size"] % 2 == 0
+    # whole groups: the analytic bytes and the packed layout agree
+    assert d["in_dim"] % d["group_size"] == 0
+    # the weight-read contrast the segment exists to price
+    assert (qmm_weight_bytes("int4") < qmm_weight_bytes("int8")
+            < qmm_weight_bytes("bf16"))
+    return {"config": dict(d)}
+
+
+def _qmm_segment_result():
+    from tensorflowonspark_tpu.benchmarks import qmm_weight_bytes
+
+    ms = bench_qmm_segment()
+    return {"metric": "qmm_ms", "value": round(ms["int8"], 3),
+            "unit": "ms/matmul",
+            "aux": {"qmm_ms_bf16": round(ms["bf16"], 3),
+                    "qmm_ms_int4": round(ms["int4"], 3),
+                    "speedup_int8_vs_bf16": round(
+                        ms["bf16"] / ms["int8"], 2),
+                    "speedup_int4_vs_bf16": round(
+                        ms["bf16"] / ms["int4"], 2),
+                    # analytic per-step weight read (the bound the
+                    # kernels chase on a weight-bound decode matmul)
+                    "weight_mb_bf16": round(
+                        qmm_weight_bytes("bf16") / 1e6, 2),
+                    "weight_mb_int8": round(
+                        qmm_weight_bytes("int8") / 1e6, 2),
+                    "weight_mb_int4": round(
+                        qmm_weight_bytes("int4") / 1e6, 2)}}
 
 
 def _prefill_segment_setup():
@@ -661,11 +747,15 @@ def _engine_segment_setup():
 
 
 def _engine_segment_result():
-    async_tps, traced_tps, serial_tps, astats = bench_engine_segment()
+    (async_tps, traced_tps, serial_tps, int8_tps,
+     astats) = bench_engine_segment()
     return {"metric": "engine_tps", "value": round(async_tps, 1),
             "unit": "tokens/s",
             "aux": {"engine_tps_serial": round(serial_tps, 1),
                     "speedup_vs_serial": round(async_tps / serial_tps, 2),
+                    # the async engine with int8-quantized weights
+                    # (fused-dequant matmul path, W8A16 serving store)
+                    "engine_tps_int8": round(int8_tps, 1),
                     # fractional tokens/s lost with every request
                     # traced (negative = noise); keeps "tracing is
                     # free on the hot path" an actual regression check
@@ -745,6 +835,12 @@ SEGMENTS = {
         "setup": _decode_segment_setup,
         "help": "steady-state paged slot-decode step "
                 "(flash-decode kernel vs einsum full-gather)"},
+    "qmm_ms": {
+        "run": _qmm_segment_result,
+        "setup": _qmm_segment_setup,
+        "help": "fused-dequant weight matmul on the flagship projection "
+                "(int8 / nibble-packed int4 Pallas kernels vs the dense "
+                "bf16 store, with the analytic weight-bytes contrast)"},
     "prefill_ms": {
         "run": _prefill_segment_result,
         "setup": _prefill_segment_setup,

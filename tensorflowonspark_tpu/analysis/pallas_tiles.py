@@ -7,9 +7,16 @@
     whose literal minor dim is not a multiple of 128 (or second-minor not a
     multiple of 8, the f32 floor) compiles — Mosaic pads — but every block
     load/store wastes the pad fraction and can force relayouts.  Only
-    literal ints are checked (symbolic dims pass); a literal ``1``
-    second-minor is allowed (scalar rows); specs with an explicit
+    literal ints are checked (symbolic dims pass); specs with an explicit
     ``memory_space`` (SMEM scalar specs) are exempt.
+
+    Two quantized-weight carve-outs (ops/quant_matmul.py):  a literal
+    minor that is a multiple of 64 passes, because a nibble-packed int4
+    block of 64 bytes spans a full 128 logical lanes once unpacked; and a
+    literal second-minor that *divides* 8 passes (1, 2, 4), because
+    per-group scale blocks carry ``block_k / group_size`` rows — a
+    handful of broadcast rows, not a sublane-tiled operand (the previous
+    scalar-row allowance for ``1`` is the degenerate case).
 
 ``pallas-interpret``
     Every ``pl.pallas_call`` must thread an ``interpret=`` flag.  The repo
@@ -37,6 +44,7 @@ from .core import Finding, Rule, register
 from .tracer import _call_name
 
 _LANE = 128
+_HALF_LANE = 64  # nibble-packed int4: 64 bytes = 128 logical lanes
 _SUBLANE = 8  # f32 floor; bf16 wants 16, int8 wants 32
 
 
@@ -76,20 +84,23 @@ class PallasTileRule(Rule):
             if not dims:
                 continue
             minor, minor_line = dims[-1]
-            if minor is not None and minor % _LANE != 0:
+            if (minor is not None and minor % _LANE != 0
+                    and minor % _HALF_LANE != 0):
                 yield Finding(
                     ctx.path, minor_line, self.name,
                     f"BlockSpec minor dim {minor} is not a multiple of "
-                    f"{_LANE} (TPU lane width); Mosaic pads every block "
+                    f"{_LANE} (TPU lane width; {_HALF_LANE} allowed for "
+                    "nibble-packed int4 blocks); Mosaic pads every block "
                     "load/store to the full tile")
             if len(dims) >= 2:
                 sub, sub_line = dims[-2]
-                if sub is not None and sub != 1 and sub % _SUBLANE != 0:
+                if (sub is not None and sub % _SUBLANE != 0
+                        and (sub <= 0 or _SUBLANE % sub != 0)):
                     yield Finding(
                         ctx.path, sub_line, self.name,
                         f"BlockSpec second-minor dim {sub} is not a multiple "
                         f"of {_SUBLANE} (f32 sublane; bf16 needs 16, int8 "
-                        "needs 32)")
+                        "needs 32) nor a divisor of it (grouped-scale rows)")
 
 
 def _prefetch_arity(call):

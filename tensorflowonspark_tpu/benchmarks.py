@@ -55,14 +55,18 @@ FLAGSHIP_DECODE = dict(n_slots=16, page_size=64, max_seq=4096, fill=2000)
 
 
 def make_decode_step(impl="kernel", n_slots=None, page_size=None,
-                     max_seq=None, fill=None):
+                     max_seq=None, fill=None, quantize=None):
     """Build the steady-state paged slot-decode step for the decode_ms
     segment: flagship-LM dims (FLAGSHIP_LM_V2) at ``max_seq``, every row
     fully page-mapped and pre-filled to ``fill`` tokens, so each timed
     step is one mid-stream decode token for all ``n_slots`` rows.
     ``impl`` picks the paged READ path ("kernel" = the Pallas
     flash-decode kernel, "einsum" = the full-gather reference —
-    TransformerConfig.paged_attn_impl).  Returns
+    TransformerConfig.paged_attn_impl).  ``quantize`` ("int8"/"int4")
+    stores the weights quantized exactly as serving does (quantize_tree
+    then the compute-width cast for the survivors, serve._load_lm's
+    order), so the step decodes through the fused-dequant quant_matmul
+    path.  Returns
     ``(step, params, cache, (toks, temps, seeds, ords))``; the cache is
     donated — advance with
     ``toks, cache, ords = step(params, cache, toks, temps, seeds, ords)``.
@@ -85,6 +89,10 @@ def make_decode_step(impl="kernel", n_slots=None, page_size=None,
     # params don't depend on seq length: init with a short trace
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
+    if quantize:
+        from tensorflowonspark_tpu import quantize as quantize_mod
+        params = quantize_mod.quantize_tree(params, mode=quantize)
+        params = quantize_mod.cast_float_leaves(params, cfg.dtype)
     max_pages = max_seq // page
     # every row fully mapped (pages are row-contiguous; +1 = the sink,
     # unused here but init_paged_slot_cache's caller contract): steps
@@ -113,6 +121,69 @@ def make_decode_step(impl="kernel", n_slots=None, page_size=None,
     seeds = jnp.zeros((n_slots,), jnp.int32)
     ords = jnp.zeros((n_slots,), jnp.int32)
     return step, params, cache, (toks, temps, seeds, ords)
+
+
+# The qmm_ms segment workload (bench.py --segments): one decode-shaped
+# weight matmul on the flagship's widest projection — d_model -> d_ff
+# (2048 x 8192, the DenseMLP up-projection kernel) with a decode batch
+# of rows.  Decode matmuls are weight-read-bound (rows is the slot
+# batch, tiny next to the kernel), so the fused-dequant stores' smaller
+# resident bytes (qmm_weight_bytes) should convert ~directly into step
+# time.  Frozen like FLAGSHIP_DECODE: changing any value invalidates
+# qmm_ms comparability.
+FLAGSHIP_QMM = dict(rows=16, in_dim=2048, out_dim=8192, group_size=128)
+
+
+def make_qmm_op(mode="bf16", rows=None, in_dim=None, out_dim=None,
+                group_size=None):
+    """Build the qmm_ms segment op: a jitted ``fn(x, w) -> y`` plus its
+    ``(x, w)`` operands for one flagship projection matmul.  ``mode``
+    picks the weight store — "bf16" = the dense compute-width matmul
+    (the W16 serving baseline), "int8" / "int4" = the fused-dequant
+    Pallas kernels (ops.quant_matmul) over the quantized leaf, built by
+    the same quantize_tree serving uses.  The activation is bf16 in
+    every mode: weight-only quantization (W8A16 / W4A16)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import quantize as quantize_mod
+    from tensorflowonspark_tpu.ops import quant_matmul
+
+    d = FLAGSHIP_QMM
+    rows = rows or d["rows"]
+    K = in_dim or d["in_dim"]
+    N = out_dim or d["out_dim"]
+    G = group_size or d["group_size"]
+    kx, kw = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(kx, (rows, K), jnp.bfloat16)
+    w = jax.random.normal(kw, (K, N), jnp.float32)
+    if mode == "bf16":
+        return jax.jit(jnp.dot), x, w.astype(jnp.bfloat16)
+    qleaf = quantize_mod.quantize_tree(
+        {"proj": {"kernel": w}}, mode=mode, min_elements=0,
+        group_size=G)["proj"]["kernel"]
+    return jax.jit(quant_matmul), x, qleaf
+
+
+def qmm_weight_bytes(mode, in_dim=None, out_dim=None, group_size=None):
+    """Analytic resident weight bytes for one qmm_ms matmul — the
+    per-step weight read the segment exists to price (a decode matmul
+    streams the whole kernel once per step).  bf16: K·N·2.  int8: K·N
+    payload + N per-channel f32 scales.  int4: the nibble-packed
+    payload (two input rows per stored byte, input dim padded to whole
+    groups) + one f32 scale per (group, output channel)."""
+    d = FLAGSHIP_QMM
+    K = in_dim or d["in_dim"]
+    N = out_dim or d["out_dim"]
+    G = group_size or d["group_size"]
+    if mode == "bf16":
+        return K * N * 2
+    if mode == "int8":
+        return K * N + N * 4
+    if mode == "int4":
+        n_groups = -(-K // G)
+        return n_groups * (G // 2) * N + n_groups * N * 4
+    raise ValueError(f"unknown qmm mode {mode!r}")
 
 
 # The prefill_ms segment workload (bench.py --segments): steady-state
@@ -280,12 +351,16 @@ FLAGSHIP_ENGINE = dict(n_slots=8, prompts=16, prompt_len=64, max_new=96,
 
 def make_engine_burst(engine="async", n_slots=None, prompts=None,
                       prompt_len=None, max_new=None, prefill_chunk=None,
-                      prefill_rows=None, max_seq=None, pipeline_depth=2):
+                      prefill_rows=None, max_seq=None, pipeline_depth=2,
+                      quantize=None):
     """Build the engine_tps segment workload: a ContinuousBatcher on the
     flagship-LM dims running the requested ``engine`` ("async" = the
     double-buffered producer/consumer pipeline, "serial" = the
     single-thread dispatch/process baseline) plus the prompt burst to
-    submit.  Returns ``(batcher, prompts_list, max_new)``; the caller
+    submit.  ``quantize`` ("int8"/"int4") stores the weights quantized
+    exactly as serving does (serve._load_lm's quantize-then-cast order),
+    so the whole burst decodes through the fused-dequant quant_matmul
+    path.  Returns ``(batcher, prompts_list, max_new)``; the caller
     submits the burst, drains every handle, and computes tokens/s from
     wall clock (device-idle fraction comes from ``batcher.stats()``).
     Caller must ``batcher.stop()``.  Prompts are distinct random garbage
@@ -311,6 +386,10 @@ def make_engine_burst(engine="async", n_slots=None, prompts=None,
     model = Transformer(cfg)
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
+    if quantize:
+        from tensorflowonspark_tpu import quantize as quantize_mod
+        params = quantize_mod.quantize_tree(params, mode=quantize)
+        params = quantize_mod.cast_float_leaves(params, cfg.dtype)
     batcher = serve_mod.ContinuousBatcher(
         model, params, n_slots=n_slots, read_chunk=4, prefill_chunk=chunk,
         prefill_rows=rows, engine=engine, pipeline_depth=pipeline_depth)

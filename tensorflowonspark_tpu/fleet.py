@@ -929,7 +929,12 @@ class Gateway:
                   # no traffic in a class contributes 0, so an idle
                   # class on one replica can't poison fleet averages)
                   "parked_sessions": 0, "sessions_parked": 0,
-                  "sessions_unparked": 0, "park_spills": 0}
+                  "sessions_unparked": 0, "park_spills": 0,
+                  # weight-only quantization (serve metadata's cached
+                  # generate_quantize block): resident quantized weight
+                  # bytes and their float-equivalent sum across probed
+                  # replicas (unquantized replicas contribute 0)
+                  "weight_bytes": 0, "weight_float_equivalent_bytes": 0}
         for cls in PRIORITY_CLASSES:
             totals[f"ttft_{cls}_count"] = 0
             totals[f"ttft_{cls}_ms_sum"] = 0.0
@@ -954,6 +959,11 @@ class Gateway:
                         gstats.get("prefill_tokens_shared") or 0)
                     totals["prefix_pages_cached"] += int(
                         gstats.get("prefix_pages_cached") or 0)
+                    qinfo = model.get("generate_quantize") or {}
+                    totals["weight_bytes"] += int(
+                        qinfo.get("weight_bytes") or 0)
+                    totals["weight_float_equivalent_bytes"] += int(
+                        qinfo.get("float_equivalent_bytes") or 0)
                     # kv-pool occupancy across the fleet (paged replicas
                     # report these; dense ones contribute 0)
                     for key in ("kv_pages_used", "kv_pages_free",

@@ -17,22 +17,36 @@ import jax
 import jax.numpy as jnp
 
 
-def _params_view(params):
+def _params_view(params, cfg=None):
     """Model-ready view of `params` inside a jitted program.
 
-    Weight-only int8 leaves (``{"q", "scale"}`` dicts from
-    `quantize.quantize_tree`) dequantize HERE, under the trace — XLA fuses
-    the ``q.astype(f32) * scale`` into the consuming matmul's operand
-    read, so the full-precision kernel never materializes in HBM and each
-    decode step reads ~2x fewer weight bytes than the W16 serving store
-    (~4x vs f32 masters; decode is weight-bandwidth bound).  Unquantized
-    trees pass through untouched; the walk happens at
-    trace time only.  Every jitted decode entry point routes params
-    through this, so quantized trees work in solo `generate`, streaming,
+    Quantized weight leaves (int8 ``{"q", "scale"}`` dicts and int4
+    ``Int4Weight`` from `quantize.quantize_tree`) route one of two ways,
+    picked by the owning model's ``cfg.quant_matmul_impl``:
+
+    - ``"kernel"`` (default): 2-D leaves stay QUANTIZED
+      (`quantize.qdense_view`) and `transformer.QuantDense` consumes
+      them through the Pallas fused-dequant matmul
+      (ops/quant_matmul.py) — weight tiles dequantize in VMEM, so the
+      dense kernel never exists in HBM and each decode step reads ~2x
+      (int8) / ~4x (int4) fewer weight bytes than the W16 serving store
+      (decode is weight-bandwidth bound).
+    - ``"dequant"`` (and ``cfg=None``, e.g. non-Transformer callers):
+      leaves dequantize HERE, under the trace — XLA fuses the
+      ``q.astype(f32) * scale`` into the consuming matmul's operand
+      read (the pre-kernel behavior, kept as the parity oracle and the
+      sharded fallback).
+
+    Unquantized trees pass through untouched; the walk happens at trace
+    time only.  Every jitted decode entry point routes params through
+    this, so quantized trees work in solo `generate`, streaming,
     speculative rounds, and the serving slot engine alike.
     """
-    from tensorflowonspark_tpu.quantize import dequantize_tree
+    from tensorflowonspark_tpu.quantize import dequantize_tree, qdense_view
 
+    if (cfg is not None
+            and getattr(cfg, "quant_matmul_impl", "dequant") == "kernel"):
+        return qdense_view(params)
     return dequantize_tree(params)
 
 
@@ -75,7 +89,8 @@ def _jitted_step(decode_model):
     @jax.jit
     def step(params, tokens, cache):
         logits, mut = decode_model.apply(
-            {"params": _params_view(params), "cache": cache}, tokens,
+            {"params": _params_view(params, decode_model.cfg),
+             "cache": cache}, tokens,
             mutable=["cache"])
         return logits[:, -1], mut["cache"]
 
@@ -91,7 +106,8 @@ def _jitted_step_all(decode_model):
     @jax.jit
     def step(params, tokens, cache):
         logits, mut = decode_model.apply(
-            {"params": _params_view(params), "cache": cache}, tokens,
+            {"params": _params_view(params, decode_model.cfg),
+             "cache": cache}, tokens,
             mutable=["cache"])
         return logits, mut["cache"]
 
@@ -118,7 +134,8 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
              topks=None, topps=None, minps=None, seen=None,
              rep=None):
         logits, mut = decode_model.apply(
-            {"params": _params_view(params), "cache": cache}, tok[:, None],
+            {"params": _params_view(params, decode_model.cfg),
+             "cache": cache}, tok[:, None],
             mutable=["cache"])
         logits = logits[:, -1]
         if seen is not None:
@@ -430,7 +447,8 @@ def _jitted_slot_prefill(slot_model):
     @functools.partial(jax.jit, donate_argnums=(1,))
     def prefill(params, cache, chunk, row, start, n_valid):
         return _slot_prefill_body(
-            slot_model, {"params": _params_view(params)}, cache, chunk,
+            slot_model,
+            {"params": _params_view(params, slot_model.cfg)}, cache, chunk,
             row, start, n_valid)
 
     return prefill
@@ -505,7 +523,8 @@ def _jitted_slot_step(slot_model):
              reps=None, rems=None, eoss=None, eos_on=None):
         return _slot_step_body(
             slot_model,
-            {"params": _params_view(params), "cache": cache},
+            {"params": _params_view(params, slot_model.cfg),
+             "cache": cache},
             toks, temps, seeds, ords, topks, topps, minps, seen,
             reps, rems, eoss, eos_on)
 
@@ -544,7 +563,8 @@ def _jitted_slot_step_lora(slot_model):
              reps=None, rems=None, eoss=None, eos_on=None):
         return _slot_step_body(
             slot_model,
-            {"params": _params_view(params), "cache": cache,
+            {"params": _params_view(params, slot_model.cfg),
+             "cache": cache,
              "lora": _lora_with_ids(lora, ids)},
             toks, temps, seeds, ords, topks, topps, minps, seen,
             reps, rems, eoss, eos_on)
@@ -565,7 +585,7 @@ def _jitted_slot_prefill_lora(slot_model):
         ids = jnp.full((1,), adapter_id, jnp.int32)
         return _slot_prefill_body(
             slot_model,
-            {"params": _params_view(params),
+            {"params": _params_view(params, slot_model.cfg),
              "lora": _lora_with_ids(lora, ids)},
             cache, chunk, row, start, n_valid)
 
@@ -634,8 +654,9 @@ def _jitted_slot_prefill_many(slot_model):
     @functools.partial(jax.jit, donate_argnums=(1,))
     def prefill(params, cache, chunks, rows, starts, n_valids, sink):
         return _slot_prefill_many_body(
-            slot_model, {"params": _params_view(params)}, cache, chunks,
-            rows, starts, n_valids, sink)
+            slot_model,
+            {"params": _params_view(params, slot_model.cfg)}, cache,
+            chunks, rows, starts, n_valids, sink)
 
     return prefill
 
@@ -651,7 +672,7 @@ def _jitted_slot_prefill_many_lora(slot_model):
                 sink, adapter_ids):
         return _slot_prefill_many_body(
             slot_model,
-            {"params": _params_view(params),
+            {"params": _params_view(params, slot_model.cfg),
              "lora": _lora_with_ids(lora, adapter_ids.astype(jnp.int32))},
             cache, chunks, rows, starts, n_valids, sink)
 
@@ -754,8 +775,8 @@ def _jitted_slot_spec_round(t_model, d_model, k):
                        donate_argnames=("rems",))
     def spec_round(t_params, d_params, t_cache, d_cache, toks,
                    rems=None, eoss=None, eos_on=None):
-        t_params = _params_view(t_params)
-        d_params = _params_view(d_params)
+        t_params = _params_view(t_params, t_model.cfg)
+        d_params = _params_view(d_params, d_model.cfg)
         # per-row committed length = cache_index before this round (all
         # layers agree; read one leaf)
         idx = _first_named_leaf(t_cache, "cache_index")

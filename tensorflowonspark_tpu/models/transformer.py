@@ -14,7 +14,7 @@ shapes everywhere, einsum formulations that map onto the MXU.
 """
 import dataclasses
 import logging
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -30,6 +30,8 @@ from tensorflowonspark_tpu.ops.paged_attention import (
     paged_attention, paged_attention_available)
 from tensorflowonspark_tpu.ops.paged_prefill import (
     paged_prefill, paged_prefill_available)
+from tensorflowonspark_tpu.ops.quant_matmul import (
+    quant_matmul, quant_matmul_available)
 
 logger = logging.getLogger(__name__)
 
@@ -100,6 +102,14 @@ class TransformerConfig:
     # into the page read); "einsum" = the reference full-gather body
     # (kept for parity tests and as the fallback under an active mesh,
     # where an unpartitionable pallas custom call cannot run)
+    quant_matmul_impl: str = "kernel"  # quantized weight matmul path:
+    # "kernel" = the Pallas fused-dequant matmul (ops/quant_matmul.py —
+    # int8/int4 weight tiles dequantize in VMEM, the dense kernel never
+    # exists in HBM); "dequant" = inline ``q.astype(dtype) * scale``
+    # under the trace (XLA fuses it into the consumer — the parity
+    # oracle, and the fallback under an active mesh like paged_attn_impl).
+    # Only consulted when the param tree holds quantized leaves
+    # (quantize.qdense_view); float trees always take the plain Dense path.
     paged_prefill_impl: str = "kernel"  # paged prefill (S>1) WRITE+READ
     # path: "kernel" = the Pallas paged-prefill kernels
     # (ops/paged_prefill.py — the chunk's k/v store page-granular and IN
@@ -134,6 +144,76 @@ def apply_rope(x, positions, theta=10000.0):
                             x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
+class QuantDense(nn.Module):
+    """``nn.Dense`` drop-in whose kernel may arrive QUANTIZED.
+
+    Param names, shapes and initializers match ``nn.Dense`` exactly
+    ("kernel" [+ "bias"], lecun_normal f32 masters), so checkpoints,
+    the name-matched sharding rules (parallel/sharding.py), LoRA banks
+    and the init RNG stream are unchanged — a float tree behaves
+    bit-for-bit like ``nn.Dense``.  At apply time a kernel that is a
+    quantize.py leaf (int8 ``{"q", "scale"}`` dict or ``Int4Weight``)
+    is consumed in its quantized form: ``impl="kernel"`` routes through
+    ``ops.quant_matmul`` (weight tiles dequantize in VMEM — taken when
+    the TPU pallas extension imported and no mesh is ambient, since a
+    pallas custom call cannot be partitioned by GSPMD); otherwise the
+    leaf dequantizes inline under the trace (``q.astype(dtype) *
+    scale``, for XLA to fuse into the consuming matmul — the
+    pre-kernel behavior, kept as the parity oracle and the sharded
+    fallback, mirroring ``paged_attn_impl``).
+
+    The quantized kernel is fetched via ``get_variable`` rather than
+    ``self.param`` — flax shape-validates declared params against their
+    stored value, and a quantized leaf is a container, not an array.
+    """
+    features: int
+    use_bias: bool = False
+    dtype: Optional[Any] = None
+    impl: str = "kernel"
+
+    @nn.compact
+    def __call__(self, x):
+        from tensorflowonspark_tpu import quantize
+
+        if self.impl not in ("kernel", "dequant"):
+            raise ValueError(f"quant_matmul_impl={self.impl!r} not in "
+                             "('kernel', 'dequant')")
+        qleaf = None
+        if (not self.is_initializing()
+                and self.has_variable("params", "kernel")):
+            stored = self.get_variable("params", "kernel")
+            if quantize.is_quantized_leaf(stored):
+                qleaf = stored
+        kernel = None
+        if qleaf is None:
+            kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                                (x.shape[-1], self.features), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.float32)
+                if self.use_bias else None)
+        if qleaf is None:  # float kernel: exact nn.Dense semantics
+            x, kernel, bias = nn.dtypes.promote_dtype(
+                x, kernel, bias, dtype=self.dtype)
+            y = jax.lax.dot_general(
+                x, kernel, (((x.ndim - 1,), (0,)), ((), ())))
+        else:
+            # the dtype promote_dtype would have picked for a float tree
+            dtype = (jnp.promote_types(jnp.result_type(x), jnp.float32)
+                     if self.dtype is None else jnp.dtype(self.dtype))
+            x = x.astype(dtype)
+            if (self.impl == "kernel" and quant_matmul_available()
+                    and _ambient_mesh() is None):
+                y = quant_matmul(x, qleaf)
+            else:
+                w = quantize.dequantize_leaf(qleaf, dtype)
+                y = jax.lax.dot_general(
+                    x, w, (((x.ndim - 1,), (0,)), ((), ())))
+            bias = None if bias is None else bias.astype(dtype)
+        if bias is not None:
+            y = y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        return y
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -149,8 +229,8 @@ class Attention(nn.Module):
         (S-LoRA-style; net-new beyond the reference).  Index 0 is the
         null adapter (all-zero b), so un-adapted rows are EXACTLY the
         base model.  Without the collection this is a plain Dense."""
-        y = nn.Dense(features, use_bias=self.cfg.use_bias, name=name,
-                     dtype=dtype)(x)
+        y = QuantDense(features, use_bias=self.cfg.use_bias, name=name,
+                       dtype=dtype, impl=self.cfg.quant_matmul_impl)(x)
         if (not self.is_initializing()
                 and self.has_variable("lora", f"{name}_a")):
             a = self.get_variable("lora", f"{name}_a")
@@ -747,18 +827,19 @@ class DenseMLP(nn.Module):
         if cfg.mlp_style not in ("plain", "gated"):
             raise ValueError(
                 f"mlp_style={cfg.mlp_style!r} not in ('plain', 'gated')")
+        impl = cfg.quant_matmul_impl
         if cfg.mlp_style == "gated":
-            g = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, name="wi_gate",
-                         dtype=dtype)(x)
-            u = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, name="wi_up",
-                         dtype=dtype)(x)
+            g = QuantDense(cfg.d_ff, use_bias=cfg.use_bias, name="wi_gate",
+                           dtype=dtype, impl=impl)(x)
+            u = QuantDense(cfg.d_ff, use_bias=cfg.use_bias, name="wi_up",
+                           dtype=dtype, impl=impl)(x)
             h = _activation(g, cfg.activation) * u
         else:
-            h = nn.Dense(cfg.d_ff, use_bias=cfg.use_bias, name="wi",
-                         dtype=dtype)(x)
+            h = QuantDense(cfg.d_ff, use_bias=cfg.use_bias, name="wi",
+                           dtype=dtype, impl=impl)(x)
             h = _activation(h, cfg.activation)
-        return nn.Dense(cfg.d_model, use_bias=cfg.use_bias,
-                        name="wo", dtype=dtype)(h)
+        return QuantDense(cfg.d_model, use_bias=cfg.use_bias,
+                          name="wo", dtype=dtype, impl=impl)(h)
 
 
 class MoEMLP(nn.Module):
@@ -782,7 +863,8 @@ class MoEMLP(nn.Module):
         if cfg.moe_router not in ("dense", "topk"):
             raise ValueError(
                 f"moe_router={cfg.moe_router!r} not in ('dense', 'topk')")
-        gate_logits = nn.Dense(E, use_bias=False, name="router")(
+        gate_logits = QuantDense(E, use_bias=False, name="router",
+                                 impl=cfg.quant_matmul_impl)(
             x.astype(jnp.float32))
         probs = jax.nn.softmax(gate_logits, axis=-1)
 
@@ -1037,8 +1119,8 @@ class Transformer(nn.Module):
         x = _make_ln(cfg, "ln_f")(x)
         if return_hidden and not self.is_initializing():
             return x.astype(dtype)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
-                          dtype=dtype)(x)
+        logits = QuantDense(cfg.vocab_size, use_bias=False, name="lm_head",
+                            dtype=dtype, impl=cfg.quant_matmul_impl)(x)
         if return_hidden:
             return x.astype(dtype)  # init pass: lm_head params were created
         return logits

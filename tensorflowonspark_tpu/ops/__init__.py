@@ -25,17 +25,24 @@ interpret-mode path so the same kernels are testable on the CPU mesh.
   over [occupied context pages || chunk]; O(chunk) traffic, no dense
   [B, max_seq] kv view (see ops/paged_prefill.py; the default S>1
   paged path, TransformerConfig.paged_prefill_impl)
+- quant_matmul : weight-stationary matmul over int8 / nibble-packed
+  int4 kernels — weight tiles dequantize in VMEM (per-channel or
+  per-group scales), the dense bf16/f32 kernel never exists in HBM
+  (see ops/quant_matmul.py; the QuantDense decode path,
+  TransformerConfig.quant_matmul_impl)
 """
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 from tensorflowonspark_tpu.ops.fused_optim import adamw_fused, lion_fused
 from tensorflowonspark_tpu.ops.layernorm import fused_layernorm
 from tensorflowonspark_tpu.ops.paged_attention import paged_attention
 from tensorflowonspark_tpu.ops.paged_prefill import paged_prefill
+from tensorflowonspark_tpu.ops.quant_matmul import (quant_matmul,
+                                                    quant_matmul_available)
 from tensorflowonspark_tpu.ops.xent import fused_unembed_xent
 
 __all__ = ["flash_attention", "fused_layernorm", "fused_unembed_xent",
            "adamw_fused", "lion_fused", "paged_attention",
-           "paged_prefill"]
+           "paged_prefill", "quant_matmul", "quant_matmul_available"]
 
 
 def default_interpret():

@@ -1,19 +1,33 @@
-"""Weight-only int8 post-training quantization for serving.
+"""Weight-only int8/int4 post-training quantization for serving.
 
 Serving on TPU is usually HBM-bandwidth-bound: each request reads every
 weight once.  Storing kernels as int8 with per-output-channel float32
 scales cuts that traffic (and the export artifact) ~4x, while activations
-stay in the model's compute dtype (W8A16).  Under jit the dequantize
-(`q.astype(dtype) * scale`) fuses into the consuming matmul's operand
-read, so the full-precision kernel never materializes in HBM.
+stay in the model's compute dtype (W8A16).  Int4 halves the weight bytes
+again (W4A16) with per-group symmetric scales (AWQ-style: a ``group_size``
+run of input rows shares one scale per output column), two nibbles packed
+per int8 byte.
+
+Two consumption paths exist for a quantized tree:
+
+  * materialized: ``dequantize_tree`` rebuilds float kernels (XLA fuses
+    the ``q.astype(dtype) * scale`` into the consuming matmul when jitted
+    — hopefully; there is no guarantee the dense kernel never spills).
+  * fused: ``models.transformer.QuantDense`` consumes int8 dicts and
+    ``Int4Weight`` leaves directly, routing through the
+    ``ops.quant_matmul`` Pallas kernel which dequantizes weight tiles in
+    VMEM so the dense kernel never exists in HBM.  ``qdense_view``
+    prepares a param tree for that path.
 
     qtree = quantize.quantize_tree(params)         # kernels -> {q, scale}
     logits = model.apply({"params": quantize.dequantize_tree(qtree)}, x)
 
-The quantized tree is a plain pytree (int8/float32 arrays), so
+The int8 tree is a plain pytree (int8/float32 arrays), so
 `utils.checkpoint`, `export`, and host<->device transfer all handle it
-unchanged.  Quantization is symmetric per-channel (no zero-points): TPU
-matmuls take the scale as a single fused multiply.
+unchanged.  ``Int4Weight`` is a registered pytree node created at load
+time (it is not a checkpoint format: export artifacts stay f32/int8 and
+int4 packing happens in ``serve._load_lm``).  Quantization is symmetric
+(no zero-points): TPU matmuls take the scale as a single fused multiply.
 """
 import logging
 import re
@@ -21,7 +35,9 @@ import re
 logger = logging.getLogger(__name__)
 
 DEFAULT_TARGETS = r"kernel$"
+DEFAULT_GROUP_SIZE = 128
 _QKEYS = frozenset({"q", "scale"})
+_INT4_REGISTERED = [False]
 
 
 def _is_qleaf(node):
@@ -31,19 +47,129 @@ def _is_qleaf(node):
             and str(getattr(node.get("q"), "dtype", "")) == "int8")
 
 
-def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
-                  axis=-1):
-    """Replace every matching >=2-D kernel with {"q": int8, "scale": f32}.
+class Int4Weight:
+    """A nibble-packed int4 kernel leaf: ``q`` holds two signed 4-bit
+    values per int8 byte along the input dim (row ``2i`` in the low
+    nibble, ``2i+1`` in the high nibble), ``scale`` is float32 with one
+    row per ``group_size`` input rows, one column per output channel.
+    ``in_dim`` records the unpadded input dim (packing zero-pads to a
+    whole number of groups).  Registered as a jax pytree node on first
+    construction, so it rides through jit/device_put like any array
+    pair; ``in_dim``/``group_size`` are static aux data."""
 
-    `scale` is per-slice along `axis` (the output-channel axis for
-    [in, out] kernels); small tensors (< `min_elements`) and non-matches
-    pass through unquantized.  Returns a tree with the same nesting —
-    quantized leaves become 2-key dicts that `dequantize_tree` recognizes.
+    __slots__ = ("q", "scale", "in_dim", "group_size")
+
+    def __init__(self, q, scale, in_dim, group_size):
+        _register_int4()
+        self.q = q
+        self.scale = scale
+        self.in_dim = int(in_dim)
+        self.group_size = int(group_size)
+
+    @property
+    def out_dim(self):
+        return self.q.shape[-1]
+
+    def __repr__(self):
+        return (f"Int4Weight(in_dim={self.in_dim}, out_dim={self.out_dim}, "
+                f"group_size={self.group_size})")
+
+
+def _register_int4():
+    if _INT4_REGISTERED[0]:
+        return
+    import jax
+
+    def flatten(w):
+        return (w.q, w.scale), (w.in_dim, w.group_size)
+
+    def unflatten(aux, children):
+        out = object.__new__(Int4Weight)
+        out.q, out.scale = children
+        out.in_dim, out.group_size = aux
+        return out
+
+    jax.tree_util.register_pytree_node(Int4Weight, flatten, unflatten)
+    _INT4_REGISTERED[0] = True
+
+
+def is_quantized_leaf(node):
+    """True for either quantized-leaf form: an int8 {"q", "scale"} dict
+    or an Int4Weight."""
+    return _is_qleaf(node) or isinstance(node, Int4Weight)
+
+
+def int4_pack(w, group_size=DEFAULT_GROUP_SIZE):
+    """Quantize a 2-D [in, out] float kernel to a nibble-packed
+    Int4Weight with per-(group, output-channel) symmetric scales.
+
+    ``group_size`` must be even; the input dim is zero-padded up to a
+    whole number of groups before packing, so ``q`` has exactly
+    ``n_groups * group_size / 2`` rows and ``scale`` has ``n_groups``.
+    Values are clipped to the symmetric int4 range [-7, 7] (the -8 code
+    is unused, matching the int8 path's +-127 symmetry).
+    """
+    import jax.numpy as jnp
+
+    if group_size < 2 or group_size % 2:
+        raise ValueError(f"group_size must be even and >= 2, "
+                         f"got {group_size}")
+    w = jnp.asarray(w, jnp.float32)
+    if w.ndim != 2:
+        raise ValueError(f"int4_pack needs a 2-D [in, out] kernel, "
+                         f"got shape {w.shape}")
+    in_dim, out_dim = w.shape
+    n_groups = -(-in_dim // group_size)
+    padded = n_groups * group_size
+    if padded != in_dim:
+        w = jnp.pad(w, ((0, padded - in_dim), (0, 0)))
+    grouped = w.reshape(n_groups, group_size, out_dim)
+    amax = jnp.max(jnp.abs(grouped), axis=1)              # [G, out]
+    scale = jnp.maximum(amax, 1e-12) / 7.0
+    q = jnp.clip(jnp.round(grouped / scale[:, None, :]), -7, 7)
+    q = q.reshape(padded, out_dim).astype(jnp.int8)
+    lo, hi = q[0::2], q[1::2]
+    packed = ((lo & jnp.int8(0x0F)) | (hi << 4)).astype(jnp.int8)
+    return Int4Weight(packed, scale.astype(jnp.float32), in_dim, group_size)
+
+
+def int4_unpack(w):
+    """Rebuild the float32 [in, out] kernel from an Int4Weight (padding
+    rows sliced off).  The exact dequant the fused kernel computes."""
+    import jax.numpy as jnp
+
+    p = w.q
+    # arithmetic shifts on int8 sign-extend the nibbles
+    lo = ((p << 4) >> 4).astype(jnp.float32)
+    hi = (p >> 4).astype(jnp.float32)
+    rows = jnp.stack([lo, hi], axis=1).reshape(2 * p.shape[0], p.shape[1])
+    scales = jnp.repeat(w.scale, w.group_size, axis=0)
+    return (rows * scales)[: w.in_dim]
+
+
+def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
+                  axis=-1, mode="int8", group_size=DEFAULT_GROUP_SIZE):
+    """Replace every matching >=2-D kernel with a quantized leaf.
+
+    ``mode="int8"``: leaves become ``{"q": int8, "scale": f32}`` with
+    `scale` per-slice along `axis` (the output-channel axis for
+    [in, out] kernels).  ``mode="int4"``: 2-D kernels become nibble-packed
+    ``Int4Weight`` leaves with per-``group_size`` scales; matched kernels
+    of rank >= 3 (e.g. stacked MoE expert banks consumed by raw einsums)
+    fall back to int8 dicts so the whole tree stays servable.  Small
+    tensors (< `min_elements`) and non-matches pass through unquantized.
+    Returns a tree with the same nesting that `dequantize_tree`
+    recognizes.
     """
     import jax.numpy as jnp
 
     from .treeutil import flatten_with_paths
 
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"mode must be 'int8' or 'int4', got {mode!r}")
+    if mode == "int4" and axis not in (-1, 1):
+        raise ValueError("int4 grouping runs along the input dim; only "
+                         "axis=-1 output-channel scales are supported")
     pat = re.compile(targets)
     flat, _ = flatten_with_paths(params)
     selected = {
@@ -52,6 +178,15 @@ def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
             and pat.search(path) and leaf.size >= min_elements
             and jnp.issubdtype(leaf.dtype, jnp.floating))}
     n_quant = [0]
+
+    def quantize_int8(leaf):
+        w = jnp.asarray(leaf, jnp.float32)
+        reduce_axes = tuple(i for i in range(w.ndim)
+                            if i != (axis % w.ndim))
+        amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        return {"q": q, "scale": scale.astype(jnp.float32)}
 
     def walk(node, path):
         if isinstance(node, dict) and not _is_qleaf(node):
@@ -67,14 +202,10 @@ def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
                  for i, v in enumerate(node)])
         leaf = node
         if path in selected:
-            w = jnp.asarray(leaf, jnp.float32)
-            reduce_axes = tuple(i for i in range(w.ndim)
-                                if i != (axis % w.ndim))
-            amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
-            scale = jnp.maximum(amax, 1e-12) / 127.0
-            q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
             n_quant[0] += 1
-            return {"q": q, "scale": scale.astype(jnp.float32)}
+            if mode == "int4" and leaf.ndim == 2:
+                return int4_pack(leaf, group_size)
+            return quantize_int8(leaf)
         return leaf
 
     out = walk(params, "")
@@ -91,23 +222,55 @@ def quantize_tree(params, targets=DEFAULT_TARGETS, min_elements=4096,
             "rewrite (only dict/list/tuple nesting is supported — convert "
             "with e.g. flax.core.unfreeze first)")
     qb, fb = quantized_bytes(out)
-    logger.info("quantized %d kernels to int8 (weight bytes %.2fx smaller)",
-                n_quant[0], fb / max(qb, 1))
+    logger.info("quantized %d kernels to %s (weight bytes %.2fx smaller)",
+                n_quant[0], mode, fb / max(qb, 1))
     return out
+
+
+def dequantize_leaf(node, dtype=None):
+    """Dequantize a single quantized leaf (int8 dict or Int4Weight) to a
+    float array; `dtype=None` keeps float32."""
+    import jax.numpy as jnp
+
+    target = jnp.float32 if dtype is None else jnp.dtype(dtype)
+    if _is_qleaf(node):
+        return (node["q"].astype(jnp.float32) * node["scale"]).astype(target)
+    if isinstance(node, Int4Weight):
+        return int4_unpack(node).astype(target)
+    raise TypeError(f"not a quantized leaf: {type(node)!r}")
 
 
 def dequantize_tree(qtree, dtype=None):
     """Rebuild a model-ready param tree; quantized leaves become
     `q.astype(dtype) * scale` (XLA fuses this into the consumer when
     called under jit).  `dtype=None` keeps float32."""
-    import jax.numpy as jnp
-
-    target = jnp.float32 if dtype is None else jnp.dtype(dtype)
 
     def walk(node):
+        if is_quantized_leaf(node):
+            return dequantize_leaf(node, dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            walked = [walk(v) for v in node]
+            return (type(node)(*walked) if hasattr(node, "_fields")
+                    else type(node)(walked))
+        return node
+
+    return walk(qtree)
+
+
+def qdense_view(qtree):
+    """Prepare a quantized tree for the fused QuantDense path: 2-D
+    quantized leaves (int8 dicts and Int4Weight) pass through for the
+    kernel to consume in quantized form; rank->=3 int8 leaves (stacked
+    expert banks read by raw einsums, which QuantDense never sees)
+    dequantize to float32 here.  Float leaves are untouched."""
+
+    def walk(node):
+        if isinstance(node, Int4Weight):
+            return node
         if _is_qleaf(node):
-            return (node["q"].astype(jnp.float32)
-                    * node["scale"]).astype(target)
+            return node if node["q"].ndim == 2 else dequantize_leaf(node)
         if isinstance(node, dict):
             return {k: walk(v) for k, v in node.items()}
         if isinstance(node, (list, tuple)):
@@ -121,25 +284,26 @@ def dequantize_tree(qtree, dtype=None):
 
 def cast_float_leaves(tree, dtype):
     """Cast floating leaves to `dtype`, SKIPPING quantized leaves — their
-    int8 payload is already narrow and their f32 scales must stay f32 (a
-    blanket cast would round the scales to the compute width).  The
-    serving load path uses this to store unquantized leaves (embeddings,
-    norm scales) at the model's compute width.  A tree_map with the
-    qleaf dicts as leaves, so any registered pytree container (FrozenDict,
-    custom nodes) traverses like the plain-dict case."""
+    int8/int4 payload is already narrow and their f32 scales must stay
+    f32 (a blanket cast would round the scales to the compute width).
+    The serving load path uses this to store unquantized leaves
+    (embeddings, norm scales) at the model's compute width.  A tree_map
+    with the quantized leaves as leaves, so any registered pytree
+    container (FrozenDict, custom nodes) traverses like the plain-dict
+    case."""
     import jax
     import jax.numpy as jnp
 
     target = jnp.dtype(dtype)
 
     def cast(x):
-        if _is_qleaf(x):
+        if is_quantized_leaf(x):
             return x
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(target)
         return x
 
-    return jax.tree_util.tree_map(cast, tree, is_leaf=_is_qleaf)
+    return jax.tree_util.tree_map(cast, tree, is_leaf=is_quantized_leaf)
 
 
 def quantized_bytes(qtree):
@@ -148,7 +312,10 @@ def quantized_bytes(qtree):
 
     def walk(node):
         nonlocal qb, fb
-        if _is_qleaf(node):
+        if isinstance(node, Int4Weight):
+            qb += node.q.size + node.scale.size * 4
+            fb += node.in_dim * node.out_dim * 4
+        elif _is_qleaf(node):
             qb += node["q"].size + node["scale"].size * 4
             fb += node["q"].size * 4
         elif isinstance(node, dict):
@@ -164,7 +331,7 @@ def quantized_bytes(qtree):
 
 def max_abs_error(params, qtree):
     """Worst-case per-tensor |W - dequant(Q)| (quantization noise bound:
-    0.5 * scale per channel)."""
+    0.5 * scale per channel/group)."""
     import jax.numpy as jnp
 
     deq = dequantize_tree(qtree)

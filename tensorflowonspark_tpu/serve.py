@@ -48,6 +48,12 @@ logger = logging.getLogger(__name__)
 # set either.  Everything else in the scheduler keys off these names.
 PRIORITY_CLASSES = ("interactive", "batch")
 
+# Weight-only quantization modes for the :generate LM — the ONE source
+# of truth shared by the --generate_quantize argparse choices and
+# GenerateService._load_lm's validation (they drifted once; int4 landed
+# in both through this constant).
+QUANTIZE_MODES = ("none", "int8", "int4")
+
 
 def build_argparser():
     p = argparse.ArgumentParser(
@@ -170,14 +176,20 @@ def build_argparser():
                    metavar="NAME=PATH",
                    help="register adapter NAME from a lora.save_adapters "
                         "file at startup (repeatable)")
-    p.add_argument("--generate_quantize", choices=["none", "int8"],
+    p.add_argument("--generate_quantize", choices=list(QUANTIZE_MODES),
                    default="none",
-                   help="int8 = weight-only post-training quantization of "
-                        "the :generate LM (and draft): matmul kernels are "
-                        "stored int8 + per-channel scale and dequantize "
-                        "inline in each decode step — ~4x less weight HBM "
-                        "and ~half the per-token weight read; outputs "
-                        "shift by the (bounded) quantization noise")
+                   help="weight-only post-training quantization of the "
+                        ":generate LM (and draft): int8 = kernels stored "
+                        "int8 + per-channel scale (~4x less weight HBM, "
+                        "~half the per-token weight read vs bf16); int4 = "
+                        "nibble-packed with per-group scales (~8x / ~4x). "
+                        "Decode steps consume the quantized leaves through "
+                        "the Pallas fused-dequant matmul "
+                        "(ops/quant_matmul.py; inline-dequant fallback "
+                        "under a mesh) — int8 outputs match the "
+                        "materialized-dequant path token-for-token, int4 "
+                        "shifts outputs by the (bounded, grouped) "
+                        "quantization noise")
     p.add_argument("--input_mapping", default=None)
     p.add_argument("--output_mapping", default=None)
     p.add_argument("--engine", choices=["auto", "native", "jax", "builder"],
@@ -682,13 +694,14 @@ class ModelService:
                 out["model"]["generate_slots"] = self._gen.batcher.n_slots
                 out["model"]["generate_stats"] = self._gen.batcher.stats()
             if self._gen and self._gen.quantize_mode != "none":
-                from . import quantize as quantize_mod
-
-                qb, fb = quantize_mod.quantized_bytes(self._gen.params)
+                # sizes were computed ONCE at engine build (a full
+                # param-tree walk) — fleet heartbeats probe metadata,
+                # so this must stay O(1) per probe
                 out["model"]["generate_quantize"] = {
                     "mode": self._gen.quantize_mode,
-                    "weight_bytes": qb,
-                    "float_equivalent_bytes": fb}
+                    "weight_bytes": self._gen.weight_bytes,
+                    "float_equivalent_bytes":
+                        self._gen.float_equivalent_bytes}
         return out
 
     def metrics_text(self):
@@ -3645,9 +3658,9 @@ class GenerateService:
         from . import quantize as quantize_mod
         from .models.transformer import Transformer
 
-        if quantize_mode not in (None, "none", "int8"):
+        if quantize_mode not in (None,) + QUANTIZE_MODES:
             raise ValueError(
-                f"quantize_mode={quantize_mode!r} not in ('none', 'int8')")
+                f"quantize_mode={quantize_mode!r} not in {QUANTIZE_MODES}")
         # take the STORED tree: for an int8-quantized export served with
         # --generate_quantize int8 the artifact's qtree is used as-is —
         # no eager dequant + re-quantize round trip, and the full-width
@@ -3670,15 +3683,26 @@ class GenerateService:
             stored_q = False
         if quantize_mode == "int8" and not stored_q:
             # weight-only W8A16: matmul kernels become {int8, f32 scale}
-            # leaves that every jitted decode step dequantizes INLINE
-            # (decode._params_view — the full-width kernel never lands in
-            # HBM).  ~4x less resident weight memory and ~half the
-            # per-token weight read vs the W16 store below; norm scales /
-            # embeddings stay at compute width (quantize.DEFAULT_TARGETS).
-            # Quantize BEFORE the compute-width cast: scales derive from
-            # the f32 masters, not bf16-rounded copies, and the big
-            # kernels never pay a cast that quantization then discards
+            # leaves that every jitted decode step consumes through the
+            # Pallas fused-dequant matmul (decode._params_view ->
+            # transformer.QuantDense -> ops.quant_matmul; inline dequant
+            # under a mesh — either way the full-width kernel never
+            # lands in HBM).  ~4x less resident weight memory and ~half
+            # the per-token weight read vs the W16 store below; norm
+            # scales / embeddings stay at compute width
+            # (quantize.DEFAULT_TARGETS).  Quantize BEFORE the
+            # compute-width cast: scales derive from the f32 masters,
+            # not bf16-rounded copies, and the big kernels never pay a
+            # cast that quantization then discards
             params = quantize_mod.quantize_tree(params)
+        elif quantize_mode == "int4":
+            # weight-only W4A16: 2-D kernels become nibble-packed
+            # Int4Weight leaves (per-group scales) for the same fused
+            # path — ~8x less resident weight vs f32, ~4x less weight
+            # read per token vs bf16.  Exports never store int4 (the
+            # artifact stays f32/int8), so packing always happens here;
+            # a stored int8 artifact was dequantized just above
+            params = quantize_mod.quantize_tree(params, mode="int4")
         compute = jnp.dtype(built.cfg.dtype)
         if jnp.issubdtype(compute, jnp.floating) and compute != jnp.float32:
             # serving reads every weight once per decoded token: store the
@@ -3706,6 +3730,15 @@ class GenerateService:
         self.quantize_mode = quantize_mode or "none"
         self.model, self.params = self._load_lm(export_dir,
                                                 self.quantize_mode)
+        # weight-size accounting computed ONCE here: metadata() reports
+        # it on every probe and fleet heartbeats probe metadata, so the
+        # full param-tree walk must not run per probe
+        self.weight_bytes = self.float_equivalent_bytes = 0
+        if self.quantize_mode != "none":
+            from . import quantize as quantize_mod
+
+            self.weight_bytes, self.float_equivalent_bytes = (
+                quantize_mod.quantized_bytes(self.params))
         draft_model = draft_params = None
         if draft_export_dir:
             # speculative decoding: greedy requests verify k draft tokens

@@ -247,6 +247,25 @@ def test_pallas_tile_negative_aligned_smem_symbolic():
     assert hits == []
 
 
+def test_pallas_tile_quantized_carveouts():
+    # ops/quant_matmul.py shapes: a 64-wide nibble-packed int4 block spans
+    # 128 logical lanes, and grouped-scale blocks carry 1/2/4 rows — both
+    # pass; near-misses (96 minor, 3 or 12 second-minor) still flag.
+    hits, _ = run("""
+        from jax.experimental import pallas as pl
+
+        packed_int4 = pl.BlockSpec((8, 64), lambda i: (i, 0))
+        packed_wide = pl.BlockSpec((8, 192), lambda i: (i, 0))
+        scale_rows2 = pl.BlockSpec((2, 128), lambda i: (0, 0))
+        scale_rows4 = pl.BlockSpec((4, 128), lambda i: (0, 0))
+        bad_minor = pl.BlockSpec((8, 96), lambda i: (i, 0))
+        bad_sub3 = pl.BlockSpec((3, 128), lambda i: (0, 0))
+        bad_sub12 = pl.BlockSpec((12, 128), lambda i: (i, 0))
+    """, ["pallas-tile"])
+    assert hits == [("pallas-tile", 8), ("pallas-tile", 9),
+                    ("pallas-tile", 10)]
+
+
 def test_pallas_prefetch_arity_positive():
     hits, fs = run("""
         from jax.experimental import pallas as pl

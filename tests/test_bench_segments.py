@@ -31,6 +31,7 @@ def test_segment_registry_shape_and_setup_dry_run():
     assert "engine_tps" in bench.SEGMENTS
     assert "sched_ms" in bench.SEGMENTS
     assert "warm_ttft_ms" in bench.SEGMENTS
+    assert "qmm_ms" in bench.SEGMENTS
     for name, entry in bench.SEGMENTS.items():
         assert set(entry) == {"run", "setup", "help"}, name
         assert callable(entry["run"]), name

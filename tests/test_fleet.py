@@ -44,6 +44,7 @@ class StubReplica:
         self.generate_prompts = []
         self.generate_requests = []  # full :generate body per hit
         self.extra_stats = {}        # merged over canned generate_stats
+        self.extra_model = {}        # merged over the metadata model dict
         self.migrate_headers = []   # X-Fleet-Migrate-To seen per :generate
         self.kv_peer_headers = []   # X-Fleet-KV-Peer seen per :generate
         self.idem_keys = []         # Idempotency-Key per :generate/:resume
@@ -110,10 +111,9 @@ class StubReplica:
                           "sessions_unparked": 1,
                           "parked_sessions": 0}
                     gs.update(stub.extra_stats)
-                    self._send(200, {
-                        "status": "ok",
-                        "model": {"engine": "stub",
-                                  "generate_stats": gs}})
+                    model = {"engine": "stub", "generate_stats": gs}
+                    model.update(stub.extra_model)
+                    self._send(200, {"status": "ok", "model": model})
                 else:
                     self._send(404, {"error": self.path})
 
@@ -591,6 +591,29 @@ def test_fleet_stats_host_tier_totals(gateway):
     assert t["host_evictions"] == 2
     assert t["host_cache_bytes"] == 4096
     assert t["host_pages_cached"] == 4
+
+
+def test_fleet_stats_quantized_weight_totals(gateway):
+    # quantized replicas advertise their resident weight bytes through
+    # metadata's generate_quantize block; the fleet sums them (mixed
+    # int8/int4 fleets included), and unquantized replicas contribute 0
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=2)
+    stubs[0].extra_model = {"generate_quantize": {
+        "mode": "int8", "weight_bytes": 1000,
+        "float_equivalent_bytes": 4000}}
+    stubs[1].extra_model = {"generate_quantize": {
+        "mode": "int4", "weight_bytes": 500,
+        "float_equivalent_bytes": 4000}}
+    status, body = _client(gw).fleet_stats()
+    assert status == 200
+    t = body["totals"]
+    assert t["weight_bytes"] == 1500
+    assert t["weight_float_equivalent_bytes"] == 8000
+    stubs[0].extra_model = stubs[1].extra_model = {}
+    status, body = _client(gw).fleet_stats()
+    assert body["totals"]["weight_bytes"] == 0
+    assert body["totals"]["weight_float_equivalent_bytes"] == 0
 
 
 def test_generate_spill_plants_kv_peer_header(gateway):

@@ -853,6 +853,115 @@ def test_generate_quantized_through_http(tmp_path):
         srv.server_close()
 
 
+def test_generate_int4_through_http(tmp_path):
+    # --generate_quantize int4 serves through the fused nibble-packed
+    # path; outputs match a direct int4 decode (same quantize_tree, same
+    # jitted engine) and metadata reports the ~8x weight-byte shrink
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import quantize
+    from tensorflowonspark_tpu.models import decode
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=41, d_model=64, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=64, max_seq_len=32, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "lm"), "--port", "0",
+         "--generate_slots", "2", "--generate_quantize", "int4"])
+    srv, svc = serve.make_server(args)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        code, out = _post_gen(srv, "/v1/models/default:generate",
+                              {"inputs": [[1, 2, 3]], "max_new_tokens": 5})
+        assert code == 200
+        q4 = quantize.quantize_tree(params, mode="int4")
+        ref = decode.generate(model, q4,
+                              jnp.asarray([[1, 2, 3]], jnp.int32),
+                              max_new_tokens=5, temperature=0.0)
+        assert out["outputs"] == np.asarray(ref).tolist()
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/default") as r:
+            meta = json.loads(r.read())
+        qinfo = meta["model"]["generate_quantize"]
+        assert qinfo["mode"] == "int4"
+        # tiny test kernels (in_dim 64 < group_size 128) pad to a whole
+        # group, halving the shrink; real kernels see ~8x
+        assert qinfo["weight_bytes"] < qinfo["float_equivalent_bytes"] / 3.5
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_quantize_modes_single_source():
+    # the argparser's choices and _load_lm's validation share ONE
+    # constant — a mode added to either alone is a bug caught here
+    assert serve.QUANTIZE_MODES == ("none", "int8", "int4")
+    ap = serve.build_argparser()
+    action = next(a for a in ap._actions if a.dest == "generate_quantize")
+    assert tuple(action.choices) == serve.QUANTIZE_MODES
+    with pytest.raises(SystemExit):
+        ap.parse_args(["--export_dir", "x", "--generate_quantize", "int5"])
+    # a programmatic caller skipping argparse gets the named-modes error
+    # before any export I/O (the path does not even need to exist)
+    with pytest.raises(ValueError, match=r"int5.*not in.*int8.*int4"):
+        serve.GenerateService._load_lm("/does/not/exist",
+                                       quantize_mode="int5")
+
+
+def test_metadata_does_not_recompute_quantized_bytes(tmp_path,
+                                                     monkeypatch):
+    # fleet heartbeats probe metadata(): the weight-byte sizes must come
+    # from the values cached at engine build, never a per-probe
+    # param-tree walk
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import quantize
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    cfg_kw = dict(vocab_size=41, d_model=64, n_heads=2, n_kv_heads=1,
+                  n_layers=1, d_ff=64, max_seq_len=32, dtype="float32",
+                  rope=True, attention_impl="dense")
+    model = Transformer(TransformerConfig(**cfg_kw))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    export.export_saved_model(
+        str(tmp_path / "lm"), params,
+        builder="tensorflowonspark_tpu.models.transformer:build_transformer",
+        builder_kwargs=cfg_kw)
+    args = serve.build_argparser().parse_args(
+        ["--export_dir", str(tmp_path / "lm"), "--port", "0",
+         "--generate_slots", "2", "--generate_quantize", "int8"])
+    srv, svc = serve.make_server(args)
+    try:
+        gen = svc.generate_service()
+        assert gen.weight_bytes > 0
+        assert gen.weight_bytes < gen.float_equivalent_bytes
+        calls = []
+        real = quantize.quantized_bytes
+        monkeypatch.setattr(quantize, "quantized_bytes",
+                            lambda *a, **k: calls.append(1) or real(*a, **k))
+        for _ in range(3):
+            meta = svc.metadata()
+            qinfo = meta["model"]["generate_quantize"]
+            assert qinfo["weight_bytes"] == gen.weight_bytes
+        assert calls == [], "metadata() walked the param tree per probe"
+    finally:
+        svc.close()
+
+
 def test_quantized_export_serves_without_requant(tmp_path):
     # an artifact exported with quantize_int8=True + --generate_quantize
     # int8 serves the STORED qtree (no dequant->requant round trip); the
