@@ -66,6 +66,7 @@ best-of-3 windows against dispatch-latency noise.
 """
 import argparse
 import json
+import os
 import queue
 import sys
 import time
@@ -500,6 +501,90 @@ def bench_sched_segment(result_timeout=600):
     return (out[True][0], out[False][0], out[True][1], out[True][2])
 
 
+def bench_job_segment(result_timeout=600):
+    """The job_tps segment: a real :class:`jobs.JobManager` drains a
+    jsonl record file through one paged batcher as batch-class work
+    (benchmarks.make_job_burst / FLAGSHIP_JOB) while interactive probes
+    ride on top — the offline data pump at full engine utilization.
+    The dispatch callable drives the batcher directly (no model export
+    / HTTP fleet bring-up on the bench box); everything above it —
+    partition splits, checkpointing, idempotency keys, the output
+    merge — is the production jobs path.  Returns ``(records_per_s,
+    inter_p95_loaded_ms, inter_p95_idle_ms)``."""
+    import shutil
+    import tempfile
+
+    from tensorflowonspark_tpu import jobs as jobs_mod
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_JOB,
+                                                  make_job_burst)
+
+    (batcher, record_prompts, record_max_new,
+     inter_prompts, inter_max_new) = make_job_burst()
+    d = FLAGSHIP_JOB
+    work = tempfile.mkdtemp(prefix="bench_job_")
+    try:
+        # compile warmup: one prefill+decode at each population's shape
+        batcher.submit(record_prompts[0], record_max_new,
+                       priority="batch").result(timeout=result_timeout)
+        batcher.submit(inter_prompts[0], inter_max_new,
+                       priority="interactive").result(
+                           timeout=result_timeout)
+
+        def probe_p95():
+            lats = []
+            for p in inter_prompts:
+                t0 = time.perf_counter()
+                batcher.submit(p, inter_max_new,
+                               priority="interactive").result(
+                                   timeout=result_timeout)
+                lats.append((time.perf_counter() - t0) * 1e3)
+            lats.sort()
+            return lats[int(0.95 * (len(lats) - 1))]
+
+        idle_p95 = probe_p95()
+
+        input_path = os.path.join(work, "records.jsonl")
+        with open(input_path, "w", encoding="utf-8") as f:
+            for p in record_prompts:
+                f.write(json.dumps(p) + "\n")
+
+        def dispatch(body, key):
+            hs = [batcher.submit(p, int(body.get("max_new_tokens",
+                                                 record_max_new)),
+                                 priority=body.get("priority", "batch"))
+                  for p in body["inputs"]]
+            return {"outputs": [h.result(timeout=result_timeout)
+                                for h in hs]}
+
+        mgr = jobs_mod.JobManager(os.path.join(work, "jobs"),
+                                  dispatch=dispatch,
+                                  default_workers=d["workers"],
+                                  checkpoint_every=d["checkpoint_every"])
+        try:
+            t0 = time.perf_counter()
+            st = mgr.submit({"input": input_path,
+                             "partitions": d["partitions"],
+                             "request": {"max_new_tokens":
+                                         record_max_new}})
+            loaded_p95 = probe_p95()     # probes ride on the live job
+            deadline = time.monotonic() + result_timeout
+            while (mgr.status(st["id"])["state"] == "running"
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            elapsed = time.perf_counter() - t0
+            final = mgr.status(st["id"])
+            assert final["state"] == "completed", final
+            assert final["records_done"] == len(record_prompts), final
+            with open(final["output"], encoding="utf-8") as f:
+                assert sum(1 for _ in f) == len(record_prompts)
+        finally:
+            mgr.stop()
+        return len(record_prompts) / elapsed, loaded_p95, idle_p95
+    finally:
+        batcher.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_warm_segment(result_timeout=600):
     """The warm-turn segment: 8 returning conversations through a paged
     batcher with the host-DRAM page tier armed (benchmarks.
@@ -570,6 +655,33 @@ def _warm_segment_result():
                         cold_ms / warm_ms, 2) if warm_ms else None,
                     "host_hits": host_hits,
                     "prefill_tokens_skipped": skipped}}
+
+
+def _job_segment_setup():
+    from tensorflowonspark_tpu import jobs
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_JOB,
+                                                  make_job_burst)
+
+    assert callable(make_job_burst)
+    assert callable(jobs.JobManager) and callable(jobs.split_file)
+    d = FLAGSHIP_JOB
+    assert d["record_prompt_len"] + d["record_max_new"] <= d["max_seq"]
+    assert d["inter_prompt_len"] + d["inter_max_new"] <= d["max_seq"]
+    assert d["max_seq"] % d["kv_page_size"] == 0
+    assert 1 <= d["partitions"] <= d["records"]
+    assert d["workers"] >= 1 and d["checkpoint_every"] >= 1
+    assert d["preempt_ms"] > 0 and d["inter_probes"] >= 2
+    return {"config": dict(d)}
+
+
+def _job_segment_result():
+    tps, loaded_p95, idle_p95 = bench_job_segment()
+    return {"metric": "job_tps", "value": round(tps, 1),
+            "unit": "records/s",
+            "aux": {"interactive_p95_ms": round(loaded_p95, 1),
+                    "interactive_p95_idle_ms": round(idle_p95, 1),
+                    "interactive_p95_delta_ms": round(
+                        loaded_p95 - idle_p95, 1)}}
 
 
 def _sched_segment_setup():
@@ -881,6 +993,12 @@ SEGMENTS = {
         "help": "returning-conversation time-to-first-token with prefix "
                 "pages promoted from the host-DRAM kv tier vs a cold "
                 "full prefill"},
+    "job_tps": {
+        "run": _job_segment_result,
+        "setup": _job_segment_setup,
+        "help": "offline bulk-inference job drain rate (records/s "
+                "through the jobs spool/checkpoint path at full engine "
+                "utilization, with the interactive p95 it costs)"},
 }
 
 

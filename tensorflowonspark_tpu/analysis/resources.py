@@ -203,6 +203,23 @@ SPECS = (
         acquire=("begin",),
         release=("end", "abandon"),
     ),
+    # Bulk-job partition leases (jobs.py).  A JobRunner worker claims a
+    # partition with `_lease_partition` and must hand the lease back
+    # through exactly one of `_commit_partition` (the partition's
+    # checkpoint says done) or `_abandon_partition` (fault/interruption
+    # — the partition requeues for another worker or another gateway
+    # life).  A dropped lease strands the partition: it is neither
+    # pending nor done, so the job can never finish; a double return
+    # corrupts the pending queue (the partition runs twice
+    # concurrently, racing its own checkpoint).
+    ResourceSpec(
+        name="job-partition-lease",
+        description="bulk-inference job partition lease "
+                    "(_lease_partition → _commit_partition/"
+                    "_abandon_partition)",
+        acquire=("self._lease_partition",),
+        release=("self._commit_partition", "self._abandon_partition"),
+    ),
     # jax.jit donated buffers.  Not acquire/release shaped: donation is
     # inferred from donate_argnums/donate_argnames on jitted callables
     # (including the `_jitted_*` factory idiom in models/decode.py) and

@@ -585,6 +585,75 @@ def make_warm_burst(n_slots=None, conversations=None, prompt_len=None,
     return batcher, prompts_list, max_new
 
 
+# The job_tps segment workload (bench.py --segments): an offline bulk-
+# inference job (jobs.JobManager — the TFoS data pump) draining a jsonl
+# record file through a paged ContinuousBatcher as batch-class work,
+# while a trickle of interactive requests rides on top.  The segment
+# reports sustained records/s at full engine utilization plus the
+# interactive p95 latency with the job running vs idle — the WFQ story
+# at fleet scale: batch jobs soak every spare slot, interactive latency
+# holds.  Preemption armed (same controller FLAGSHIP_SCHED prices).
+# Frozen like FLAGSHIP_ENGINE: changing any value invalidates job_tps
+# comparability.
+FLAGSHIP_JOB = dict(n_slots=4, records=64, record_prompt_len=32,
+                    record_max_new=4, partitions=4, workers=3,
+                    checkpoint_every=16, inter_probes=8,
+                    inter_prompt_len=32, inter_max_new=4,
+                    prefill_chunk=256, kv_page_size=32, kv_pages=64,
+                    max_seq=256, preempt_ms=5.0)
+
+
+def make_job_burst(n_slots=None, records=None, record_prompt_len=None,
+                   prefill_chunk=None, kv_page_size=None, kv_pages=None,
+                   max_seq=None, preempt_ms=None):
+    """Build the job_tps segment workload: one paged ContinuousBatcher
+    (preemption armed) plus the two prompt populations.  Returns
+    ``(batcher, record_prompts, record_max_new, inter_prompts,
+    inter_max_new)``; the caller spools ``record_prompts`` into a jsonl
+    input file, runs a real :class:`jobs.JobManager` over it with a
+    dispatch callable driving THIS batcher, and probes interactive
+    latency while the job drains.  Caller must ``batcher.stop()``.
+    Prompts are distinct random garbage for the same reasons as
+    :func:`make_prefill_burst`."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_JOB
+    n_slots = n_slots or d["n_slots"]
+    records = records or d["records"]
+    rec_len = record_prompt_len or d["record_prompt_len"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    page = kv_page_size or d["kv_page_size"]
+    pages = kv_pages or d["kv_pages"]
+    max_seq = max_seq or d["max_seq"]
+    preempt_ms = d["preempt_ms"] if preempt_ms is None else preempt_ms
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    batcher = serve_mod.ContinuousBatcher(
+        model, params, n_slots=n_slots, read_chunk=1,
+        prefill_chunk=chunk, kv_page_size=page, kv_pages=pages,
+        preempt_ms=preempt_ms)
+    rs = np.random.RandomState(0)
+
+    def burst(n, length):
+        return [rs.randint(1, cfg.vocab_size,
+                           length).astype("int32").tolist()
+                for _ in range(n)]
+
+    record_prompts = burst(records, rec_len)
+    inter_prompts = burst(d["inter_probes"], d["inter_prompt_len"])
+    return (batcher, record_prompts, d["record_max_new"],
+            inter_prompts, d["inter_max_new"])
+
+
 def make_flagship_step(batch_size=None, seq_len=None, config="v2",
                        optimizer=None):
     """Build the flagship-LM training step exactly as the driver metric

@@ -73,6 +73,20 @@ SITES = frozenset({
     "kvtransfer.prefix_pull",  # pull_prefix: cross-replica kv:prefix pull
                                # (a raise = peer unreachable; the replica
                                # falls back to its own tier + prefill)
+    "jobs.partition_read",     # jobs.iter_partition: opening/scanning one
+                               # partition split of a job's input file (a
+                               # raise abandons the partition — it requeues
+                               # and retries from its checkpoint)
+    "jobs.record_dispatch",    # jobs.JobManager._dispatch: one record's
+                               # fleet delivery attempt (a raise looks like
+                               # a replica dying mid-request; the runner
+                               # retries against a peer under the same
+                               # Idempotency-Key)
+    "jobs.checkpoint_write",   # jobs.JobManager._spool_write: the atomic
+                               # tmp+rename of a partition checkpoint or
+                               # job.json (bounded retry; exhaustion
+                               # abandons the partition, never marks it
+                               # durable)
     "trace.export",            # trace.Recorder._push (deny = spans are
                                # dropped silently) and the /metrics +
                                # /v1/trace HTTP exporters (a raise = the

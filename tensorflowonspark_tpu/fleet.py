@@ -60,7 +60,7 @@ import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import faults, reservation, trace, util
+from . import faults, jobs as jobs_mod, reservation, trace, util
 from .metrics import Counters, LatencyWindow, prometheus_text
 
 logger = logging.getLogger(__name__)
@@ -284,7 +284,8 @@ class Gateway:
                  readmit_cooldown_s=None, redrive_attempts=3,
                  redrive_deadline_s=30.0, retry_after_cap_s=30.0,
                  tenant_quota=0, tenant_quotas=None, tenant_classes=None,
-                 spill_wait_s=0.0):
+                 spill_wait_s=0.0, jobs_dir=None, job_workers=2,
+                 job_checkpoint_every=16, job_record_timeout_s=60.0):
         self.host, self.port = host, int(port)
         self.registry_host = registry_host or host
         self.registry_port = int(registry_port)
@@ -348,6 +349,19 @@ class Gateway:
         # gateway-side span ring: route/relay/replay spans, stitched
         # with replica spans by GET /v1/trace/<id>
         self.trace = trace.Recorder()
+        # ---- offline bulk jobs (POST /v1/jobs) ------------------------
+        # jobs_dir arms the subsystem: partition records dispatch as
+        # batch-class work through THIS gateway's quota/WFQ/breaker
+        # envelope, and a restarted gateway rescans the directory to
+        # resume incomplete jobs from their checkpoints
+        self.jobs = None
+        if jobs_dir:
+            self.jobs = jobs_mod.JobManager(
+                jobs_dir, gateway=self,
+                default_workers=job_workers,
+                checkpoint_every=job_checkpoint_every,
+                record_timeout_s=job_record_timeout_s,
+                counters=self.counters, trace=self.trace)
         self._replicas = {}
         self._lock = threading.RLock()
         self._registry = _Registry(self)
@@ -378,10 +392,22 @@ class Gateway:
                          name="fleet-http", daemon=True).start()
         logger.info("fleet gateway on http://%s:%d (registry %s:%d)",
                     *self.http_addr, *self.registry_addr)
+        if self.jobs is not None:
+            # resume bulk jobs a previous gateway life left incomplete
+            # (their durable state still says running); runners start
+            # dispatching as soon as replicas register
+            resumed = self.jobs.rescan()
+            if resumed:
+                logger.info("fleet gateway resumed %d bulk job(s): %s",
+                            len(resumed), ", ".join(resumed))
         return self.http_addr, self.registry_addr
 
     def stop(self):
         self._stop.set()
+        if self.jobs is not None:
+            # halt runners BEFORE the HTTP front drops: durable job
+            # state stays "running" so the next life's rescan resumes
+            self.jobs.stop()
         if self._http is not None:
             self._http.shutdown()
             self._http.server_close()
@@ -934,7 +960,13 @@ class Gateway:
                   # generate_quantize block): resident quantized weight
                   # bytes and their float-equivalent sum across probed
                   # replicas (unquantized replicas contribute 0)
-                  "weight_bytes": 0, "weight_float_equivalent_bytes": 0}
+                  "weight_bytes": 0, "weight_float_equivalent_bytes": 0,
+                  # offline bulk jobs: gateway-side progress (replicas
+                  # see only ordinary batch-class requests, so these
+                  # keys are filled from the JobManager below, not
+                  # summed from probes; 0 when jobs are disabled)
+                  "jobs_active": 0, "jobs_records_done": 0,
+                  "jobs_records_failed": 0}
         for cls in PRIORITY_CLASSES:
             totals[f"ttft_{cls}_count"] = 0
             totals[f"ttft_{cls}_ms_sum"] = 0.0
@@ -1040,6 +1072,8 @@ class Gateway:
                     totals[f"{stem}_ms_sum"], 3)
                 totals[f"{stem}_avg_ms"] = (
                     round(totals[f"{stem}_ms_sum"] / n, 3) if n else 0.0)
+        if self.jobs is not None:
+            totals.update(self.jobs.stats())
         with self._lock:
             prefix_tokens = self._prefix_tokens
             tenants_inflight = dict(self._tenant_inflight)
@@ -1512,6 +1546,22 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 self._send(500, {"error": f"trace export failed: {e}"})
                 return
             self._send(200, out)
+        elif path == "/v1/jobs":
+            if gw.jobs is None:
+                self._send(503, {"error": "bulk jobs disabled (start "
+                                 "the gateway with --jobs_dir)"})
+                return
+            self._send(200, {"jobs": gw.jobs.list()})
+        elif path.startswith("/v1/jobs/"):
+            if gw.jobs is None:
+                self._send(503, {"error": "bulk jobs disabled (start "
+                                 "the gateway with --jobs_dir)"})
+                return
+            jid = path[len("/v1/jobs/"):]
+            try:
+                self._send(200, gw.jobs.status(jid))
+            except KeyError:
+                self._send(404, {"error": f"unknown job {jid!r}"})
         elif path.startswith("/v1/models/"):
             # metadata passthrough: any one healthy replica's view
             try:
@@ -1553,6 +1603,43 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 self._send(404, {"error": str(e)})
                 return
             self._send(200 if out["drained"] else 504, out)
+            return
+        if path == "/v1/jobs" or (path.startswith("/v1/jobs/")
+                                  and path.endswith(":cancel")):
+            if gw.jobs is None:
+                self._send(503, {"error": "bulk jobs disabled (start "
+                                 "the gateway with --jobs_dir)"})
+                return
+            if path == "/v1/jobs":
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b"{}"
+                try:
+                    spec = json.loads(raw)
+                except ValueError as e:
+                    self._send(400, {"error": f"bad job spec: {e}"})
+                    return
+                tid_hdr = self.headers.get("X-Trace-Id")
+                if (isinstance(spec, dict) and "trace" not in spec
+                        and tid_hdr and trace.valid_id(tid_hdr)):
+                    # header form of the trace id, mirroring :generate —
+                    # job.partition/job.record spans land under it
+                    spec["trace"] = tid_hdr
+                try:
+                    out = gw.jobs.submit(spec,
+                                         tenant=gw.tenant_of(self.headers))
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except jobs_mod.JobError as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                self._send(200, out)
+            else:
+                jid = path[len("/v1/jobs/"):-len(":cancel")]
+                try:
+                    self._send(200, gw.jobs.cancel(jid))
+                except KeyError:
+                    self._send(404, {"error": f"unknown job {jid!r}"})
             return
         if path == "/v1/debug:profile":
             # on-demand TPU profiling, proxied to one replica
@@ -1792,6 +1879,21 @@ def build_argparser():
                    help="how long a request may wait out a saturated "
                         "fleet in the weighted-fair queue before its "
                         "429 (0 = reject immediately)")
+    p.add_argument("--jobs_dir", default=None,
+                   help="spool directory arming the offline bulk-job "
+                        "surface (POST /v1/jobs); a restarted gateway "
+                        "rescans it and resumes incomplete jobs from "
+                        "their partition checkpoints")
+    p.add_argument("--job_workers", type=int, default=2,
+                   help="default concurrent partition runners per bulk "
+                        "job (a job spec's 'workers' overrides it)")
+    p.add_argument("--job_checkpoint_every", type=int, default=16,
+                   help="records between partition checkpoint writes; "
+                        "at most this many records re-dispatch after a "
+                        "crash (exactly-once output either way)")
+    p.add_argument("--job_record_timeout_s", type=float, default=60.0,
+                   help="per-record replica read timeout on the bulk "
+                        "dispatch path")
     p.add_argument("--verbose", action="store_true")
     return p
 
@@ -1833,7 +1935,13 @@ def make_gateway(args):
                  tenant_quota=getattr(args, "tenant_quota", 0),
                  tenant_classes=_parse_tenant_classes(
                      getattr(args, "tenant_class", None)),
-                 spill_wait_s=getattr(args, "spill_wait_s", 0.0))
+                 spill_wait_s=getattr(args, "spill_wait_s", 0.0),
+                 jobs_dir=getattr(args, "jobs_dir", None),
+                 job_workers=getattr(args, "job_workers", 2),
+                 job_checkpoint_every=getattr(args, "job_checkpoint_every",
+                                              16),
+                 job_record_timeout_s=getattr(args, "job_record_timeout_s",
+                                              60.0))
     gw.start()
     return gw
 

@@ -226,6 +226,48 @@ class FleetClient:
         return self._call("GET",
                           "/v1/fleet" + ("" if probe else "?probe=0"))
 
+    # -- offline bulk jobs (gateway only) ------------------------------
+
+    def submit_job(self, input_path, request=None, partitions=None,
+                   workers=None, fmt=None, **extra):
+        """``POST /v1/jobs``: score every record of `input_path`
+        through the fleet as batch-class work.  Returns
+        ``(status, job_status_dict)``; poll :meth:`job_status` with the
+        returned id until the state goes terminal."""
+        spec = {"input": input_path,
+                "model": self.model_name}
+        if request is not None:
+            spec["request"] = request
+        if partitions is not None:
+            spec["partitions"] = int(partitions)
+        if workers is not None:
+            spec["workers"] = int(workers)
+        if fmt is not None:
+            spec["format"] = fmt
+        spec.update(extra)
+        return self._call("POST", "/v1/jobs", spec)
+
+    def job_status(self, job_id):
+        return self._call("GET", f"/v1/jobs/{job_id}")
+
+    def jobs(self):
+        return self._call("GET", "/v1/jobs")
+
+    def cancel_job(self, job_id):
+        return self._call("POST", f"/v1/jobs/{job_id}:cancel")
+
+    def wait_job(self, job_id, timeout_s=60.0, step=0.1):
+        """Poll until the job leaves ``running`` (or the wait times
+        out); returns the last status body either way."""
+        deadline = time.monotonic() + timeout_s
+        status = {}
+        while time.monotonic() < deadline:
+            code, status = self.job_status(job_id)
+            if code == 200 and status.get("state") != "running":
+                return status
+            time.sleep(step)
+        return status
+
     def drain(self, replica_id, timeout_s=60.0):
         rid = replica_id.replace(":", "%3A")
         return self._call(

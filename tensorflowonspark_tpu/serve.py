@@ -3988,7 +3988,7 @@ class GenerateService:
 
         return slot_events()
 
-    def generate(self, req, kv_peer=None):
+    def generate(self, req, kv_peer=None, idem_key=None):
         (inputs, max_new, temperature, eos_id, seed, adapter,
          top_k, top_p, min_p, stop, rep, priority,
          trace_id) = self._validate(req)
@@ -4001,13 +4001,23 @@ class GenerateService:
         # with each other AND with other HTTP requests' prompts (no
         # service lock -- the batcher's driver thread owns the device)
         handles = []
+        claims = []
         try:
-            for p, s in zip(inputs, seeds):
-                handles.append(self.batcher.submit(
+            for i, (p, s) in enumerate(zip(inputs, seeds)):
+                h = self.batcher.submit(
                     p, max_new, temperature=temperature, eos_id=eos_id,
                     seed=s, adapter=adapter, top_k=top_k, top_p=top_p,
                     min_p=min_p, stop=stop, repetition_penalty=rep,
-                    priority=priority, trace_id=trace_id))
+                    priority=priority, trace_id=trace_id)
+                handles.append(h)
+                if idem_key is not None:
+                    # the one-shot dedupe (bulk jobs lean on this): a
+                    # duplicate dispatch under the same key cancels the
+                    # orphaned twin instead of double-generating
+                    k = (idem_key if len(inputs) == 1
+                         else f"{idem_key}/{i}")
+                    self._idem_claim(k, h)
+                    claims.append((k, h))
             outs = [h.result(timeout=self.timeout_s) for h in handles]
         except Exception:
             # a failed request (one prompt too long, a timeout) must not
@@ -4016,6 +4026,9 @@ class GenerateService:
             for h in handles:
                 h.cancel()
             raise
+        finally:
+            for k, h in claims:
+                self._idem_finish(k, h)
         self.requests += 1
         return outs
 
@@ -4273,7 +4286,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                    kv_peer=kv_peer))
                 else:
                     self._send(200, {"outputs": gen.generate(
-                        req, kv_peer=self.headers.get("X-Fleet-KV-Peer"))})
+                        req, kv_peer=self.headers.get("X-Fleet-KV-Peer"),
+                        idem_key=idem_key)})
             else:
                 preds = self.service.predict(req.get("instances"))
                 self._send(200, {"predictions": preds})

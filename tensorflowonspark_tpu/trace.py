@@ -53,6 +53,7 @@ MAX_ID_LEN = 64
 #   queue  admit  prefill  decode  retire
 #   freeze  wire  resume  replay  park  unpark
 #   promote  prefix_pull
+#   job.submit  job.partition  job.record  job.cancel  job.done
 DEFAULT_RING = 4096
 DEFAULT_DECODE_SAMPLE = 16
 

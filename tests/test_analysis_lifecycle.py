@@ -35,12 +35,12 @@ def run(src, path="tensorflowonspark_tpu/mod.py"):
 
 # ----------------------------------------------------------- spec table ----
 
-def test_spec_registry_covers_the_ten_resources():
+def test_spec_registry_covers_the_eleven_resources():
     names = {s.name for s in resources.SPECS}
     assert names == {"kv-page", "decode-slot", "lora-adapter", "socket",
                      "donated-buffer", "migration-lease",
                      "journal-entry", "parked-session", "host-kv-page",
-                     "trace-span"}
+                     "trace-span", "job-partition-lease"}
     kv = resources.spec_by_name("kv-page")
     assert kv.share_map == "_page_rc" and kv.device_only
     assert resources.spec_by_name("socket").release_idempotent
@@ -57,6 +57,10 @@ def test_spec_registry_covers_the_ten_resources():
     span = resources.spec_by_name("trace-span")
     assert span.acquire == ("begin",)
     assert set(span.release) == {"end", "abandon"}
+    part = resources.spec_by_name("job-partition-lease")
+    assert part.acquire == ("self._lease_partition",)
+    assert set(part.release) == {"self._commit_partition",
+                                 "self._abandon_partition"}
 
 
 def test_parked_session_leak_and_pool_transfer():

@@ -21,10 +21,13 @@ The whole file is marker-gated (``-m chaos``, ``tox -e chaos``) and
 seeded via ``CHAOS_SEED`` so CI can run the same schedules on fixed
 seeds and a soak box can sweep new ones.
 """
+import json
 import os
 import queue
 import random
+import threading
 import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 import pytest
@@ -32,7 +35,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from tensorflowonspark_tpu import faults, fleet, kvtransfer, serve
+from tensorflowonspark_tpu import (faults, fleet, fleet_client, jobs,
+                                   kvtransfer, serve)
 from tensorflowonspark_tpu.models import decode
 from tensorflowonspark_tpu.models.transformer import (Transformer,
                                                       TransformerConfig)
@@ -634,3 +638,372 @@ def test_trace_export_deny_never_costs_tokens(model_and_params):
         assert b.trace.summary(tid2)["spans"] >= 6
     finally:
         b.stop()
+
+
+# ---------------------------------------------------------------- jobs --
+# Bulk-inference jobs under chaos (the TFoS data pump): a replica dying
+# mid-partition, the GATEWAY dying mid-job, and checkpoint-write faults
+# must all leave the merged output exactly-once — byte-identical to an
+# uninterrupted run.  Replicas here are deterministic scoring stubs
+# (outputs a pure function of inputs) behind a REAL Gateway; the
+# machinery under test is the jobs spool/checkpoint/dispatch contract,
+# not the model.
+
+
+def _wait(pred, timeout=30.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+def _job_score(prompt):
+    return [t * 2 + 1 for t in prompt]
+
+
+class _ScoreStub:
+    """serve.py stand-in whose ``:generate`` outputs are a pure
+    function of the inputs, so job output is byte-comparable across
+    interrupted and uninterrupted runs."""
+
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.idem_keys = []
+        self._lock = threading.Lock()
+        stub = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.rstrip("/") or "/"
+                if path in ("/healthz", "/readyz"):
+                    self._send(200, {"status": "ok"})
+                elif path == "/v1/models/default":
+                    self._send(200, {"status": "ok",
+                                     "model": {"engine": "stub",
+                                               "generate_stats": {}}})
+                else:
+                    self._send(404, {"error": self.path})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not self.path.endswith(":generate"):
+                    self._send(404, {"error": self.path})
+                    return
+                with stub._lock:
+                    stub.idem_keys.append(
+                        self.headers.get("Idempotency-Key"))
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                self._send(200, {"outputs": [_job_score(p)
+                                             for p in req["inputs"]],
+                                 "replica": stub.id})
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.host, self.port = self._server.server_address[:2]
+        self.id = f"{self.host}:{self.port}"
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _job_gateway(jobs_dir):
+    return fleet.Gateway(heartbeat_timeout_s=0.6, monitor_interval_s=0.05,
+                         breaker_threshold=2, breaker_cooldown_s=0.3,
+                         connect_timeout_s=2.0, replica_timeout_s=10.0,
+                         probe_timeout_s=2.0, jobs_dir=str(jobs_dir),
+                         job_workers=3, job_checkpoint_every=8)
+
+
+def _register_stub(gw, stub):
+    return fleet_client.register_replica(
+        gw.registry_addr, stub.host, stub.port, n_slots=4,
+        features={"kv_page_size": 4}, heartbeat_interval_s=0.15)
+
+
+def _write_job_input(path, n):
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(n):
+            f.write(json.dumps([(i * 5 + j) % 97 for j in range(3)])
+                    + "\n")
+    return str(path)
+
+
+def _job_expected(path, n_partitions):
+    """Solo sequential scoring: the bytes a completed job must merge."""
+    lines = []
+    for p, (s, e) in enumerate(jobs.split_file(path, n_partitions)):
+        for off, _nxt, text in jobs.iter_partition(path, s, e):
+            body = jobs.record_request(text, {}, "x")
+            obj = {"p": p, "offset": off,
+                   "outputs": [_job_score(pr) for pr in body["inputs"]]}
+            lines.append(json.dumps(obj, sort_keys=True) + "\n")
+    return "".join(lines).encode()
+
+
+def test_job_replica_killed_mid_partition_exactly_once(tmp_path):
+    """A replica dying with records in flight costs retries, never
+    records: the job completes on the survivor with output identical
+    to an uninterrupted sequential scoring."""
+    path = _write_job_input(tmp_path / "in.jsonl", 300)
+    gw = _job_gateway(tmp_path / "jobs")
+    gw.start()
+    stubs = [_ScoreStub(delay_s=0.004) for _ in range(2)]
+    regs = [_register_stub(gw, s) for s in stubs]
+    try:
+        cli = fleet_client.FleetClient(*gw.http_addr)
+        code, st = cli.submit_job(path, partitions=6, workers=3)
+        assert code == 200, st
+        assert _wait(lambda: cli.job_status(st["id"])[1]
+                     .get("records_done", 0) > 40)
+        # kill one replica mid-partition: heartbeat stops (ejection)
+        # AND the socket goes away (in-flight dispatches fail)
+        regs[0].stop_heartbeat()
+        stubs[0].close()
+        final = cli.wait_job(st["id"], timeout_s=90.0)
+        assert final["state"] == "completed", final
+        assert final["records_done"] == 300
+        assert final["records_failed"] == 0
+        with open(final["output"], "rb") as f:
+            assert f.read() == _job_expected(path, 6)
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for s in stubs:
+            try:
+                s.close()
+            except Exception:
+                pass
+        gw.stop()
+
+
+def test_job_gateway_restart_resumes_from_checkpoint(tmp_path):
+    """The gateway itself dying mid-job must not lose the job: durable
+    state stays ``running``, and the next gateway's ``--jobs_dir``
+    rescan resumes every unfinished partition from its checkpoint —
+    merged output still exactly-once."""
+    path = _write_job_input(tmp_path / "in.jsonl", 400)
+    jobs_dir = tmp_path / "jobs"
+    stubs = [_ScoreStub(delay_s=0.004) for _ in range(2)]
+    gw1 = _job_gateway(jobs_dir)
+    gw1.start()
+    regs = [_register_stub(gw1, s) for s in stubs]
+    gw2 = None
+    try:
+        cli = fleet_client.FleetClient(*gw1.http_addr)
+        code, st = cli.submit_job(path, partitions=8, workers=3)
+        assert code == 200, st
+        assert _wait(lambda: cli.job_status(st["id"])[1]
+                     .get("records_done", 0) > 60)
+        for reg in regs:
+            reg.deregister()
+        gw1.stop()                      # mid-job death: NOT a cancel
+
+        gw2 = _job_gateway(jobs_dir)    # next gateway life, same spool
+        # rescan fires inside start(), before the replicas re-register:
+        # widen the retry budget so the resumed workers ride out the
+        # registration gap instead of abandoning partitions
+        gw2.jobs.record_attempts = 10
+        gw2.jobs.partition_attempts = 10
+        gw2.start()
+        regs = [_register_stub(gw2, s) for s in stubs]
+        assert gw2.counters.get("jobs_resumed") == 1
+        cli2 = fleet_client.FleetClient(*gw2.http_addr)
+        final = cli2.wait_job(st["id"], timeout_s=90.0)
+        assert final["state"] == "completed", final
+        assert final["records_done"] == 400
+        assert final["records_failed"] == 0
+        with open(final["output"], "rb") as f:
+            assert f.read() == _job_expected(path, 8)
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for s in stubs:
+            s.close()
+        for gw in (gw1, gw2):
+            if gw is not None:
+                try:
+                    gw.stop()
+                except Exception:
+                    pass
+
+
+def test_job_checkpoint_fault_bounded_retry_never_completes(tmp_path):
+    """A persistently failing checkpoint write is retried a bounded
+    number of times, then abandons the partition and fails the JOB —
+    it must never mark the job complete over a spool it could not make
+    durable.  Once the fault clears, a rescan resumes the job from the
+    last durable checkpoint and finishes exactly-once."""
+    path = _write_job_input(tmp_path / "in.jsonl", 24)
+
+    def dispatch(body, key):
+        return {"outputs": [_job_score(p) for p in body["inputs"]]}
+
+    # nth=2: let submit's job.json write land (the job must EXIST
+    # durably), then every checkpoint write after it faults forever
+    plan = faults.FaultPlan(CHAOS_SEED).on(
+        "jobs.checkpoint_write", "oserror", nth=2, times=None)
+    mgr = jobs.JobManager(str(tmp_path / "jobs"), dispatch=dispatch,
+                          default_workers=2, checkpoint_every=4,
+                          ckpt_attempts=3, partition_attempts=2)
+    with faults.active(plan):
+        st = mgr.submit({"input": path, "partitions": 2})
+        assert _wait(lambda: mgr.status(st["id"])["state"] != "running",
+                     timeout=30)
+        # join the workers INSIDE the fault window so the state-persist
+        # attempt (which must also fail) cannot race the plan teardown
+        mgr.stop()
+        final = mgr.status(st["id"])
+    assert final["state"] == "failed"
+    assert final["output"] is None
+    assert not os.path.exists(
+        os.path.join(mgr.jobs_dir, st["id"], "output.jsonl"))
+    assert mgr.counters.get("jobs_ckpt_retries") >= 3   # bounded retry ran
+    assert ("jobs.checkpoint_write", "oserror") in plan.fired
+
+    # fault cleared: the durable state is still behind (persist failed
+    # too), so a fresh manager resumes and completes exactly-once
+    mgr2 = jobs.JobManager(str(tmp_path / "jobs"), dispatch=dispatch,
+                           default_workers=2, checkpoint_every=4)
+    assert mgr2.rescan() == [st["id"]]
+    assert _wait(lambda: mgr2.status(st["id"])["state"] == "completed",
+                 timeout=30)
+    with open(mgr2.status(st["id"])["output"], "rb") as f:
+        assert f.read() == _job_expected(path, 2)
+    mgr2.stop()
+
+
+def _interactive_p95_ms(cli, n=30):
+    lats = []
+    for _ in range(n):
+        t0 = time.monotonic()
+        code, _body = cli.generate([[1, 2, 3]], priority="interactive")
+        lats.append((time.monotonic() - t0) * 1000.0)
+        assert code == 200
+    lats.sort()
+    return lats[int(0.95 * (len(lats) - 1))]
+
+
+def test_job_fleet_scale_chaos_byte_identical(tmp_path):
+    """The acceptance gate: a >=1000-record job that loses a replica
+    mid-run AND the gateway mid-run produces output byte-identical to
+    an uninterrupted run — while a concurrent interactive burst's p95
+    latency stays bounded (batch-class jobs must not starve the
+    interactive class; the same asymmetry test_preemption.py pins on
+    the replica scheduler)."""
+    path = _write_job_input(tmp_path / "in.jsonl", 1000)
+
+    # ---- uninterrupted reference run --------------------------------
+    gw = _job_gateway(tmp_path / "jobs_ref")
+    gw.start()
+    stubs = [_ScoreStub(delay_s=0.002) for _ in range(2)]
+    regs = [_register_stub(gw, s) for s in stubs]
+    try:
+        cli = fleet_client.FleetClient(*gw.http_addr)
+        code, st = cli.submit_job(path, partitions=8, workers=3)
+        assert code == 200, st
+        ref = cli.wait_job(st["id"], timeout_s=180.0)
+        assert ref["state"] == "completed", ref
+        with open(ref["output"], "rb") as f:
+            ref_bytes = f.read()
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for s in stubs:
+            s.close()
+        gw.stop()
+    assert ref_bytes == _job_expected(path, 8)
+
+    # ---- chaos run: replica kill + gateway restart + burst ----------
+    jobs_dir = tmp_path / "jobs_chaos"
+    stubs = [_ScoreStub(delay_s=0.002) for _ in range(3)]
+    gw1 = _job_gateway(jobs_dir)
+    gw1.start()
+    regs = [_register_stub(gw1, s) for s in stubs]
+    gw2 = None
+    try:
+        cli = fleet_client.FleetClient(*gw1.http_addr)
+        idle_p95 = _interactive_p95_ms(cli)     # baseline, fleet idle
+        code, st = cli.submit_job(path, partitions=8, workers=3)
+        assert code == 200, st
+        job_id = st["id"]
+        assert _wait(lambda: cli.job_status(job_id)[1]
+                     .get("records_done", 0) > 100, timeout=60)
+        # interactive burst rides on top of the job at full tilt
+        before = cli.job_status(job_id)[1]["records_done"]
+        burst_p95 = _interactive_p95_ms(cli)
+        after = cli.job_status(job_id)[1]["records_done"]
+        assert after > before            # the job really was running
+        # replica killed mid-run
+        regs[0].stop_heartbeat()
+        stubs[0].close()
+        assert _wait(lambda: cli.job_status(job_id)[1]
+                     .get("records_done", 0) > 400, timeout=60)
+        for reg in regs[1:]:
+            reg.deregister()
+        gw1.stop()                       # gateway killed mid-run
+
+        gw2 = _job_gateway(jobs_dir)
+        gw2.jobs.record_attempts = 10
+        gw2.jobs.partition_attempts = 10
+        gw2.start()
+        regs = [_register_stub(gw2, s) for s in stubs[1:]]
+        cli2 = fleet_client.FleetClient(*gw2.http_addr)
+        final = cli2.wait_job(job_id, timeout_s=180.0)
+        assert final["state"] == "completed", final
+        assert final["records_done"] == 1000
+        assert final["records_failed"] == 0
+        with open(final["output"], "rb") as f:
+            chaos_bytes = f.read()
+        # THE invariant: chaos cost retries and a re-scan, not bytes
+        assert chaos_bytes == ref_bytes
+        # interactive latency under full batch load stays bounded: the
+        # WFQ scheduler spills batch, not interactive (generous CI
+        # bound — the relative claim, like test_preemption's
+        # armed < disarmed, is what matters)
+        assert burst_p95 <= max(10.0 * idle_p95, 1000.0), \
+            (burst_p95, idle_p95)
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for s in stubs:
+            try:
+                s.close()
+            except Exception:
+                pass
+        for gw in (gw1, gw2):
+            if gw is not None:
+                try:
+                    gw.stop()
+                except Exception:
+                    pass

@@ -616,6 +616,55 @@ def test_fleet_stats_quantized_weight_totals(gateway):
     assert body["totals"]["weight_float_equivalent_bytes"] == 0
 
 
+def test_fleet_stats_job_totals_and_metrics(gateway, tmp_path):
+    # bulk-job progress surfaces beside the replica sums: gateway-side
+    # keys (filled from the JobManager, not probes) present-and-zero on
+    # a jobs-disabled gateway, live counts on a jobs-enabled one — in
+    # BOTH /v1/fleet totals and the /metrics exposition
+    gw, stubs, regs = gateway
+    _spawn(gw, stubs, regs, n=1)
+    status, body = _client(gw).fleet_stats()
+    assert status == 200
+    t = body["totals"]
+    assert t["jobs_active"] == 0
+    assert t["jobs_records_done"] == 0
+    assert t["jobs_records_failed"] == 0
+    assert "tfospark_fleet_jobs_records_done 0" in gw.metrics_text()
+
+    gw2 = fleet.Gateway(heartbeat_timeout_s=0.6, monitor_interval_s=0.05,
+                        connect_timeout_s=2.0, replica_timeout_s=10.0,
+                        probe_timeout_s=2.0,
+                        jobs_dir=str(tmp_path / "jobs"))
+    gw2.start()
+    reg2 = None
+    try:
+        reg2 = fleet_client.register_replica(
+            gw2.registry_addr, stubs[0].host, stubs[0].port, n_slots=2,
+            features={"kv_page_size": 4}, heartbeat_interval_s=0.15)
+        path = tmp_path / "in.jsonl"
+        path.write_text("".join(json.dumps([i, 7]) + "\n"
+                                for i in range(5)))
+        cli = _client(gw2)
+        code, st = cli.submit_job(str(path), partitions=2)
+        assert code == 200, st
+        assert cli.wait_job(st["id"],
+                            timeout_s=30.0)["state"] == "completed"
+        status, body = cli.fleet_stats()
+        assert body["totals"]["jobs_records_done"] == 5
+        assert body["totals"]["jobs_records_failed"] == 0
+        assert body["totals"]["jobs_active"] == 0
+        text = gw2.metrics_text()
+        assert "tfospark_fleet_jobs_records_done 5" in text
+        assert "tfospark_gateway_jobs_completed 1" in text
+    finally:
+        if reg2 is not None:
+            try:
+                reg2.deregister()
+            except Exception:
+                pass
+        gw2.stop()
+
+
 def test_generate_spill_plants_kv_peer_header(gateway):
     # ISSUE-12 tentpole: when routing lands AWAY from the prefix-affine
     # replica (here: it saturated), the gateway hands the chosen one

@@ -1,0 +1,615 @@
+"""Bulk-inference job tests: partition-split semantics (the Hadoop
+FileSplit contract), record->request parsing, exactly-once output under
+duplicate dispatch and checkpoint resume, and the ``/v1/jobs`` HTTP
+surface over a real Gateway with stub replicas.
+
+CPU-only and model-free, like test_fleet.py: replicas here are
+:class:`ScoreStub` HTTP servers whose ``:generate`` outputs are a pure
+function of the request inputs — so the e2e tests can compare a fleet
+job's merged output byte-for-byte against a solo sequential scoring of
+the same input file.
+"""
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tensorflowonspark_tpu import faults, fleet, fleet_client, jobs
+from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu.analysis.resources import spec_by_name
+
+
+def _wait_until(pred, timeout=5.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+def score(prompt):
+    """The deterministic 'model': outputs are a pure function of the
+    prompt, so solo and fleet runs are byte-comparable."""
+    return [t * 2 + 1 for t in prompt]
+
+
+def local_dispatch(calls=None, fail=None):
+    """A JobManager ``dispatch`` callable scoring records in-process.
+    ``calls`` (a list) records every ``(key, body)``; ``fail(key, n)``
+    may raise to simulate dispatch failures (n = times this key was
+    attempted so far, 1-based)."""
+    seen = {}
+    lock = threading.Lock()
+
+    def dispatch(body, key):
+        with lock:
+            n = seen[key] = seen.get(key, 0) + 1
+            if calls is not None:
+                calls.append((key, body))
+        if fail is not None:
+            fail(key, n)
+        return {"outputs": [score(p) for p in body["inputs"]]}
+
+    return dispatch
+
+
+def write_jsonl(path, prompts, raw_lines=None):
+    """One token-id-list record per line (plus optional raw lines)."""
+    with open(path, "w", encoding="utf-8") as f:
+        for p in prompts:
+            f.write(json.dumps(p) + "\n")
+        for line in raw_lines or []:
+            f.write(line + "\n")
+    return str(path)
+
+
+def expected_output(path, n_partitions, fmt="jsonl"):
+    """Solo sequential scoring of `path`, producing exactly the bytes a
+    completed fleet job must merge (same splits, same line shape)."""
+    splits = jobs.split_file(path, n_partitions, fmt=fmt)
+    lines = []
+    for p, (s, e) in enumerate(splits):
+        for off, _nxt, text in jobs.iter_partition(path, s, e, fmt=fmt):
+            obj = {"p": p, "offset": off}
+            try:
+                body = jobs.record_request(text, {}, f"x/{p}/{off}")
+            except ValueError as err:
+                obj["error"] = str(err)
+            else:
+                obj["outputs"] = [score(pr) for pr in body["inputs"]]
+            lines.append(json.dumps(obj, sort_keys=True) + "\n")
+    return "".join(lines).encode()
+
+
+# ---------------------------------------------------------------------------
+# partition splitting
+
+
+def test_split_covers_every_record_exactly_once(tmp_path):
+    # ragged line lengths so split boundaries land mid-record
+    prompts = [[i] * (1 + (i * 7) % 13) for i in range(41)]
+    path = write_jsonl(tmp_path / "in.jsonl", prompts)
+    size = os.path.getsize(path)
+    for n in (1, 2, 3, 5, 9, 64):
+        splits = jobs.split_file(path, n)
+        assert splits[0][0] == 0 and splits[-1][1] == size
+        for (a, b), (c, d) in zip(splits, splits[1:]):
+            assert b == c and a < b          # contiguous, non-empty
+        seen = []
+        for s, e in splits:
+            for off, nxt, text in jobs.iter_partition(path, s, e):
+                assert s <= off < e          # ownership: first byte in split
+                assert off < nxt
+                seen.append((off, json.loads(text)))
+        assert [p for _, p in sorted(seen)] == prompts
+        assert len(seen) == len(set(o for o, _ in seen))
+
+
+def test_split_empty_file(tmp_path):
+    path = write_jsonl(tmp_path / "empty.jsonl", [])
+    assert jobs.split_file(path, 8) == [(0, 0)]
+    assert jobs.count_records(path, [(0, 0)]) == 0
+
+
+def test_split_more_partitions_than_records(tmp_path):
+    path = write_jsonl(tmp_path / "tiny.jsonl", [[1], [2]])
+    splits = jobs.split_file(path, 50)
+    # some partitions own zero records (their range starts mid-record);
+    # the union must still be every record exactly once
+    total = sum(1 for s, e in splits
+                for _ in jobs.iter_partition(path, s, e))
+    assert total == 2
+    assert jobs.count_records(path, splits) == 2
+
+
+def test_blank_lines_are_not_records(tmp_path):
+    path = write_jsonl(tmp_path / "in.jsonl", [[1], [2]],
+                       raw_lines=["", "   ", json.dumps([3])])
+    splits = jobs.split_file(path, 2)
+    assert jobs.count_records(path, splits) == 3
+
+
+def test_oversized_record_yields_error_marker(tmp_path):
+    path = write_jsonl(tmp_path / "in.jsonl", [[1], [9] * 400, [2]])
+    recs = list(jobs.iter_partition(path, 0, os.path.getsize(path),
+                                    max_record_bytes=64))
+    assert len(recs) == 3
+    assert recs[1][2] is None                # oversized -> no text
+    assert json.loads(recs[0][2]) == [1]     # neighbours intact
+    assert json.loads(recs[2][2]) == [2]
+
+
+def test_tfrecord_split_snaps_to_frames(tmp_path):
+    path = str(tmp_path / "in.tfrecord")
+    payloads = [json.dumps([i, i + 1]).encode() for i in range(17)]
+    w = tfrecord.TFRecordWriter(path, index=True)
+    for pl in payloads:
+        w.write(pl)
+    w.close()
+    splits = jobs.split_file(path, 4, fmt="tfrecord")
+    assert splits[0][0] == 0
+    assert splits[-1][1] == os.path.getsize(path)
+    got = [text for s, e in splits
+           for _, _, text in jobs.iter_partition(path, s, e,
+                                                 fmt="tfrecord")]
+    assert got == [pl.decode() for pl in payloads]
+
+
+# ---------------------------------------------------------------------------
+# record -> request
+
+
+def test_record_request_forms():
+    tmpl = {"max_new_tokens": 4, "temperature": 0.0}
+    # bare list sugar
+    req = jobs.record_request("[1, 2, 3]", tmpl, "j/0/0")
+    assert req["inputs"] == [[1, 2, 3]]
+    assert req["max_new_tokens"] == 4
+    assert req["priority"] == "batch"
+    # object merged OVER the template; record fields win; stream dropped
+    req = jobs.record_request(
+        json.dumps({"inputs": [[7]], "max_new_tokens": 9, "stream": True}),
+        tmpl, "j/0/0")
+    assert req["max_new_tokens"] == 9
+    assert "stream" not in req
+    # sampled + unseeded -> pinned per-record seed, stable across calls
+    req1 = jobs.record_request("[5]", {"temperature": 0.8}, "j/1/10")
+    req2 = jobs.record_request("[5]", {"temperature": 0.8}, "j/1/10")
+    assert req1["seed"] == req2["seed"] == jobs.record_seed("j/1/10")
+    assert jobs.record_request("[5]", {"temperature": 0.8},
+                               "j/1/11")["seed"] != req1["seed"]
+    # explicit seed is respected
+    assert jobs.record_request(json.dumps({"inputs": [[5]], "seed": 3}),
+                               {"temperature": 0.8}, "j/0/0")["seed"] == 3
+    with pytest.raises(ValueError):
+        jobs.record_request("not json", tmpl, "k")
+    with pytest.raises(ValueError):
+        jobs.record_request("{}", {}, "k")     # no inputs anywhere
+    with pytest.raises(ValueError):
+        jobs.record_request('"scalar"', tmpl, "k")
+
+
+# ---------------------------------------------------------------------------
+# manager: local dispatch
+
+
+def _manager(tmp_path, **kw):
+    kw.setdefault("checkpoint_every", 4)
+    kw.setdefault("default_workers", 3)
+    return jobs.JobManager(str(tmp_path / "jobs"), **kw)
+
+
+def test_local_job_completes_exactly_once(tmp_path):
+    prompts = [[i, i + 1] for i in range(23)]
+    path = write_jsonl(tmp_path / "in.jsonl", prompts)
+    calls = []
+    mgr = _manager(tmp_path, dispatch=local_dispatch(calls))
+    st = mgr.submit({"input": path, "partitions": 4, "workers": 3})
+    assert st["state"] == "running" and st["records_total"] == 23
+    assert _wait_until(
+        lambda: mgr.status(st["id"])["state"] != "running", timeout=20)
+    final = mgr.status(st["id"])
+    assert final["state"] == "completed"
+    assert final["records_done"] == 23 and final["records_failed"] == 0
+    assert final["partitions_done"] == final["partitions"]
+    assert final["output"] and os.path.isfile(final["output"])
+    with open(final["output"], "rb") as f:
+        assert f.read() == expected_output(path, 4)
+    # every record dispatched exactly once, keyed job/p/offset
+    keys = [k for k, _ in calls]
+    assert len(keys) == 23 and len(set(keys)) == 23
+    assert all(k.startswith(st["id"] + "/") for k in keys)
+    # every dispatch went out batch-class
+    assert all(b["priority"] == "batch" for _, b in calls)
+    assert mgr.stats() == {"jobs_active": 0, "jobs_records_done": 23,
+                           "jobs_records_failed": 0}
+    mgr.stop()
+
+
+def test_empty_input_completes_with_empty_output(tmp_path):
+    path = write_jsonl(tmp_path / "empty.jsonl", [])
+    mgr = _manager(tmp_path, dispatch=local_dispatch())
+    st = mgr.submit({"input": path})
+    assert _wait_until(
+        lambda: mgr.status(st["id"])["state"] == "completed", timeout=10)
+    with open(mgr.status(st["id"])["output"], "rb") as f:
+        assert f.read() == b""
+    mgr.stop()
+
+
+def test_bad_record_fails_record_not_job(tmp_path):
+    path = write_jsonl(tmp_path / "in.jsonl", [[1]],
+                       raw_lines=["this is not json", json.dumps([2])])
+    mgr = _manager(tmp_path, dispatch=local_dispatch())
+    st = mgr.submit({"input": path, "partitions": 1})
+    assert _wait_until(
+        lambda: mgr.status(st["id"])["state"] == "completed", timeout=10)
+    final = mgr.status(st["id"])
+    assert final["records_done"] == 2 and final["records_failed"] == 1
+    with open(final["output"], encoding="utf-8") as f:
+        lines = [json.loads(ln) for ln in f]
+    assert len(lines) == 3                    # output stays 1:1 with input
+    assert "error" in lines[1] and "outputs" not in lines[1]
+    assert lines[0]["outputs"] == [score([1])]
+    assert lines[2]["outputs"] == [score([2])]
+    mgr.stop()
+
+
+def test_exactly_once_under_duplicate_dispatch(tmp_path):
+    """The lost-reply case: the dispatch reaches the 'replica' (the call
+    is recorded) but the attempt fails, so the runner re-sends.  The
+    retry must carry the SAME Idempotency-Key and the output must hold
+    exactly one line per record."""
+    prompts = [[i] for i in range(12)]
+    path = write_jsonl(tmp_path / "in.jsonl", prompts)
+    calls = []
+
+    def fail(key, n):
+        if n == 1 and int(key.rsplit("/", 1)[1]) % 3 == 0:
+            raise OSError("reply lost after side effect")
+
+    mgr = _manager(tmp_path, dispatch=local_dispatch(calls, fail=fail))
+    st = mgr.submit({"input": path, "partitions": 2, "workers": 2})
+    assert _wait_until(
+        lambda: mgr.status(st["id"])["state"] == "completed", timeout=20)
+    keys = [k for k, _ in calls]
+    assert len(keys) > len(set(keys))         # duplicates really happened
+    with open(mgr.status(st["id"])["output"], "rb") as f:
+        data = f.read()
+    assert data == expected_output(path, 2)   # ...but output is once-each
+    assert mgr.counters.get("jobs_record_retries") > 0
+    mgr.stop()
+
+
+def test_checkpoint_resume_survives_manager_restart(tmp_path):
+    """Stop the manager mid-job (the gateway-crash path: durable state
+    stays 'running'), rescan with a fresh manager, and the job completes
+    with exactly-once output."""
+    prompts = [[i] for i in range(40)]
+    path = write_jsonl(tmp_path / "in.jsonl", prompts)
+    gate = threading.Event()
+    n_done = [0]
+
+    def slow_fail(key, n):
+        n_done[0] += 1
+        if n_done[0] > 12 and not gate.is_set():
+            gate.wait(5.0)                    # stall mid-job until stop
+
+    mgr = _manager(tmp_path, checkpoint_every=3,
+                   dispatch=local_dispatch(fail=slow_fail))
+    st = mgr.submit({"input": path, "partitions": 4, "workers": 2})
+    assert _wait_until(lambda: n_done[0] > 12, timeout=10)
+    mgr._stop.set()                           # begin shutdown...
+    gate.set()                                # ...release stalled workers
+    mgr.stop(timeout_s=10)
+    assert mgr.status(st["id"])["state"] == "running"   # NOT terminal
+
+    mgr2 = _manager(tmp_path, dispatch=local_dispatch())
+    assert mgr2.rescan() == [st["id"]]
+    assert mgr2.counters.get("jobs_resumed") == 1
+    assert _wait_until(
+        lambda: mgr2.status(st["id"])["state"] == "completed", timeout=20)
+    with open(mgr2.status(st["id"])["output"], "rb") as f:
+        data = f.read()
+    assert data == expected_output(path, 4)
+    # terminal state is durable: a third rescan resumes nothing
+    mgr3 = _manager(tmp_path, dispatch=local_dispatch())
+    assert mgr3.rescan() == []
+    assert mgr3.status(st["id"])["state"] == "completed"
+    mgr2.stop()
+    mgr3.stop()
+
+
+def test_undeliverable_partition_fails_job(tmp_path):
+    path = write_jsonl(tmp_path / "in.jsonl", [[1], [2]])
+
+    def fail(key, n):
+        raise OSError("fleet is a smoking crater")
+
+    mgr = _manager(tmp_path, dispatch=local_dispatch(fail=fail),
+                   record_attempts=2, partition_attempts=2)
+    st = mgr.submit({"input": path, "partitions": 1})
+    assert _wait_until(
+        lambda: mgr.status(st["id"])["state"] == "failed", timeout=20)
+    final = mgr.status(st["id"])
+    assert "partition 0" in final["error"]
+    assert final["output"] is None
+    assert mgr.counters.get("jobs_failed") == 1
+    assert mgr.stats()["jobs_active"] == 0
+    mgr.stop()
+
+
+def test_submit_validation(tmp_path):
+    mgr = jobs.JobManager(str(tmp_path / "jobs"),
+                          dispatch=local_dispatch())
+    with pytest.raises(ValueError):
+        mgr.submit({"input": str(tmp_path / "nope.jsonl")})
+    with pytest.raises(ValueError):
+        mgr.submit([])
+    path = write_jsonl(tmp_path / "in.jsonl", [[1]])
+    with pytest.raises(ValueError):
+        mgr.submit({"input": path, "format": "parquet"})
+    with pytest.raises(ValueError):
+        mgr.submit({"input": path, "request": "template"})
+    with pytest.raises(ValueError):
+        mgr.submit({"input": path, "partitions": 0})
+    mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite wiring: graftcheck spec + fault sites
+
+
+def test_partition_lease_resource_spec_registered():
+    spec = spec_by_name("job-partition-lease")
+    assert spec.acquire == ("self._lease_partition",)
+    assert set(spec.release) == {"self._commit_partition",
+                                 "self._abandon_partition"}
+
+
+def test_job_fault_sites_registered():
+    for site in ("jobs.partition_read", "jobs.record_dispatch",
+                 "jobs.checkpoint_write"):
+        assert site in faults.SITES
+
+
+def test_checkpoint_write_fault_is_absorbed_by_retry(tmp_path):
+    """A transient checkpoint-write fault must be retried, not fail the
+    job; with the bounded retry exhausted the partition abandons and the
+    job is NOT marked completed."""
+    path = write_jsonl(tmp_path / "in.jsonl", [[i] for i in range(6)])
+    plan = faults.FaultPlan(seed=7).on("jobs.checkpoint_write", "oserror",
+                                       nth=1, times=2)
+    mgr = _manager(tmp_path, dispatch=local_dispatch(),
+                   checkpoint_every=2)
+    with faults.active(plan):
+        st = mgr.submit({"input": path, "partitions": 1})
+        assert _wait_until(
+            lambda: mgr.status(st["id"])["state"] == "completed",
+            timeout=20)
+    assert mgr.counters.get("jobs_ckpt_retries") == 2
+    assert len(plan.fired) == 2
+    mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: real Gateway + deterministic scoring stubs
+
+
+class ScoreStub:
+    """A serve.py stand-in whose ``:generate`` outputs are a pure
+    function of the inputs (``score()``), so fleet job output is
+    comparable against solo sequential scoring."""
+
+    def __init__(self, generate_delay_s=0.0):
+        self.generate_delay_s = generate_delay_s
+        self.generate_requests = []
+        self.idem_keys = []
+        self.priorities = []
+        self.fail_next = 0
+        self._lock = threading.Lock()
+        stub = self
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.rstrip("/") or "/"
+                if path in ("/healthz", "/readyz"):
+                    self._send(200, {"status": "ok"})
+                elif path == "/v1/models/default":
+                    self._send(200, {"status": "ok",
+                                     "model": {"engine": "stub",
+                                               "generate_stats": {}}})
+                else:
+                    self._send(404, {"error": self.path})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not self.path.endswith(":generate"):
+                    self._send(404, {"error": self.path})
+                    return
+                with stub._lock:
+                    stub.generate_requests.append(dict(req))
+                    stub.idem_keys.append(
+                        self.headers.get("Idempotency-Key"))
+                    stub.priorities.append(
+                        self.headers.get("X-Priority"))
+                    if stub.fail_next > 0:
+                        stub.fail_next -= 1
+                        self._send(500, {"error": "injected failure"})
+                        return
+                if stub.generate_delay_s:
+                    time.sleep(stub.generate_delay_s)
+                self._send(200, {"outputs": [score(p)
+                                             for p in req["inputs"]],
+                                 "replica": stub.id})
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.host, self.port = self._server.server_address[:2]
+        self.id = f"{self.host}:{self.port}"
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def jobs_gateway(tmp_path):
+    gw = fleet.Gateway(heartbeat_timeout_s=0.6, monitor_interval_s=0.05,
+                       breaker_threshold=2, breaker_cooldown_s=0.3,
+                       connect_timeout_s=2.0, replica_timeout_s=10.0,
+                       probe_timeout_s=2.0,
+                       jobs_dir=str(tmp_path / "jobs"), job_workers=3,
+                       job_checkpoint_every=4)
+    gw.start()
+    stubs, regs = [], []
+    try:
+        yield gw, stubs, regs
+    finally:
+        for reg in regs:
+            try:
+                reg.deregister()
+            except Exception:
+                pass
+        for s in stubs:
+            s.close()
+        gw.stop()
+
+
+def _spawn(gw, stubs, regs, n=2, n_slots=4, generate_delay_s=0.0):
+    for _ in range(n):
+        s = ScoreStub(generate_delay_s=generate_delay_s)
+        stubs.append(s)
+        regs.append(fleet_client.register_replica(
+            gw.registry_addr, s.host, s.port, n_slots=n_slots,
+            features={"kv_page_size": 4}, heartbeat_interval_s=0.15))
+    assert _wait_until(
+        lambda: {s.id for s in stubs}
+        <= set(gw.fleet_stats(probe=False)["replicas"]))
+
+
+def _client(gw):
+    return fleet_client.FleetClient(*gw.http_addr)
+
+
+def test_http_job_matches_sequential_scoring(jobs_gateway, tmp_path):
+    gw, stubs, regs = jobs_gateway
+    _spawn(gw, stubs, regs, n=2)
+    prompts = [[i, (i * 3) % 7] for i in range(40)]
+    path = write_jsonl(tmp_path / "in.jsonl", prompts)
+    cli = _client(gw)
+    tid = "ab12" * 8
+    code, st = cli.submit_job(path, partitions=4, workers=3,
+                              request={"max_new_tokens": 4}, trace=tid)
+    assert code == 200, st
+    final = cli.wait_job(st["id"], timeout_s=30.0)
+    assert final["state"] == "completed", final
+    assert final["records_done"] == 40 and final["records_failed"] == 0
+    with open(final["output"], "rb") as f:
+        assert f.read() == expected_output(path, 4)
+    # load actually spread over the fleet, all batch-class, keyed
+    assert all(s.generate_requests for s in stubs)
+    keys = [k for s in stubs for k in s.idem_keys]
+    assert len(keys) == 40 and len(set(keys)) == 40
+    assert all(k.split("/")[0] == st["id"] for k in keys)
+    prios = {p for s in stubs for p in s.priorities}
+    assert prios == {"batch"}
+    bodies = [b for s in stubs for b in s.generate_requests]
+    assert all(b["priority"] == "batch" for b in bodies)
+    # job lifecycle spans land in the stitched trace timeline
+    code, timeline = cli._call("GET", f"/v1/trace/{tid}")
+    assert code == 200
+    assert {"job.submit", "job.partition", "job.record",
+            "job.done"} <= set(timeline["stages"])
+    parts = [s for s in timeline["spans"] if s["name"] == "job.partition"]
+    assert len(parts) == 4                    # one span per partition
+    assert {s["attrs"]["status"] for s in parts} == {"done"}
+    # job listed + progress surface
+    code, listing = cli.jobs()
+    assert code == 200
+    assert [j["id"] for j in listing["jobs"]] == [st["id"]]
+    code, _ = cli.job_status("doesnotexist")
+    assert code == 404
+
+
+def test_http_job_replica_500_retries_through(jobs_gateway, tmp_path):
+    gw, stubs, regs = jobs_gateway
+    _spawn(gw, stubs, regs, n=2)
+    stubs[0].fail_next = 2                    # first hits bounce 500
+    path = write_jsonl(tmp_path / "in.jsonl", [[i] for i in range(10)])
+    cli = _client(gw)
+    code, st = cli.submit_job(path, partitions=2, workers=2)
+    assert code == 200, st
+    final = cli.wait_job(st["id"], timeout_s=30.0)
+    assert final["state"] == "completed", final
+    assert final["records_done"] == 10
+    with open(final["output"], "rb") as f:
+        assert f.read() == expected_output(path, 2)
+
+
+def test_http_job_cancel_frees_quota(jobs_gateway, tmp_path):
+    gw, stubs, regs = jobs_gateway
+    _spawn(gw, stubs, regs, n=2, generate_delay_s=0.15)
+    path = write_jsonl(tmp_path / "in.jsonl", [[i] for i in range(200)])
+    cli = _client(gw)
+    code, st = cli.submit_job(path, partitions=4, workers=3)
+    assert code == 200, st
+    # let a few records land, then cancel mid-flight
+    assert _wait_until(
+        lambda: cli.job_status(st["id"])[1].get("records_done", 0) > 0,
+        timeout=10)
+    code, cancelled = cli.cancel_job(st["id"])
+    assert code == 200 and cancelled["state"] == "cancelled"
+    # terminal + idempotent
+    code, again = cli.cancel_job(st["id"])
+    assert code == 200 and again["state"] == "cancelled"
+    final = cli.wait_job(st["id"], timeout_s=10.0)
+    assert final["state"] == "cancelled"
+    assert final["output"] is None
+    assert final["records_done"] < 200
+    # admission quota drains: no tenant slots leak from in-flight
+    # records that were aborted by the cancel
+    assert _wait_until(lambda: not gw._tenant_inflight, timeout=10)
+    code, _ = cli.cancel_job("doesnotexist")
+    assert code == 404
+
+
+def test_jobs_surface_disabled_without_jobs_dir(tmp_path):
+    gw = fleet.Gateway(monitor_interval_s=0.05)
+    gw.start()
+    try:
+        cli = _client(gw)
+        code, body = cli.jobs()
+        assert code == 503
+        code, body = cli.submit_job(str(tmp_path / "in.jsonl"))
+        assert code == 503
+        assert "jobs" in (body.get("error") or "")
+    finally:
+        gw.stop()
+
+
+def test_http_job_bad_spec_400(jobs_gateway, tmp_path):
+    gw, stubs, regs = jobs_gateway
+    cli = _client(gw)
+    code, body = cli.submit_job(str(tmp_path / "missing.jsonl"))
+    assert code == 400
+    assert "input" in (body.get("error") or "")
