@@ -15,6 +15,8 @@ if [ "${1:-}" = "--analyze" ]; then
     python scripts/lint.py
     # SARIF side-channel so CI can annotate findings per line
     python scripts/graftcheck.py --sarif-output build/graftcheck.sarif
+    # extracted wire-protocol contract (endpoints / emissions / planes)
+    python scripts/graftcheck.py --format protocol --output build/protocol.json
 fi
 make -C native
 if [ "${1:-}" = "--fast" ]; then

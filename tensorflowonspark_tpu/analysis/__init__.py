@@ -1,9 +1,11 @@
 """graftcheck — JAX/TPU-aware stdlib static analysis.
 
-Rule framework + four semantic analyzers (tracer hazards, sharding lint,
-Pallas tile checks, lock discipline) + the style tier scripts/lint.py
-delegates to.  Run as ``python scripts/graftcheck.py`` or
-``python -m tensorflowonspark_tpu.analysis``; see docs/source/analysis.rst.
+Rule framework + the semantic analyzers (tracer hazards, sharding lint,
+Pallas tile checks, lock discipline, thread-role races, resource
+lifecycles, jit-recompile lint, wire-protocol contracts) + the style
+tier scripts/lint.py delegates to.  Run as ``python
+scripts/graftcheck.py`` or ``python -m tensorflowonspark_tpu.analysis``;
+see docs/source/analysis.rst.
 """
 from .core import (Finding, Project, Rule, REGISTRY, analyze_source,  # noqa: F401
                    main, register, run_rules)

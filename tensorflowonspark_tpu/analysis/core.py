@@ -382,6 +382,10 @@ def sarif_report(findings, rules=None):
     rule_objs = [{
         "id": r.name,
         "shortDescription": {"text": r.description or r.name},
+        # each rule is documented under a `.. _rule-<name>:` anchor in
+        # the analysis guide; tests/test_analysis.py asserts the link
+        # resolves for every registered rule
+        "helpUri": f"docs/source/analysis.rst#rule-{r.name}",
         "properties": {"kind": r.kind, "scope": r.scope},
     } for r in sorted(rules, key=lambda r: r.name)
         if r.name in seen_rules or not findings]
@@ -455,7 +459,7 @@ def main(argv=None):
     # code can import core without pulling every analyzer.
     from tensorflowonspark_tpu.analysis import (  # noqa
         hostsync, lifecycle, locks, pallas_tiles, recompile, shardlint,
-        style, threads, tracer)
+        style, threads, tracer, wireproto)
 
     ap = argparse.ArgumentParser(
         prog="graftcheck",
@@ -468,11 +472,18 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON (same as --format json)")
     ap.add_argument("--format", default=None, dest="fmt",
-                    choices=("text", "json", "sarif"),
-                    help="report format on stdout (default text)")
+                    choices=("text", "json", "sarif", "protocol"),
+                    help="report format on stdout (default text); "
+                    "'protocol' dumps the extracted wire contract "
+                    "(endpoints, client emissions, message planes, "
+                    "propagated fields) as JSON instead of findings")
     ap.add_argument("--sarif-output", default=None, metavar="FILE",
                     help="additionally write a SARIF 2.1.0 report to FILE "
                     "(whatever --format is; CI annotation side channel)")
+    ap.add_argument("--output", default=None, metavar="FILE",
+                    help="with --format protocol: write the contract dump "
+                    "to FILE instead of stdout (tox commands cannot "
+                    "shell-redirect)")
     ap.add_argument("--changed-only", action="store_true",
                     help="report findings only for files git sees as "
                     "changed/untracked (full project still loads, so "
@@ -522,6 +533,18 @@ def main(argv=None):
     except FileNotFoundError as e:
         print(f"graftcheck: error: {e}", file=sys.stderr)
         return 2
+
+    if fmt == "protocol":
+        from tensorflowonspark_tpu.analysis import wireproto as _wp
+        doc = json.dumps(_wp.protocol_dump(project), indent=2)
+        if args.output:
+            os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+            with open(args.output, "w", encoding="utf-8") as f:
+                f.write(doc + "\n")
+            print(f"graftcheck: wire-protocol dump -> {args.output}")
+        else:
+            print(doc)
+        return 0
 
     stats = {} if args.stats else None
     findings = run_rules(project, rules, stats=stats)

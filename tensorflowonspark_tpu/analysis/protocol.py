@@ -1,0 +1,228 @@
+"""Declarative model of the fleet's wire protocol (graftcheck wireproto).
+
+The serving plane speaks an *informal* protocol: HTTP routes are
+``if path == ...`` chains in ``serve.py``/``fleet.py``, the rendezvous
+and KV-transfer planes dispatch on ``msg["type"]`` / ``req["kind"]``
+string compares, and contract fields (``priority``, ``trace``, ``seed``,
+``Idempotency-Key``) must be re-written by hand into every carrier
+payload — journal replay bodies, wire snapshots, job records.  Nothing
+type-checks any of it.  ``analysis/wireproto.py`` extracts the protocol
+from the AST; this module declares what the extractor cannot infer:
+
+- the dataclasses the extraction produces (``Endpoint``,
+  ``ClientCall``, ``MessageCase``) — also the shape of the
+  ``--format protocol`` JSON dump;
+- :data:`FIELD_SPECS` — the :class:`PropagatedFieldSpec` table (the
+  PR 8 ``ResourceSpec`` pattern): one row per contract field naming
+  every carrier function that must write it, checked by
+  ``wire-dropped-field``;
+- :data:`EXTERNAL_ENDPOINTS` / :data:`ACK_MESSAGES` — server surfaces
+  with no in-repo client *by design* (Prometheus scrapes, operator
+  curl, protocol ack frames), each with its rationale.  Everything
+  else unmatched is a ``wire-dead-endpoint`` finding.
+
+Like ``resources.py``, growing the protocol is a table edit, not an
+analyzer change: a new endpoint that rides an existing idiom is
+extracted automatically, a new contract field is one
+:class:`PropagatedFieldSpec` row, and a new operator-only surface is
+one allowlist entry with a rationale string.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Endpoint:
+    """One server route: a (method, path-pattern) a handler answers.
+
+    ``path`` is the normalized pattern: literal segments kept, every
+    dynamic piece (f-string interpolation, ``startswith`` tail)
+    collapsed to ``*`` — ``/v1/models/*:generate``, ``/v1/trace/*``.
+    ``kind`` records how the handler matched: ``exact``, ``prefix``
+    (a bare ``startswith``), or ``verb`` (prefix + ``:verb`` suffix).
+    """
+    method: str
+    path: str
+    layer: str                 # module short name: "serve" / "fleet"
+    handler: str               # qualname of the do_GET/do_POST
+    line: int
+    kind: str = "exact"
+    statuses: tuple = ()       # literal codes; "*" = relayed/dynamic
+
+    def as_dict(self):
+        return {"method": self.method, "path": self.path,
+                "layer": self.layer, "handler": self.handler,
+                "line": self.line, "kind": self.kind,
+                "statuses": sorted(self.statuses, key=str)}
+
+
+@dataclasses.dataclass
+class ClientCall:
+    """One client emission site: a call that puts a request on the wire.
+
+    ``path`` is normalized like :class:`Endpoint.path` (querystrings
+    stripped); ``None`` means the path is dynamic (a relay forwarding
+    ``self.path``) and the site is exempt from endpoint matching.
+    ``statuses`` are the literal codes the surrounding function's
+    status checks distinguish; ``retried`` marks sites re-driven by a
+    retry loop (their status handling feeds ``wire-status-unhandled``).
+    """
+    method: str
+    path: object               # str pattern or None (dynamic relay)
+    layer: str
+    caller: str                # qualname of the emitting function
+    line: int
+    headers: tuple = ()
+    body_fields: tuple = ()
+    statuses: tuple = ()
+    retried: bool = False
+
+    def as_dict(self):
+        return {"method": self.method, "path": self.path,
+                "layer": self.layer, "caller": self.caller,
+                "line": self.line, "headers": sorted(self.headers),
+                "body_fields": sorted(self.body_fields),
+                "statuses_distinguished": sorted(self.statuses, key=str),
+                "retried": self.retried}
+
+
+@dataclasses.dataclass
+class MessageCase:
+    """One message-plane case: a ``{"type": X}`` / ``{"kind": X}``
+    constant either dispatched on by a server loop (``side="handle"``)
+    or put on the wire by a send (``side="emit"``)."""
+    key: str                   # the dispatch key: "type" or "kind"
+    value: str                 # the constant: "REG", "pull", ...
+    side: str                  # "handle" | "emit"
+    layer: str
+    where: str                 # qualname
+    line: int
+
+    def as_dict(self):
+        return {"key": self.key, "value": self.value, "side": self.side,
+                "layer": self.layer, "where": self.where,
+                "line": self.line}
+
+
+# ---------------------------------------------------------------------------
+# propagated contract fields
+
+
+@dataclasses.dataclass(frozen=True)
+class PropagatedFieldSpec:
+    """One contract field and the carrier payloads it must survive.
+
+    ``carriers`` are ``"module.function"`` patterns — the module's last
+    dotted component plus the bare function/method name (class names
+    are deliberately not part of the pattern, same suffix-matching
+    spirit as ``ResourceSpec``).  ``wire-dropped-field`` resolves each
+    pattern through the call graph and verifies the function (or a
+    same-project callee, depth-bounded) writes the field into a
+    mapping: a dict-literal key, a ``d["field"] = ...`` store, a
+    ``d.setdefault("field", ...)``, or a ``dict(field=...)`` keyword.
+
+    A carrier pattern that resolves to no scanned function is skipped,
+    not flagged — specs survive refactors that delete a carrier, and
+    fixture projects exercise single specs in isolation.
+    """
+    field: str
+    carriers: tuple
+    description: str
+
+
+# The contract fields the fleet promises survive every hop (serving.rst
+# "Multi-tenant scheduling" / "Request tracing" / "Crash recovery").
+# Each carrier builds a payload that crosses a process boundary; a
+# carrier that stops writing the field silently demotes every session
+# on that path — exactly the bug class wire_snapshot shipped with
+# (priority was dropped on the migration path until this table landed).
+FIELD_SPECS = (
+    PropagatedFieldSpec(
+        field="priority",
+        carriers=("fleet._replay_meta",        # journal re-drive body
+                  "fleet._stream_generate",    # journaled stream body
+                  "fleet._route_models",       # non-stream relay body
+                  "kvtransfer.wire_snapshot",  # migration/park meta
+                  "jobs.record_request"),      # bulk-job request body
+        description="tenant priority class: a re-driven, migrated, "
+                    "parked, or job-dispatched session must admit "
+                    "under the class the first drive resolved",
+    ),
+    PropagatedFieldSpec(
+        field="trace",
+        carriers=("fleet._replay_meta",
+                  "fleet._stream_generate",
+                  "fleet._route_models",
+                  "kvtransfer.wire_snapshot"),
+        description="trace id: every hop (replay, migration, park) "
+                    "must record spans under the request's one id so "
+                    "GET /v1/trace/<id> stitches one timeline",
+    ),
+    PropagatedFieldSpec(
+        field="seed",
+        carriers=("fleet._seed_body",          # gateway seeds pre-journal
+                  "fleet._replay_meta",
+                  "kvtransfer.wire_snapshot",
+                  "jobs.record_request"),
+        description="sampling seed: byte-identical recovery rests on "
+                    "noise being a pure function of (seed, ordinal) — "
+                    "a carrier that drops the seed breaks replay parity",
+    ),
+    PropagatedFieldSpec(
+        field="Idempotency-Key",
+        carriers=("fleet._attempt_stream",     # drive + re-drive headers
+                  "jobs._dispatch_gateway"),   # job record re-dispatch
+        description="exactly-once key: a re-drive or re-dispatch whose "
+                    "predecessor is still decoding must dedupe on the "
+                    "replica instead of double-generating",
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# surfaces with no in-repo client, by design
+
+
+# (method, path-pattern) -> rationale.  These endpoints are driven from
+# OUTSIDE the repo — Prometheus scrapers, operator curl, load-balancer
+# checks — so "no client emission matches" is the expected state, not a
+# dead route.  wire-dead-endpoint skips them; the protocol dump still
+# lists them (with the rationale) so the docs-drift test covers them.
+EXTERNAL_ENDPOINTS = {
+    ("GET", "/metrics"):
+        "Prometheus scrape target (text exposition); no in-repo client",
+    ("GET", "/v1/metrics"):
+        "alias of /metrics for path-prefixed scrape configs",
+    ("GET", "/"):
+        "human/browser landing alias of the metadata endpoint",
+    ("GET", "/v1/trace/*"):
+        "operator timeline lookup; the gateway stitches replicas "
+        "itself via an internal probe, clients use curl",
+    ("POST", "/v1/debug:profile"):
+        "operator-triggered jax.profiler capture (the gateway proxies "
+        "the same path to a replica, which keeps the pair matched)",
+}
+
+
+# Modules that speak a framed message plane, and the dict key their
+# dispatch switches on.  Extraction is gated on this table so that
+# unrelated `x["kind"]` compares elsewhere in the repo (snapshot
+# layout tags, config dicts) never read as protocol dispatch.
+MESSAGE_PLANES = {
+    "reservation": "type",     # rendezvous RPCs: REG/QUERY/BEAT/...
+    "kvtransfer": "kind",      # page-server frames: pull/header/block/...
+}
+
+
+# Message-plane constants that are *replies*, not requests: the
+# request/response planes share one framed socket, so a reply frame is
+# "emitted" by the server dispatcher yet dispatched on by no one —
+# clients treat any non-exception reply as the ack and surface ERR
+# payload text through exceptions rather than a type switch.
+ACK_MESSAGES = {
+    ("type", "OK"):
+        "rendezvous ack frame; clients treat any reply as success",
+    ("type", "ERR"):
+        "rendezvous error reply; surfaced as raised text, not dispatched",
+}

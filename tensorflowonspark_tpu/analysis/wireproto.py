@@ -1,0 +1,1089 @@
+"""graftcheck wireproto: whole-fleet wire-protocol contract analysis.
+
+The fleet's protocol is implicit: server routes are ``if path == ...``
+chains in ``BaseHTTPRequestHandler`` subclasses, clients build paths
+with f-strings three modules away, the rendezvous/KV planes dispatch on
+``msg["type"]`` / ``req["kind"]`` string compares, and contract fields
+must be hand-copied into every payload that crosses a process boundary.
+This pass extracts both sides of that contract from the AST (on the
+PR 7 callgraph substrate) and cross-checks them:
+
+- **server route table** — every ``do_GET``/``do_POST`` method of a
+  handler class, its path predicates (literal compares, membership
+  tuples, ``startswith`` prefixes, f-string ``:verb`` compares — also
+  when assigned to ``is_predict``-style locals), and the status codes
+  each route can ``send_response()``, summarized through ``self._send``
+  -style helpers;
+- **client emission sites** — every ``conn.request(method, path, ...)``
+  plus the wrapper closure over it (``Gateway._request``,
+  ``FleetClient._call``, ``probe``): a wrapper forwarding its
+  ``method``/``path`` params becomes an emitter, so the call site that
+  pins the literals is where the emission is recorded, with the
+  headers/body fields written along the chain and the status codes the
+  chain's ``resp.status`` checks distinguish;
+- **message planes** — for the modules in ``protocol.MESSAGE_PLANES``,
+  the dispatch cases (compares against the plane key on received
+  dicts) versus the emitted frames (``{"type": ...}`` literals passed
+  to a send, including via a local variable);
+- **propagated contract fields** — each ``protocol.FIELD_SPECS`` row
+  is verified by walking its carrier functions (and their resolvable
+  callees) for a write of the field.
+
+Rules: ``wire-unhandled-endpoint`` (client emits what no handler
+routes), ``wire-dead-endpoint`` (route or dispatch case no client
+emits, minus the declared operator-only surfaces),
+``wire-dropped-field`` (a spec carrier stopped writing a contract
+field), ``wire-status-unhandled`` (a retry-driven emission whose
+status handling cannot tell a permanent 4xx from a transient failure,
+against a route that really emits one).  ``protocol_dump`` backs the
+CLI's ``--format protocol`` JSON contract dump.
+
+Like every graftcheck pass: stdlib ``ast`` only, best-effort
+resolution — a dynamic path (``self.path`` relays) is recorded but
+exempt from matching, so missed edges cost recall, never precision.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from . import callgraph as callgraph_mod
+from .core import Finding, Rule, register, _posix
+from .protocol import (ACK_MESSAGES, EXTERNAL_ENDPOINTS, FIELD_SPECS,
+                       MESSAGE_PLANES, ClientCall, Endpoint, MessageCase)
+
+HTTP_METHODS = ("GET", "POST", "PUT", "DELETE", "HEAD", "PATCH")
+
+# 4xx a retry policy may legitimately treat like a transient failure
+RETRYABLE_4XX = (408, 429)
+
+_HEADER_RE = re.compile(r"^[A-Z][A-Za-z0-9]*(?:-[A-Za-z0-9]+)+$")
+_PCT_RE = re.compile(r"%[srdif]")
+_BODYISH = ("body", "payload", "req", "meta", "msg", "record")
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _norm(pattern):
+    """Canonical path pattern: query string stripped, duplicate
+    wildcards collapsed, trailing slash dropped (handlers rstrip)."""
+    pattern = pattern.split("?")[0]
+    while "**" in pattern:
+        pattern = pattern.replace("**", "*")
+    if len(pattern) > 1 and pattern.endswith("/"):
+        pattern = pattern.rstrip("/") or "/"
+    return pattern
+
+
+def _pattern_exprs(node, fn_node=None, _depth=0):
+    """Every path pattern ``node`` can evaluate to (dynamic pieces as
+    ``*``); ``[]`` when the expression is not statically path-shaped.
+
+    Handles constants, f-strings, ``+`` concatenation, ``%`` formatting,
+    conditional expressions, and (when ``fn_node`` is given) local names
+    resolved through their assignments in the enclosing function — the
+    ``path = f"...:resume"`` / ``path = f"...:generate"`` idiom.
+    """
+    s = _const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return ["".join(parts)]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        lefts = _pattern_exprs(node.left, fn_node, _depth)
+        rights = _pattern_exprs(node.right, fn_node, _depth)
+        if lefts and rights:
+            return [a + b for a in lefts for b in rights]
+        return []
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        left = _const_str(node.left)
+        if left is not None:
+            return [_PCT_RE.sub("*", left)]
+        return []
+    if isinstance(node, ast.IfExp):
+        a = _pattern_exprs(node.body, fn_node, _depth)
+        b = _pattern_exprs(node.orelse, fn_node, _depth)
+        return a + b if a and b else []
+    if isinstance(node, ast.Name) and fn_node is not None and _depth < 3:
+        out = []
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and n.targets[0].id == node.id and n.value is not node:
+                got = _pattern_exprs(n.value, fn_node, _depth + 1)
+                if not got:
+                    return []      # one dynamic rebind poisons the name
+                out.extend(got)
+        return out
+    return []
+
+
+# ---------------------------------------------------------------------------
+# server route table
+
+
+def _is_pathish(expr):
+    """Does this expression read the request path?  Matches ``path``
+    locals, ``self.path``, and anything chained off them."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and "path" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "path" in n.attr.lower():
+            return True
+    return False
+
+
+def _endswith_const(expr):
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr == "endswith" and expr.args \
+            and _is_pathish(expr.func.value):
+        return _const_str(expr.args[0])
+    return None
+
+
+def _route_tests(test, fn_node):
+    """``[(pattern, kind)]`` for every route predicate in a boolean
+    expression (Or unions, And combines startswith+endswith)."""
+    out = []
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+        for v in test.values:
+            out.extend(_route_tests(v, fn_node))
+        return out
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        prefixes, suffix, others = [], None, []
+        for v in test.values:
+            for pat, kind in _route_tests(v, fn_node):
+                (prefixes if kind == "prefix" else others).append((pat, kind))
+            suffix = suffix or _endswith_const(v)
+        if prefixes and suffix:
+            pat = prefixes[0][0]
+            return [(pat + suffix if pat.endswith("*") else pat, "verb")]
+        return prefixes or others
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        op = test.ops[0]
+        left, right = test.left, test.comparators[0]
+        if isinstance(op, ast.Eq):
+            for a, b in ((left, right), (right, left)):
+                if _is_pathish(a):
+                    pats = _pattern_exprs(b, fn_node)
+                    return [(p, "verb" if "*" in p else "exact")
+                            for p in pats]
+        if isinstance(op, ast.In) and _is_pathish(left) \
+                and isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+            return [(p, "exact") for elt in right.elts
+                    for p in _pattern_exprs(elt, fn_node)]
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Attribute) \
+            and test.func.attr == "startswith" and test.args \
+            and _is_pathish(test.func.value):
+        return [(p + "*", "prefix")
+                for p in _pattern_exprs(test.args[0], fn_node)]
+    return []
+
+
+def _status_summary(cg, fi, memo, _active=None):
+    """``(codes, param_idxs)``: literal status codes ``fi`` can pass to
+    ``send_response`` (directly or through helpers like ``_send``), and
+    the indices of its own params that flow into one."""
+    key = id(fi.node)
+    if key in memo:
+        return memo[key]
+    _active = _active if _active is not None else set()
+    if key in _active:
+        return set(), set()
+    _active.add(key)
+    codes, params = set(), set()
+    for call in ast.walk(fi.node):
+        if not isinstance(call, ast.Call):
+            continue
+        for c, p in _codes_for_call(call, cg, fi, memo, _active):
+            if p is not None:
+                params.add(p)
+            else:
+                codes.add(c)
+    _active.discard(key)
+    memo[key] = (codes, params)
+    return memo[key]
+
+
+def _code_values(expr, fi):
+    """Status values of a code argument: ``[(code, None)]`` for
+    literals / dynamic ``"*"``, ``[(None, idx)]`` for a forwarded
+    param of ``fi``."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return [(int(expr.value), None)]
+    if isinstance(expr, ast.IfExp):
+        return _code_values(expr.body, fi) + _code_values(expr.orelse, fi)
+    if isinstance(expr, ast.Name) and fi is not None and expr.id in fi.params:
+        return [(None, fi.params.index(expr.id))]
+    return [("*", None)]
+
+
+def _callee_of(call, cg, fi):
+    """(FunctionInfo, arg_offset) for a call, or (None, 0).  Falls back
+    to nothing here — name-fallback is emission-specific."""
+    callee = cg.resolve_call(call.func, fi)
+    if callee is None:
+        return None, 0
+    offset = 1 if (callee.cls is not None
+                   and isinstance(call.func, ast.Attribute)) else 0
+    return callee, offset
+
+
+def _call_arg(call, idx, offset, callee):
+    """The expression bound to the callee's param ``idx``."""
+    pos = idx - offset
+    if 0 <= pos < len(call.args):
+        return call.args[pos]
+    params = callee.params
+    if idx < len(params):
+        for kw in call.keywords:
+            if kw.arg == params[idx]:
+                return kw.value
+    return None
+
+
+def _codes_for_call(call, cg, fi, memo, _active=None):
+    """Status codes one call contributes (direct send_response, or a
+    helper whose summary forwards a code param)."""
+    out = []
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "send_response":
+        if call.args:
+            out.extend(_code_values(call.args[0], fi))
+        return out
+    callee, offset = _callee_of(call, cg, fi)
+    if callee is None or callee is fi:
+        return out
+    sub_codes, sub_params = _status_summary(cg, callee, memo, _active)
+    for c in sub_codes:
+        out.append((c, None))
+    for idx in sub_params:
+        arg = _call_arg(call, idx, offset, callee)
+        if arg is not None:
+            out.extend(_code_values(arg, fi))
+    return out
+
+
+def _statuses_in(stmts, cg, fi, memo):
+    codes = set()
+    for st in stmts:
+        for call in ast.walk(st):
+            if isinstance(call, ast.Call):
+                for c, p in _codes_for_call(call, cg, fi, memo):
+                    if p is None:
+                        codes.add(c)
+    return codes
+
+
+def _extract_routes(cg, memo):
+    """Every Endpoint in every handler class of the project."""
+    endpoints = []
+    for mi in cg.modules.values():
+        layer = mi.modname.rsplit(".", 1)[-1]
+        for ci in mi.classes.values():
+            for mname, fi in sorted(ci.methods.items()):
+                if not mname.startswith("do_") or len(mname) <= 3:
+                    continue
+                method = mname[3:].upper()
+                if method not in HTTP_METHODS:
+                    continue
+                endpoints.extend(
+                    _routes_of_handler(cg, mi, layer, fi, method, memo))
+    return endpoints
+
+
+def _routes_of_handler(cg, mi, layer, fi, method, memo):
+    branch_routes = []       # (routes, body stmts)
+    assign_routes = []       # (routes, lineno)
+    attributed = set()       # stmt ids inside attributed route bodies
+
+    def scan(stmts):
+        for st in stmts:
+            if isinstance(st, ast.If):
+                routes = _route_tests(st.test, fi.node)
+                if routes:
+                    branch_routes.append((routes, st.body, st.lineno))
+                    for b in st.body:
+                        attributed.add(id(b))
+                    scan(st.orelse)
+                    continue
+                scan(st.body)
+                scan(st.orelse)
+            elif isinstance(st, ast.Assign):
+                routes = _route_tests(st.value, fi.node)
+                if routes:
+                    assign_routes.append((routes, st.lineno))
+            elif isinstance(st, (ast.Try,)):
+                scan(st.body)
+                for h in st.handlers:
+                    scan(h.body)
+                scan(st.orelse)
+                scan(st.finalbody)
+            elif isinstance(st, (ast.With, ast.For, ast.While)):
+                scan(st.body)
+                scan(getattr(st, "orelse", []))
+
+    scan(fi.node.body)
+
+    # statuses emitted outside any attributed route branch: the shared
+    # tail (404 fallthrough, draining 503, the predict/generate try) —
+    # attached to the assignment-matched routes, which is where the
+    # shared tail's work happens
+    residual = set()
+
+    def residual_scan(stmts):
+        for st in stmts:
+            if id(st) in attributed:
+                continue
+            if isinstance(st, ast.If):
+                for call in ast.walk(st.test):
+                    if isinstance(call, ast.Call):
+                        for c, p in _codes_for_call(call, cg, fi, memo):
+                            if p is None:
+                                residual.add(c)
+                residual_scan(st.body)
+                residual_scan(st.orelse)
+            elif isinstance(st, ast.Try):
+                residual_scan(st.body)
+                for h in st.handlers:
+                    residual_scan(h.body)
+                residual_scan(st.orelse)
+                residual_scan(st.finalbody)
+            elif isinstance(st, (ast.With, ast.For, ast.While)):
+                residual_scan(st.body)
+                residual_scan(getattr(st, "orelse", []))
+            else:
+                residual.update(_statuses_in([st], cg, fi, memo))
+
+    residual_scan(fi.node.body)
+
+    out = []
+    for routes, body, lineno in branch_routes:
+        statuses = frozenset(_statuses_in(body, cg, fi, memo))
+        for pat, kind in routes:
+            out.append(Endpoint(method=method, path=_norm(pat), layer=layer,
+                                handler=fi.qualname, line=lineno, kind=kind,
+                                statuses=tuple(sorted(statuses, key=str))))
+    res = tuple(sorted(residual, key=str))
+    for routes, lineno in assign_routes:
+        for pat, kind in routes:
+            out.append(Endpoint(method=method, path=_norm(pat), layer=layer,
+                                handler=fi.qualname, line=lineno, kind=kind,
+                                statuses=res))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# client emission sites
+
+
+@dataclasses.dataclass
+class _Emit:
+    """One way a function puts bytes on the wire: each slot is
+    ``("lit", value)``, ``("param", idx)``, or ``None`` (dynamic)."""
+    method: object
+    path: object
+    site: object               # the ast.Call at this function's level
+    chain: tuple               # FunctionInfo chain down to conn.request
+
+    def key(self):
+        return (self.method, self.path)
+
+
+def _is_base_emit(call):
+    """``X.request(method, path, ...)`` — a direct wire emission site
+    (``self.request`` would be handler-side, not a client)."""
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "request" and len(call.args) >= 2
+            and not (isinstance(call.func.value, ast.Name)
+                     and call.func.value.id == "self"))
+
+
+def _slot(expr, params, fn_node, verb):
+    s = _const_str(expr)
+    if verb:
+        if s is not None:
+            return ("lit", s.upper()) if s.upper() in HTTP_METHODS else None
+        if isinstance(expr, ast.Name) and expr.id in params:
+            return ("param", params.index(expr.id))
+        return None
+    pats = _pattern_exprs(expr, fn_node)
+    if pats:
+        return ("lit", tuple(_norm(p) for p in pats))
+    if isinstance(expr, ast.Name) and expr.id in params:
+        return ("param", params.index(expr.id))
+    return None
+
+
+def _emitters_fixpoint(cg):
+    """Propagate emitter summaries up the wrapper chain; returns
+    ``(emissions, relays, call_sites)`` where emissions are concrete
+    ``_Emit``s with both slots literal, attributed to the function that
+    pinned them, and ``call_sites`` maps FunctionInfo -> [(caller,
+    call node)] for the retry-context scan."""
+    funcs = list(cg.info_by_node.values())
+    summaries = {}            # FunctionInfo -> [_Emit with a param slot]
+    emissions, relays = [], []
+
+    def classify(em):
+        meth, path = em.method, em.path
+        if meth is not None and meth[0] == "lit":
+            if path is not None and path[0] == "lit":
+                emissions.append(em)
+                return
+            if path is None:
+                relays.append(em)
+                return
+        if (meth is not None and meth[0] == "param") or \
+                (path is not None and path[0] == "param"):
+            summaries.setdefault(em.chain[0], []).append(em)
+
+    # One sweep over every AST: pick up the base emission sites and
+    # resolve every call exactly once.  The fixpoint rounds below then
+    # touch only the (few) calls aimed at summary-holding wrappers
+    # instead of re-walking the whole project per round.
+    call_sites = {}
+    sites_seen = set()
+    calls_to = {}             # FunctionInfo -> [(caller, call, offset)]
+    unresolved = {}           # terminal name -> [(caller, call)]
+    for caller in funcs:
+        params = caller.params
+        for call in ast.walk(caller.node):
+            if not isinstance(call, ast.Call):
+                continue
+            if _is_base_emit(call):
+                classify(_Emit(
+                    method=_slot(call.args[0], params, caller.node,
+                                 verb=True),
+                    path=_slot(call.args[1], params, caller.node,
+                               verb=False),
+                    site=call, chain=(caller,)))
+            callee, offset = _callee_of(call, cg, caller)
+            if callee is None:
+                # `gw._request(...)` — the receiver is a local, so the
+                # callgraph punts; remember the terminal name for the
+                # unique-wrapper fallback resolved per round below
+                term = call.func.attr \
+                    if isinstance(call.func, ast.Attribute) else \
+                    (call.func.id if isinstance(call.func, ast.Name)
+                     else None)
+                if term is not None:
+                    unresolved.setdefault(term, []).append((caller, call))
+                continue
+            calls_to.setdefault(callee, []).append((caller, call, offset))
+            sk = (id(caller.node), id(call), id(callee.node))
+            if sk not in sites_seen:
+                sites_seen.add(sk)
+                call_sites.setdefault(callee, []).append((caller, call))
+
+    emit_seen = set()
+    for _ in range(8):
+        grown = False
+        names = {}
+        for fi in summaries:
+            names.setdefault(fi.name, []).append(fi)
+        work = []
+        for callee in list(summaries):
+            for caller, call, offset in calls_to.get(callee, ()):
+                work.append((caller, call, callee, offset))
+            for caller, call in unresolved.get(callee.name, ()):
+                # fall back to a unique name match among known emitter
+                # wrappers — ambiguous names stay unresolved
+                if len(names.get(callee.name, ())) != 1:
+                    continue
+                offset = 1 if isinstance(call.func, ast.Attribute) else 0
+                sk = (id(caller.node), id(call), id(callee.node))
+                if sk not in sites_seen:
+                    sites_seen.add(sk)
+                    call_sites.setdefault(callee, []).append((caller, call))
+                work.append((caller, call, callee, offset))
+        for caller, call, callee, offset in work:
+            for em in list(summaries.get(callee, ())):
+                new = _derive(em, call, offset, callee, caller,
+                              caller.params)
+                if new is None:
+                    continue
+                if new.method and new.method[0] == "lit" and \
+                        new.path and new.path[0] == "lit":
+                    k = (id(caller.node), call.lineno, new.key())
+                    if k not in emit_seen:
+                        emit_seen.add(k)
+                        emissions.append(new)
+                elif new.method and new.method[0] == "lit" and \
+                        new.path is None:
+                    k = (id(caller.node), call.lineno, "relay")
+                    if k not in emit_seen:
+                        emit_seen.add(k)
+                        relays.append(new)
+                else:
+                    have = summaries.setdefault(caller, [])
+                    if all(h.key() != new.key() or
+                           h.chain != new.chain for h in have):
+                        have.append(new)
+                        grown = True
+        if not grown:
+            break
+    return emissions, relays, call_sites
+
+
+def _derive(em, call, offset, callee, caller, caller_params):
+    def rebind(slot):
+        if slot is None or slot[0] == "lit":
+            return slot
+        arg = _call_arg(call, slot[1], offset, callee)
+        if arg is None:
+            return None
+        return _slot(arg, caller_params, caller.node,
+                     verb=(slot is em.method))
+    meth = rebind(em.method)
+    path = rebind(em.path)
+    if meth is None and path is None:
+        return None
+    return _Emit(method=meth, path=path, site=call,
+                 chain=(caller,) + em.chain)
+
+
+def _header_keys(fn_node):
+    keys = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                s = _const_str(k) if k is not None else None
+                if s and _HEADER_RE.match(s):
+                    keys.add(s)
+        elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Subscript):
+            s = _const_str(n.targets[0].slice)
+            if s and _HEADER_RE.match(s):
+                keys.add(s)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in ("putheader", "setdefault") and n.args:
+            s = _const_str(n.args[0])
+            if s and _HEADER_RE.match(s):
+                keys.add(s)
+    return keys
+
+
+def _payload_fields(fn_node):
+    fields = set()
+    for n in ast.walk(fn_node):
+        tgt = None
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            tgt = n.targets[0]
+            if isinstance(tgt, ast.Subscript) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and any(tgt.value.id.startswith(b) for b in _BODYISH):
+                s = _const_str(tgt.slice)
+                if s:
+                    fields.add(s)
+            elif isinstance(tgt, ast.Name) \
+                    and any(tgt.id.startswith(b) for b in _BODYISH) \
+                    and isinstance(n.value, ast.Dict):
+                for k in n.value.keys:
+                    s = _const_str(k) if k is not None else None
+                    if s:
+                        fields.add(s)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "setdefault" and n.args \
+                and isinstance(n.func.value, ast.Name) \
+                and any(n.func.value.id.startswith(b) for b in _BODYISH):
+            s = _const_str(n.args[0])
+            if s:
+                fields.add(s)
+    return fields
+
+
+def _status_checks(fn_node):
+    """``(consts, has_range)``: codes this function's ``.status``
+    comparisons single out, and whether any class-boundary comparison
+    (``>= 500``, ``400 <= s < 500``) exists."""
+    consts, has_range = set(), False
+
+    def statusish(e):
+        return (isinstance(e, ast.Attribute) and e.attr == "status") or \
+               (isinstance(e, ast.Name) and e.id == "status")
+
+    for n in ast.walk(fn_node):
+        if not isinstance(n, ast.Compare):
+            continue
+        operands = [n.left] + list(n.comparators)
+        if not any(statusish(o) for o in operands):
+            continue
+        for op, lhs, rhs in zip(n.ops, operands, operands[1:]):
+            other = rhs if statusish(lhs) else lhs
+            if isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE)):
+                has_range = True
+            elif isinstance(op, (ast.Eq, ast.NotEq)):
+                if isinstance(other, ast.Constant) \
+                        and isinstance(other.value, int):
+                    consts.add(int(other.value))
+            elif isinstance(op, (ast.In, ast.NotIn)):
+                if isinstance(other, (ast.Tuple, ast.List, ast.Set)):
+                    for elt in other.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, int):
+                            consts.add(int(elt.value))
+    return consts, has_range
+
+
+def _is_retry_loop(node):
+    if isinstance(node, ast.For):
+        names = {x.id.lower() for x in ast.walk(node.target)
+                 if isinstance(x, ast.Name)}
+        if any("attempt" in s or "retr" in s for s in names):
+            return True
+        it = node.iter
+        if isinstance(it, ast.Call):
+            f = it.func
+            nm = f.attr if isinstance(f, ast.Attribute) \
+                else getattr(f, "id", "")
+            return nm in ("sleeps", "retries", "backoff", "attempts")
+        return False
+    if isinstance(node, ast.While):
+        return any(isinstance(x, ast.Name)
+                   and ("attempt" in x.id.lower() or "retr" in x.id.lower())
+                   for x in ast.walk(node.test))
+    return False
+
+
+def _in_retry_loop(fn_node, target):
+    for n in ast.walk(fn_node):
+        if isinstance(n, (ast.For, ast.While)) and _is_retry_loop(n):
+            for sub in ast.walk(n):
+                if sub is target:
+                    return True
+    return False
+
+
+def _client_calls(cg):
+    emissions, relays, call_sites = _emitters_fixpoint(cg)
+    out = []
+    for em in emissions:
+        top = em.chain[0]
+        headers, fields = set(), set()
+        consts, has_range = set(), False
+        for fi in em.chain:
+            headers |= _header_keys(fi.node)
+            fields |= _payload_fields(fi.node)
+            c, r = _status_checks(fi.node)
+            consts |= c
+            has_range = has_range or r
+        retried = _in_retry_loop(top.node, em.site) or any(
+            _in_retry_loop(caller.node, call)
+            for caller, call in call_sites.get(top, ()))
+        # distinct pattern exprs can normalize identically (e.g. a
+        # querystring-only IfExp); emit each pattern once
+        for pat in dict.fromkeys(em.path[1]):
+            out.append(ClientCall(
+                method=em.method[1], path=pat,
+                layer=top.module.modname.rsplit(".", 1)[-1],
+                caller=top.qualname, line=em.site.lineno,
+                headers=tuple(sorted(headers)),
+                body_fields=tuple(sorted(fields)),
+                statuses=tuple(sorted(consts)) + (("range",)
+                                                  if has_range else ()),
+                retried=retried))
+    relay_calls = []
+    for em in relays:
+        top = em.chain[0]
+        relay_calls.append(ClientCall(
+            method=em.method[1], path=None,
+            layer=top.module.modname.rsplit(".", 1)[-1],
+            caller=top.qualname, line=em.site.lineno))
+    return out, relay_calls
+
+
+# ---------------------------------------------------------------------------
+# message planes
+
+
+def _receiveish(call):
+    return isinstance(call, ast.Call) \
+        and isinstance(call.func, ast.Attribute) \
+        and call.func.attr in ("receive", "recv", "recv_msg", "read_msg")
+
+
+def _plane_vars(fi, key):
+    """Names in ``fi`` that hold a received message dict or its
+    dispatch key: receive() results, dispatch/serve params, and
+    ``mtype = msg.get(key)`` re-bindings."""
+    msg_vars = set()
+    dispatchish = any(tok in fi.name.lower()
+                      for tok in ("dispatch", "serve", "handle"))
+    if dispatchish:
+        msg_vars.update(p for p in fi.params if p != "self")
+    for n in ast.walk(fi.node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and _receiveish(n.value):
+            msg_vars.add(n.targets[0].id)
+    key_vars = set()
+    for _ in range(2):
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                src = _key_read(n.value, msg_vars, key)
+                if src:
+                    key_vars.add(n.targets[0].id)
+    return msg_vars, key_vars
+
+
+def _key_read(expr, msg_vars, key):
+    """Is ``expr`` a read of the plane key from a message var?"""
+    if isinstance(expr, ast.Subscript) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id in msg_vars \
+            and _const_str(expr.slice) == key:
+        return True
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr == "get" and expr.args \
+            and isinstance(expr.func.value, ast.Name) \
+            and expr.func.value.id in msg_vars \
+            and _const_str(expr.args[0]) == key:
+        return True
+    return False
+
+
+def _handled_cases(fi, key, layer):
+    msg_vars, key_vars = _plane_vars(fi, key)
+    if not msg_vars and not key_vars:
+        return []
+    out = []
+    for n in ast.walk(fi.node):
+        if not isinstance(n, ast.Compare):
+            continue
+        operands = [n.left] + list(n.comparators)
+        keyish = [o for o in operands
+                  if _key_read(o, msg_vars, key)
+                  or (isinstance(o, ast.Name) and o.id in key_vars)]
+        if not keyish:
+            continue
+        for op, lhs, rhs in zip(n.ops, operands, operands[1:]):
+            other = rhs if keyish[0] is lhs or lhs in keyish else lhs
+            if isinstance(op, (ast.Eq, ast.NotEq)):
+                s = _const_str(other)
+                if s is not None:
+                    out.append(MessageCase(key=key, value=s, side="handle",
+                                           layer=layer, where=fi.qualname,
+                                           line=n.lineno))
+            elif isinstance(op, (ast.In, ast.NotIn)) \
+                    and isinstance(other, (ast.Tuple, ast.List, ast.Set)):
+                for elt in other.elts:
+                    s = _const_str(elt)
+                    if s is not None:
+                        out.append(MessageCase(key=key, value=s,
+                                               side="handle", layer=layer,
+                                               where=fi.qualname,
+                                               line=n.lineno))
+    return out
+
+
+_SENDISH = ("send", "_request", "request", "reply", "send_msg")
+
+
+def _emitted_cases(fi, key, layer):
+    out = []
+    local_dicts = {}
+    for n in ast.walk(fi.node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Dict):
+            local_dicts[n.targets[0].id] = n.value
+
+    def dict_case(d, line):
+        for k, v in zip(d.keys, d.values):
+            if k is not None and _const_str(k) == key:
+                s = _const_str(v)
+                if s is not None:
+                    out.append(MessageCase(key=key, value=s, side="emit",
+                                           layer=layer, where=fi.qualname,
+                                           line=line))
+
+    for n in ast.walk(fi.node):
+        if not isinstance(n, ast.Call):
+            continue
+        fname = n.func.attr if isinstance(n.func, ast.Attribute) \
+            else (n.func.id if isinstance(n.func, ast.Name) else None)
+        if fname not in _SENDISH:
+            continue
+        for arg in list(n.args) + [kw.value for kw in n.keywords]:
+            if isinstance(arg, ast.Dict):
+                dict_case(arg, n.lineno)
+            elif isinstance(arg, ast.Name) and arg.id in local_dicts:
+                dict_case(local_dicts[arg.id], n.lineno)
+    return out
+
+
+def _message_cases(cg):
+    cases = []
+    for mi in cg.modules.values():
+        layer = mi.modname.rsplit(".", 1)[-1]
+        key = MESSAGE_PLANES.get(layer)
+        if key is None:
+            continue
+        for fi in cg.info_by_node.values():
+            if fi.module is not mi:
+                continue
+            cases.extend(_handled_cases(fi, key, layer))
+            cases.extend(_emitted_cases(fi, key, layer))
+    return cases
+
+
+# ---------------------------------------------------------------------------
+# propagated contract fields
+
+
+def _writes_field(fi, field, cg, depth=2, _seen=None):
+    _seen = _seen if _seen is not None else set()
+    if id(fi.node) in _seen:
+        return False
+    _seen.add(id(fi.node))
+    for n in ast.walk(fi.node):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if k is not None and _const_str(k) == field:
+                    return True
+        elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Subscript) \
+                and _const_str(n.targets[0].slice) == field:
+            return True
+        elif isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "setdefault" and n.args \
+                    and _const_str(n.args[0]) == field:
+                return True
+            if isinstance(n.func, ast.Name) and n.func.id == "dict" \
+                    and any(kw.arg == field for kw in n.keywords):
+                return True
+    if depth <= 0:
+        return False
+    for n in ast.walk(fi.node):
+        if isinstance(n, ast.Call):
+            callee = cg.resolve_call(n.func, fi)
+            if callee is not None and \
+                    _writes_field(callee, field, cg, depth - 1, _seen):
+                return True
+    return False
+
+
+def _resolve_carrier(cg, pattern):
+    mod, _, func = pattern.rpartition(".")
+    for fi in cg.info_by_node.values():
+        if fi.name == func and \
+                fi.module.modname.rsplit(".", 1)[-1] == mod:
+            return fi
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the model + rules
+
+
+@dataclasses.dataclass
+class _Model:
+    endpoints: list
+    clients: list
+    relays: list
+    messages: list
+    findings: list
+    field_table: list
+
+
+def _ep_regex(path):
+    return re.compile("".join(".*" if ch == "*" else re.escape(ch)
+                              for ch in path))
+
+
+def _matches(ep, method, pattern):
+    if ep.method != method:
+        return False
+    return _ep_regex(ep.path).fullmatch(pattern.replace("*", "\x00")) \
+        is not None
+
+
+def _build(project):
+    cg = callgraph_mod.for_project(project)
+    memo = {}
+    endpoints = _extract_routes(cg, memo)
+    clients, relays = _client_calls(cg)
+    messages = _message_cases(cg)
+    findings = []
+
+    def path_of(qualname_layer):
+        # findings anchor to the module file of the layer they concern
+        for mi in cg.modules.values():
+            if mi.modname.rsplit(".", 1)[-1] == qualname_layer:
+                return mi.path
+        return None
+
+    # wire-unhandled-endpoint (HTTP side)
+    for cc in clients:
+        if not any(_matches(ep, cc.method, cc.path) for ep in endpoints):
+            findings.append(Finding(
+                path_of(cc.layer) or "", cc.line, "wire-unhandled-endpoint",
+                f"{cc.caller} emits {cc.method} {cc.path} but no handler "
+                f"routes it (known routes miss this method/path pair)"))
+
+    # wire-dead-endpoint (HTTP side)
+    for ep in endpoints:
+        if (ep.method, ep.path) in EXTERNAL_ENDPOINTS:
+            continue
+        if not any(ep.method == cc.method and _matches(ep, cc.method, cc.path)
+                   for cc in clients):
+            findings.append(Finding(
+                path_of(ep.layer) or "", ep.line, "wire-dead-endpoint",
+                f"route {ep.method} {ep.path} ({ep.handler}) has no "
+                f"in-repo client emission and is not declared in "
+                f"protocol.EXTERNAL_ENDPOINTS"))
+
+    # message planes: emitted-but-unhandled / handled-but-unemitted
+    handled = {(m.key, m.value) for m in messages if m.side == "handle"}
+    emitted = {(m.key, m.value) for m in messages if m.side == "emit"}
+    for m in messages:
+        if m.side == "emit" and (m.key, m.value) not in handled \
+                and (m.key, m.value) not in ACK_MESSAGES:
+            findings.append(Finding(
+                path_of(m.layer) or "", m.line, "wire-unhandled-endpoint",
+                f'{m.where} sends {{"{m.key}": "{m.value}"}} but no '
+                f"dispatch case handles it (and it is not a declared "
+                f"ack frame)"))
+        elif m.side == "handle" and (m.key, m.value) not in emitted:
+            findings.append(Finding(
+                path_of(m.layer) or "", m.line, "wire-dead-endpoint",
+                f'{m.where} dispatches on {{"{m.key}": "{m.value}"}} '
+                f"but nothing in the repo emits that frame"))
+
+    # wire-dropped-field
+    field_table = []
+    for spec in FIELD_SPECS:
+        row = {"field": spec.field, "description": spec.description,
+               "carriers": []}
+        for pattern in spec.carriers:
+            fi = _resolve_carrier(cg, pattern)
+            entry = {"carrier": pattern,
+                     "resolved": fi.qualname if fi else None,
+                     "writes": None}
+            if fi is not None:
+                ok = _writes_field(fi, spec.field, cg)
+                entry["writes"] = bool(ok)
+                if not ok:
+                    findings.append(Finding(
+                        fi.module.path, fi.node.lineno, "wire-dropped-field",
+                        f"carrier {fi.qualname} does not write contract "
+                        f"field '{spec.field}' into any payload "
+                        f"({spec.description})"))
+            row["carriers"].append(entry)
+        field_table.append(row)
+
+    # wire-status-unhandled
+    for cc in clients:
+        if not cc.retried:
+            continue
+        consts = {c for c in cc.statuses if isinstance(c, int)}
+        if "range" in cc.statuses or not consts \
+                or not all(200 <= c < 300 for c in consts):
+            continue
+        for ep in endpoints:
+            if not _matches(ep, cc.method, cc.path):
+                continue
+            perm = sorted(c for c in ep.statuses if isinstance(c, int)
+                          and 400 <= c < 500 and c not in RETRYABLE_4XX)
+            if perm:
+                findings.append(Finding(
+                    path_of(cc.layer) or "", cc.line,
+                    "wire-status-unhandled",
+                    f"{cc.caller} retries {cc.method} {cc.path} but only "
+                    f"distinguishes status {sorted(consts)}; the route "
+                    f"({ep.handler}) can answer permanent "
+                    f"{perm} which would be retried as if transient"))
+                break
+
+    findings = [f for f in findings if f.path]
+    return _Model(endpoints=endpoints, clients=clients, relays=relays,
+                 messages=messages, findings=findings,
+                 field_table=field_table)
+
+
+def model_for(project):
+    model = getattr(project, "_wireproto_model", None)
+    if model is None:
+        model = _build(project)
+        project._wireproto_model = model
+    return model
+
+
+def protocol_dump(project):
+    """The machine-readable contract: ``--format protocol``."""
+    m = model_for(project)
+    ext = [{"method": k[0], "path": k[1], "rationale": v}
+           for k, v in sorted(EXTERNAL_ENDPOINTS.items())]
+    acks = [{"key": k[0], "value": k[1], "rationale": v}
+            for k, v in sorted(ACK_MESSAGES.items())]
+    return {
+        "version": 1,
+        "endpoints": [e.as_dict() for e in sorted(
+            m.endpoints, key=lambda e: (e.layer, e.method, e.path))],
+        "clients": [c.as_dict() for c in sorted(
+            m.clients, key=lambda c: (c.layer, c.caller, c.line))],
+        "relays": [c.as_dict() for c in sorted(
+            m.relays, key=lambda c: (c.layer, c.caller, c.line))],
+        "messages": [c.as_dict() for c in sorted(
+            m.messages, key=lambda c: (c.layer, c.side, c.value, c.line))],
+        "fields": m.field_table,
+        "external_endpoints": ext,
+        "ack_messages": acks,
+    }
+
+
+class _WireRule(Rule):
+    """All four rules share one cached protocol extraction per run."""
+
+    def check(self, ctx):
+        if ctx.project is None:
+            return
+        model = model_for(ctx.project)
+        mine = _posix(ctx.path)
+        for f in model.findings:
+            if f.rule == self.name and _posix(f.path) == mine:
+                yield f
+
+
+@register
+class UnhandledEndpointRule(_WireRule):
+    name = "wire-unhandled-endpoint"
+    description = ("a client emission (HTTP request or message frame) "
+                   "that no server route or dispatch case handles")
+
+
+@register
+class DeadEndpointRule(_WireRule):
+    name = "wire-dead-endpoint"
+    description = ("a server route or dispatch case no in-repo client "
+                   "emits, and not a declared operator-only surface")
+
+
+@register
+class DroppedFieldRule(_WireRule):
+    name = "wire-dropped-field"
+    description = ("a declared carrier (relay body, wire snapshot, job "
+                   "record) stopped writing a propagated contract field "
+                   "(priority/trace/seed/Idempotency-Key)")
+
+
+@register
+class StatusUnhandledRule(_WireRule):
+    name = "wire-status-unhandled"
+    description = ("a retried emission whose status checks cannot tell "
+                   "a permanent 4xx from a transient failure, against a "
+                   "route that really emits one")

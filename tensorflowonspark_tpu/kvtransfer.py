@@ -53,6 +53,17 @@ logger = logging.getLogger(__name__)
 
 WIRE_VERSION = 1
 
+
+class ResumeRefused(ValueError):
+    """Destination answered ``:resume`` with a permanent 4xx.
+
+    The replica validates the snapshot eagerly, so a 4xx means THIS
+    payload can never land there (wire-version mismatch, malformed
+    meta, unknown model) — retrying the identical POST only burns the
+    migration deadline.  Transient failures (connect errors, 5xx,
+    refused ack) stay plain OSError/ValueError and keep retrying.
+    """
+
 # Page blocks are shipped in slices well under the frame cap: each frame
 # is one msgpack bin that must be materialized whole on both sides, so
 # smaller chunks bound peak memory and keep the receiver's read loop
@@ -157,9 +168,14 @@ def wire_snapshot(frozen, model_name, page_size=0):
             "topk": int(item["topk"]), "topp": float(item["topp"]),
             "minp": float(item["minp"]), "stops": item["stops"],
             "rep": float(item["rep"]), "adapter": item.get("adapter"),
-            # the request's trace id crosses the wire with the session
-            # (like priority): the destination's spans join the same
-            # stitched timeline
+            # the request's priority class and trace id cross the wire
+            # with the session: the destination must re-admit under the
+            # same scheduling class (a migrated batch session must not
+            # resume as interactive), and its spans join the same
+            # stitched timeline.  The resume side treats a missing or
+            # unknown class as its default, so parked local snapshots
+            # (cls may be None) stay restorable.
+            "priority": item.get("cls"),
             "trace": item.get("trace")}
     blocks = {}
     for name, arr in frozen["kv"].items():
@@ -382,6 +398,14 @@ class MigrationEngine:
                 try:
                     conn, resp, first = self._post_resume(
                         dest, meta, ticket, min(budget, timeout_s))
+                except ResumeRefused as e:
+                    # permanent: the destination will refuse this
+                    # snapshot every time — fail fast to the rollback
+                    # path instead of burning the deadline on retries
+                    last_err = "attempt %d: %s" % (attempt + 1, e)
+                    logger.warning("kv migrate to %s refused (%s)",
+                                   dest, last_err)
+                    break
                 except (OSError, ValueError) as e:
                     last_err = "attempt %d: %s" % (attempt + 1, e)
                     logger.warning("kv migrate to %s failed (%s)",
@@ -474,9 +498,11 @@ class MigrationEngine:
             resp = conn.getresponse()
             if resp.status != 200:
                 data = resp.read()
-                raise ValueError("resume rejected: HTTP %d %s"
-                                 % (resp.status,
-                                    data.decode("utf-8", "replace")[:200]))
+                detail = "resume rejected: HTTP %d %s" % (
+                    resp.status, data.decode("utf-8", "replace")[:200])
+                if 400 <= resp.status < 500:
+                    raise ResumeRefused(detail)
+                raise ValueError(detail)
             line = resp.readline()
             if not line:
                 raise ValueError("resume stream closed before ack")

@@ -2833,7 +2833,6 @@ class ContinuousBatcher:
         except BaseException:
             self.rollback_migration(frozen)
             raise
-        meta["priority"] = frozen["item"].get("cls") or "interactive"
         self.complete_migration(frozen)
         self.counters.inc("sessions_parked")
         self.trace.event(meta.get("trace"), "park",

@@ -20,8 +20,8 @@ sys.path.insert(0, REPO)
 
 from tensorflowonspark_tpu.analysis import core  # noqa: E402
 from tensorflowonspark_tpu.analysis import (  # noqa: E402,F401  (registers rules)
-    hostsync, locks, pallas_tiles, recompile, shardlint, style, threads,
-    tracer)
+    hostsync, lifecycle, locks, pallas_tiles, recompile, shardlint, style,
+    threads, tracer, wireproto)
 
 MESH_AXES = {"dp", "fsdp", "pp", "tp"}
 
@@ -631,3 +631,20 @@ def test_cli_json_and_list_rules():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     data = json.loads(proc.stdout)
     assert data["findings"] == []
+
+
+def test_sarif_help_uris_resolve_to_docs_anchors():
+    """Every registered rule's SARIF helpUri points at a real
+    ``.. _rule-<name>:`` anchor in docs/source/analysis.rst — the link
+    CI code-scanning UIs surface next to each finding must not 404."""
+    doc = open(os.path.join(REPO, "docs", "source", "analysis.rst"),
+               encoding="utf-8").read()
+    report = core.sarif_report([])   # empty findings -> all rules listed
+    rules = report["runs"][0]["tool"]["driver"]["rules"]
+    assert {r["id"] for r in rules} == set(core.REGISTRY)
+    for r in rules:
+        base, _, frag = r["helpUri"].partition("#")
+        assert base == "docs/source/analysis.rst", r["id"]
+        assert frag == f"rule-{r['id']}", r["id"]
+        assert f".. _{frag}:" in doc, \
+            f"no docs anchor for rule {r['id']} (expected '.. _{frag}:')"

@@ -658,9 +658,10 @@ def test_baseline_shrink_only_guard(tmp_path):
 
 
 def test_repo_wide_scan_under_wall_clock_budget():
-    """Acceptance: the full scan (interprocedural rules AND the
-    lifecycle typestate pass included) stays under the 10 s budget,
-    and --stats makes the budget attributable per rule."""
+    """Acceptance: the full scan (interprocedural rules, the lifecycle
+    typestate pass AND the wireproto contract pass included) stays
+    under the 10 s budget, and --stats makes it attributable per
+    rule."""
     t0 = time.monotonic()
     proc = _cli(["tensorflowonspark_tpu", "tests", "examples", "--stats"])
     elapsed = time.monotonic() - t0
@@ -670,6 +671,7 @@ def test_repo_wide_scan_under_wall_clock_budget():
     # per-rule wall-time / finding-count table
     assert "graftcheck rule stats" in proc.stdout
     stats_lines = proc.stdout[proc.stdout.index("graftcheck rule stats"):]
-    for rule in ("lifecycle-double-free", "thread-race", "total"):
+    for rule in ("lifecycle-double-free", "thread-race",
+                 "wire-unhandled-endpoint", "total"):
         assert rule in stats_lines
     assert "ms" in stats_lines

@@ -1,0 +1,447 @@
+"""graftcheck wireproto tests: route-table extraction, client-emission
+propagation, message-plane matching, propagated-field specs, the four
+wire-* rules (positive and negative fixtures each), the
+``--format protocol`` dump, and the serving.rst docs-drift check.
+
+Stdlib only — no JAX import.  Fixture projects are in-memory
+multi-file Projects (the cross-file contract needs both sides of the
+wire); the real-repo tests go through the CLI like a user would.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tensorflowonspark_tpu.analysis import core  # noqa: E402
+from tensorflowonspark_tpu.analysis import (  # noqa: E402,F401  (registers)
+    hostsync, lifecycle, locks, pallas_tiles, recompile, shardlint,
+    style, threads, tracer, wireproto)
+
+WIRE_RULES = ("wire-unhandled-endpoint", "wire-dead-endpoint",
+              "wire-dropped-field", "wire-status-unhandled")
+
+
+def _project(sources):
+    project = core.Project()
+    for path, src in sources.items():
+        project.files.append(core.FileContext.from_source(
+            textwrap.dedent(src), path=path, project=project))
+    return project
+
+
+def _run(sources, rules):
+    project = _project(sources)
+    findings = core.run_rules(project, [core.REGISTRY[r] for r in rules])
+    return [(f.rule, os.path.basename(f.path), f.line) for f in findings], \
+        findings
+
+
+def _cli(args, timeout=120):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftcheck.py")]
+        + args, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+SERVER_OK = """
+    class Handler:
+        def do_POST(self):
+            path = self.path.split("?")[0]
+            if path == "/v1/thing":
+                self.send_response(200)
+                return
+            self.send_response(404)
+"""
+
+CLIENT_OK = """
+    import http.client
+
+    class Client:
+        def call(self):
+            c = http.client.HTTPConnection("h")
+            c.request("POST", "/v1/thing", "{}")
+            return c.getresponse()
+"""
+
+
+# -------------------------------------------------------------- routes ----
+
+def test_route_extraction_exact_prefix_and_verb():
+    project = _project({
+        "tensorflowonspark_tpu/srv.py": """
+            class Handler:
+                def do_GET(self):
+                    path = self.path.split("?")[0]
+                    if path == "/healthz":
+                        self.send_response(200)
+                        return
+                    if path.startswith("/v1/pages/"):
+                        self.send_response(200)
+                        return
+                    name = "x"
+                    if path == f"/v1/models/{name}:predict":
+                        self.send_response(200 if name else 503)
+                        return
+                    self.send_response(404)
+        """,
+    })
+    eps = {(e.method, e.path, e.kind): e
+           for e in wireproto.model_for(project).endpoints}
+    assert ("GET", "/healthz", "exact") in eps
+    assert ("GET", "/v1/pages/*", "prefix") in eps
+    verb = eps[("GET", "/v1/models/*:predict", "verb")]
+    # both arms of the ternary status are attributed to the branch
+    assert set(verb.statuses) == {200, 503}
+
+
+def test_route_statuses_follow_reply_helpers():
+    project = _project({
+        "tensorflowonspark_tpu/srv.py": """
+            class Handler:
+                def _send(self, code, body):
+                    self.send_response(code)
+                    self.wfile.write(body)
+
+                def do_POST(self):
+                    if self.path == "/v1/thing":
+                        try:
+                            self._send(200, b"{}")
+                        except ValueError:
+                            self._send(400, b"bad")
+                        return
+                    self._send(404, b"")
+        """,
+    })
+    eps = {e.path: e for e in wireproto.model_for(project).endpoints}
+    # codes forwarded through the helper's param land on the route
+    assert set(eps["/v1/thing"].statuses) == {200, 400}
+
+
+# ------------------------------------------------- wire-unhandled-endpoint
+
+def test_unhandled_endpoint_fires_for_unrouted_client():
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py": SERVER_OK,
+        "tensorflowonspark_tpu/cli.py": """
+            import http.client
+
+            class Client:
+                def call(self):
+                    c = http.client.HTTPConnection("h")
+                    c.request("POST", "/v1/nope", "{}")
+        """,
+    }, ["wire-unhandled-endpoint"])
+    assert flat == [("wire-unhandled-endpoint", "cli.py", 7)]
+
+
+def test_unhandled_endpoint_clean_when_routed_and_relays_exempt():
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py": SERVER_OK,
+        "tensorflowonspark_tpu/cli.py": CLIENT_OK,
+        # a relay forwarding its own request path is dynamic: exempt
+        "tensorflowonspark_tpu/proxy.py": """
+            import http.client
+
+            class Proxy:
+                def forward(self, body):
+                    c = http.client.HTTPConnection("h")
+                    c.request("POST", self.path, body)
+        """,
+    }, ["wire-unhandled-endpoint"])
+    assert flat == []
+
+
+def test_emission_pinned_through_wrapper_chain():
+    """A wrapper forwarding (method, path) params is not an emission;
+    the call site that pins the literals is."""
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py": SERVER_OK,
+        "tensorflowonspark_tpu/gw.py": """
+            import http.client
+
+            class Gateway:
+                def _request(self, method, path, body=None):
+                    c = http.client.HTTPConnection("h")
+                    c.request(method, path, body)
+                    return c.getresponse()
+
+                def good(self):
+                    return self._request("POST", "/v1/thing")
+
+                def bad(self):
+                    return self._request("POST", "/v1/missing")
+        """,
+    }, ["wire-unhandled-endpoint"])
+    assert flat == [("wire-unhandled-endpoint", "gw.py", 14)]
+
+
+# ------------------------------------------------------ wire-dead-endpoint
+
+def test_dead_endpoint_fires_without_client():
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py": SERVER_OK,
+    }, ["wire-dead-endpoint"])
+    assert flat == [("wire-dead-endpoint", "srv.py", 5)]
+
+
+def test_dead_endpoint_clean_with_client_or_allowlist():
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py": """
+            class Handler:
+                def do_POST(self):
+                    if self.path == "/v1/thing":
+                        self.send_response(200)
+
+                def do_GET(self):
+                    if self.path == "/metrics":
+                        self.send_response(200)
+        """,
+        "tensorflowonspark_tpu/cli.py": CLIENT_OK,
+    }, ["wire-dead-endpoint"])
+    # /v1/thing has a client; GET /metrics is a declared external
+    # (Prometheus) surface in protocol.EXTERNAL_ENDPOINTS
+    assert flat == []
+
+
+def test_wire_rule_suppression_applies_per_file():
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py":
+            "# graftcheck: disable-file=wire-dead-endpoint\n"
+            + textwrap.dedent(SERVER_OK),
+    }, ["wire-dead-endpoint"])
+    assert flat == []
+
+
+# -------------------------------------------------------- message planes
+
+def test_message_plane_unhandled_and_dead_cases():
+    flat, _ = _run({
+        # module name must be a declared plane (protocol.MESSAGE_PLANES)
+        "tensorflowonspark_tpu/reservation.py": """
+            class Server:
+                def _dispatch(self, msg):
+                    if msg["type"] == "REG":
+                        self.sock.send_msg({"type": "OK"})
+                    elif msg["type"] == "QUERY":
+                        self.sock.send_msg({"type": "OK"})
+
+            class Client:
+                def register(self):
+                    self.sock.send_msg({"type": "REG"})
+
+                def ping(self):
+                    frame = {"type": "PING"}
+                    self.sock.send_msg(frame)
+        """,
+    }, ["wire-unhandled-endpoint", "wire-dead-endpoint"])
+    # PING is emitted but never dispatched; QUERY is dispatched but never
+    # emitted; OK is exempt (protocol.ACK_MESSAGES); REG matches.
+    assert ("wire-unhandled-endpoint", "reservation.py", 15) in flat
+    assert ("wire-dead-endpoint", "reservation.py", 6) in flat
+    assert len(flat) == 2
+
+
+def test_message_plane_gated_to_declared_modules():
+    flat, _ = _run({
+        # same shapes in an undeclared module: config "type" tags are
+        # not protocol dispatch — no cases, no findings
+        "tensorflowonspark_tpu/other.py": """
+            class Server:
+                def _dispatch(self, msg):
+                    if msg["type"] == "QUERY":
+                        return 1
+
+            class Client:
+                def ping(self):
+                    self.sock.send_msg({"type": "PING"})
+        """,
+    }, ["wire-unhandled-endpoint", "wire-dead-endpoint"])
+    assert flat == []
+
+
+# ------------------------------------------------------ wire-dropped-field
+
+DROP_RULES = ["wire-dropped-field"]
+
+
+def test_dropped_field_fires_for_missing_priority():
+    flat, fs = _run({
+        # kvtransfer.wire_snapshot is a declared carrier for priority,
+        # trace AND seed; only priority is missing here
+        "tensorflowonspark_tpu/kvtransfer.py": """
+            def wire_snapshot(item):
+                return {"trace": item.get("trace"),
+                        "seed": item.get("seed")}
+        """,
+    }, DROP_RULES)
+    assert flat == [("wire-dropped-field", "kvtransfer.py", 2)]
+    assert "'priority'" in fs[0].message
+
+
+def test_dropped_field_clean_with_write_through_helper():
+    flat, _ = _run({
+        "tensorflowonspark_tpu/kvtransfer.py": """
+            def _meta(item):
+                return {"priority": item.get("cls"),
+                        "trace": item.get("trace"),
+                        "seed": item.get("seed")}
+
+            def wire_snapshot(item):
+                return _meta(item)
+        """,
+    }, DROP_RULES)
+    assert flat == []
+
+
+# --------------------------------------------------- wire-status-unhandled
+
+RETRY_SERVER = """
+    class Handler:
+        def do_POST(self):
+            if self.path == "/v1/thing":
+                try:
+                    self.send_response(200)
+                except ValueError:
+                    self.send_response(400)
+"""
+
+
+def _retry_client(check_lines):
+    body = "\n".join("            " + ln for ln in check_lines)
+    return textwrap.dedent("""
+        import http.client
+
+        class Client:
+            def call(self):
+                for attempt in range(3):
+                    c = http.client.HTTPConnection("h")
+                    c.request("POST", "/v1/thing", "{}")
+                    resp = c.getresponse()
+""") + body + "\n"
+
+
+def test_status_unhandled_fires_for_2xx_only_retry():
+    flat, fs = _run({
+        "tensorflowonspark_tpu/srv.py": RETRY_SERVER,
+        "tensorflowonspark_tpu/cli.py": _retry_client([
+            "if resp.status == 200:",
+            "    return resp",
+        ]),
+    }, ["wire-status-unhandled"])
+    assert flat == [("wire-status-unhandled", "cli.py", 8)]
+    assert "400" in fs[0].message
+
+
+def test_status_unhandled_clean_with_range_check_or_no_retry():
+    # a `>= 400` class check tells permanent from transient: clean
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py": RETRY_SERVER,
+        "tensorflowonspark_tpu/cli.py": _retry_client([
+            "if resp.status >= 400:",
+            "    raise ValueError(resp.status)",
+            "return resp",
+        ]),
+    }, ["wire-status-unhandled"])
+    assert flat == []
+
+    # no retry loop: nothing to mis-retry, clean even 2xx-only
+    flat, _ = _run({
+        "tensorflowonspark_tpu/srv.py": RETRY_SERVER,
+        "tensorflowonspark_tpu/cli.py": """
+            import http.client
+
+            class Client:
+                def call(self):
+                    c = http.client.HTTPConnection("h")
+                    c.request("POST", "/v1/thing", "{}")
+                    resp = c.getresponse()
+                    if resp.status == 200:
+                        return resp
+        """,
+    }, ["wire-status-unhandled"])
+    assert flat == []
+
+
+# ------------------------------------------------------------ real repo ----
+
+def test_real_repo_wire_scan_clean_on_empty_baseline():
+    proc = _cli(["--select", ",".join(WIRE_RULES)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftcheck clean" in proc.stdout
+
+
+_DUMP_CACHE = {}
+
+
+def _protocol_dump():
+    if "dump" not in _DUMP_CACHE:
+        proc = _cli(["--format", "protocol"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        _DUMP_CACHE["dump"] = json.loads(proc.stdout)
+    return _DUMP_CACHE["dump"]
+
+
+def test_protocol_dump_shape_and_contents():
+    dump = _protocol_dump()
+    assert dump["version"] == 1
+    eps = {(e["method"], e["path"]) for e in dump["endpoints"]}
+    # both layers of the verb routes, the migration splice, the planes
+    assert ("POST", "/v1/models/*:generate") in eps
+    assert ("POST", "/v1/models/*:resume") in eps
+    assert ("POST", "/v1/kv:export") in eps
+    assert ("GET", "/v1/fleet") in eps
+
+    # the migration client retries :resume and now distinguishes the
+    # permanent 4xx band via a range check (kvtransfer.ResumeRefused)
+    resumes = [c for c in dump["clients"]
+               if c["path"] == "/v1/models/*:resume"]
+    assert any(c["caller"].endswith("_post_resume") and c["retried"]
+               for c in resumes)
+    assert all("range" in c["statuses_distinguished"] for c in resumes)
+
+    # every declared carrier of every contract field resolves and writes
+    for row in dump["fields"]:
+        for entry in row["carriers"]:
+            assert entry["resolved"], (row["field"], entry)
+            assert entry["writes"] is True, (row["field"], entry)
+    assert {row["field"] for row in dump["fields"]} == {
+        "priority", "trace", "seed", "Idempotency-Key"}
+
+    # external surfaces carry their rationale into the dump
+    ext = {(e["method"], e["path"]): e["rationale"]
+           for e in dump["external_endpoints"]}
+    assert ("GET", "/metrics") in ext
+    assert all(ext.values())
+
+    # message planes: every emitted frame is handled or a declared ack
+    handled = {(m["key"], m["value"]) for m in dump["messages"]
+               if m["side"] == "handle"}
+    acks = {(a["key"], a["value"]) for a in dump["ack_messages"]}
+    for m in dump["messages"]:
+        if m["side"] == "emit":
+            assert (m["key"], m["value"]) in handled | acks, m
+
+
+def test_serving_docs_match_extracted_wire_surface():
+    """Docs drift check: the endpoint table extracted from the code must
+    equal the ``METHOD /path`` surfaces docs/source/serving.rst
+    documents — a new route needs a docs row, a deleted one needs the
+    row removed (see the "Wire surface reference" section there)."""
+    code = {(e["method"], e["path"]) for e in _protocol_dump()["endpoints"]}
+
+    text = open(os.path.join(REPO, "docs", "source", "serving.rst"),
+                encoding="utf-8").read().replace("\n", " ")
+    doc = set()
+    for m, p in re.findall(r"\b(GET|POST|PUT|DELETE)\s+(/[^\s`*,)]*)", text):
+        p = p.split("?")[0]
+        p = re.sub(r"<[^>]*>", "*", p)
+        doc.add((m, p.rstrip("/") or "/"))
+
+    assert code - doc == set(), \
+        f"routes not documented in serving.rst: {sorted(code - doc)}"
+    assert doc - code == set(), \
+        f"documented but not routed anywhere: {sorted(doc - code)}"
