@@ -628,6 +628,61 @@ def bench_warm_segment(result_timeout=600):
         batcher.stop()
 
 
+def bench_long_segment(result_timeout=600):
+    """The long_ttft_ms segment: one 32k-token mega-prompt streamed
+    through a paged batcher while a short interactive burst rides on
+    top (benchmarks.make_long_burst / FLAGSHIP_LONG), run twice — with
+    the long-context admission lane armed and disarmed.  Armed, the
+    prompt admits immediately and prefills chunk-by-chunk under the
+    lane quota, the page table growing from its seed width and cold
+    prefix pages demoting through the overflow valve; disarmed, it is
+    a monolithic admission hogging the prefill budget.  Reports the
+    mega-prompt TTFT/TPOT and the interactive p95 queueing delay both
+    ways plus the armed run's growth/demotion counts — the interactive
+    p95 holding while the monster streams IS the segment's story.
+    Returns ``(on, off)`` tuples of ``(ttft_ms, tpot_ms,
+    inter_p95_ms, table_grows, pages_demoted)``."""
+    from tensorflowonspark_tpu.benchmarks import make_long_burst
+
+    out = {}
+    for armed in (True, False):
+        (batcher, long_prompt, long_max_new,
+         inter_prompts, inter_max_new) = make_long_burst(armed=armed)
+        try:
+            # compile warmup at the interactive shape only — the mega
+            # prompt's own chunks reuse the same prefill buckets
+            batcher.submit(inter_prompts[0], inter_max_new,
+                           priority="interactive").result(
+                               timeout=result_timeout)
+            s0 = batcher.stats()
+            t0 = time.perf_counter()
+            lh = batcher.submit(long_prompt, long_max_new,
+                                priority="batch")
+            ihs = []
+            for p in inter_prompts:
+                ihs.append(batcher.submit(p, inter_max_new,
+                                          priority="interactive"))
+                time.sleep(0.01)
+            lh.tokens.get(timeout=result_timeout)
+            ttft = (time.perf_counter() - t0) * 1e3
+            for h in ihs:
+                h.result(timeout=result_timeout)
+            lh.result(timeout=result_timeout)
+            total = (time.perf_counter() - t0) * 1e3
+            st = batcher.stats()
+            out[armed] = (
+                ttft,
+                (total - ttft) / max(1, long_max_new - 1),
+                st.get("qdelay_interactive_p95_ms", 0.0),
+                st.get("kv_table_grows", 0)
+                - s0.get("kv_table_grows", 0),
+                st.get("kv_pages_demoted_overflow", 0)
+                - s0.get("kv_pages_demoted_overflow", 0))
+        finally:
+            batcher.stop()
+    return out[True], out[False]
+
+
 def _warm_segment_setup():
     from tensorflowonspark_tpu import kvtier, serve
     from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_WARM,
@@ -655,6 +710,49 @@ def _warm_segment_result():
                         cold_ms / warm_ms, 2) if warm_ms else None,
                     "host_hits": host_hits,
                     "prefill_tokens_skipped": skipped}}
+
+
+def _long_segment_setup():
+    from tensorflowonspark_tpu import serve
+    from tensorflowonspark_tpu.benchmarks import (FLAGSHIP_LONG,
+                                                  make_long_burst)
+
+    assert callable(make_long_burst)
+    assert callable(serve.max_table_pages)
+    d = FLAGSHIP_LONG
+    assert d["long_prompt_len"] + d["long_max_new"] <= d["max_seq"]
+    assert d["inter_prompt_len"] + d["inter_max_new"] <= d["max_seq"]
+    assert d["max_seq"] % d["kv_page_size"] == 0
+    # the mega-prompt routes through the lane; the interactive burst
+    # stays below the threshold and never does
+    assert d["inter_prompt_len"] <= d["long_prompt_threshold"]
+    assert d["long_prompt_threshold"] < d["long_prompt_len"]
+    # the table must grow from its seed width to cover the mega-prompt
+    assert (serve.max_table_pages(d["max_seq"], d["kv_page_size"])
+            > serve._INIT_TABLE_PAGES)
+    # pool covers the mega-prompt's own page run, but NOT that run plus
+    # every interactive session's retired prefix pages — the overflow
+    # valve must fire for the stream to finish
+    need = -(-(d["long_prompt_len"] + d["long_max_new"])
+             // d["kv_page_size"])
+    inter_pages = -(-(d["inter_prompt_len"] + d["inter_max_new"])
+                    // d["kv_page_size"])
+    assert need < d["kv_pages"]
+    assert need + d["inter_sessions"] * inter_pages > d["kv_pages"]
+    assert d["host_cache_mb"] > 0
+    return {"config": dict(d)}
+
+
+def _long_segment_result():
+    on, off = bench_long_segment()
+    return {"metric": "long_ttft_ms", "value": round(on[0], 1),
+            "unit": "ms mega-prompt time-to-first-token",
+            "aux": {"long_ttft_ms_unlaned": round(off[0], 1),
+                    "long_tpot_ms": round(on[1], 2),
+                    "interactive_p95_ms": round(on[2], 1),
+                    "interactive_p95_unlaned_ms": round(off[2], 1),
+                    "kv_table_grows": on[3],
+                    "kv_pages_demoted_overflow": on[4]}}
 
 
 def _job_segment_setup():
@@ -999,6 +1097,14 @@ SEGMENTS = {
         "help": "offline bulk-inference job drain rate (records/s "
                 "through the jobs spool/checkpoint path at full engine "
                 "utilization, with the interactive p95 it costs)"},
+    "long_ttft_ms": {
+        "run": _long_segment_result,
+        "setup": _long_segment_setup,
+        "help": "mega-prompt time-to-first-token through the "
+                "long-context admission lane (chunk-streamed growable "
+                "page table + host-tier overflow vs an unlaned "
+                "monolithic admission), with the interactive p95 it "
+                "protects"},
 }
 
 

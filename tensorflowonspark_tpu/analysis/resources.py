@@ -74,6 +74,17 @@ SPECS = (
     # `_evict_cached_pages` must un-share (rc pop) before appending a
     # page back to the pool, and only the device dispatch role may
     # touch the pool at all (the free list has no lock by design).
+    # Long-context paths ride the same lifecycle: the mega-prompt
+    # lane's per-chunk allocation (`_ensure_long_pages`) acquires via
+    # the pool pop and must hand pages back through extend on its
+    # rollback arm; a table GROW (`_grow_table`) acquires NO pages —
+    # the new tail entries alias the sink, owned by no row — and the
+    # overflow valve (`_overflow_reclaim`) releases only through
+    # `_evict_cached_pages`, which un-shares and demotes (ownership of
+    # the BYTES transfers to the host tier; the pool page itself still
+    # returns via append).  Host-tier promotion acquires fresh pool
+    # pages for the promoted copies and retires the tier entry via
+    # `discard` (see host-kv-page below).
     ResourceSpec(
         name="kv-page",
         description="paged KV cache page from the _free_pages pool",
@@ -164,7 +175,13 @@ SPECS = (
     # entry into `self._entries` (container ownership transfer, like
     # parked-session), so the interesting findings are release-without-
     # lock and an entry dropped on an error path with its bytes still
-    # charged.
+    # charged.  EVERY demote source funnels through `_make_entry` —
+    # LRU eviction, retirement demotion, peer prefix inserts (`put`),
+    # and the mega-prompt overflow valve (`serve._overflow_reclaim` →
+    # `_evict_cached_pages` → `demote`) — and every promote commit
+    # releases through `discard` → `_drop_entry`, so the overflow
+    # round trip (demote under pool pressure, promote back on access)
+    # is covered by exactly these two patterns.
     ResourceSpec(
         name="host-kv-page",
         description="host-DRAM demoted KV page entry in the "

@@ -93,7 +93,8 @@ def make_decode_step(impl="kernel", n_slots=None, page_size=None,
         from tensorflowonspark_tpu import quantize as quantize_mod
         params = quantize_mod.quantize_tree(params, mode=quantize)
         params = quantize_mod.cast_float_leaves(params, cfg.dtype)
-    max_pages = max_seq // page
+    from tensorflowonspark_tpu.serve import max_table_pages
+    max_pages = max_table_pages(max_seq, page)
     # every row fully mapped (pages are row-contiguous; +1 = the sink,
     # unused here but init_paged_slot_cache's caller contract): steps
     # can never write past an allocated page, and the KERNEL's work is
@@ -238,7 +239,8 @@ def make_prefill_chunk_step(impl="kernel", n_slots=None, page_size=None,
     # params don't depend on seq length: init with a short trace
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
-    max_pages = max_seq // page
+    from tensorflowonspark_tpu.serve import max_table_pages
+    max_pages = max_table_pages(max_seq, page)
     n_pages = n_slots * max_pages + 1       # +1 = the sink page
     slot_model, cache = decode_mod.init_paged_slot_cache(
         model, n_slots, page, n_pages, paged_prefill_impl=impl)
@@ -276,7 +278,8 @@ def prefill_chunk_write_bytes(impl, n_slots=None, page_size=None,
     dh = FLAGSHIP_LM_V2["d_model"] // FLAGSHIP_LM_V2["n_heads"]
     page_bytes = page * n_kv * dh * 2       # bf16 kv pool
     if impl == "blend":
-        pages = n_slots * (max_seq // page) + 1   # the WHOLE pool
+        from tensorflowonspark_tpu.serve import max_table_pages
+        pages = n_slots * max_table_pages(max_seq, page) + 1   # WHOLE pool
     else:
         pages = n_slots * (chunk // page + 1)     # W pages/row, in place
     return FLAGSHIP_LM_V2["n_layers"] * 2 * pages * page_bytes
@@ -651,6 +654,82 @@ def make_job_burst(n_slots=None, records=None, record_prompt_len=None,
     record_prompts = burst(records, rec_len)
     inter_prompts = burst(d["inter_probes"], d["inter_prompt_len"])
     return (batcher, record_prompts, d["record_max_new"],
+            inter_prompts, d["inter_max_new"])
+
+
+# The long_ttft_ms segment workload (bench.py --segments): one 32k-token
+# mega-prompt streamed through the long-context admission lane while a
+# short interactive burst rides on top.  Armed, the prompt admits
+# immediately but prefills chunk-by-chunk under the lane's per-round
+# quota (pages allocated per chunk, the page table growing from its
+# 8-entry seed as the stream advances, cold prefix pages demoted to the
+# host tier when the pool runs dry); disarmed, the same prompt is a
+# normal admission that reserves its full page run up front and hogs
+# the prefill budget.  The segment reports mega-prompt TTFT plus the
+# interactive p95 queueing delay both ways — the lane's story is the
+# interactive p95 holding while the monster streams.  The pool is sized
+# a hair over the mega-prompt's own run so the interactive burst's
+# retired prefix pages MUST be reclaimed through the overflow valve.
+# Frozen like FLAGSHIP_ENGINE: changing any value invalidates
+# long_ttft_ms comparability.
+FLAGSHIP_LONG = dict(n_slots=4, long_prompt_len=32768, long_max_new=8,
+                     long_prompt_threshold=4096, inter_sessions=8,
+                     inter_prompt_len=32, inter_max_new=4,
+                     prefill_chunk=256, kv_page_size=32, kv_pages=1040,
+                     host_cache_mb=64, max_seq=32800)
+
+
+def make_long_burst(armed=True, n_slots=None, long_prompt_len=None,
+                    prefill_chunk=None, kv_page_size=None, kv_pages=None,
+                    host_cache_mb=None, max_seq=None,
+                    long_prompt_threshold=None):
+    """Build the long_ttft_ms segment workload: one paged
+    ContinuousBatcher (mega-prompt lane armed when ``armed`` — disarmed
+    = threshold 0, the prompt admits as ordinary work) plus the
+    mega-prompt and the interactive population.  Returns ``(batcher,
+    long_prompt, long_max_new, inter_prompts, inter_max_new)``; the
+    caller submits the mega-prompt, trickles the interactive burst on
+    top, drains everything, and reads TTFT / per-class queueing delay /
+    growth and demotion counters from ``batcher.stats()``.  Caller must
+    ``batcher.stop()``.  Prompts are distinct random garbage for the
+    same reasons as :func:`make_prefill_burst`."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_LONG
+    n_slots = n_slots or d["n_slots"]
+    long_len = long_prompt_len or d["long_prompt_len"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    page = kv_page_size or d["kv_page_size"]
+    pages = kv_pages or d["kv_pages"]
+    cache_mb = host_cache_mb or d["host_cache_mb"]
+    max_seq = max_seq or d["max_seq"]
+    threshold = (long_prompt_threshold or d["long_prompt_threshold"]
+                 if armed else 0)
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    batcher = serve_mod.ContinuousBatcher(
+        model, params, n_slots=n_slots, read_chunk=1,
+        prefill_chunk=chunk, kv_page_size=page, kv_pages=pages,
+        host_cache_mb=cache_mb, long_prompt_threshold=threshold)
+    rs = np.random.RandomState(0)
+
+    def burst(n, length):
+        return [rs.randint(1, cfg.vocab_size,
+                           length).astype("int32").tolist()
+                for _ in range(n)]
+
+    long_prompt = burst(1, long_len)[0]
+    inter_prompts = burst(d["inter_sessions"], d["inter_prompt_len"])
+    return (batcher, long_prompt, d["long_max_new"],
             inter_prompts, d["inter_max_new"])
 
 

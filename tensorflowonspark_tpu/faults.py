@@ -70,6 +70,16 @@ SITES = frozenset({
     "serve.host_promote",      # ContinuousBatcher._host_tier_lookup (deny
                                # = a host-tier hit reads as a miss; the
                                # pages prefill normally, byte-identically)
+    "serve.table_grow",        # ContinuousBatcher._grow_table (device
+                               # thread: a raise kills the engine mid-
+                               # growth — the mega-prompt-lane crash
+                               # simulation; callers' rollback keeps the
+                               # pool conserved)
+    "serve.overflow_demote",   # ContinuousBatcher._overflow_reclaim (deny
+                               # = the overflow valve reads as empty; the
+                               # mega-prompt lane stalls, and on an idle
+                               # replica fails TYPED — KVOverflowError /
+                               # 503 — instead of wedging admission)
     "kvtransfer.prefix_pull",  # pull_prefix: cross-replica kv:prefix pull
                                # (a raise = peer unreachable; the replica
                                # falls back to its own tier + prefill)

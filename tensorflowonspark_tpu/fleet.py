@@ -505,13 +505,22 @@ class Gateway:
         return not (r.failures >= self.breaker_threshold
                     and r.open_until > now)
 
-    def _choose(self, prefix_key=None, exclude=(), roles=None):
+    def _choose(self, prefix_key=None, exclude=(), roles=None,
+                prompt_len=0):
         """Pick a replica, or raise :class:`NoReplica` /
         :class:`Saturated`.  `prefix_key` engages affinity routing.
         `roles` is a soft preference: when at least one routable replica
         carries one of the named roles, the choice is restricted to
         those; otherwise every routable replica stays eligible (a
-        prefill-only or decode-only fleet must not go dark)."""
+        prefill-only or decode-only fleet must not go dark).
+
+        `prompt_len` engages mega-prompt headroom routing: when the
+        prompt exceeds a routable replica's advertised
+        ``long_prompt_threshold`` REG feature, the pick goes to the
+        lane-capable replica with the LARGEST kv capacity
+        (kv_pages * kv_page_size) instead of by prefix affinity — a
+        100k-token prompt cares about fitting, not about a few warm
+        prefix pages."""
         with self._lock:
             now = time.monotonic()
             routable = [r for r in self._replicas.values()
@@ -534,6 +543,18 @@ class Gateway:
                      if r.outstanding < self._max_outstanding(r)]
             if not open_:
                 raise Saturated("all replica queues at bound")
+            if prompt_len:
+                lane = [r for r in open_
+                        if 0 < int(r.features.get(
+                            "long_prompt_threshold") or 0) < prompt_len]
+                if lane:
+                    self.counters.inc("long_routes")
+                    pick = max(lane, key=lambda r: (
+                        int(r.features.get("kv_pages") or 0)
+                        * int(r.features.get("kv_page_size") or 0),
+                        -r.outstanding, r.id))
+                    pick.outstanding += 1
+                    return pick
             if prefix_key is not None:
                 # rendezvous (highest-random-weight) hashing: stateless,
                 # deterministic, and a membership change only remaps the
@@ -647,12 +668,12 @@ class Gateway:
                 self._tenant_inflight[tenant] = cur - 1
 
     def _choose_degraded(self, tenant, cls, prefix_key=None,
-                         exclude=(), roles=None):
+                         exclude=(), roles=None, prompt_len=0):
         """`_choose`, but a Saturated fleet degrades into a bounded
         weighted-fair wait instead of an instant 429 (overload
         degradation).  With spill_wait_s == 0 this IS `_choose`."""
         try:
-            return self._choose(prefix_key, exclude, roles)
+            return self._choose(prefix_key, exclude, roles, prompt_len)
         except Saturated:
             if self.spill_wait_s <= 0:
                 raise
@@ -668,7 +689,8 @@ class Gateway:
                     raise Saturated("saturated after %.1fs weighted-fair"
                                     " wait" % self.spill_wait_s)
                 try:
-                    r = self._choose(prefix_key, exclude, roles)
+                    r = self._choose(prefix_key, exclude, roles,
+                                     prompt_len)
                 except Saturated:
                     continue           # head, but still no room: re-wait
                 self._wfq.leave(ticket, served=True)
@@ -743,6 +765,16 @@ class Gateway:
             return key if key else None
         except (KeyError, IndexError, TypeError):
             return None
+
+    @staticmethod
+    def prompt_len_of(body):
+        """Longest prompt (tokens) in a :generate body, for mega-prompt
+        headroom routing; 0 when absent/malformed (the replica 400s
+        it)."""
+        try:
+            return max(len(p) for p in body["inputs"])
+        except (KeyError, TypeError, ValueError):
+            return 0
 
     # ---- session recovery (streaming :generate) --------------------------
 
@@ -945,6 +977,11 @@ class Gateway:
                   # replicas contribute 0 to both)
                   "prefill_kernel_dispatches": 0,
                   "prefill_blend_fallbacks": 0,
+                  # long-context serving: table growth, overflow demote
+                  # pressure, and lane traffic sum across replicas (a
+                  # replica without the mega-prompt lane contributes 0)
+                  "kv_table_grows": 0, "kv_pages_demoted_overflow": 0,
+                  "long_prompts_active": 0, "long_chunks_dispatched": 0,
                   "ttft_count": 0, "ttft_ms_sum": 0.0,
                   "decode_steps": 0, "pipeline_depth_peak": 0,
                   "migrations_started": 0, "migrations_completed": 0,
@@ -1005,7 +1042,11 @@ class Gateway:
                                 "host_evictions", "host_cache_bytes",
                                 "host_pages_cached",
                                 "prefill_kernel_dispatches",
-                                "prefill_blend_fallbacks"):
+                                "prefill_blend_fallbacks",
+                                "kv_table_grows",
+                                "kv_pages_demoted_overflow",
+                                "long_prompts_active",
+                                "long_chunks_dispatched"):
                         totals[key] += int(gstats.get(key) or 0)
                     # TTFT: only count/sum are summable across replicas
                     # (exact percentiles aren't — the fleet-wide view
@@ -1327,14 +1368,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 try:
                     r = gw._choose_degraded(
                         tenant, cls, prefix_key=gw.prefix_key(body),
-                        roles=("prefill", "mixed"), exclude=failed)
+                        roles=("prefill", "mixed"), exclude=failed,
+                        prompt_len=gw.prompt_len_of(body))
                 except (NoReplica, Saturated):
                     if not failed:
                         raise
                     failed = set()   # only known-bad picks left: any
                     r = gw._choose_degraded(
                         tenant, cls, prefix_key=gw.prefix_key(body),
-                        roles=("prefill", "mixed"))
+                        roles=("prefill", "mixed"),
+                        prompt_len=gw.prompt_len_of(body))
             except (NoReplica, Saturated) as e:
                 if not state["started"]:
                     # nothing sent yet: fail FAST (typed 503/429 with
@@ -1747,8 +1790,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             # connection via the source's relay thread)
             roles = ("prefill", "mixed") if is_generate else None
             t_route = time.monotonic()
+            plen = (gw.prompt_len_of(body_obj)
+                    if is_generate and isinstance(body_obj, dict) else 0)
             r = gw._choose_degraded(tenant, cls, prefix_key=prefix_key,
-                                    roles=roles)
+                                    roles=roles, prompt_len=plen)
         except (NoReplica, Saturated) as e:
             self._reject(e)
             return

@@ -168,7 +168,7 @@ def _jitted_decode_body(decode_model, greedy, with_eos):
 
 def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
                     kv_dtype=None, paged_attn_impl=None,
-                    paged_prefill_impl=None):
+                    paged_prefill_impl=None, table_pages=0):
     """Build the slot-decode model + empty cache with `n_slots` rows.
     ``page_size``/``n_pages`` > 0 switches to the PAGED kv layout
     (see `init_paged_slot_cache`); ``kv_dtype="int8"`` quantizes the
@@ -179,7 +179,11 @@ def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
     ``paged_prefill_impl`` picks the paged S>1 chunk path ("kernel" =
     the Pallas in-place page-write + chunked flash read, "blend" = the
     one-hot einsum blend reference —
-    TransformerConfig.paged_prefill_impl; None keeps the config's)."""
+    TransformerConfig.paged_prefill_impl; None keeps the config's);
+    ``table_pages`` > 0 starts every row's page table at that width
+    instead of the full ``max_seq_len // page_size``
+    (TransformerConfig.kv_table_pages — the growable-table layout;
+    callers widen with `_jitted_grow_page_table` as rows outgrow it)."""
     from tensorflowonspark_tpu.models.transformer import (
         Transformer, TransformerConfig)
 
@@ -192,6 +196,7 @@ def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
         dataclasses.replace(
             cfg, decode=True, decode_slots=True,
             kv_page_size=page_size, kv_pages=n_pages,
+            kv_table_pages=table_pages,
             **({"kv_dtype": kv_dtype} if kv_dtype is not None else {}),
             **({"paged_attn_impl": paged_attn_impl}
                if paged_attn_impl is not None else {}),
@@ -207,7 +212,7 @@ def init_slot_cache(model_or_cfg, n_slots, page_size=0, n_pages=0,
 
 def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages,
                           kv_dtype=None, paged_attn_impl=None,
-                          paged_prefill_impl=None):
+                          paged_prefill_impl=None, table_pages=0):
     """Build a PAGED slot-decode model + empty cache: kv lives in a
     shared pool of ``n_pages`` pages of ``page_size`` tokens, mapped per
     row through a page table (TransformerConfig.kv_page_size).  The
@@ -218,11 +223,19 @@ def init_paged_slot_cache(model_or_cfg, n_slots, page_size, n_pages,
     blocks DO receive writes (bucket-padded prefill overshoot,
     post-retirement garbage steps), so entries must never default to a
     page another row owns (serve.ContinuousBatcher allocates
-    kv_pages + 1 and uses the extra page as the sink)."""
+    kv_pages + 1 and uses the extra page as the sink).
+
+    ``table_pages`` > 0 allocates the tables at that INITIAL width
+    instead of the full ``max_seq_len // page_size`` — the growable
+    layout: short-prompt workloads then pay table bytes proportional to
+    what they actually map, and `_jitted_grow_page_table` widens every
+    row geometrically (sink-padded tails) when a long prompt outgrows
+    the current width.  0 keeps the historical full-width tables."""
     return init_slot_cache(model_or_cfg, n_slots, page_size=page_size,
                            n_pages=n_pages, kv_dtype=kv_dtype,
                            paged_attn_impl=paged_attn_impl,
-                           paged_prefill_impl=paged_prefill_impl)
+                           paged_prefill_impl=paged_prefill_impl,
+                           table_pages=table_pages)
 
 
 def _leaf_name(path):
@@ -277,6 +290,34 @@ def _jitted_set_row_page_table(slot_model):
         return jax.tree_util.tree_map_with_path(set_leaf, cache)
 
     return set_table
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_grow_page_table(slot_model, new_width):
+    """Widen every layer's page_table to `new_width` entries (the
+    growable-table splice): existing mappings keep their columns, the
+    new tail columns fill with the `sink` page id — the same
+    tails-alias-the-sink contract `_jitted_set_row_page_table` relies
+    on, so the widened table is immediately safe to step.  One cache
+    entry (and one trace) per (model, width); serving grows in pow2
+    steps, so the jit cache stays O(log max_width) like the per-width
+    retraces of the step/prefill jits themselves."""
+
+    # donate: the pool leaves pass through untouched and must not copy;
+    # the page_table leaves change shape, so those reallocate (tiny —
+    # [n_slots, new_width] int32)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def grow(cache, sink):
+        def grow_leaf(path, leaf):
+            if _leaf_name(path) != "page_table":
+                return leaf
+            b, w = leaf.shape
+            pad = jnp.full((b, new_width - w), sink, jnp.int32)
+            return jnp.concatenate([leaf, pad], axis=1)
+
+        return jax.tree_util.tree_map_with_path(grow_leaf, cache)
+
+    return grow
 
 
 # ---- kv migration helpers (kvtransfer.MigrationEngine) ------------------

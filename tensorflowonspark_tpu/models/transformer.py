@@ -89,6 +89,15 @@ class TransformerConfig:
     # ACTUAL sequence need instead of reserving max_seq_len each — the
     # capacity win that lets n_slots exceed the dense-cache HBM limit.
     kv_pages: int = 0             # pool size (pages) when kv_page_size > 0
+    kv_table_pages: int = 0       # >0: INITIAL per-row page_table width
+    # (pages); the serving layer grows tables geometrically in pow2
+    # steps (decode._jitted_grow_page_table) as prefill chunks land, so
+    # a short chat row never pays table bytes for a max_seq_len-capable
+    # mapping.  0 = full width (max_seq_len // kv_page_size), the static
+    # layout every pre-growth caller gets by default.  Attention derives
+    # the LIVE width from the page_table leaf itself, so a grown cache
+    # costs one fresh trace per pow2 width — O(log) compiles, like
+    # `_jitted_set_row_page_table`'s per-width retraces.
     kv_dtype: str = "auto"        # decode kv-cache storage: "auto" = the
     # activation dtype; "int8" = quantized cache (int8 payload +
     # per-(token, head) f32 scales over head_dim, quantize-on-write /
@@ -500,9 +509,12 @@ def _paged_attention_body(attn_self, q, k, v):
 
     kv lives in a SHARED pool ``pages_key/pages_value [kv_pages,
     page, n_kv, Dh]``; each row owns the pool pages its per-row
-    ``page_table [B, max_seq/page]`` names (the serving layer allocates
+    ``page_table [B, table_pages]`` names (the serving layer allocates
     them from a free list at admission and returns them at retirement —
-    serve.ContinuousBatcher).  Prefill chunks (S > 1) default to the
+    serve.ContinuousBatcher).  The table starts ``cfg.kv_table_pages``
+    wide (0 = the full ``max_seq_len // page`` width) and the serving
+    layer widens it geometrically as rows outgrow it; the width read
+    below always comes from the leaf, so every pow2 width is one trace.  Prefill chunks (S > 1) default to the
     Pallas paged-prefill kernels (``cfg.paged_prefill_impl ==
     "kernel"``, ops/paged_prefill.py): page-granular in-place pool
     stores + one online softmax over [occupied context pages || chunk],
@@ -535,8 +547,9 @@ def _paged_attention_body(attn_self, q, k, v):
     cfg = attn_self.cfg
     B, S, n_kv, Dh = k.shape
     P, NP = cfg.kv_page_size, cfg.kv_pages
-    max_pages = cfg.max_seq_len // P
-    L = max_pages * P
+    cap_pages = cfg.max_seq_len // P
+    init_pages = (min(cfg.kv_table_pages, cap_pages)
+                  if cfg.kv_table_pages else cap_pages)
     dtype = k.dtype
     quant = cfg.kv_dtype == "int8"    # validated by _decode_attention,
     store = jnp.int8 if quant else dtype   # the sole caller
@@ -551,12 +564,20 @@ def _paged_attention_body(attn_self, q, k, v):
                                  (NP, P, n_kv), jnp.float32)
     table = attn_self.variable(
         "cache", "page_table",
-        lambda: jnp.zeros((B, max_pages), jnp.int32))
+        lambda: jnp.zeros((B, init_pages), jnp.int32))
     ci = attn_self.variable("cache", "cache_index",
                             lambda: jnp.zeros((B,), jnp.int32))
     if attn_self.is_initializing():
         kf, vf = _kv_repeat(q, k, v)
         return dot_product_attention(q, kf, vf, causal=cfg.causal)
+    # The live table width comes from the LEAF, never the config: the
+    # serving layer grows tables in pow2 steps as long prompts land
+    # (decode._jitted_grow_page_table splices sink-padded tails on), and
+    # each width is one fresh trace of this body.  The Pallas kernels
+    # below are already width-polymorphic (ops/paged_attention.py and
+    # ops/paged_prefill.py read `table.shape[1]`).
+    max_pages = table.value.shape[1]
+    L = max_pages * P
     idx = ci.value
     if (S > 1 and cfg.paged_prefill_impl == "kernel"
             and paged_prefill_available() and _ambient_mesh() is None):

@@ -129,6 +129,18 @@ def build_argparser():
                         "each (requires --generate_kv_pages)")
     p.add_argument("--generate_kv_pages", type=int, default=0,
                    help="pool size (pages) for --generate_kv_page_size")
+    p.add_argument("--generate_long_prompt_threshold", type=int, default=0,
+                   help=">0 routes prompts longer than this many tokens "
+                        "through the mega-prompt lane: they admit "
+                        "immediately but stream prefill chunk-by-chunk "
+                        "in their own WFQ-scheduled lane (bounded chunk "
+                        "quota per round) instead of monopolizing the "
+                        "prefill budget, allocating kv pages lazily as "
+                        "chunks land and demoting cold prefix-cache "
+                        "pages to the host tier when the device pool "
+                        "runs dry.  Requires --generate_kv_page_size; "
+                        "0 = every prompt uses the normal admission "
+                        "path")
     p.add_argument("--generate_host_cache_mb", type=int, default=0,
                    help=">0 enables the host-DRAM KV page tier behind "
                         "the paged pool: evicted and retired full-prefix "
@@ -267,6 +279,29 @@ def _pow2_width(n):
     """Padded row count for a batched prefill dispatch: next power of
     two — same bounded-compile-variants reasoning as `_bucket_len`."""
     return 1 << (n - 1).bit_length()
+
+
+def max_table_pages(max_seq_len, kv_page_size):
+    """The page-table width CAP for one row: enough entries to map a
+    full max_seq_len sequence.  The single sizing authority — every
+    width computation (initial allocation, growth clamp, resume
+    validation) goes through here so the growable-table layout has
+    exactly one notion of \"full width\"."""
+    return max_seq_len // kv_page_size
+
+
+# Initial per-row page-table width (entries).  Rows start this small and
+# grow geometrically (pow2 steps, decode._jitted_grow_page_table) only
+# when an admission actually needs more — a short-prompt workload never
+# pays page-table bytes for a max_seq_len-capable table.
+_INIT_TABLE_PAGES = 8
+
+
+class KVOverflowError(RuntimeError):
+    """Device kv pool + host tier could not yield the pages a request
+    needs even with nothing else running — the request cannot fit on
+    this replica.  Maps to a typed 503 (retryable on a peer with more
+    headroom), NOT a 400: the request is well-formed."""
 
 
 def _aligned_prefill_chunk(prefill_chunk, kv_page_size):
@@ -459,6 +494,8 @@ class ModelService:
                                        0.0) or 0.0
         self._gen_park_capacity = getattr(args, "generate_park_capacity",
                                           8) or 8
+        self._gen_long_threshold = getattr(
+            args, "generate_long_prompt_threshold", 0) or 0
         self._gen_trace_ring = getattr(args, "generate_trace_ring",
                                        4096) or 4096
         sample = getattr(args, "generate_trace_decode_sample", 16)
@@ -531,6 +568,7 @@ class ModelService:
                         prio_weight=self._gen_prio_weight,
                         preempt_ms=self._gen_preempt_ms,
                         park_capacity=self._gen_park_capacity,
+                        long_prompt_threshold=self._gen_long_threshold,
                         trace_ring=self._gen_trace_ring,
                         trace_decode_sample=self._gen_trace_sample)
                 except TypeError as e:
@@ -875,6 +913,7 @@ class ContinuousBatcher:
                  paged_attn_impl=None, paged_prefill_impl=None,
                  engine="async", pipeline_depth=2,
                  prio_weight=4, preempt_ms=0.0, park_capacity=8,
+                 long_prompt_threshold=0, long_chunk_quota=1,
                  trace_recorder=None, trace_ring=4096,
                  trace_decode_sample=16):
         import itertools
@@ -929,6 +968,15 @@ class ContinuousBatcher:
                 "host_cache_mb > 0 requires a paged kv cache "
                 "(--generate_kv_page_size): the host tier holds "
                 "demoted PAGES")
+        self.long_prompt_threshold = int(long_prompt_threshold or 0)
+        if self.long_prompt_threshold < 0:
+            raise ValueError("long_prompt_threshold must be >= 0")
+        if self.long_prompt_threshold and not self.kv_page_size:
+            raise ValueError(
+                "long_prompt_threshold > 0 requires a paged kv cache "
+                "(--generate_kv_page_size): the mega-prompt lane "
+                "allocates pages lazily as chunks land")
+        self.long_chunk_quota = max(1, int(long_chunk_quota or 1))
         if self.kv_page_size:
             # PAGED kv: rows draw pages from a shared pool sized by
             # kv_pages instead of reserving max_seq_len each — n_slots
@@ -945,10 +993,20 @@ class ContinuousBatcher:
             # the sink, where writes are harmless.
             self._sink = int(kv_pages)
             self._total_pages = int(kv_pages)
+            # GROWABLE page tables: rows start at a small pow2 width and
+            # widen geometrically (decode._jitted_grow_page_table) the
+            # first time an admission's projected need exceeds it — a
+            # short-prompt workload never allocates a max_seq-capable
+            # table.  _table_cap is the one sizing authority (the old
+            # per-site `max_seq_len // page_size` computations).
+            self._table_cap = max_table_pages(
+                model.cfg.max_seq_len, self.kv_page_size)
+            self._table_width = min(self._table_cap, _INIT_TABLE_PAGES)
             self.slot_model, self._cache = decode_mod.init_paged_slot_cache(
                 model, n_slots, self.kv_page_size, int(kv_pages) + 1,
                 kv_dtype=kv_dtype, paged_attn_impl=paged_attn_impl,
-                paged_prefill_impl=paged_prefill_impl)
+                paged_prefill_impl=paged_prefill_impl,
+                table_pages=self._table_width)
             # host-side mirror of the model's S>1 prefill gate (the
             # branch resolves at trace time, so the jit itself cannot
             # count): drives the prefill_kernel_dispatches /
@@ -987,8 +1045,8 @@ class ContinuousBatcher:
                     int(host_cache_mb) << 20)
             else:
                 self._host_tier = None
-            max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
-            self._sink_entries = jnp.full((max_pages,), self._sink,
+            # sized to the CURRENT table width; _grow_table rebuilds it
+            self._sink_entries = jnp.full((self._table_width,), self._sink,
                                           jnp.int32)
             for row in range(n_slots):   # unoccupied rows start at sink
                 self._cache = self._set_table(
@@ -1112,6 +1170,13 @@ class ContinuousBatcher:
         # graftcheck: disable-next-line=thread-race
         self._classq = {c: collections.deque() for c in PRIORITY_CLASSES}
         self._batch_credit = 0   # interactive picks since last batch pick
+        # mega-prompt lane: prompts above long_prompt_threshold queue
+        # here and admit one at a time (lazy page allocation; prefill
+        # streams chunk-by-chunk under long_chunk_quota).  Device-thread
+        # owned; stats() only len()s it
+        # graftcheck: disable-next-line=thread-race
+        self._longq = collections.deque()
+        self._long_credit = 0    # normal picks since last long pick
         # preemption controller state: parked sessions are frozen
         # host-side snapshots (no device pages held) awaiting resume;
         # the deque is shared between the controller thread and the
@@ -1312,6 +1377,11 @@ class ContinuousBatcher:
             # explicit (not just via the counter fold): present-at-zero
             # so dashboards see the gauge before the first sink write
             out["kv_sink_writes"] = self.counters.get("kv_sink_writes")
+            # growable page tables: current global width vs the full-
+            # sequence cap (width only ever grows; jit retraces once
+            # per pow2 step)
+            out["kv_table_width"] = self._table_width
+            out["kv_table_cap"] = self._table_cap
         if self.lora_rank:
             out["lora_rank"] = self.lora_rank
             # the one mutable-container read: snapshot under _lora_lock so
@@ -1345,6 +1415,19 @@ class ContinuousBatcher:
         for cls in PRIORITY_CLASSES:
             out.update(self._ttft_cls[cls].stats(f"ttft_{cls}"))
             out.update(self._qdelay[cls].stats(f"qdelay_{cls}"))
+        # mega-prompt lane: present-at-zero counters (fleet totals sum
+        # them) plus a skew-tolerant active gauge — queued, mid-prefill,
+        # and decoding long prompts all count as "active"
+        for key in ("kv_table_grows", "kv_pages_demoted_overflow",
+                    "long_chunks_dispatched"):
+            out[key] = self.counters.get(key)
+        out["long_prompt_threshold"] = self.long_prompt_threshold
+        n_long = len(self._longq)
+        n_long += sum(1 for adm in list(self._admissions)
+                      if (adm.get("item") or {}).get("long"))
+        n_long += sum(1 for s in list(self._slots)
+                      if s is not None and (s.get("item") or {}).get("long"))
+        out["long_prompts_active"] = n_long
         out.update(self.trace.stats())
         # event counters (kv_sink_writes, ...) ride along by name
         out.update(self.counters.snapshot())
@@ -1578,13 +1661,18 @@ class ContinuousBatcher:
         h = SlotHandle(prompt)
         if aidx:
             h._on_done = lambda idx=aidx: self._release_adapter(idx)
+        # mega-prompt lane flag: decided ONCE at submit (threshold reads
+        # are config, not state) so every later hop — ingress drain, WFQ
+        # pick, lazy allocation, chunk quota — keys off the item itself
+        is_long = bool(self.long_prompt_threshold and self.kv_page_size
+                       and len(prompt) > self.long_prompt_threshold)
         self._pending.put({
             "h": h, "prompt": list(prompt), "max_new": max_new,
             "temp": float(temperature), "eos": eos_id, "seed": int(seed),
             "aidx": aidx, "topk": int(top_k), "topp": float(top_p),
             "minp": float(min_p), "stops": stops,
             "rep": float(repetition_penalty), "adapter": adapter,
-            "cls": cls, "trace": tid,
+            "cls": cls, "long": is_long, "trace": tid,
             "t_submit": time.monotonic()})  # TTFT clock starts at submit
         self.trace.event(tid, "submit", cls=cls, prompt_len=len(prompt),
                          max_new=max_new)
@@ -1599,10 +1687,13 @@ class ContinuousBatcher:
         import queue as queue_mod
 
         # class queues first (older items — they were pulled off
-        # `_pending` already), then the raw ingress queue
+        # `_pending` already), then the mega-prompt lane, then the raw
+        # ingress queue
         for q in self._classq.values():
             while q:
                 q.popleft()["h"]._fail(err)
+        while self._longq:
+            self._longq.popleft()["h"]._fail(err)
         while True:
             try:
                 item = self._pending.get_nowait()
@@ -1920,11 +2011,132 @@ class ContinuousBatcher:
             f"cache is corrupted — the sink must never be owned by a row")
         return pages
 
-    def _try_allocate(self, row, item):
+    def _row_entries(self, pages):
+        """One row's page-table entries at the CURRENT table width:
+        `pages` then sink padding for the unallocated tail (never page
+        0 — that may belong to someone)."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(
+            pages + [self._sink] * (self._table_width - len(pages)),
+            jnp.int32)
+
+    def _grow_table(self, need):
+        """Widen every row's page table to cover `need` entries: pow2
+        geometric steps (at least doubling) clamped at the full-
+        sequence cap, so the step jit retraces O(log cap) times over
+        the replica's lifetime — same bounded-compile-variants
+        reasoning as `_bucket_len`.  New tail entries alias the sink
+        (decode._jitted_grow_page_table), so rows mid-decode are
+        untouched: growth changes no mapped page.  Device thread
+        only; callers keep it inside their allocation rollback scope
+        (a raise here must conserve the pool like any other
+        allocation failure)."""
+        import jax.numpy as jnp
+
+        from .models import decode as decode_mod
+
+        faults.check("serve.table_grow")
+        new_w = min(self._table_cap,
+                    max(_pow2_width(need), 2 * self._table_width))
+        if new_w <= self._table_width:
+            return
+        grow = decode_mod._jitted_grow_page_table(self.slot_model, new_w)
+        self._cache = grow(self._cache,
+                           jnp.asarray(self._sink, jnp.int32))
+        self._table_width = new_w
+        self._sink_entries = jnp.full((new_w,), self._sink, jnp.int32)
+        self.counters.inc("kv_table_grows")
+
+    def _overflow_reclaim(self, want):
+        """Mega-prompt overflow valve: free up to `want` pool pages by
+        evicting cold (rc==0) prefix-cache pages, least recently used
+        first.  With the host tier armed the victims DEMOTE before
+        their pool pages are reused (`_evict_cached_pages`), so they
+        promote back on a later prefix hit instead of re-prefilling.
+        Returns the number freed; 0 under a `serve.overflow_demote`
+        fault (the lane then stalls or fails typed — admission never
+        wedges)."""
+        if want <= 0:
+            return 0
+        if faults.deny("serve.overflow_demote"):
+            return 0
+        freed = self._evict_cached_pages(want)
+        if freed:
+            self.counters.inc("kv_pages_demoted_overflow", freed)
+        return freed
+
+    def _ensure_long_pages(self, adm):
+        """Mega-prompt lane lazy allocation: map pool pages covering
+        the positions `adm`'s NEXT chunk writes (plus the decode tail
+        when that chunk is final — decode allocates nothing after
+        admission).  Returns False when the chunk cannot run this
+        round: either a transient stall (other rows will retire and
+        free pages) or — when the replica is otherwise IDLE and still
+        cannot cover the need even after the overflow valve — a
+        definitive failure that fails the request with a typed
+        KVOverflowError instead of wedging the lane forever."""
+        import jax.numpy as jnp
+
+        if adm["di"] < len(adm["d_sizes"]):
+            return True     # draft catch-up: dense draft cache, no pages
+        item, row = adm["item"], adm["row"]
+        upto = adm["offset"] + adm["sizes"][adm["i"]]
+        if upto >= len(adm["src"]):
+            need = self._pages_needed(len(item["prompt"]),
+                                      item["max_new"],
+                                      temperature=item["temp"])
+        else:
+            need = -(-upto // self.kv_page_size)
+        have = len(self._row_pages[row] or [])
+        if need <= have:
+            return True
+        k = need - have
+        if len(self._free_pages) < k:
+            self._overflow_reclaim(k - len(self._free_pages))
+        if len(self._free_pages) < k:
+            if (all(s is None for s in self._slots)
+                    and len(self._admissions) <= 1
+                    and self._parked is None):
+                # nothing left to retire, nothing left to evict: no
+                # future round can do better — fail loud and typed
+                self._admissions.remove(adm)
+                self._free_row(row)
+                item["h"]._fail(KVOverflowError(
+                    f"mega-prompt needs {k} more kv pages but only "
+                    f"{len(self._free_pages)} are free with the replica "
+                    "otherwise idle; raise --generate_kv_pages or "
+                    "--generate_host_cache_mb"))
+                return False
+            return False    # stall this round; decode keeps retiring
+        fresh = [self._free_pages.pop() for _ in range(k)]
+        try:
+            pages = self._assert_no_sink(
+                (self._row_pages[row] or []) + fresh)
+            if len(pages) > self._table_width:
+                self._grow_table(len(pages))
+            self._cache = self._set_table(self._cache,
+                                          jnp.asarray(row, jnp.int32),
+                                          self._row_entries(pages))
+        except BaseException:
+            # conservation: a grow kill / device OOM between the pops
+            # and the table write must not strand the fresh pages
+            self._free_pages.extend(fresh)
+            raise
+        self._row_pages[row] = pages
+        return True
+
+    def _try_allocate(self, row, item, lazy=False):
         """Reserve `item`'s page need for `row` — reusing cached prefix
         pages where the prompt matches — or False when the pool (after
         LRU eviction of unreferenced cached pages) cannot cover the
-        rest; the caller parks the item until pages free."""
+        rest; the caller parks the item until pages free.
+
+        ``lazy`` (the mega-prompt lane): map only the already-computed
+        pages (device prefix hits + host-tier promotions) now; FRESH
+        pages are allocated chunk-by-chunk as the lane's prefill
+        advances (`_ensure_long_pages`), so admitting a 100k-token
+        prompt does not reserve its whole footprint up front."""
         import jax.numpy as jnp
 
         if faults.deny("serve.alloc"):
@@ -1945,6 +2157,8 @@ class ContinuousBatcher:
         # prefilling — they occupy FRESH pool pages (popped below), get
         # scattered, and re-enter the prefix cache at rc=1
         host_run = self._host_tier_lookup(keys, len(shared))
+        if lazy:
+            need = len(shared) + len(host_run)
         fresh_need = need - len(shared)
         if len(self._free_pages) < fresh_need:
             self._evict_cached_pages(fresh_need - len(self._free_pages))
@@ -1956,14 +2170,11 @@ class ContinuousBatcher:
         promo = fresh[:len(host_run)]
         try:
             pages = self._assert_no_sink(shared + fresh)
-            max_pages = self.slot_model.cfg.max_seq_len // self.kv_page_size
-            # unallocated tail entries alias the SINK (never page 0 — that
-            # may belong to someone)
-            entries = jnp.asarray(
-                pages + [self._sink] * (max_pages - len(pages)), jnp.int32)
+            if len(pages) > self._table_width:
+                self._grow_table(len(pages))
             self._cache = self._set_table(self._cache,
                                           jnp.asarray(row, jnp.int32),
-                                          entries)
+                                          self._row_entries(pages))
             if host_run:
                 self._promote_scatter(promo, host_run)
         except BaseException:
@@ -2125,9 +2336,15 @@ class ContinuousBatcher:
         # installed exactly as a migration would, and decode continues
         # byte-identically (seed + ordinal reconstruct the RNG chain)
         src = item["resume"]["seq"][:-1] if "resume" in item else prompt
-        if self.kv_page_size and not self._try_allocate(row, item):
+        if self.kv_page_size and not self._try_allocate(
+                row, item, lazy=item.get("long") is True):
             self._parked = (row, item)   # wait for pages (FIFO: nothing
             return                       # else admits while parked)
+        if item.get("long"):
+            # lane span anchor: admission happened (pages map lazily;
+            # per-chunk progress shows up as long.chunk events)
+            self.trace.event(item.get("trace"), "long.admit", row=row,
+                             prompt_len=len(prompt))
         # prefix-shared pages already hold their kv: the TARGET prefill
         # starts after them (a fully cached prompt prefills only its
         # last page).  The DRAFT's dense per-row cache shares nothing:
@@ -2176,23 +2393,52 @@ class ContinuousBatcher:
         batch-class ones, stable within a class, so a single-class
         workload keeps the sequential path's exact FIFO chunk schedule
         (the parity baseline) while a mixed round spends the Sarathi
-        budget on interactive prompts first."""
+        budget on interactive prompts first.
+
+        Mega-prompt lane: long admissions rank AFTER both normal
+        classes and at most `long_chunk_quota` of them join a round —
+        the lane streams its prompt across many rounds instead of
+        monopolizing the budget.  Each long pick must first map pool
+        pages for its chunk (`_ensure_long_pages`); a page-starved
+        long HEAD is the one documented exception to the head-always
+        rule, because dispatching its chunk through unmapped (sink)
+        table entries would corrupt nothing but compute garbage —
+        the round's budget goes to the other admissions instead."""
         if not self._admissions:
             return []
-        rest = self._admissions[1:]
-        order = [self._admissions[0]]
-        order += [a for a in rest
-                  if (a["item"] or {}).get("cls") != "batch"]
-        order += [a for a in rest
-                  if (a["item"] or {}).get("cls") == "batch"]
-        selected, spent = [], 0
+
+        def _is_long(a):
+            return (a["item"] or {}).get("long") is True
+
+        head = self._admissions[0]
+        if _is_long(head) and not self._ensure_long_pages(head):
+            head = None
+        # _ensure_long_pages may have FAILED the head out of the queue
+        pool = list(self._admissions)
+        if head is not None and head not in pool:
+            head = None
+        rest = [a for a in pool if a is not head]
+        order = [head] if head is not None else []
+        order += [a for a in rest if not _is_long(a)
+                  and (a["item"] or {}).get("cls") != "batch"]
+        order += [a for a in rest if not _is_long(a)
+                  and (a["item"] or {}).get("cls") == "batch"]
+        order += [a for a in rest if _is_long(a)]
+        selected, spent, long_picked = [], 0, 0
         for adm in order:
+            if _is_long(adm):
+                if long_picked >= self.long_chunk_quota:
+                    continue
+                if adm is not head and not self._ensure_long_pages(adm):
+                    continue
             size = self._next_chunk_len(adm)
             if selected and (len(selected) >= self.prefill_rows
                              or spent + size > self.prefill_budget):
                 break
             selected.append(adm)
             spent += size
+            if _is_long(adm):
+                long_picked += 1
         return selected
 
     def _sink_page(self):
@@ -2299,10 +2545,17 @@ class ContinuousBatcher:
                 n_valids, jnp.asarray(0, jnp.int32))
         self.counters.inc("prefill_dispatches")
         # per-chunk prefill spans: host-clocked at dispatch (the jit
-        # call returns asynchronously; no device value is read here)
+        # call returns asynchronously; no device value is read here).
+        # Mega-prompt chunks get their own event name (+ counter) so
+        # the lane's progress reads directly off the trace timeline
         for (erow, chunk, off), adm in zip(entries, selected):
-            self.trace.event(adm["item"].get("trace"), "prefill",
-                             row=erow, chunk=len(chunk), offset=off)
+            if (adm["item"] or {}).get("long"):
+                self.counters.inc("long_chunks_dispatched")
+                self.trace.event(adm["item"].get("trace"), "long.chunk",
+                                 row=erow, chunk=len(chunk), offset=off)
+            else:
+                self.trace.event(adm["item"].get("trace"), "prefill",
+                                 row=erow, chunk=len(chunk), offset=off)
         if self.kv_page_size:
             # which S>1 path served this dispatch: the Pallas paged-
             # prefill kernels or the einsum blend (impl="blend", or
@@ -2460,7 +2713,7 @@ class ContinuousBatcher:
         unless a class queue already holds work."""
         import queue as queue_mod
 
-        if block and any(self._classq.values()):
+        if block and (any(self._classq.values()) or self._longq):
             block = False
         while True:
             try:
@@ -2468,7 +2721,17 @@ class ContinuousBatcher:
             except queue_mod.Empty:
                 return
             block = False
-            self._classq[item.get("cls") or "interactive"].append(item)
+            if item.get("long"):
+                self._longq.append(item)   # the mega-prompt lane
+            else:
+                self._classq[item.get("cls") or "interactive"].append(item)
+
+    def _long_admitting(self):
+        """Mega-prompt admissions currently mid-prefill: the lane
+        admits ONE at a time (its prompt spans many rounds; a second
+        would just split the same chunk quota)."""
+        return sum(1 for adm in self._admissions
+                   if (adm["item"] or {}).get("long"))
 
     def _next_item(self):
         """Weighted-fair pick across the class queues: while both
@@ -2476,10 +2739,22 @@ class ContinuousBatcher:
         batch admission (interactive wins ties; batch alone drains
         freely).  Records the picked item's queueing delay — the
         per-class window the preemption controller and the fleet
-        dashboards watch."""
+        dashboards watch.
+
+        The mega-prompt lane rides the same credit idiom one level up:
+        while normal work waits, up to `prio_weight` normal admissions
+        run per long admission, and a waiting mega-prompt admits
+        immediately when the classes are idle — long prompts neither
+        starve the batch nor wait for it to drain, and at most one is
+        mid-prefill at a time."""
         inter = self._classq["interactive"]
         batch = self._classq["batch"]
-        if inter and batch:
+        if self._longq and not self._long_admitting() and (
+                self._long_credit >= self.prio_weight
+                or not (inter or batch)):
+            self._long_credit = 0
+            item = self._longq.popleft()
+        elif inter and batch:
             if self._batch_credit >= self.prio_weight:
                 self._batch_credit = 0
                 item = batch.popleft()
@@ -2493,6 +2768,8 @@ class ContinuousBatcher:
             item = batch.popleft()
         else:
             return None
+        if self._longq and not item.get("long"):
+            self._long_credit += 1
         t0 = item.get("t_submit")
         if t0 is not None:
             self._qdelay[item.get("cls") or "interactive"].record(
@@ -3227,13 +3504,11 @@ class ContinuousBatcher:
             pages = [self._free_pages.pop() for _ in range(need)]
             try:
                 self._assert_no_sink(pages)
-                max_pages = (self.slot_model.cfg.max_seq_len
-                             // self.kv_page_size)
-                entries = jnp.asarray(
-                    pages + [self._sink] * (max_pages - len(pages)),
-                    jnp.int32)
+                if len(pages) > self._table_width:
+                    self._grow_table(len(pages))
                 self._cache = self._set_table(
-                    self._cache, jnp.asarray(row, jnp.int32), entries)
+                    self._cache, jnp.asarray(row, jnp.int32),
+                    self._row_entries(pages))
                 # kv blocks were normalized and pow2-padded in
                 # submit_resume (host thread); pad rows land in the sink
                 width = _pow2_width(n_have)
@@ -3722,8 +3997,8 @@ class GenerateService:
                  kv_dtype="auto", paged_attn_impl=None,
                  paged_prefill_impl=None, engine="async",
                  pipeline_depth=2, prio_weight=4, preempt_ms=0.0,
-                 park_capacity=8, trace_ring=4096,
-                 trace_decode_sample=16):
+                 park_capacity=8, long_prompt_threshold=0,
+                 trace_ring=4096, trace_decode_sample=16):
         import itertools
 
         self.quantize_mode = quantize_mode or "none"
@@ -3762,6 +4037,7 @@ class GenerateService:
             engine=engine or "async",
             pipeline_depth=pipeline_depth, prio_weight=prio_weight,
             preempt_ms=preempt_ms, park_capacity=park_capacity,
+            long_prompt_threshold=long_prompt_threshold,
             trace_ring=trace_ring,
             trace_decode_sample=trace_decode_sample)
         try:
@@ -4293,6 +4569,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError, AttributeError) as e:
             # malformed client input in any shape -> 400
             self._send(400, {"error": str(e) or type(e).__name__})
+        except KVOverflowError as e:
+            # the request is well-formed but cannot fit this replica's
+            # kv (device pool + host tier, replica idle): typed 503 so
+            # the gateway retries it on a peer with more headroom
+            self._send(503, {"error": str(e), "type": "kv_overflow"})
         except Exception as e:   # keep the server alive on model errors
             logger.exception("predict failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
@@ -4350,6 +4631,14 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
         raise ValueError("--generate_host_cache_mb needs "
                          "--generate_kv_page_size > 0 (the host tier "
                          "holds demoted pages of the paged kv cache)")
+    if getattr(args, "generate_long_prompt_threshold", 0) < 0:
+        raise ValueError("--generate_long_prompt_threshold must be >= 0 "
+                         "(0 disables the mega-prompt lane)")
+    if getattr(args, "generate_long_prompt_threshold", 0) and \
+            not getattr(args, "generate_kv_page_size", 0):
+        raise ValueError("--generate_long_prompt_threshold needs "
+                         "--generate_kv_page_size > 0 (the mega-prompt "
+                         "lane allocates kv pages lazily per chunk)")
     if getattr(args, "generate_lora", None) and \
             not getattr(args, "generate_lora_rank", 0):
         raise ValueError("--generate_lora needs --generate_lora_rank > 0 "
@@ -4444,6 +4733,12 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer,
             eng = None
         if eng is not None:
             features["kv_prefix_addr"] = eng.prefix_addr()
+    if getattr(args, "generate_long_prompt_threshold", 0):
+        # mega-prompt lane: the gateway routes prompts above this to
+        # the lane-capable replica with the most kv headroom
+        # (kv_pages * kv_page_size) instead of by prefix affinity
+        features["long_prompt_threshold"] = (
+            args.generate_long_prompt_threshold)
     if getattr(args, "draft_export_dir", None):
         features["speculative"] = True
     if getattr(args, "generate_quantize", "none") != "none":

@@ -599,6 +599,120 @@ def test_prefix_pull_fault_falls_back_to_local_prefill(model_and_params):
         b.stop()
 
 
+# ------------------------------------------------ mega-prompt lane ----
+# Long-context serving under chaos: a replica dying mid-stream while a
+# mega-prompt's page table is GROWING, and a persistently-denied
+# overflow valve.  The lane needs a model whose full-width table
+# exceeds the 8-entry seed width (max_seq 128 / page 8 = 16), so these
+# build their own instead of using the module fixture.
+
+
+@pytest.fixture(scope="module")
+def long_model_and_params():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=128, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _mega_prompt(n=96, seed=7):
+    rs = np.random.RandomState(seed)
+    return rs.randint(1, 64, n).astype("int32").tolist()
+
+
+def test_mega_prompt_kill_mid_growth_redrives_byte_identically(
+        long_model_and_params):
+    # a replica dies INSIDE the table growth a mega-prompt's third
+    # chunk forces — two lane chunks already dispatched, zero tokens
+    # journaled.  Recovery is the mid-prefill contract: the dead engine
+    # fails its handles loudly, and the gateway's journal re-drive (no
+    # committed tokens -> a fresh :generate on a peer) replays the
+    # whole stream byte-identically through the peer's own lane.
+    model, params = long_model_and_params
+    kw = dict(prefill_chunk=32, kv_page_size=8, kv_pages=16,
+              long_prompt_threshold=24)
+    src = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    dst = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                  **kw)
+    journal = fleet.StreamJournal()
+    prompt, n_new = _mega_prompt(96), 8
+    try:
+        entry = journal.journal_open({"prompt": prompt, "seed": 0})
+        plan = faults.FaultPlan(CHAOS_SEED).on("serve.table_grow",
+                                               kind="oserror", nth=1)
+        with faults.active(plan):
+            h = src.submit(prompt, n_new)
+            with pytest.raises(OSError, match="injected fault"):
+                h.result(timeout=300)
+        assert plan.fired == [("serve.table_grow", "oserror")]
+        # chunks streamed before the kill, but no token ever committed:
+        # the stream is the None sentinel alone
+        assert src.counters.get("long_chunks_dispatched") >= 2
+        assert h.tokens.get_nowait() is None
+        # the engine died mid-growth; later submits fail fast
+        with pytest.raises(RuntimeError, match="batcher died"):
+            src.submit(prompt, n_new)
+        out = dst.submit(prompt, n_new).result(timeout=300)
+        assert out == _solo(model, params, prompt, n_new)
+        st = dst.stats()
+        assert st["kv_table_grows"] == 1      # the peer's growth landed
+        assert st["long_chunks_dispatched"] >= 3
+        journal.journal_close(entry)
+        assert len(journal) == 0
+    finally:
+        src.stop()
+        dst.stop()
+
+
+def test_overflow_demote_deny_fails_typed_and_never_wedges(
+        long_model_and_params):
+    # the overflow valve is PERSISTENTLY denied: a mega-prompt whose
+    # final chunk needs reclaimed pages stalls, and once the replica is
+    # otherwise idle it must degrade to a TYPED failure — the
+    # KVOverflowError the HTTP handler maps to a retryable 503 — with
+    # the engine alive, the pool conserved, and later admissions
+    # (short AND long) flowing normally
+    model, params = long_model_and_params
+    kv_pages = 14
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=32, kv_page_size=8,
+                                kv_pages=kv_pages, host_cache_mb=16,
+                                long_prompt_threshold=24)
+    short, prompt, n_new = list(range(1, 19)), _mega_prompt(96), 8
+    try:
+        # 2 cold cached prefix pages make the valve load-bearing: the
+        # mega-prompt's last chunk cannot be covered by the free list
+        cold_short = b.submit(short, 4).result(timeout=300)
+        assert b.stats()["prefix_pages_cached"] == 2
+        plan = faults.FaultPlan(CHAOS_SEED).on(
+            "serve.overflow_demote", kind="deny", nth=1, times=None)
+        with faults.active(plan):
+            h = b.submit(prompt, n_new)
+            with pytest.raises(serve.KVOverflowError, match="kv pages"):
+                h.result(timeout=300)
+        assert ("serve.overflow_demote", "deny") in plan.fired
+        assert b.stats()["kv_pages_demoted_overflow"] == 0
+        assert issubclass(serve.KVOverflowError, RuntimeError)
+        # admission never wedged: the SAME engine keeps serving, and
+        # with the fault gone the SAME mega-prompt streams to the end
+        assert b.submit(short, 4).result(timeout=300) == cold_short
+        out = b.submit(prompt, n_new).result(timeout=300)
+        assert out == _solo(model, params, prompt, n_new)
+        st = b.stats()
+        assert st["kv_pages_demoted_overflow"] >= 1
+        assert st["long_prompts_active"] == 0
+        # pool conserved: every page back in free or cold-cached
+        assert (len(b._free_pages) + len(b._prefix) == kv_pages
+                and not any(b._row_pages))
+    finally:
+        b.stop()
+
+
 def test_trace_export_deny_never_costs_tokens(model_and_params):
     # the observability plane fails: every span export is denied for
     # the whole run.  The contract is asymmetric on purpose — tracing

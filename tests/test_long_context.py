@@ -1,0 +1,273 @@
+"""True long-context serving (growable page tables + mega-prompt lane).
+
+Page-table rows start at a small seed width and grow geometrically as
+long prompts actually materialize (`decode.init_paged_slot_cache
+table_pages` / `serve._grow_table`); prompts above the batcher's
+``long_prompt_threshold`` admit immediately but stream chunk-by-chunk
+through their own WFQ lane, allocating pool pages per chunk and
+reclaiming cold prefix pages through the host-tier overflow valve when
+the pool runs dry.  Criteria: byte parity with solo generate through
+forced growth plus a demote/promote round trip (greedy and seeded), and
+a short-prompt-only workload allocating strictly fewer page-table bytes
+than the full-width reservation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    # max_seq_len 128 so the full-width table (16 pages of 8) is twice
+    # the 8-entry seed width — growth has somewhere to go
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=128, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, temperature=0.0, seed=0):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None))
+    return np.asarray(out)[0].tolist()
+
+
+def _long_prompt(n=96, seed=7):
+    rs = np.random.RandomState(seed)
+    return rs.randint(1, 64, n).astype("int32").tolist()
+
+
+def _table_widths(cache):
+    """Every page_table leaf's width, one entry per layer."""
+    widths = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if "page_table" in jax.tree_util.keystr(path):
+            widths.append(leaf.shape[-1])
+    return widths
+
+
+def _pool_conserved(batcher, kv_pages):
+    free = list(batcher._free_pages)
+    assert len(free) == len(set(free))
+    assert batcher._sink not in free
+    cached = set(batcher._prefix.values())
+    owned = []
+    for rp in batcher._row_pages:
+        if rp:
+            assert batcher._sink not in rp
+            owned.extend(p for p in rp if p not in batcher._page_rc)
+    everywhere = sorted(free + list(cached) + owned)
+    assert everywhere == list(range(kv_pages)), (
+        f"pool not conserved: free={sorted(free)} cached={sorted(cached)} "
+        f"owned={sorted(owned)}")
+
+
+def _wait_host_pages(tier, n, timeout=30.0):
+    import time as time_mod
+
+    deadline = time_mod.time() + timeout
+    while time_mod.time() < deadline:
+        tier.flush(5)
+        if tier.stats()["host_pages_cached"] >= n:
+            return
+        time_mod.sleep(0.01)
+    raise AssertionError(
+        f"host tier never reached {n} pages: {tier.stats()}")
+
+
+def test_table_pages_seeds_narrow_tables_and_grows(model_and_params):
+    # decode-level contract: table_pages seeds every page_table leaf at
+    # the requested width (default stays the full max_seq reservation),
+    # and _jitted_grow_page_table widens in place — existing entries
+    # preserved, the new tail aliasing the sink
+    model, params = model_and_params
+    P, NP, n_slots = 8, 6, 2
+    full = model.cfg.max_seq_len // P
+    pm, cache_full = decode.init_paged_slot_cache(model, n_slots, P, NP)
+    assert _table_widths(cache_full) and all(
+        w == full for w in _table_widths(cache_full))
+    pm, cache = decode.init_paged_slot_cache(model, n_slots, P, NP,
+                                             table_pages=2)
+    assert all(w == 2 for w in _table_widths(cache))
+
+    sink = NP - 1
+    set_table = decode._jitted_set_row_page_table(pm)
+    cache = set_table(cache, jnp.asarray(0, jnp.int32),
+                      jnp.asarray([3, 1], jnp.int32))
+    grown = decode._jitted_grow_page_table(pm, 4)(
+        cache, jnp.asarray(sink, jnp.int32))
+    assert all(w == 4 for w in _table_widths(grown))
+    for path, leaf in jax.tree_util.tree_flatten_with_path(grown)[0]:
+        if "page_table" not in jax.tree_util.keystr(path):
+            continue
+        assert np.asarray(leaf[0, :2]).tolist() == [3, 1]
+        assert np.asarray(leaf[:, 2:]).tolist() == [[sink, sink]] * n_slots
+
+
+def test_short_workload_allocates_strictly_fewer_table_bytes(
+        model_and_params):
+    # the sizing win: a short-prompt-only replica never pays the
+    # full-width page table — its rows stay at the seed width while the
+    # cap (the old unconditional reservation) is twice as wide
+    model, params = model_and_params
+    kv_pages = 8
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=1, kv_page_size=8,
+                                      kv_pages=kv_pages)
+    try:
+        cap = serve.max_table_pages(model.cfg.max_seq_len, 8)
+        assert batcher._table_cap == cap == 16
+        assert batcher._table_width == serve._INIT_TABLE_PAGES == 8
+        prompts = [[1, 2, 3], [9, 8, 7, 6], [5, 4]]
+        for p in prompts:
+            assert batcher.submit(p, 4).result(timeout=120) == \
+                _solo(model, params, p, 4)
+        # nothing grew, and the live leaves are strictly narrower (so
+        # strictly fewer bytes) than the full-width reservation
+        assert batcher._table_width == 8
+        widths = _table_widths(batcher._cache)
+        assert widths and all(w == 8 < cap for w in widths)
+        st = batcher.stats()
+        assert st["kv_table_width"] == 8 and st["kv_table_cap"] == 16
+        assert st["kv_table_grows"] == 0
+        assert st["long_prompts_active"] == 0
+        _pool_conserved(batcher, kv_pages)
+    finally:
+        batcher.stop()
+
+
+def test_plain_paged_path_grows_table_on_demand(model_and_params):
+    # no lane involved: an ordinary admission whose page run exceeds
+    # the current width widens the table inside _try_allocate and stays
+    # token-identical to solo
+    model, params = model_and_params
+    kv_pages = 16
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=1, kv_page_size=8,
+                                      kv_pages=kv_pages)
+    try:
+        prompt = _long_prompt(96)        # 96 + 8 new = 13 pages > seed 8
+        assert batcher.submit(prompt, 8).result(timeout=120) == \
+            _solo(model, params, prompt, 8)
+        st = batcher.stats()
+        assert st["kv_table_grows"] == 1
+        assert st["kv_table_width"] == 16
+        _pool_conserved(batcher, kv_pages)
+    finally:
+        batcher.stop()
+
+
+@pytest.mark.parametrize("temperature,seed", [(0.0, 0), (0.9, 13)])
+def test_mega_prompt_lane_parity_growth_and_overflow_roundtrip(
+        model_and_params, temperature, seed):
+    # THE byte-parity gate: a mega-prompt streamed through the lane —
+    # chunk-by-chunk page allocation, a forced table growth, and at
+    # least one demote through the overflow valve — emits exactly the
+    # solo sequence, greedy and seeded; the demoted page then promotes
+    # back from the host tier on a later turn (the full round trip)
+    model, params = model_and_params
+    kv_pages = 14
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=1, prefill_chunk=32,
+                                      kv_page_size=8, kv_pages=kv_pages,
+                                      host_cache_mb=64,
+                                      long_prompt_threshold=24)
+    try:
+        # a short conversation retires first: its 2 full prefix pages
+        # stay cached cold, so the mega-prompt's last chunk CANNOT be
+        # covered by the free list alone and the valve must fire
+        short = list(range(1, 19))       # 18 tokens = 2 full prefix pages
+        cold_short = batcher.submit(short, 4).result(timeout=120)
+        assert cold_short == _solo(model, params, short, 4)
+        assert batcher.stats()["prefix_pages_cached"] == 2
+
+        long = _long_prompt(96)          # 3 chunks of 32; 13 pages total
+        got = batcher.submit(long, 8, temperature=temperature,
+                             seed=seed).result(timeout=180)
+        assert got == _solo(model, params, long, 8,
+                            temperature=temperature, seed=seed)
+        st = batcher.stats()
+        assert st["long_prompt_threshold"] == 24
+        assert st["kv_table_grows"] == 1 and st["kv_table_width"] == 16
+        assert st["kv_pages_demoted_overflow"] >= 1
+        assert st["long_chunks_dispatched"] >= 3
+        assert st["long_prompts_active"] == 0
+
+        # round trip: the evicted short-prompt page lives only in the
+        # host tier now — the same conversation returning is served by
+        # host->device promotion, byte-identically
+        _wait_host_pages(batcher._host_tier, 1)
+        h0 = batcher.counters.get("host_hits")
+        assert batcher.submit(short, 4).result(timeout=120) == cold_short
+        assert batcher.counters.get("host_hits") > h0
+        _pool_conserved(batcher, kv_pages)
+    finally:
+        batcher.stop()
+
+
+def test_lane_streams_while_interactive_burst_rides_on_top(
+        model_and_params):
+    # scheduling story: the mega-prompt admits immediately but yields
+    # chunk slots to the interactive burst (long_chunk_quota), and
+    # everyone — lane and burst, greedy and seeded — stays solo-exact
+    model, params = model_and_params
+    kv_pages = 26
+    batcher = serve.ContinuousBatcher(model, params, n_slots=3,
+                                      read_chunk=1, prefill_chunk=32,
+                                      kv_page_size=8, kv_pages=kv_pages,
+                                      long_prompt_threshold=24)
+    try:
+        long = _long_prompt(96)
+        lh = batcher.submit(long, 8, temperature=0.9, seed=13,
+                            priority="batch")
+        shorts = [[i + 1, i + 2, i + 3] for i in range(4)]
+        ihs = [batcher.submit(p, 4, priority="interactive")
+               for p in shorts]
+        for p, h in zip(shorts, ihs):
+            assert h.result(timeout=120) == _solo(model, params, p, 4)
+        assert lh.result(timeout=180) == _solo(model, params, long, 8,
+                                               temperature=0.9, seed=13)
+        st = batcher.stats()
+        assert st["long_chunks_dispatched"] >= 3
+        assert st["long_prompts_active"] == 0
+        assert st["kv_table_grows"] >= 1
+        _pool_conserved(batcher, kv_pages)
+    finally:
+        batcher.stop()
+
+
+def test_unservable_mega_prompt_rejected_at_submit(model_and_params):
+    # a prompt the WHOLE pool can never hold fails fast at submit (the
+    # lane streams page demand over time; it cannot shrink the peak)
+    model, params = model_and_params
+    batcher = serve.ContinuousBatcher(model, params, n_slots=2,
+                                      read_chunk=1, kv_page_size=8,
+                                      kv_pages=6,
+                                      long_prompt_threshold=24)
+    try:
+        with pytest.raises(ValueError, match="kv pages"):
+            batcher.submit(_long_prompt(96), 8)
+    finally:
+        batcher.stop()
+
+
+def test_long_threshold_requires_paged_cache(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="paged"):
+        serve.ContinuousBatcher(model, params, n_slots=2,
+                                long_prompt_threshold=24)
