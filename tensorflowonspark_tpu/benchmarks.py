@@ -403,6 +403,78 @@ def make_engine_burst(engine="async", n_slots=None, prompts=None,
     return batcher, prompts_list, max_new
 
 
+# The spec_tps segment workload (bench.py --segments): sustained decode
+# through the ContinuousBatcher with speculation in each of its modes —
+# "ngram" (model-free prompt-lookup drafting), "model" (a 4-layer
+# scaled-down draft LM on the flagship dims), "off" (the plain-step
+# baseline the other two are compared against).  Prompts are REPETITIVE
+# (a short random motif tiled to prompt_len): prompt-lookup speculation
+# pays off exactly when the continuation echoes the context, so this
+# workload is where ngram drafting must beat spec-off — the acceptance
+# rate and adaptive mean-k ride along as aux.  Greedy requests: the
+# accept rate then measures draft quality alone, not sampling noise.
+# Frozen like FLAGSHIP_ENGINE: changing any value invalidates spec_tps
+# comparability.
+FLAGSHIP_SPEC = dict(n_slots=8, prompts=16, prompt_len=64, max_new=96,
+                     prefill_chunk=256, prefill_rows=4, max_seq=256,
+                     draft_k=4, motif_len=8, draft_layers=4)
+
+
+def make_spec_burst(mode="ngram", n_slots=None, prompts=None,
+                    prompt_len=None, max_new=None, prefill_chunk=None,
+                    prefill_rows=None, max_seq=None, draft_k=None):
+    """Build the spec_tps segment workload: a ContinuousBatcher on the
+    flagship-LM dims with ``mode`` speculation ("ngram" / "model" /
+    "off") plus the repetitive prompt burst to submit.  Returns
+    ``(batcher, prompts_list, max_new)``; the caller submits the burst
+    greedily, drains every handle, computes tokens/s from wall clock,
+    and reads acceptance/mean-k aux from ``batcher.stats()``.  Caller
+    must ``batcher.stop()``."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serve as serve_mod
+    from tensorflowonspark_tpu.models.transformer import (
+        Transformer, TransformerConfig)
+
+    d = FLAGSHIP_SPEC
+    n_slots = n_slots or d["n_slots"]
+    n_prompts = prompts or d["prompts"]
+    prompt_len = prompt_len or d["prompt_len"]
+    max_new = max_new or d["max_new"]
+    chunk = prefill_chunk or d["prefill_chunk"]
+    rows = d["prefill_rows"] if prefill_rows is None else prefill_rows
+    max_seq = max_seq or d["max_seq"]
+    draft_k = draft_k or d["draft_k"]
+    cfg = TransformerConfig(**dict(FLAGSHIP_LM_V2, max_seq_len=max_seq))
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft_model = draft_params = None
+    if mode == "model":
+        d_cfg = TransformerConfig(**dict(
+            FLAGSHIP_LM_V2, max_seq_len=max_seq,
+            n_layers=d["draft_layers"]))
+        draft_model = Transformer(d_cfg)
+        draft_params = draft_model.init(
+            jax.random.key(1), jnp.zeros((1, 8), jnp.int32))["params"]
+    batcher = serve_mod.ContinuousBatcher(
+        model, params, n_slots=n_slots, read_chunk=4, prefill_chunk=chunk,
+        prefill_rows=rows, spec_draft=mode, draft_model=draft_model,
+        draft_params=draft_params, draft_k=draft_k)
+    rs = np.random.RandomState(0)
+    motif_len = d["motif_len"]
+    prompts_list = []
+    for _ in range(n_prompts):
+        motif = rs.randint(1, cfg.vocab_size, motif_len)
+        reps = prompt_len // motif_len + 1
+        prompts_list.append(
+            np.tile(motif, reps)[:prompt_len].astype("int32").tolist())
+    return batcher, prompts_list, max_new
+
+
 # The migrate_ms segment workload (bench.py --segments): one live paged
 # session frozen mid-decode on a source ContinuousBatcher, shipped page-
 # by-page through a real kvtransfer.PageServer socket on localhost, and

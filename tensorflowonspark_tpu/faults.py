@@ -97,6 +97,11 @@ SITES = frozenset({
                                # job.json (bounded retry; exhaustion
                                # abandons the partition, never marks it
                                # durable)
+    "serve.spec_verify",       # ContinuousBatcher._dispatch spec gate
+                               # (deny/raise = the round falls back to a
+                               # plain decode step — tokens byte-identical
+                               # by the lossless guarantee, only slower;
+                               # counted in spec_draft_fallbacks)
     "trace.export",            # trace.Recorder._push (deny = spans are
                                # dropped silently) and the /metrics +
                                # /v1/trace HTTP exporters (a raise = the

@@ -998,6 +998,12 @@ class Gateway:
                   # bytes and their float-equivalent sum across probed
                   # replicas (unquantized replicas contribute 0)
                   "weight_bytes": 0, "weight_float_equivalent_bytes": 0,
+                  # speculative decoding: proposal/acceptance volume
+                  # sums across replicas (a spec-off replica contributes
+                  # 0); the fleet accept rate derives from the summed
+                  # counts below, never from averaging per-replica rates
+                  "spec_rounds": 0, "spec_tokens_proposed": 0,
+                  "spec_tokens_accepted": 0, "spec_draft_fallbacks": 0,
                   # offline bulk jobs: gateway-side progress (replicas
                   # see only ordinary batch-class requests, so these
                   # keys are filled from the JobManager below, not
@@ -1046,7 +1052,10 @@ class Gateway:
                                 "kv_table_grows",
                                 "kv_pages_demoted_overflow",
                                 "long_prompts_active",
-                                "long_chunks_dispatched"):
+                                "long_chunks_dispatched",
+                                "spec_rounds", "spec_tokens_proposed",
+                                "spec_tokens_accepted",
+                                "spec_draft_fallbacks"):
                         totals[key] += int(gstats.get(key) or 0)
                     # TTFT: only count/sum are summable across replicas
                     # (exact percentiles aren't — the fleet-wide view
@@ -1106,6 +1115,12 @@ class Gateway:
         totals["ttft_avg_ms"] = (
             round(totals["ttft_ms_sum"] / totals["ttft_count"], 3)
             if totals["ttft_count"] else 0.0)
+        # fleet accept rate from the summed counts (averaging per-replica
+        # rates would weight an idle replica equal to a busy one)
+        totals["spec_accept_rate"] = (
+            round(totals["spec_tokens_accepted"]
+                  / totals["spec_tokens_proposed"], 4)
+            if totals["spec_tokens_proposed"] else 0.0)
         for cls in PRIORITY_CLASSES:
             for stem in (f"ttft_{cls}", f"qdelay_{cls}"):
                 n = totals[f"{stem}_count"]

@@ -27,7 +27,11 @@ flat ``stats()`` dicts the serving plane already produces into
 Prometheus text exposition (gauges for numeric keys, ``_bucket``/
 ``_sum``/``_count`` triplets for :meth:`LatencyWindow.histogram`
 dicts), so ``GET /metrics`` on replica and gateway is generated, not
-hand-maintained.
+hand-maintained.  New engine stats keys are therefore exported
+automatically — e.g. the speculative-decoding counters
+(``spec_rounds``/``spec_tokens_proposed``/``spec_tokens_accepted``/
+``spec_accept_rate``/``spec_draft_fallbacks``) appear on ``/metrics``
+with no exporter change.
 """
 import bisect
 import threading

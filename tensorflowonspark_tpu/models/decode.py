@@ -863,6 +863,287 @@ def _jitted_slot_spec_round(t_model, d_model, k):
     return spec_round
 
 
+# Speculation v2 key schedule: the three extra random draws of a spec
+# round (draft proposal, acceptance test, residual resample) each live
+# in their own stream, derived per POSITION ordinal `o` as
+# fold_in(fold_in(key(seed), o), TAG).  The plain path's sampling key is
+# the single-fold fold_in(key(seed), o) (`step_keys`), which the spec
+# path never consumes — so the draws at a given ordinal are identical
+# no matter how ordinals are grouped into rounds, making sampled
+# speculative output invariant to draft length, adaptive-k timing, and
+# fault-injected fallbacks to plain rounds.
+_SPEC_DRAFT_TAG = 1
+_SPEC_ACCEPT_TAG = 2
+_SPEC_RESAMPLE_TAG = 3
+
+
+def _spec_pos_keys(seeds, ords, i, tag):
+    """Per-row keys for in-round position `i` of stream `tag` (see the
+    schedule note above)."""
+    return jax.vmap(lambda s, o: jax.random.fold_in(
+        jax.random.fold_in(jax.random.key(s), o + i), tag))(seeds, ords)
+
+
+def ngram_propose(ctx, ctx_len, k, max_match=3):
+    """Model-free draft: propose ``k`` continuation tokens per row by
+    suffix-matching the row's OWN context (prompt-lookup decoding).
+
+    ``ctx [n, C]`` holds each row's committed tokens (prompt + delivered
+    output), ``ctx_len [n]`` the valid length; ``ctx[r, ctx_len[r]-1]``
+    is the token being fed this round.  Each position re-matches the
+    block-so-far suffix (up to ``max_match`` tokens, longest match wins,
+    most recent site breaks ties) against the context with earlier
+    proposals VIRTUALLY appended — so proposal ``i`` is a pure function
+    of the row's committed prefix at that ordinal, independent of where
+    round boundaries fall.  That invariance is what keeps sampled
+    speculative output seed-deterministic under adaptive draft lengths.
+    Rows with no match (or no context) fall back to repeating their
+    last token — a still-lossless guess.  Zero weight bytes, zero
+    FLOPs beyond [n, C] integer compares."""
+    n, C = ctx.shape
+    rows = jnp.arange(n)
+    pos = jnp.arange(C)[None, :]
+    ctx_v = ctx
+    props = []
+    for i in range(k):
+        len_v = ctx_len + i
+        # the last `max_match` tokens of the block-so-far (clip-gathered;
+        # short rows mask the affected match terms below)
+        tail = [jnp.take_along_axis(
+            ctx_v, jnp.clip(len_v - 1 - g, 0, C - 1)[:, None],
+            axis=1)[:, 0] for g in range(max_match)]
+        score = jnp.zeros((n, C), jnp.int32)
+        chain = jnp.ones((n, C), bool)
+        shifted = ctx_v
+        for g in range(max_match):
+            chain = (chain & (shifted == tail[g][:, None])
+                     & (len_v >= g + 1)[:, None])
+            score = score + chain.astype(jnp.int32)
+            # compare position j-(g+1) next round: shift right, j=0 invalid
+            shifted = jnp.concatenate(
+                [jnp.full((n, 1), -1, ctx_v.dtype), shifted[:, :-1]], axis=1)
+        # candidate j needs a continuation inside the valid region
+        # (j <= len-2, which also excludes the trivial self-match)
+        valid = pos <= (len_v - 2)[:, None]
+        rank = jnp.where(valid & (score >= 1), score * (C + 1) + pos, -1)
+        j_star = jnp.argmax(rank, axis=1)
+        found = jnp.take_along_axis(rank, j_star[:, None], axis=1)[:, 0] >= 0
+        cont = jnp.take_along_axis(
+            ctx_v, jnp.clip(j_star + 1, 0, C - 1)[:, None], axis=1)[:, 0]
+        prop = jnp.where(found, cont, tail[0])
+        props.append(prop)
+        # virtual append (full rows drop instead of clobbering the tail)
+        ctx_v = ctx_v.at[rows, len_v].set(prop, mode="drop")
+    return jnp.stack(props, axis=1)
+
+
+def _ngram_append(ctx, ctx_len, c_tok, n_del):
+    """Commit this round's deliverable tokens into the n-gram table:
+    scatter ``c_tok[r, :n_del[r]]`` at ``ctx_len[r]`` (masked positions
+    are pushed out of range and DROPPED, matching the paged cache's
+    OOB-write semantics)."""
+    n, k = c_tok.shape
+    pos = ctx_len[:, None] + jnp.arange(k)[None, :]
+    pos = jnp.where(jnp.arange(k)[None, :] < n_del[:, None], pos,
+                    ctx.shape[1])
+    ctx = ctx.at[jnp.arange(n)[:, None], pos].set(c_tok, mode="drop")
+    return ctx, ctx_len + n_del
+
+
+def spec_accept_sampled(t_logits, props, temps, seeds, ords, topks=None,
+                        topps=None, minps=None, q_logits=None):
+    """Canonical speculative-sampling acceptance walk (Leviathan et al.;
+    Chen et al.) over one verify block — the pure math, factored out so
+    distribution preservation is testable without an engine.
+
+    ``t_logits [n, k, V]`` are the target's raw logits at the k block
+    positions, ``props [n, k]`` the proposed tokens, ``q_logits`` the
+    proposer's (scaled+filtered) logits or None for point-mass proposals
+    (n-gram / greedy drafts).  Position i accepts with probability
+    min(1, p_i(x_i)/q_i(x_i)) — computed division-free as
+    ``u*q < p`` — and the first rejection resamples from the residual
+    max(p - q, 0) (for point masses: p with the proposal zeroed),
+    renormalized.  Chained over positions this reproduces the target's
+    sampling distribution EXACTLY for any proposal distribution, which
+    is the lossless guarantee.  Randomness comes from the tagged
+    per-position streams above, so outputs are reproducible and
+    round-boundary invariant.
+
+    Returns ``(c_tok [n, k], commit [n])``: row r commits
+    ``c_tok[r, :commit[r]]`` — accepted proposals plus either the
+    resampled correction or (full acceptance) the last proposal."""
+    n, k, _ = t_logits.shape
+    rows = jnp.arange(n)
+    scaled = t_logits / jnp.maximum(temps, 1e-6)[:, None, None]
+    if topks is not None:
+        p_sl = jnp.stack([filter_top_k_p(scaled[:, i], topks, topps, minps)
+                          for i in range(k)], axis=1)
+    else:
+        p_sl = scaled
+    p_probs = jax.nn.softmax(p_sl, axis=-1)
+    p_prop = jnp.take_along_axis(p_probs, props[..., None], axis=-1)[..., 0]
+    if q_logits is None:
+        q_probs = None
+        q_prop = jnp.ones_like(p_prop)
+    else:
+        q_probs = jax.nn.softmax(q_logits, axis=-1)
+        q_prop = jnp.take_along_axis(q_probs, props[..., None],
+                                     axis=-1)[..., 0]
+    u = jnp.stack([jax.vmap(jax.random.uniform)(
+        _spec_pos_keys(seeds, ords, i, _SPEC_ACCEPT_TAG))
+        for i in range(k)], axis=1)                           # [n, k]
+    accept = u * q_prop < p_prop        # u < min(1, p/q), division-free
+    j = jnp.where(accept.all(axis=1), k, jnp.argmin(accept, axis=1))
+    if q_probs is None:
+        res = p_probs.at[rows[:, None], jnp.arange(k)[None, :],
+                         props].set(0.0)
+    else:
+        res = jnp.maximum(p_probs - q_probs, 0.0)
+    # degenerate residual (p == q to float precision) falls back to p
+    res_ok = res.sum(axis=-1, keepdims=True) > 1e-9
+    res_l = jnp.where(res_ok, jnp.where(res > 0, jnp.log(res), -jnp.inf),
+                      p_sl)
+    y = jnp.stack([jax.vmap(jax.random.categorical)(
+        _spec_pos_keys(seeds, ords, i, _SPEC_RESAMPLE_TAG), res_l[:, i])
+        for i in range(k)], axis=1)
+    commit = jnp.minimum(j, k - 1) + 1
+    ii = jnp.arange(k)[None, :]
+    c_tok = jnp.where(ii == j[:, None], y, props)
+    return c_tok, commit
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_set_row_ctx():
+    """Install one row's committed-token history into the n-gram context
+    table at admission / resume / rollback / park-restore.  ``toks`` is
+    padded to a power-of-two bucket by the caller (bounded compile
+    variants); entries past ``length`` keep their old values — stale
+    tokens are invisible because the lookup never ranks positions past
+    ``ctx_len``.  The table is donated: it lives only on the device
+    thread and never rides readback chunks."""
+
+    @functools.partial(jax.jit, donate_argnames=("ctx",))
+    def set_ctx(ctx, ctx_len, row, toks, length):
+        width = toks.shape[0]
+        old = jax.lax.dynamic_index_in_dim(ctx, row, axis=0,
+                                           keepdims=False)[:width]
+        new = jnp.where(jnp.arange(width) < length, toks, old)
+        ctx = jax.lax.dynamic_update_slice(ctx, new[None, :], (row, 0))
+        return ctx, ctx_len.at[row].set(length)
+
+    return set_ctx
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_slot_spec_round_v2(t_model, d_model, k, lora=False):
+    """One fused speculative round over ALL slots, v2: lossless for
+    sampled rows, draftable without a draft model, LoRA-composable.
+
+    Per round: k proposals per row (``d_model`` draft slot-steps, or —
+    when ``d_model is None`` — the `ngram_propose` context lookup), ONE
+    target pass over the ``[n, k]`` block verifies, and each row commits
+    1..k tokens:
+
+    - greedy rows (``temps <= 0``) keep v1's longest-prefix rule — every
+      committed token is the target's own argmax, byte-identical to
+      plain decode by construction;
+    - sampled rows run `spec_accept_sampled` — the canonical
+      min(1, p/q) rejection walk with residual resampling, applied to
+      the SAME scaled/filtered logits chain (`filter_top_k_p`) the
+      plain step samples from, so the output distribution is exactly
+      the non-speculative one.
+
+    With ``lora=True`` the target verifies under the per-row adapter
+    banks (``lora_tree``/``ids``) while the draft stays on base weights
+    — any divergence just lowers acceptance; verification corrects it.
+    Both caches (and the n-gram table) rewind/advance per row by the
+    commit length, and the budget/eos walk mirrors v1 over the
+    committed tokens.  Everything is one dispatch, hostsync-clean.
+
+    Returns ``(new_toks, c_tok [n, k], commit, n_del, done, rems_new,
+    ords_new, t_cache, d_cache)`` in model mode, with ``(ctx, ctx_len)``
+    replacing ``d_cache`` in n-gram mode."""
+    use_ngram = d_model is None
+    donate = ("t_cache", "rems") + (("ctx",) if use_ngram else ("d_cache",))
+
+    @functools.partial(jax.jit, donate_argnames=donate)
+    def spec_round(t_params, t_cache, toks, temps, seeds, ords, rems,
+                   eoss, eos_on, d_params=None, d_cache=None, ctx=None,
+                   ctx_len=None, lora_tree=None, ids=None, topks=None,
+                   topps=None, minps=None):
+        t_params = _params_view(t_params, t_model.cfg)
+        idx = _first_named_leaf(t_cache, "cache_index")
+        is_g = temps <= 0
+
+        def _filt(logits):
+            s = logits / jnp.maximum(temps, 1e-6)[:, None]
+            if topks is not None:
+                s = filter_top_k_p(s, topks, topps, minps)
+            return s
+
+        if use_ngram:
+            props = ngram_propose(ctx, ctx_len, k)
+            q_sl = None
+        else:
+            d_params_v = _params_view(d_params, d_model.cfg)
+            d_tok, plist, qlist = toks, [], []
+            for i in range(k):                  # unrolled: k static
+                d_logits, mut = d_model.apply(
+                    {"params": d_params_v, "cache": d_cache},
+                    d_tok[:, None], mutable=["cache"])
+                d_cache = mut["cache"]
+                dl = d_logits[:, -1]
+                d_sc = _filt(dl)
+                d_tok = jnp.where(
+                    is_g, jnp.argmax(dl, axis=-1),
+                    jax.vmap(jax.random.categorical)(
+                        _spec_pos_keys(seeds, ords, i, _SPEC_DRAFT_TAG),
+                        d_sc))
+                plist.append(d_tok)
+                qlist.append(d_sc)
+            props = jnp.stack(plist, axis=1)                  # [n, k]
+            q_sl = jnp.stack(qlist, axis=1)                   # [n, k, V]
+        block = jnp.concatenate([toks[:, None], props[:, :-1]], axis=1)
+        variables = {"params": t_params, "cache": t_cache}
+        if lora:
+            variables["lora"] = _lora_with_ids(lora_tree, ids)
+        t_logits, mut = t_model.apply(variables, block, mutable=["cache"])
+        t_cache = mut["cache"]
+        t_pick = jnp.argmax(t_logits, axis=-1)                # [n, k]
+        matches = props == t_pick
+        a = jnp.where(matches.all(axis=1), k - 1,
+                      jnp.argmin(matches, axis=1))
+        commit_g = a + 1
+        c_s, commit_s = spec_accept_sampled(
+            t_logits, props, temps, seeds, ords, topks=topks, topps=topps,
+            minps=minps, q_logits=q_sl)
+        commit = jnp.where(is_g, commit_g, commit_s)
+        c_tok = jnp.where(is_g[:, None], t_pick, c_s)
+        new_toks = jnp.take_along_axis(c_tok, (commit - 1)[:, None],
+                                       axis=1)[:, 0]
+        ords_new = ords + commit
+        t_cache = _set_row_indices_vec(t_cache, idx + commit)
+        if not use_ngram:
+            d_cache = _set_row_indices_vec(d_cache, idx + commit)
+        # deliverable walk (v1's rule, over the committed tokens)
+        mask = jnp.arange(k)[None, :] < commit[:, None]
+        is_eos = eos_on[:, None] & (c_tok == eoss[:, None]) & mask
+        j_eos = jnp.where(is_eos.any(axis=1), jnp.argmax(is_eos, axis=1),
+                          k)
+        n_del = jnp.minimum(commit,
+                            jnp.minimum(jnp.maximum(rems, 0), j_eos + 1))
+        rems_new = rems - n_del
+        done = (rems_new <= 0) | (j_eos < n_del)
+        if use_ngram:
+            ctx, ctx_len = _ngram_append(ctx, ctx_len, c_tok, n_del)
+            return (new_toks, c_tok, commit, n_del, done, rems_new,
+                    ords_new, t_cache, ctx, ctx_len)
+        return (new_toks, c_tok, commit, n_del, done, rems_new,
+                ords_new, t_cache, d_cache)
+
+    return spec_round
+
+
 _LOOP_PROBE = {}    # platform name -> measured "scan" | "host" verdict
 _LOOP_PROBE_LOCK = threading.Lock()   # one measurement at a time: racing
 # probes would contend on the device and could cache a skewed verdict

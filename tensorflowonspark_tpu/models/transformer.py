@@ -247,6 +247,9 @@ class Attention(nn.Module):
             ids = self.get_variable("lora", "ids")
             a = jnp.take(a, ids, axis=0)            # [B, d_in, r]
             b = jnp.take(b, ids, axis=0)            # [B, r, d_out]
+            # S is arbitrary: 1 for plain decode, k for a speculative
+            # verify block — per-row adapters apply identically at any
+            # width, which is what lets LoRA compose with speculation
             delta = jnp.einsum("bsd,bdr,bro->bso", x.astype(jnp.float32),
                                a.astype(jnp.float32), b.astype(jnp.float32))
             y = y + delta.astype(y.dtype)

@@ -22,7 +22,9 @@ no request-level serialization.
 
 The engine composes (docs/source/serving.rst for each): paged kv with
 prefix caching (``--generate_kv_page_size``/``--generate_kv_pages``),
-fused speculative decoding (``--draft_export_dir``), weight-only int8
+lossless speculative decoding (``--spec_draft``/``--draft_export_dir``:
+model-based or n-gram drafting, rejection-sampled verification for
+sampled rows, adaptive draft length), weight-only int8
 (``--generate_quantize``), an int8 kv cache (``--generate_kv_dtype``),
 multi-adapter LoRA (``--generate_lora_rank``/``--generate_lora``), and
 per-request sampling controls (``top_k``/``top_p``/``min_p``/
@@ -74,11 +76,22 @@ def build_argparser():
                    help="upper bound a :generate request may ask for")
     p.add_argument("--draft_export_dir", default=None,
                    help="a smaller decoder-LM export used as the "
-                        "speculative draft for greedy :generate requests "
-                        "(identical outputs, faster when the draft agrees); "
-                        "speculation runs inside the decode slots")
+                        "speculative draft for :generate requests "
+                        "(greedy outputs identical, sampled outputs "
+                        "distribution-preserving; faster when the draft "
+                        "agrees); speculation runs inside the decode slots")
     p.add_argument("--draft_k", type=int, default=4,
-                   help="draft tokens proposed per verification pass")
+                   help="max draft tokens proposed per verification pass "
+                        "(per-row acceptance EWMA adapts the actual k "
+                        "between 1 and this)")
+    p.add_argument("--spec_draft", default=None,
+                   choices=("model", "ngram", "off"),
+                   help="speculative draft source: 'model' runs the "
+                        "--draft_export_dir LM, 'ngram' proposes by "
+                        "suffix-matching the row's own context (no draft "
+                        "model needed), 'off' disables speculation; "
+                        "default: 'model' when --draft_export_dir is set, "
+                        "else 'off'")
     p.add_argument("--generate_slots", type=int, default=8,
                    help="decode slots of the :generate engine (continuous "
                         "batching: concurrent requests join the in-flight "
@@ -461,6 +474,7 @@ class ModelService:
         self._max_new_limit = getattr(args, "max_new_tokens_limit", 512)
         self._draft_dir = getattr(args, "draft_export_dir", None)
         self._draft_k = getattr(args, "draft_k", 4)
+        self._spec_draft = getattr(args, "spec_draft", None)
         self._gen_slots = getattr(args, "generate_slots", 8) or 8
         self._gen_read_chunk = getattr(args, "generate_read_chunk", 8) or 8
         self._gen_prefill_chunk = getattr(args, "generate_prefill_chunk",
@@ -547,7 +561,9 @@ class ModelService:
                         self.export_dir,
                         max_new_tokens_limit=self._max_new_limit,
                         draft_export_dir=self._draft_dir,
-                        draft_k=self._draft_k, slots=self._gen_slots,
+                        draft_k=self._draft_k,
+                        spec_draft=self._spec_draft,
+                        slots=self._gen_slots,
                         read_chunk=self._gen_read_chunk,
                         prefill_chunk=self._gen_prefill_chunk,
                         prefill_rows=self._gen_prefill_rows,
@@ -897,17 +913,23 @@ class ContinuousBatcher:
     construction at ANY dtype.  Greedy decoding is token-identical to a
     solo `decode.generate` in f32; sampled rows draw from the SHARED
     schedule ``fold_in(key(seed), ordinal)`` (decode.step_keys), so a
-    sampled slot run reproduces the solo call too.  With a draft model,
-    greedy slots advance by fused speculative rounds (k draft steps + one
-    verify dispatch, per-row acceptance) — tokens unchanged, speed up
-    where the draft agrees.  Net-new beyond the reference (no generation
-    serving there at all).
+    sampled slot run reproduces the solo call too.  With speculation
+    (``spec_draft``: a draft model or model-free n-gram lookup), slots
+    advance by fused speculative rounds (k proposals + one verify
+    dispatch, per-row acceptance, adaptive k): greedy rows commit the
+    target's own argmax — tokens unchanged — and sampled rows verify by
+    rejection sampling against the target's filtered distribution —
+    distribution-preserving and seed-deterministic (the accept/resample
+    key schedule is keyed per POSITION, not per round, so tokens don't
+    depend on round boundaries or the adaptive-k trajectory).  Net-new
+    beyond the reference (no generation serving there at all).
     """
 
     def __init__(self, model, params, n_slots=8, max_pending=1024,
                  read_chunk=8, prefill_chunk=512, prefill_rows=4,
                  prefill_budget=0, draft_model=None,
-                 draft_params=None, draft_k=4, kv_page_size=0, kv_pages=0,
+                 draft_params=None, draft_k=4, spec_draft=None,
+                 kv_page_size=0, kv_pages=0,
                  host_cache_mb=0,
                  lora_rank=0, lora_capacity=8, kv_dtype=None,
                  paged_attn_impl=None, paged_prefill_impl=None,
@@ -1070,12 +1092,11 @@ class ContinuousBatcher:
         # its next dispatch).  S-LoRA-style; net-new beyond the reference.
         self.lora_rank = int(lora_rank or 0)
         if self.lora_rank:
-            if draft_model is not None:
-                raise ValueError(
-                    "draft speculation does not compose with LoRA "
-                    "serving yet (the verify pass would need per-row "
-                    "adapters too) — drop --draft_export_dir or "
-                    "lora_rank")
+            # speculation composes with LoRA since v2: the draft (model
+            # or n-gram) proposes on BASE weights and the verify pass
+            # applies the per-row adapter banks — any draft/adapter
+            # divergence just lowers acceptance; verification corrects
+            # it, so the output is still exactly the adapted model's
             cfg = model.cfg
             head_dim = cfg.d_model // cfg.n_heads
             n_kv = (cfg.n_heads if cfg.n_kv_heads is None
@@ -1115,6 +1136,29 @@ class ContinuousBatcher:
                 self.slot_model)
             self._step = decode_mod._jitted_slot_step(self.slot_model)
         self._set_row = decode_mod._jitted_set_row(self.slot_model)
+        # ---- speculative decoding (v2: lossless for sampled rows) ------
+        # spec_draft picks the proposer: "model" = a separate draft
+        # transformer (requires draft_model), "ngram" = model-free
+        # prompt-lookup from a per-slot on-device context table, "off" =
+        # plain decode.  None keeps the historical default: model when a
+        # draft was passed, off otherwise.
+        mode = spec_draft
+        if mode is None:
+            mode = "model" if draft_model is not None else "off"
+        if mode not in ("model", "ngram", "off"):
+            raise ValueError(
+                f"spec_draft={mode!r} not in ('model', 'ngram', 'off')")
+        if mode == "model" and draft_model is None:
+            raise ValueError(
+                "spec_draft='model' requires a draft model "
+                "(--draft_export_dir)")
+        if mode == "ngram" and draft_model is not None:
+            raise ValueError(
+                "spec_draft='ngram' is model-free — drop the draft "
+                "model (or pick spec_draft='model')")
+        if mode == "off":
+            draft_model = draft_params = None
+        self.spec_mode = mode
         self.draft_model = self.draft_params = None
         self.draft_k = draft_k
         if draft_model is not None:
@@ -1127,16 +1171,30 @@ class ContinuousBatcher:
                 draft_model, n_slots, kv_dtype=kv_dtype)
             self._d_prefill_many = decode_mod._jitted_slot_prefill_many(
                 self.d_slot_model)
-            self._spec_round = decode_mod._jitted_slot_spec_round(
-                self.slot_model, self.d_slot_model, draft_k)
         self.n_slots = n_slots
         self.max_seq = self.slot_model.cfg.max_seq_len
         if draft_model is not None:
-            # both caches hold the sequence; only GREEDY requests need
-            # the extra draft_k verify-overshoot headroom (speculation
-            # never engages while a sampled row is active, and sampled
-            # rows never speculate) — per-request in submit()
+            # both caches hold the sequence (spec-eligible requests also
+            # reserve draft_k verify-overshoot headroom — in submit())
             self.max_seq = min(self.max_seq, draft_model.cfg.max_seq_len)
+        if self.spec_mode == "ngram":
+            # per-slot n-gram table: the row's committed tokens (prompt
+            # + delivered output), resident on device so proposals and
+            # commit-time appends stay inside the spec-round program
+            self._spec_ctx = jnp.zeros((n_slots, self.max_seq), jnp.int32)
+            self._spec_ctx_len = jnp.zeros((n_slots,), jnp.int32)
+            self._set_row_ctx = decode_mod._jitted_set_row_ctx()
+        # adaptive draft length: the host thread EWMAs per-row acceptance
+        # (`_spec_ewma`, host-thread-owned) and publishes a suggested
+        # round width through `_speck_q`; the device thread drains the
+        # queue at dispatch (latest wins) into the device-thread-owned
+        # `_spec_k` — cross-thread state moves only through the queue,
+        # the same discipline as _retire_q
+        self._spec_k = self.draft_k     # device-thread-owned round width
+        self._spec_k_sum = 0            # device-thread-owned (mean-k)
+        self._speck_q = queue_mod.Queue(8)
+        self._spec_ewma = [1.0] * n_slots   # host-thread-owned
+        self._spec_k_pub = self.draft_k     # host-thread-owned
         self.read_chunk = max(1, read_chunk)
         self.prefill_chunk = _aligned_prefill_chunk(prefill_chunk,
                                                     self.kv_page_size)
@@ -1312,6 +1370,7 @@ class ContinuousBatcher:
             "requests_served": self.counters.get("requests_served"),
             "decode_steps": self._steps,
             "spec_rounds": self._spec_rounds,
+            "spec_mode": self.spec_mode,
             "engine": self.engine,
             "pipeline_depth": self.pipeline_depth,
             # high-water mark of dispatched-but-unprocessed steps: > 1
@@ -1333,6 +1392,22 @@ class ContinuousBatcher:
         out["device_idle_fraction"] = (
             round(min(1.0, wait_ms / elapsed_ms), 4) if elapsed_ms > 0
             else 0.0)
+        # speculative decoding: proposal/acceptance volume (monotone,
+        # fleet-summable; present-at-zero so dashboards see the keys on
+        # a spec-off or cold replica), the derived accept rate, the
+        # adaptive round width and its running mean, and the injected-
+        # fault fallback count
+        for key in ("spec_tokens_proposed", "spec_tokens_accepted",
+                    "spec_draft_fallbacks"):
+            out[key] = self.counters.get(key)
+        proposed = out["spec_tokens_proposed"]
+        out["spec_accept_rate"] = (
+            round(out["spec_tokens_accepted"] / proposed, 4) if proposed
+            else 0.0)
+        out["spec_k_current"] = self._spec_k
+        out["spec_k_mean"] = (
+            round(self._spec_k_sum / self._spec_rounds, 4)
+            if self._spec_rounds else 0.0)
         # admission->first-token latency: count/sum (monotone, fleet-
         # aggregable) + p50/p95 over the recent window
         out.update(self._ttft.stats("ttft"))
@@ -1624,12 +1699,13 @@ class ContinuousBatcher:
             raise ValueError(
                 f"repetition_penalty={repetition_penalty!r} must be in "
                 "(0, 1e6] (1.0 disables; >1 discourages repeats)")
-        # greedy requests on a draft-equipped server need draft_k cache
-        # headroom for the speculative verify overshoot; sampled requests
-        # never speculate (and disable spec rounds while active), so they
-        # keep the full window
-        headroom = (self.draft_k if (self.draft_model is not None
-                                     and temperature == 0) else 0)
+        # spec-eligible requests on a speculating server need draft_k
+        # cache headroom for the verify overshoot.  Since v2 sampled
+        # rows speculate too (rejection-sampled verification), so only
+        # repetition-penalized requests — which disable spec rounds
+        # while active and never speculate — keep the full window
+        headroom = (self.draft_k if (self.spec_mode != "off"
+                                     and repetition_penalty == 1.0) else 0)
         if len(prompt) + max_new + headroom > self.max_seq:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new}"
@@ -1637,7 +1713,7 @@ class ContinuousBatcher:
                 + f" exceeds max_seq_len {self.max_seq}")
         if self.kv_page_size:
             need = self._pages_needed(len(prompt), max_new,
-                                      temperature=temperature)
+                                      rep=repetition_penalty)
             if need > self._total_pages:
                 # a request the WHOLE pool cannot hold would park forever
                 # at the head of the line, wedging every later admission
@@ -1752,10 +1828,11 @@ class ContinuousBatcher:
         sizes.append(rest)
         return sizes
 
-    def _pages_needed(self, prompt_len, max_new, temperature=0.0):
-        # verify-overshoot headroom: greedy-with-draft only (see submit)
-        headroom = (self.draft_k if (self.draft_model is not None
-                                     and temperature == 0) else 0)
+    def _pages_needed(self, prompt_len, max_new, rep=1.0):
+        # verify-overshoot headroom: every spec-eligible request (see
+        # submit — only penalized rows are exempt since v2)
+        headroom = (self.draft_k if (self.spec_mode != "off"
+                                     and rep == 1.0) else 0)
         return -(-(prompt_len + max_new + headroom) // self.kv_page_size)
 
     # ---- prefix cache (paged mode) --------------------------------------
@@ -2085,7 +2162,7 @@ class ContinuousBatcher:
         if upto >= len(adm["src"]):
             need = self._pages_needed(len(item["prompt"]),
                                       item["max_new"],
-                                      temperature=item["temp"])
+                                      rep=item["rep"])
         else:
             need = -(-upto // self.kv_page_size)
         have = len(self._row_pages[row] or [])
@@ -2142,8 +2219,8 @@ class ContinuousBatcher:
         if faults.deny("serve.alloc"):
             return False
 
-        prompt, max_new, temp = item["prompt"], item["max_new"], item["temp"]
-        need = self._pages_needed(len(prompt), max_new, temperature=temp)
+        prompt, max_new = item["prompt"], item["max_new"]
+        need = self._pages_needed(len(prompt), max_new, rep=item["rep"])
         shared, keys = self._prefix_lookup(
             prompt, root=self._lora_prefix_root(item["aidx"]))
         # hold refs BEFORE any eviction: rc==0 shared pages would
@@ -2651,6 +2728,25 @@ class ContinuousBatcher:
                             # every resident register from it (the device
                             # arrays alone can't be read back mid-flight)
                             "item": item}
+        self._install_ctx(row, seq)
+
+    def _install_ctx(self, row, seq):
+        """Seed the n-gram table with a row's committed tokens (prompt +
+        first token at admission; the whole sequence on a migration
+        splice / rollback / replay, which keeps n-gram speculation
+        composable with every lifecycle the plain path supports).
+        Pow2-padded to bound compile variants; no-op outside ngram
+        mode."""
+        import jax.numpy as jnp
+
+        if self.spec_mode != "ngram":
+            return
+        width = min(_pow2_width(len(seq)), self.max_seq)
+        toks = list(seq) + [0] * (width - len(seq))
+        self._spec_ctx, self._spec_ctx_len = self._set_row_ctx(
+            self._spec_ctx, self._spec_ctx_len,
+            jnp.asarray(row, jnp.int32), jnp.asarray(toks, jnp.int32),
+            jnp.asarray(len(seq), jnp.int32))
 
     def _finish_replay(self, adm):
         """Final replay chunk done: the row's cache now holds kv for
@@ -3005,6 +3101,7 @@ class ContinuousBatcher:
             self._seen = self._seen.at[row].set(0).at[
                 row, jnp.asarray(seq[:-1], jnp.int32)].set(1)
             self._reps = self._reps.at[row].set(item["rep"])
+        self._install_ctx(row, seq)
 
     def freeze_session(self, h, timeout_s=10.0):
         """Cut a live session for migration: ask the host thread to
@@ -3324,7 +3421,8 @@ class ContinuousBatcher:
                     f"{len(seq) - 1} committed positions need "
                     f"{max(1, expect_pages)}")
             if self._pages_needed(plen, max_new,
-                                  temperature=temp) > self._total_pages:
+                                  rep=float(meta.get("rep", 1.0))
+                                  ) > self._total_pages:
                 raise ValueError(
                     "resumed request does not fit this replica's kv "
                     "pool; raise --generate_kv_pages")
@@ -3433,7 +3531,7 @@ class ContinuousBatcher:
         temp = float(meta.get("temp") or 0.0)
         if (self.kv_page_size
                 and self._pages_needed(plen, max_new,
-                                       temperature=temp)
+                                       rep=float(meta.get("rep", 1.0)))
                 > self._total_pages):
             raise ValueError(
                 "replayed request does not fit this replica's kv "
@@ -3496,7 +3594,7 @@ class ContinuousBatcher:
             need = max(n_have,
                        self._pages_needed(len(item["prompt"]),
                                           item["max_new"],
-                                          temperature=item["temp"]))
+                                          rep=item["rep"]))
             if len(self._free_pages) < need:
                 self._evict_cached_pages(need - len(self._free_pages))
             if len(self._free_pages) < need:
@@ -3558,23 +3656,30 @@ class ContinuousBatcher:
         """One arrived chunk -> emissions/retires, in dispatch order
         (host side of the pipeline).  `batch` is (toks_dev [k, n] or
         [k, n, draft_k], counts [k, n] or None, done [k, n],
-        [gen_snapshot per entry]); counts (speculative rounds) say how
-        many of each row's draft_k tokens are DELIVERABLE, and `done`
-        carries the device-computed stop verdict (budget exhausted or
-        eos among the delivered tokens) — the host never inspects token
-        values to decide whether the device may continue; only the
-        client-supplied stop SEQUENCES still need the host's substring
-        check.  Tokens are delivered to each stream batched per tick
-        (one queue put per handle per chunk, not per token).  The host
-        copy was started at flush (copy_to_host_async), so the
-        np.asarray here is usually free."""
-        import numpy as np
+        [gen_snapshot per entry], [spec round k or None per entry]);
+        counts (speculative rounds) say how many of each row's tokens
+        are DELIVERABLE, and `done` carries the device-computed stop
+        verdict (budget exhausted or eos among the delivered tokens) —
+        the host never inspects token values to decide whether the
+        device may continue; only the client-supplied stop SEQUENCES
+        still need the host's substring check.  Tokens are delivered to
+        each stream batched per tick (one queue put per handle per
+        chunk, not per token).  The host copy was started at flush
+        (copy_to_host_async), so the np.asarray here is usually free.
 
-        stacked, counts, done, gens_list = batch
+        Speculative entries also close the adaptive-draft-length loop
+        here: per-row acceptance EWMAs (host-thread-owned) update from
+        the delivered counts, and a new suggested round width goes back
+        to the device thread through `_speck_q`."""
+        import numpy as np
+        import queue as queue_mod
+
+        stacked, counts, done, gens_list, ks_list = batch
         block = np.asarray(stacked)
         counts = None if counts is None else np.asarray(counts)
         done = np.asarray(done)
         pend = {}     # row -> tokens accumulated this tick
+        spec_pend = {}  # row -> [rounds, accepted, k] this tick
 
         def emit(r, s):
             toks = pend.pop(r, None)
@@ -3593,6 +3698,12 @@ class ContinuousBatcher:
                                          tokens=len(toks),
                                          seq_len=len(s["seq"]),
                                          tick=s["_trace_ticks"])
+                        sp = spec_pend.get(r)
+                        if sp:
+                            self.trace.event(tid, "spec.round", row=r,
+                                             rounds=sp[0],
+                                             accepted=sp[1], k=sp[2])
+            spec_pend.pop(r, None)
 
         for i, (gens, row_toks) in enumerate(zip(gens_list, block)):
             for r, s in enumerate(self._slots):
@@ -3629,6 +3740,17 @@ class ContinuousBatcher:
                 else:             # speculative round: n_del[r] tokens
                     toks = [int(t) for t in
                             np.atleast_1d(row_toks[r])[:counts[i][r]]]
+                    k_e = ks_list[i]
+                    if k_e:       # acceptance feedback (adaptive k)
+                        c = int(counts[i][r])
+                        acc = k_e if c >= k_e else max(0, c - 1)
+                        self.counters.inc("spec_tokens_accepted", acc)
+                        w = self._spec_ewma
+                        w[r] = 0.5 * w[r] + 0.5 * (acc / k_e)
+                        sp = spec_pend.setdefault(r, [0, 0, k_e])
+                        sp[0] += 1
+                        sp[1] += acc
+                        sp[2] = k_e
                 ended = False
                 for tok in toks:
                     s["seq"].append(tok)
@@ -3650,6 +3772,27 @@ class ContinuousBatcher:
         for r, s in enumerate(self._slots):
             if s is not None and r in pend:
                 emit(r, s)
+        if any(ks_list):
+            # suggest the next round width: the max of the per-row
+            # desired lengths (pow2-bucketed to bound compile variants)
+            # — an all-disagreeing burst degrades to k=1, ~plain decode,
+            # while one agreeing row keeps its long drafts.  Token
+            # streams are invariant to WHEN the device adopts a new k
+            # (round-boundary-invariant proposals + key streams), so
+            # this feedback loop may lag freely
+            desired = 1
+            for r, s in enumerate(self._slots):
+                if s is not None:
+                    desired = max(desired,
+                                  1 + round(self._spec_ewma[r]
+                                            * (self.draft_k - 1)))
+            k_next = min(_pow2_width(desired), self.draft_k)
+            if k_next != self._spec_k_pub:
+                try:
+                    self._speck_q.put_nowait(k_next)
+                    self._spec_k_pub = k_next
+                except queue_mod.Full:
+                    pass
         self.counters.inc("host_ticks")
 
     def _host_loop(self):
@@ -3671,29 +3814,78 @@ class ContinuousBatcher:
 
     def _dispatch(self):
         """One decode advance for all active slots: a fused speculative
-        round when a draft is loaded and every active row is greedy, else
-        one plain step.  Returns the readback entry (toks, counts, done,
-        gens) — everything the host needs, shipped down in one copy; no
-        host sync happens here."""
+        round (v2 — greedy AND sampled rows speculate, proposals from
+        the draft model or the n-gram table) unless speculation is off
+        or a repetition-penalized row is active, else one plain step.
+        Returns the readback entry (toks, counts, done, gens, spec_k) —
+        everything the host needs, shipped down in one copy; no host
+        sync happens here."""
+        import queue as queue_mod
+
+        from .models import decode as decode_mod
+
         if self.kv_page_size:
             # every dispatch steps ALL rows; the unoccupied ones write
             # their junk token into the sink page (the reason it exists)
             idle = sum(s is None for s in self._slots)
             if idle:
                 self.counters.inc("kv_sink_writes", idle)
-        use_spec = (self.draft_model is not None
-                    and all(s is None or (s["temp"] == 0
-                                          and not s.get("pen"))
-                            for s in self._slots))
+        # a penalized row samples from history-adjusted logits the
+        # verify block does not reproduce position-by-position, so any
+        # penalized occupant gates speculation off globally (penalized
+        # requests also skip the verify-overshoot headroom — see submit)
+        use_spec = self.spec_mode != "off" and not self._n_penalized
         if use_spec:
-            (nxt, t_next, _commit, n_del, sdone, self._rems, self._cache,
-             self._d_cache) = self._spec_round(
-                self.params, self.draft_params, self._cache, self._d_cache,
-                self._toks, rems=self._rems, eoss=self._eoss,
-                eos_on=self._eos_on)
-            self._toks = nxt
+            try:
+                faults.check("serve.spec_verify")
+            except Exception:
+                # injected verify failure: fall back to a plain step and
+                # re-probe next dispatch.  Greedy rows are byte-identical
+                # either way; a sampled fallback step draws from the same
+                # distribution via the plain path's shared (seed, ordinal)
+                # schedule, so a PERSISTENT failure degrades to exactly
+                # the non-spec engine (solo-parity), while an isolated
+                # one stays distribution-preserving
+                self.counters.inc("spec_draft_fallbacks")
+                use_spec = False
+        if use_spec:
+            # adaptive draft length: adopt the host thread's latest
+            # suggestion (latest wins; the queue is the only channel)
+            try:
+                while True:
+                    self._spec_k = self._speck_q.get_nowait()
+            except queue_mod.Empty:
+                pass
+            k = self._spec_k
+            ngram = self.spec_mode == "ngram"
+            fn = decode_mod._jitted_slot_spec_round_v2(
+                self.slot_model, None if ngram else self.d_slot_model,
+                k, lora=bool(self.lora_rank))
+            kw = {}
+            if self._n_filtered:
+                kw.update(topks=self._topks, topps=self._topps,
+                          minps=self._minps)
+            if self.lora_rank:
+                kw.update(lora_tree=self._lora_banks, ids=self._lora_ids)
+            if ngram:
+                kw.update(ctx=self._spec_ctx, ctx_len=self._spec_ctx_len)
+            else:
+                kw.update(d_params=self.draft_params,
+                          d_cache=self._d_cache)
+            ret = fn(self.params, self._cache, self._toks, self._temps,
+                     self._seeds, self._ords, self._rems, self._eoss,
+                     self._eos_on, **kw)
+            (self._toks, c_tok, _commit, n_del, sdone, self._rems,
+             self._ords, self._cache) = ret[:8]
+            if ngram:
+                self._spec_ctx, self._spec_ctx_len = ret[8], ret[9]
+            else:
+                self._d_cache = ret[8]
             self._spec_rounds += 1
-            return (t_next, n_del, sdone, tuple(self._gen))
+            self._spec_k_sum += k
+            n_live = sum(s is not None for s in self._slots)
+            self.counters.inc("spec_tokens_proposed", k * n_live)
+            return (c_tok, n_del, sdone, tuple(self._gen), k)
         # filter/penalty arrays are passed only while such a row is
         # active: their PRESENCE is static under jit, so plain workloads
         # run the exact pre-feature program (no per-step sort / mask);
@@ -3720,13 +3912,15 @@ class ContinuousBatcher:
             nxt, self._cache, self._ords, self._rems, done = ret
         self._toks = nxt
         self._steps += 1
-        return (nxt, None, done, tuple(self._gen))
+        return (nxt, None, done, tuple(self._gen), None)
 
     def _flush_entries(self, reads):
         """Stack this chunk's entries for one async host copy.  Plain
         steps stack to [k, n]; speculative rounds to [k, n, draft_k] with
-        a [k, n] counts plane.  Mixed chunks pad plain entries to width
-        draft_k with count 1.  The done plane stacks to [k, n] always."""
+        a [k, n] counts plane.  Mixed chunks pad every entry to width
+        draft_k — plain steps with count 1, adaptive rounds at k <
+        draft_k with their own counts (n_del never exceeds the round's
+        k).  The done plane stacks to [k, n] always."""
         import jax.numpy as jnp
 
         done = jnp.stack([e[2] for e in reads])
@@ -3735,10 +3929,12 @@ class ContinuousBatcher:
         k = self.draft_k
 
         def widen(e):
-            toks, counts, _, _ = e
+            toks, counts = e[0], e[1]
             if counts is None:
-                return (jnp.pad(toks[:, None], ((0, 0), (0, k - 1))),
-                        jnp.ones(toks.shape[0], jnp.int32))
+                toks = toks[:, None]
+                counts = jnp.ones(toks.shape[0], jnp.int32)
+            if toks.shape[1] < k:
+                toks = jnp.pad(toks, ((0, 0), (0, k - toks.shape[1])))
             return toks, counts
 
         wide = [widen(e) for e in reads]
@@ -3762,7 +3958,8 @@ class ContinuousBatcher:
                 # failure mid-copy) must kill the engine, not pass
                 self.counters.inc("copy_to_host_fallbacks")
                 break
-        return (stacked, counts, done, [e[3] for e in reads])
+        return (stacked, counts, done, [e[3] for e in reads],
+                [e[4] for e in reads])
 
     def _flush_due(self, n_reads, active):
         """Whether the accumulated reads should flush now: a full chunk,
@@ -3921,9 +4118,13 @@ class GenerateService:
     Constructed LAZILY on the first :generate request so forward-only
     serving never pays a second param load.
 
-    With ``draft_export_dir``, greedy decoding speculates inside the
-    slots (fused per-round draft+verify; tokens unchanged by
-    construction — see decode._jitted_slot_spec_round).
+    With speculation enabled (``--spec_draft`` / ``draft_export_dir``)
+    decoding speculates inside the slots: greedy rows commit the
+    target's own argmax (byte-identical by construction) and sampled
+    rows verify by rejection sampling (distribution-preserving and
+    seed-deterministic) — see decode._jitted_slot_spec_round_v2.
+    ``spec_draft='ngram'`` needs no draft model at all: proposals come
+    from suffix-matching the row's own context on device.
     """
 
     @staticmethod
@@ -3988,7 +4189,8 @@ class GenerateService:
         return built, params
 
     def __init__(self, export_dir, max_new_tokens_limit=512,
-                 draft_export_dir=None, draft_k=4, slots=8, read_chunk=8,
+                 draft_export_dir=None, draft_k=4, spec_draft=None,
+                 slots=8, read_chunk=8,
                  prefill_chunk=512, prefill_rows=4, prefill_budget=0,
                  request_timeout_s=None,
                  kv_page_size=0, kv_pages=0, host_cache_mb=0,
@@ -4014,13 +4216,16 @@ class GenerateService:
             self.weight_bytes, self.float_equivalent_bytes = (
                 quantize_mod.quantized_bytes(self.params))
         draft_model = draft_params = None
-        if draft_export_dir:
-            # speculative decoding: greedy requests verify k draft tokens
-            # per target pass — EXACTLY the same tokens (the draft only
+        if draft_export_dir and spec_draft != "off":
+            # speculative decoding: requests verify k draft tokens per
+            # target pass — greedy rows commit EXACTLY the same tokens
+            # and sampled rows the same distribution (the draft only
             # changes speed), so no request-level opt-in is needed.  The
             # draft quantizes with the target: speculation commits only
-            # tokens the TARGET chose, so draft quantization can never
-            # change outputs, only the acceptance rate
+            # tokens the TARGET accepts, so draft quantization can never
+            # change outputs, only the acceptance rate.  spec_draft
+            # "off" skips the load entirely (A/B benching a replica
+            # with the draft artifact still on disk)
             draft_model, draft_params = self._load_lm(draft_export_dir,
                                                       self.quantize_mode)
         self.batcher = ContinuousBatcher(
@@ -4028,7 +4233,8 @@ class GenerateService:
             read_chunk=read_chunk, prefill_chunk=prefill_chunk,
             prefill_rows=prefill_rows, prefill_budget=prefill_budget,
             draft_model=draft_model, draft_params=draft_params,
-            draft_k=draft_k, kv_page_size=kv_page_size, kv_pages=kv_pages,
+            draft_k=draft_k, spec_draft=spec_draft,
+            kv_page_size=kv_page_size, kv_pages=kv_pages,
             host_cache_mb=host_cache_mb,
             lora_rank=lora_rank, lora_capacity=lora_capacity,
             kv_dtype=(None if kv_dtype in (None, "auto") else kv_dtype),
@@ -4643,11 +4849,21 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
             not getattr(args, "generate_lora_rank", 0):
         raise ValueError("--generate_lora needs --generate_lora_rank > 0 "
                          "(the bank's adapter rank)")
-    if getattr(args, "generate_lora_rank", 0) and \
-            getattr(args, "draft_export_dir", None):
-        raise ValueError("--generate_lora_rank does not compose with "
-                         "--draft_export_dir (speculative verify has no "
-                         "per-row adapters yet)")
+    # spec_draft resolves inside ContinuousBatcher (None -> 'model' when
+    # a draft export is given, else 'off'); the fail-fast checks here
+    # mirror that resolution so a CLI typo surfaces at startup, not as a
+    # misleading :generate 404.  LoRA composes with speculation since
+    # v2 (base-weight draft, adapted verify), so no lora x draft guard.
+    _spec = getattr(args, "spec_draft", None)
+    _draft_dir = getattr(args, "draft_export_dir", None)
+    if _spec == "model" and not _draft_dir:
+        raise ValueError("--spec_draft model needs --draft_export_dir "
+                         "(the draft LM to propose with); use "
+                         "--spec_draft ngram for model-free speculation")
+    if _spec == "ngram" and _draft_dir:
+        raise ValueError("--spec_draft ngram is model-free — drop "
+                         "--draft_export_dir (or pick --spec_draft model)")
+    _model_draft = bool(_draft_dir) and _spec in (None, "model")
     if getattr(args, "generate_prefill_rows", 4) < 1:
         raise ValueError("--generate_prefill_rows must be >= 1 "
                          "(1 = sequential admission)")
@@ -4661,22 +4877,22 @@ def make_server(args: Any) -> "tuple[ThreadingHTTPServer, ModelService]":
                          "(flushed chunks in flight device->host)")
     if getattr(args, "role", "mixed") not in ("mixed", "prefill", "decode"):
         raise ValueError("--role must be 'mixed', 'prefill' or 'decode'")
-    if getattr(args, "role", "mixed") != "mixed" and \
-            getattr(args, "draft_export_dir", None):
+    if getattr(args, "role", "mixed") != "mixed" and _model_draft:
         raise ValueError("--role prefill/decode does not compose with "
                          "--draft_export_dir (kv migration cannot ship "
-                         "the draft model's cache)")
+                         "the draft model's cache); --spec_draft ngram "
+                         "keeps no draft cache and composes")
     if getattr(args, "generate_priority_weight", 4) < 1:
         raise ValueError("--generate_priority_weight must be >= 1 "
                          "(interactive admissions per batch admission)")
     if getattr(args, "generate_preempt_ms", 0.0) < 0:
         raise ValueError("--generate_preempt_ms must be >= 0 "
                          "(0 disables the preemption controller)")
-    if getattr(args, "generate_preempt_ms", 0.0) and \
-            getattr(args, "draft_export_dir", None):
+    if getattr(args, "generate_preempt_ms", 0.0) and _model_draft:
         raise ValueError("--generate_preempt_ms does not compose with "
                          "--draft_export_dir (freeze_session cannot cut "
-                         "a speculating row)")
+                         "a row mid-round through the draft cache); "
+                         "--spec_draft ngram composes")
     if getattr(args, "generate_park_capacity", 8) < 1:
         raise ValueError("--generate_park_capacity must be >= 1 "
                          "(the preemption controller's park pool bound)")
@@ -4739,8 +4955,17 @@ def _register_with_fleet(args: Any, server: ThreadingHTTPServer,
         # (kv_pages * kv_page_size) instead of by prefix affinity
         features["long_prompt_threshold"] = (
             args.generate_long_prompt_threshold)
-    if getattr(args, "draft_export_dir", None):
-        features["speculative"] = True
+    # speculation: advertise the resolved draft mode (None defaults to
+    # 'model' with a draft export, 'off' without — same resolution as
+    # ContinuousBatcher) so dashboards can tell ngram replicas (zero
+    # extra weight bytes) from model-draft ones
+    _spec = getattr(args, "spec_draft", None)
+    if _spec is None:
+        _spec = ("model" if getattr(args, "draft_export_dir", None)
+                 else "off")
+    if _spec != "off":
+        features["speculative"] = _spec
+        features["draft_k"] = getattr(args, "draft_k", 4)
     if getattr(args, "generate_quantize", "none") != "none":
         features["quantize"] = args.generate_quantize
     if getattr(args, "generate_lora_rank", 0):

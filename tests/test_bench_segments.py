@@ -34,6 +34,7 @@ def test_segment_registry_shape_and_setup_dry_run():
     assert "qmm_ms" in bench.SEGMENTS
     assert "job_tps" in bench.SEGMENTS
     assert "long_ttft_ms" in bench.SEGMENTS
+    assert "spec_tps" in bench.SEGMENTS
     for name, entry in bench.SEGMENTS.items():
         assert set(entry) == {"run", "setup", "help"}, name
         assert callable(entry["run"]), name

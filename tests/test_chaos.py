@@ -754,6 +754,46 @@ def test_trace_export_deny_never_costs_tokens(model_and_params):
         b.stop()
 
 
+def test_spec_verify_fault_falls_back_byte_identical(model_and_params):
+    # the speculation plane fails: every verify-gate probe raises for
+    # the whole run.  The contract mirrors trace.export — speculation
+    # may lose ALL its speedup, serving may lose NOTHING: under a
+    # persistent fault the engine degrades to exactly the non-spec
+    # plain path (greedy AND seeded-sampled rows byte-identical to solo
+    # decode, fallbacks counted, zero spec rounds), and the moment the
+    # fault clears the SAME engine speculates again with unchanged
+    # greedy bytes
+    model, params = model_and_params
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, spec_draft="ngram",
+                                draft_k=3)
+    prompt, n_new = [3, 1, 4, 3, 1, 4], 8
+    try:
+        want = _solo(model, params, prompt, n_new)
+        want_sampled = _solo(model, params, prompt, n_new,
+                             temperature=0.9, seed=7)
+        plan = faults.FaultPlan(CHAOS_SEED).on("serve.spec_verify",
+                                               kind="oserror", nth=1,
+                                               times=None)
+        with faults.active(plan):
+            out = b.submit(prompt, n_new).result(timeout=300)
+            out_s = b.submit(prompt, n_new, temperature=0.9,
+                             seed=7).result(timeout=300)
+        assert ("serve.spec_verify", "oserror") in plan.fired
+        assert out == want                    # byte parity through fault
+        assert out_s == want_sampled          # plain-path sample parity
+        st = b.stats()
+        assert st["spec_draft_fallbacks"] > 0  # every round fell back...
+        assert st["spec_rounds"] == 0          # ...none speculated
+        # fault cleared: same engine speculates again, bytes unchanged
+        assert b.submit(prompt, n_new).result(timeout=300) == want
+        st = b.stats()
+        assert st["spec_rounds"] > 0
+        assert st["spec_tokens_proposed"] > 0
+    finally:
+        b.stop()
+
+
 # ---------------------------------------------------------------- jobs --
 # Bulk-inference jobs under chaos (the TFoS data pump): a replica dying
 # mid-partition, the GATEWAY dying mid-job, and checkpoint-write faults

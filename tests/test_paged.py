@@ -292,10 +292,12 @@ def test_batcher_stats_snapshot(model_and_params):
         batcher.stop()
 
 
-def test_draft_headroom_only_for_greedy(model_and_params):
-    # review regression: sampled requests never speculate, so a
-    # draft-equipped server must serve them up to the FULL window; only
-    # greedy requests reserve the verify-overshoot headroom
+def test_draft_headroom_for_spec_eligible_rows(model_and_params):
+    # v2: sampled requests speculate too (rejection-sampled verify), so
+    # BOTH greedy and sampled requests reserve the verify-overshoot
+    # headroom on a spec-enabled server; only penalized requests (which
+    # never speculate — the penalty depends on every committed token)
+    # keep the full window
     model, params = model_and_params
     draft_cfg = TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
                                   n_kv_heads=1, n_layers=1, d_ff=32,
@@ -311,10 +313,15 @@ def test_draft_headroom_only_for_greedy(model_and_params):
         prompt = list(range(1, 27))          # 26 + 6 == max_seq_len 32
         with pytest.raises(ValueError, match="headroom"):
             batcher.submit(prompt, 6)        # greedy: needs 26+6+3 > 32
-        got = batcher.submit(prompt, 6, temperature=0.8,
-                             seed=5).result(timeout=120)
-        assert got == _solo(model, params, prompt, 6, temperature=0.8,
-                            seed=5)
+        with pytest.raises(ValueError, match="headroom"):
+            batcher.submit(prompt, 6, temperature=0.8, seed=5)
+        got = batcher.submit(prompt, 6, repetition_penalty=1.3)\
+            .result(timeout=120)
+        ref = decode.generate(model, params,
+                              jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=6, loop="host",
+                              repetition_penalty=1.3)
+        assert got == np.asarray(ref)[0].tolist()
     finally:
         batcher.stop()
 

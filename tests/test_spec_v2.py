@@ -1,0 +1,280 @@
+"""Speculative decoding v2: lossless for sampled rows, model-free
+n-gram drafting, adaptive draft length.
+
+The contracts these tests pin:
+
+- **greedy byte-parity** — with either draft mode (a separate draft LM
+  or the n-gram context lookup), greedy rows commit the TARGET's own
+  argmax, so spec output is byte-identical to solo non-spec decode
+  across dense, paged, and int8-kv engines;
+- **distribution preservation** — sampled rows verify by the canonical
+  min(1, p/q) rejection walk (`decode.spec_accept_sampled`): the
+  committed marginal equals the target's sampling distribution for ANY
+  proposal source, validated by chi-square on a toy vocab;
+- **seed-determinism** — the accept/resample streams are keyed per
+  POSITION (`decode._spec_pos_keys`), so a sampled spec run is
+  reproducible run-to-run and invariant to engine layout;
+- **n-gram proposals** — `decode.ngram_propose` continues the longest
+  suffix match (most recent site wins) and is a pure function of the
+  committed prefix.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import lora, serve
+from tensorflowonspark_tpu.models import decode
+from tensorflowonspark_tpu.models.transformer import (Transformer,
+                                                      TransformerConfig)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_kv_heads=2, n_layers=2, d_ff=64,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def draft_lm():
+    # a genuinely different (smaller) draft so verification exercises
+    # both agreement and rejection
+    cfg = TransformerConfig(vocab_size=64, d_model=16, n_heads=2,
+                            n_kv_heads=1, n_layers=1, d_ff=32,
+                            max_seq_len=32, dtype="float32", rope=True,
+                            attention_impl="dense")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(9),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new, temperature=0.0, seed=0, **kw):
+    out = decode.generate(model, params, jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=n_new, loop="host",
+                          temperature=temperature,
+                          rng=(jax.random.key(seed) if temperature > 0
+                               else None), **kw)
+    return np.asarray(out)[0].tolist()
+
+
+# the acceptance mixed burst: greedy + sampled (temperature/top-k/top-p)
+# requests of varied lengths, repetitive prompts so the n-gram draft has
+# something to match; every prompt leaves draft_k=3 verify-overshoot
+# headroom inside max_seq_len 32
+_BURST = [
+    ([1, 2, 3, 1, 2, 3, 1, 2], 6, 0.0, 0, {}),
+    ([5, 4, 3, 2, 1], 5, 0.0, 0, {}),
+    ([9, 8, 7, 9, 8, 7], 6, 0.9, 13, {"top_k": 8}),
+    ([2, 3, 2, 3, 2, 3], 5, 0.7, 5, {"top_p": 0.9}),
+    (list(range(10, 22)), 4, 0.0, 0, {}),
+    ([4, 5, 4, 5, 4], 6, 0.0, 0, {}),
+    ([6, 6, 6, 6], 5, 0.9, 11, {}),
+]
+
+
+def _run_burst(model, params, mode, draft=None, draft_k=3, **kw):
+    b = serve.ContinuousBatcher(
+        model, params, n_slots=4, read_chunk=2, prefill_chunk=8,
+        spec_draft=mode,
+        draft_model=(draft[0] if mode == "model" else None),
+        draft_params=(draft[1] if mode == "model" else None),
+        draft_k=draft_k, **kw)
+    try:
+        handles = [b.submit(p, n, temperature=t, seed=s, **extra)
+                   for p, n, t, s, extra in _BURST]
+        outs = [h.result(timeout=600) for h in handles]
+        stats = b.stats()
+    finally:
+        b.stop()
+    return outs, stats
+
+
+# ------------------------------------------------------------ unit math --
+
+
+def test_ngram_propose_continues_longest_match():
+    # row 0: [5, 6, 7, 5, 6] feeding 6 — suffix (5, 6) matches position
+    # 1, continuation 7; with 7 virtually appended, suffix (6, 7)
+    # matches position 2, continuation 5
+    # row 1: no repeat — falls back to repeating the fed token
+    ctx = jnp.zeros((2, 16), jnp.int32)
+    ctx = ctx.at[0, :5].set(jnp.asarray([5, 6, 7, 5, 6]))
+    ctx = ctx.at[1, :4].set(jnp.asarray([11, 12, 13, 14]))
+    props = decode.ngram_propose(ctx, jnp.asarray([5, 4]), k=2)
+    assert np.asarray(props).tolist() == [[7, 5], [14, 14]]
+
+
+def test_ngram_propose_is_prefix_pure():
+    # round-boundary invariance: proposing k=3 in one call equals
+    # proposing 1 then 2 with the first commit appended — the property
+    # that keeps sampled ngram output independent of adaptive-k timing
+    ctx = jnp.zeros((1, 16), jnp.int32)
+    ctx = ctx.at[0, :7].set(jnp.asarray([3, 1, 4, 1, 5, 3, 1]))
+    ln = jnp.asarray([7])
+    once = np.asarray(decode.ngram_propose(ctx, ln, k=3)).tolist()
+    first = decode.ngram_propose(ctx, ln, k=1)
+    ctx2, ln2 = decode._ngram_append(ctx, ln, first, jnp.asarray([1]))
+    rest = np.asarray(decode.ngram_propose(ctx2, ln2, k=2)).tolist()
+    assert once[0] == np.asarray(first).tolist()[0] + rest[0]
+
+
+@pytest.mark.parametrize("draft", ["point_mass", "model"])
+def test_rejection_sampling_preserves_distribution(draft):
+    # chi-square on a toy vocab: the committed marginal must equal the
+    # target's sampling distribution regardless of the proposal source
+    # (the lossless guarantee).  Point-mass = ngram/greedy drafts;
+    # model = proposals drawn from a DIFFERENT distribution q
+    V, n = 8, 4096
+    rng = np.random.default_rng(0)
+    p_log = jnp.asarray(rng.normal(size=V), jnp.float32)
+    temps = jnp.ones((n,), jnp.float32) * 0.8
+    seeds = jnp.arange(n, dtype=jnp.int32)
+    ords = jnp.zeros((n,), jnp.int32)
+    t_logits = jnp.broadcast_to(p_log, (n, 1, V))
+    if draft == "point_mass":
+        # adversarial: always propose the mode of p
+        props = jnp.full((n, 1), int(jnp.argmax(p_log)), jnp.int32)
+        q_logits = None
+    else:
+        q_log = jnp.asarray(rng.normal(size=V), jnp.float32) / 0.8
+        q_probs = np.asarray(jax.nn.softmax(q_log))
+        props = jnp.asarray(
+            rng.choice(V, size=(n, 1), p=q_probs).astype(np.int32))
+        q_logits = jnp.broadcast_to(q_log, (n, 1, V))
+    c_tok, commit = decode.spec_accept_sampled(
+        t_logits, props, temps, seeds, ords, q_logits=q_logits)
+    assert np.asarray(commit).tolist() == [1] * n   # k=1 always commits 1
+    obs = np.bincount(np.asarray(c_tok)[:, 0], minlength=V)
+    exp = np.asarray(jax.nn.softmax(p_log / 0.8)) * n
+    chi2 = float(((obs - exp) ** 2 / exp).sum())
+    assert chi2 < 24.32, chi2       # df=7 critical at alpha=0.001
+
+
+def test_rejection_sampling_respects_top_k_filter():
+    # the verify walk samples from the SAME filtered chain as the plain
+    # step: with top_k=2 only the two highest-p tokens may ever commit
+    V, n = 8, 512
+    p_log = jnp.asarray([2.0, 1.5, 0.0, -1.0, 0.5, -0.5, 0.2, -2.0])
+    c_tok, _ = decode.spec_accept_sampled(
+        jnp.broadcast_to(p_log, (n, 1, V)),
+        jnp.full((n, 1), 7, jnp.int32),          # propose a filtered-out tok
+        jnp.ones((n,), jnp.float32),
+        jnp.arange(n, dtype=jnp.int32), jnp.zeros((n,), jnp.int32),
+        topks=jnp.full((n,), 2, jnp.int32), topps=jnp.ones((n,)),
+        minps=jnp.zeros((n,)))
+    assert set(np.asarray(c_tok)[:, 0].tolist()) <= {0, 1}
+
+
+# --------------------------------------------------------------- engine --
+
+
+@pytest.mark.parametrize("mode", ["ngram", "model"])
+def test_greedy_parity_mixed_burst_dense(lm, draft_lm, mode):
+    model, params = lm
+    outs, st = _run_burst(model, params, mode, draft_lm)
+    for (p, n, t, s, extra), got in zip(_BURST, outs):
+        if t == 0.0:                # greedy rows: byte-identical to solo
+            assert got == _solo(model, params, p, n)
+    assert st["spec_mode"] == mode
+    assert st["spec_rounds"] > 0
+    assert st["spec_tokens_proposed"] >= st["spec_tokens_accepted"] > 0
+    assert 0.0 < st["spec_accept_rate"] <= 1.0
+    assert 1 <= st["spec_k_current"] <= 3       # adaptive k stays in range
+    assert 1.0 <= st["spec_k_mean"] <= 3.0
+
+
+def test_sampled_spec_is_seed_deterministic(lm):
+    # run-to-run: a fresh engine replays the identical burst — sampled
+    # rows included (per-position tagged key streams, decode.py)
+    model, params = lm
+    outs1, _ = _run_burst(model, params, "ngram")
+    outs2, _ = _run_burst(model, params, "ngram")
+    assert outs1 == outs2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["ngram", "model"])
+def test_mixed_burst_paged_matches_dense(lm, draft_lm, mode):
+    # engine-layout invariance: the paged engine commits the SAME bytes
+    # as dense — greedy rows also checked against solo decode
+    model, params = lm
+    outs_p, st = _run_burst(model, params, mode, draft_lm,
+                            kv_page_size=8, kv_pages=24)
+    outs_d, _ = _run_burst(model, params, mode, draft_lm)
+    assert outs_p == outs_d
+    for (p, n, t, s, extra), got in zip(_BURST, outs_p):
+        if t == 0.0:
+            assert got == _solo(model, params, p, n)
+    assert st["spec_rounds"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["ngram", "model"])
+def test_greedy_parity_mixed_burst_paged_int8(lm, draft_lm, mode):
+    # int8 kv: all layouts hold the same quantized values, so greedy
+    # spec parity holds against the int8 solo reference
+    model, params = lm
+    outs, st = _run_burst(model, params, mode, draft_lm,
+                          kv_page_size=8, kv_pages=24, kv_dtype="int8")
+    for (p, n, t, s, extra), got in zip(_BURST, outs):
+        if t == 0.0:
+            assert got == _solo(model, params, p, n, kv_dtype="int8")
+    assert st["spec_rounds"] > 0
+
+
+def test_ngram_spec_composes_with_lora(lm):
+    # base-weight proposals, adapted verify: still byte-identical to
+    # non-spec decode over the merged params (greedy)
+    model, params = lm
+    ad = lora.init(jax.random.key(3), params, rank=4)
+    for i, pth in enumerate(sorted(ad)):
+        ad[pth]["b"] = jax.random.normal(
+            jax.random.fold_in(jax.random.key(103), i), ad[pth]["b"].shape)
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, lora_rank=4,
+                                spec_draft="ngram", draft_k=3)
+    prompt = [1, 2, 3, 1, 2, 3]
+    try:
+        b.register_adapter("a", ad, scale=0.5)
+        adapted = b.submit(prompt, 6, adapter="a").result(timeout=300)
+        base = b.submit(prompt, 6).result(timeout=300)
+        st = b.stats()
+    finally:
+        b.stop()
+    assert st["spec_rounds"] > 0
+    assert adapted == _solo(model, lora.merge(params, ad, 0.5), prompt, 6)
+    assert base == _solo(model, params, prompt, 6)
+
+
+def test_spec_mode_validation(lm, draft_lm):
+    model, params = lm
+    draft, d_params = draft_lm
+    with pytest.raises(ValueError, match="requires a draft model"):
+        serve.ContinuousBatcher(model, params, n_slots=2,
+                                spec_draft="model")
+    with pytest.raises(ValueError, match="model-free"):
+        serve.ContinuousBatcher(model, params, n_slots=2,
+                                spec_draft="ngram", draft_model=draft,
+                                draft_params=d_params)
+    with pytest.raises(ValueError, match="not in"):
+        serve.ContinuousBatcher(model, params, n_slots=2,
+                                spec_draft="bogus")
+    # "off" with a draft passed: speculation disabled, plain serving
+    b = serve.ContinuousBatcher(model, params, n_slots=2, read_chunk=1,
+                                prefill_chunk=8, spec_draft="off",
+                                draft_model=draft, draft_params=d_params)
+    try:
+        got = b.submit([1, 2, 3], 5).result(timeout=300)
+        st = b.stats()
+    finally:
+        b.stop()
+    assert got == _solo(model, params, [1, 2, 3], 5)
+    assert st["spec_mode"] == "off" and st["spec_rounds"] == 0
